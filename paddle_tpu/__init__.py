@@ -1,0 +1,50 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface.
+
+Built from scratch on JAX/XLA/PJRT (compute) + Pallas (hot kernels) +
+GSPMD/shard_map (parallelism). The reference implementation being matched
+(not ported) is PaddlePaddle (see /root/repo/SURVEY.md for the blueprint);
+docstrings cite reference files for capability parity checks.
+"""
+
+from __future__ import annotations
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bfloat16,
+    bool,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.flags import get_flags, set_flags
+from .core.tensor import Parameter, Tensor
+from .core import autograd as _autograd
+from .core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from .core.autograd import grad
+from .ops import *  # noqa: F401,F403 — the full op namespace (paddle.* functional surface)
+from .ops import dispatch as _dispatch
+from .core import device
+from .core.device import CPUPlace, CUDAPlace, TPUPlace, get_device, is_compiled_with_cuda, set_device
+
+from . import amp, autograd, io, jit, metric, nn, optimizer, vision
+from . import distributed
+from .framework import io_utils as _io_utils
+from .framework.io_utils import load, save
+from .framework.random_utils import get_cuda_rng_state, set_cuda_rng_state
+
+disable_static = lambda *a, **k: None  # dygraph is the default and only eager mode
+enable_static = lambda *a, **k: None
+
+__version__ = "0.1.0"

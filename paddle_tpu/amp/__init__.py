@@ -1,0 +1,4 @@
+from .auto_cast import amp_guard, auto_cast, decorate, white_list, black_list
+from .grad_scaler import AmpScaler, GradScaler
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler", "amp_guard"]

@@ -1,0 +1,127 @@
+"""AMP autocast.
+
+Parity: python/paddle/amp/auto_cast.py:1029 ``auto_cast`` + amp_lists.py
+(allow/block lists), fluid/eager/amp_auto_cast.h:23 (the C++ hook inside
+generated forwards). TPU design: bf16 is the native half type; the
+autocast hook is installed into the eager dispatch layer
+(ops.dispatch.set_amp_hook) and casts op inputs per O1 lists. O2
+(``decorate``) casts parameters to bf16 with fp32 master weights kept by
+the optimizer (our optimizer states are fp32 already).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..ops import dispatch as _dispatch
+
+# O1 lists (subset of reference amp_lists.py FP16_WHITE_LIST / BLACK_LIST).
+white_list = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "einsum", "sdpa", "flash_attention", "addmm",
+}
+black_list = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "bce_with_logits", "binary_cross_entropy",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "cos_sim", "softmax_with_cross_entropy", "pow", "square", "reciprocal", "rsqrt",
+    "norm", "nll_loss", "kl_div", "mse_loss", "l1_loss", "smooth_l1_loss",
+}
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = jnp.bfloat16
+        _state.level = "O1"
+        _state.custom_white = set()
+        _state.custom_black = set()
+    return _state
+
+
+def _amp_hook(op_name: str, datas):
+    st = _st()
+    if not st.enabled:
+        return datas
+    wl = (white_list | st.custom_white) - st.custom_black
+    bl = (black_list | st.custom_black) - st.custom_white
+    if op_name in wl:
+        return [d.astype(st.dtype) if d.dtype in (jnp.float32, jnp.float16, jnp.bfloat16) and d.dtype != st.dtype else d
+                for d in datas]
+    if op_name in bl:
+        return [d.astype(jnp.float32) if d.dtype in (jnp.float16, jnp.bfloat16) else d for d in datas]
+    # gray zone: promote to widest float among inputs
+    fdts = [d.dtype for d in datas if d.dtype in (jnp.float16, jnp.bfloat16, jnp.float32)]
+    if fdts and any(dt == jnp.float32 for dt in fdts) and any(dt != jnp.float32 for dt in fdts):
+        return [d.astype(jnp.float32) if d.dtype in (jnp.float16, jnp.bfloat16) else d for d in datas]
+    return datas
+
+
+_dispatch.set_amp_hook(_amp_hook)
+
+
+class auto_cast:
+    """Context manager: O1 autocasting (and O2: everything-not-black in low
+    precision)."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16", use_promote=True):
+        self._enable = enable
+        self._level = level
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._white = set(custom_white_list or ())
+        self._black = set(custom_black_list or ())
+
+    def __enter__(self):
+        st = _st()
+        self._saved = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+        st.enabled = self._enable
+        st.dtype = self._dtype
+        st.level = self._level
+        st.custom_white = self._white
+        st.custom_black = self._black
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.enabled, st.dtype, st.level, st.custom_white, st.custom_black = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """O2: cast model params to low precision (master weights live in the
+    optimizer's fp32 state). Parity: python/paddle/amp/auto_cast.py:1114."""
+    d = dtypes.convert_dtype(dtype)
+    from ..nn.layer import Layer
+
+    def _cast_layer(layer):
+        from ..nn.layers_conv_norm import _BatchNormBase, GroupNorm, LayerNorm
+
+        for sub in layer.sublayers(include_self=True):
+            if isinstance(sub, (_BatchNormBase, LayerNorm, GroupNorm)):
+                continue
+            if excluded_layers and isinstance(sub, tuple(excluded_layers)):
+                continue
+            for pname, p in sub._parameters.items():
+                if p is not None and dtypes.is_floating_point(p._data.dtype):
+                    p._data = p._data.astype(d)
+        layer._casted_by_pure_fp16 = True
+        return layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    model_list = [_cast_layer(m) for m in model_list]
+    models_out = model_list[0] if single_model else model_list
+    if optimizers is None:
+        return models_out
+    return models_out, optimizers

@@ -1,0 +1,118 @@
+"""paddle.autograd namespace: backward, grad, PyLayer, hooks.
+
+Parity: python/paddle/autograd/ (py_layer.py:36 PyLayer, backward_mode.py
+backward, saved_tensors_hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..core.autograd import (
+    Edge,
+    GradNode,
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    """Parity: python/paddle/autograd/py_layer.py PyLayerContext —
+    save_for_backward / saved_tensor + arbitrary attribute stashing."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd function (parity: py_layer.py:268 PyLayer).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx,
+    *grads); call via .apply(). The backward callable is registered as a
+    GradNode on the tape, so hooks/accumulation behave identically to
+    built-in ops.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_list = [outs] if single else list(outs)
+
+        record = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if record:
+            out_specs = [(tuple(o._data.shape), o._data.dtype) for o in outs_list]
+
+            def vjp_fn(cots):
+                cot_list = [cots] if len(outs_list) == 1 else list(cots)
+                cot_tensors = [Tensor(c, stop_gradient=True) for c in cot_list]
+                with no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out = []
+                for g in grads:
+                    out.append(None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+                return tuple(out)
+
+            edges = []
+            for t in tensor_inputs:
+                if t.stop_gradient:
+                    edges.append(Edge())
+                elif t._grad_node is not None:
+                    edges.append(Edge(node=t._grad_node, slot=t._out_slot))
+                else:
+                    edges.append(Edge(leaf=t))
+            node = GradNode(cls.__name__, vjp_fn, edges, out_specs)
+            for i, o in enumerate(outs_list):
+                from ..core import dtype as dtypes
+
+                if dtypes.is_floating_point(o._data.dtype):
+                    o.stop_gradient = False
+                    o._grad_node = node
+                    o._out_slot = i
+        return outs_list[0] if single else tuple(outs_list)
+
+
+class saved_tensors_hooks:
+    """Parity: python/paddle/autograd/saved_tensors_hooks.py. The eager tape
+    stores residuals inside XLA pullbacks, so pack/unpack hooks apply only
+    to PyLayer-saved tensors; kept for API compatibility."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,4 @@
+from . import autograd, dtype, flags
+from .tensor import Parameter, Tensor
+
+__all__ = ["Tensor", "Parameter", "autograd", "dtype", "flags"]

@@ -1,0 +1,253 @@
+"""Tape-based autograd engine over lazy XLA arrays.
+
+TPU-native re-design of the reference's eager autograd
+(reference: paddle/fluid/eager/grad_node_info.h:197 GradNodeBase,
+paddle/fluid/eager/backward.cc:105 RunBackward,
+paddle/fluid/eager/grad_tensor_holder.cc).
+
+Design: every differentiable eager op records one ``GradNode`` holding the
+XLA-traced pullback produced by ``jax.vjp``. ``backward()`` runs an
+in-degree/ready-queue traversal identical in spirit to the reference's
+engine, accumulating cotangents per output slot (sum semantics) and
+depositing leaf gradients on ``Tensor.grad``. The pullback itself executes
+as XLA computations, so the backward pass is device-resident and async —
+only the graph walk is host-side.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+    return _state
+
+
+def is_grad_enabled() -> bool:
+    return _tls().grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _tls().grad_enabled = mode
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording.
+
+    Parity: python/paddle/base/dygraph/base.py no_grad_.
+    """
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class Edge:
+    """Connection from a GradNode input slot to its producer.
+
+    Parity: paddle/fluid/eager/grad_node_info.h:53 Edge.
+    Either points at another GradNode's output slot, or at a leaf tensor
+    (grad-accumulation target; reference: eager/accumulation/).
+    """
+
+    __slots__ = ("node", "slot", "leaf")
+
+    def __init__(self, node: Optional["GradNode"] = None, slot: int = 0, leaf=None):
+        self.node = node
+        self.slot = slot
+        self.leaf = leaf  # Tensor (leaf accumulation target) or None
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn``: cotangents-of-outputs -> cotangents-of-inputs (XLA traced).
+    ``edges[i]`` describes where input-cotangent ``i`` flows.
+    ``out_specs``: (shape, dtype) per output slot for zero-filling.
+    """
+
+    __slots__ = ("name", "vjp_fn", "edges", "out_specs", "hooks", "released")
+
+    def __init__(self, name: str, vjp_fn: Callable, edges: List[Edge], out_specs: List[Tuple[tuple, Any]]):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges = edges
+        self.out_specs = out_specs
+        self.hooks: List[Callable] = []
+        self.released = False
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.edges)} n_out={len(self.out_specs)}>"
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_graph: bool = False) -> None:
+    """Run the tape backward from ``tensors``.
+
+    Parity: paddle/fluid/eager/backward.cc:105 RunBackward — in-degree map
+    over the grad-node graph, ready-queue traversal, per-node cotangent
+    accumulation with sum semantics.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    pending: dict = {}  # id(node) -> list of cotangent-or-None per output slot
+    nodes: dict = {}  # id(node) -> node
+    indeg: dict = {}  # id(node) -> remaining consumer count
+
+    def seed(node: GradNode, slot: int, g):
+        buf = pending.setdefault(id(node), [None] * len(node.out_specs))
+        buf[slot] = g if buf[slot] is None else buf[slot] + g
+
+    root_nodes: List[GradNode] = []
+    for t, g in zip(roots, grad_tensors):
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                gg = g._data if isinstance(g, Tensor) else (g if g is not None else jnp.ones(t._data.shape, t._data.dtype))
+                t._accumulate_grad(gg)
+            continue
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    f"grad can be implicitly created only for scalar outputs, got shape {tuple(t._data.shape)}"
+                )
+            gdata = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            gdata = g._data if isinstance(g, Tensor) else jnp.asarray(g, t._data.dtype)
+        seed(node, t._out_slot, gdata)
+        root_nodes.append(node)
+
+    # Build in-degree over the subgraph reachable from the roots.
+    stack = list(root_nodes)
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes:
+            continue
+        nodes[id(node)] = node
+        indeg.setdefault(id(node), 0)
+        for e in node.edges:
+            if e.node is not None:
+                indeg[id(e.node)] = indeg.get(id(e.node), 0) + 1
+                stack.append(e.node)
+
+    ready = deque(n for n in set(map(id, root_nodes)) if indeg[n] == 0)
+    ready = deque(nodes[nid] for nid in ready)
+    processed = set()
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        cots = pending.pop(id(node), [None] * len(node.out_specs))
+        full = [
+            c if c is not None else jnp.zeros(shape, dtype)
+            for c, (shape, dtype) in zip(cots, node.out_specs)
+        ]
+        if node.released:
+            raise RuntimeError(
+                f"grad node {node.name} was already released; call backward(retain_graph=True) "
+                "to backprop through the same graph twice"
+            )
+        out = full[0] if len(full) == 1 else tuple(full)
+        in_cots = node.vjp_fn(out)
+        for hook in node.hooks:
+            in_cots = hook(in_cots)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.released = True
+        for e, g in zip(node.edges, in_cots):
+            if e.leaf is not None:
+                if g is not None and not _is_float0(g):
+                    e.leaf._accumulate_grad(g)
+            elif e.node is not None:
+                if g is not None and not _is_float0(g):
+                    seed(e.node, e.slot, g)
+                indeg[id(e.node)] -= 1
+                if indeg[id(e.node)] == 0:
+                    ready.append(e.node)
+
+
+def grad(
+    outputs: Sequence,
+    inputs: Sequence,
+    grad_outputs: Optional[Sequence] = None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+):
+    """``paddle.grad`` equivalent: partial-graph gradient computation.
+
+    Parity: paddle/fluid/eager/backward.cc:103 GeneralGrad (non-higher-order
+    subset; ``create_graph`` raises for now — program-mode AD covers
+    higher-order via jax.grad composition).
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use "
+            "paddle_tpu.jit.to_static + jax.grad composition for higher-order AD"
+        )
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    # Save/clear existing leaf grads of inputs, run backward, collect, restore.
+    saved = [inp._grad_data for inp in inputs]
+    for inp in inputs:
+        inp._grad_data = None
+    backward(outputs, grad_outputs, retain_graph=retain_graph)
+    results = []
+    for inp, old in zip(inputs, saved):
+        gdata = inp._grad_data
+        if gdata is None:
+            if allow_unused:
+                results.append(None)
+            else:
+                results.append(Tensor(jnp.zeros(inp._data.shape, inp._data.dtype), stop_gradient=True))
+        else:
+            results.append(Tensor(gdata, stop_gradient=True))
+        inp._grad_data = old
+    return results

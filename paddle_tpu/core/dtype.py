@@ -1,0 +1,86 @@
+"""Dtype system.
+
+TPU-native equivalent of the reference's DataType enum
+(reference: paddle/phi/common/data_type.h; python/paddle/framework/dtype.py).
+Dtypes are thin aliases over numpy/jnp dtypes so they flow through XLA
+unchanged; ``bfloat16`` is first-class (the TPU-native half type).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtype instances).
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "bool": bool,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+_default_dtype = [jnp.dtype(float32)]
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (str / np dtype / jnp dtype) to the
+    canonical np.dtype for this backend (x64 disabled ⇒ int64→int32,
+    float64→float32, mirroring XLA's default type widths)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        d = np.dtype(_NAME_TO_DTYPE[dtype])
+    else:
+        d = np.dtype(dtype)
+    import jax
+
+    return np.dtype(jax.dtypes.canonicalize_dtype(d))
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d not in (np.dtype(float32), np.dtype(float64), np.dtype(float16), np.dtype(bfloat16)):
+        raise ValueError("default dtype must be a floating dtype")
+    _default_dtype[0] = d
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def is_floating_point(dtype) -> "bool":
+    return np.dtype(dtype) in {np.dtype(d) for d in _FLOATING}
+
+
+def is_integer(dtype) -> "bool":
+    return np.dtype(dtype) in {np.dtype(d) for d in _INTEGER}

@@ -1,0 +1,102 @@
+"""Process-level flag registry.
+
+TPU-native equivalent of the reference's exported-flags system
+(reference: paddle/common/flags.cc — ~180 ``PHI_DEFINE_EXPORTED_*`` flags,
+paddle/common/flags.h:38). Flags are settable programmatically via
+``set_flags`` or by environment variables ``FLAGS_<name>`` read at first
+access, mirroring the reference's env-var override semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "help", "parser", "env_read")
+
+    def __init__(self, name: str, default: Any, help: str, parser: Callable[[str], Any]):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.help = help
+        self.parser = parser
+        self.env_read = False
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    """Register a flag. Type is inferred from the default value."""
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    with _lock:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = _Flag(name, default, help, parser)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        out[name] = _get(name)
+    return out
+
+
+def _get(name: str) -> Any:
+    flag = _REGISTRY.get(name)
+    if flag is None:
+        raise KeyError(f"unknown flag: {name!r}")
+    with _lock:
+        if not flag.env_read:
+            env = os.environ.get(f"FLAGS_{name}")
+            if env is not None:
+                flag.value = flag.parser(env)
+            flag.env_read = True
+        return flag.value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for name, value in flags.items():
+        flag = _REGISTRY.get(name)
+        if flag is None:
+            raise KeyError(f"unknown flag: {name!r}")
+        with _lock:
+            flag.env_read = True
+            flag.value = value
+
+
+def flag(name: str) -> Any:
+    """Fast accessor used on hot paths."""
+    return _get(name)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of the reference's surface that is meaningful on TPU).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Check every op output for NaN/Inf (reference: FLAGS_check_nan_inf).")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 1: warn; (reference: nan_inf_utils_detail).")
+define_flag("eager_op_jit", True, "Cache-jit eager single-op executables (PJRT executable cache).")
+define_flag("benchmark", False, "Synchronize after every op for timing.")
+define_flag("tpu_matmul_precision", "default", "XLA matmul precision: default|high|highest.")
+define_flag("use_stride_kernel", False, "Unused on TPU; kept for API parity.")
+define_flag("embedding_deterministic", 0, "Deterministic embedding grad (XLA scatter is deterministic).")
+define_flag("distributed_timeout_s", 1800, "Collective/rendezvous timeout seconds.")
+define_flag("allocator_strategy", "xla", "Kept for parity; PJRT owns device memory.")
+define_flag("log_level", 0, "Framework verbose log level (VLOG equivalent).")

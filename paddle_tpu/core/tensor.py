@@ -1,0 +1,280 @@
+"""Eager Tensor: a Paddle-shaped handle over a (lazy, async) jax.Array.
+
+TPU-native equivalent of the reference's eager Tensor
+(reference: paddle/fluid/pybind/eager.cc — pytype creation,
+eager_method.cc — methods, phi/core/dense_tensor.h:37 DenseTensor).
+
+The payload is a ``jax.Array`` (PJRT buffer, asynchronously computed), so
+every op is an XLA dispatch and host code never blocks until a value is
+observed (``numpy()``/``item()``). Autograd metadata (``stop_gradient``,
+``_grad_node``, ``grad``) lives on the handle like the reference's
+AutogradMeta. Most operator methods are patched in by
+``paddle_tpu.ops`` at import time (mirroring the reference's generated
+method registration, python/paddle/tensor/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .autograd import backward as _backward_engine
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad_data",
+        "_grad_node",
+        "_out_slot",
+        "name",
+        "persistable",
+        "_hooks",
+        "__weakref__",
+    )
+
+    _next_id = [0]
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad_data = None
+        self._grad_node = None
+        self._out_slot = 0
+        if name is None:
+            Tensor._next_id[0] += 1
+            name = f"generated_tensor_{Tensor._next_id[0]}"
+        self.name = name
+        self.persistable = False
+        self._hooks = []
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        try:
+            return str(next(iter(self._data.devices())))
+        except Exception:
+            return "traced"
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_flag = self.stop_gradient
+        try:
+            body = np.array2string(np.asarray(self._data), precision=6, separator=", ")
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name if hasattr(self.dtype, 'name') else self.dtype}, "
+            f"stop_gradient={grad_flag},\n       {body})"
+        )
+
+    # ------------------------------------------------------------------
+    # Host access (sync points)
+    # ------------------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(self._data.item())
+
+    def __int__(self):
+        return int(self._data.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        return builtins_bool(self._data.item())
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # ------------------------------------------------------------------
+    # Autograd
+    # ------------------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad_data is None:
+            return None
+        return Tensor(self._grad_data, stop_gradient=True, name=self.name + "@GRAD")
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad_data = None
+        else:
+            self._grad_data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    def _accumulate_grad(self, gdata):
+        if gdata.dtype != self._data.dtype:
+            gdata = gdata.astype(self._data.dtype)
+        for hook in self._hooks:
+            out = hook(Tensor(gdata, stop_gradient=True))
+            if out is not None:
+                gdata = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        if self._grad_data is None:
+            self._grad_data = gdata
+        else:
+            self._grad_data = self._grad_data + gdata
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _backward_engine([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad_data = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad_data is not None:
+            self._grad_data = jnp.zeros_like(self._grad_data)
+        else:
+            self._grad_data = None
+
+    def register_hook(self, hook):
+        """Gradient hook on a leaf (parity: Tensor.register_hook / eager hooks)."""
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_s):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name + "@detached")
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops.dispatch import apply_op
+
+        return apply_op("clone", lambda x: x + jnp.zeros((), x.dtype), self)
+
+    # ------------------------------------------------------------------
+    # Data movement / casting helpers (others patched in by ops)
+    # ------------------------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from ..ops.dispatch import apply_op
+
+        d = dtypes.convert_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(d), self)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def _replace_(self, new: "Tensor") -> "Tensor":
+        """In-place rebind (used by inplace ops / __setitem__)."""
+        self._data = new._data
+        self._grad_node = new._grad_node
+        self._out_slot = new._out_slot
+        self.stop_gradient = new.stop_gradient
+        return self
+
+    def copy_(self, other: "Tensor") -> "Tensor":
+        self._data = jnp.asarray(other._data, self._data.dtype)
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, self._data.dtype).reshape(self._data.shape)
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # Accept dtype-like or device-like single arg, Paddle-style.
+        for a in list(args) + list(kwargs.values()):
+            try:
+                d = dtypes.convert_dtype(a)
+                return self.astype(d)
+            except (ValueError, TypeError):
+                continue
+        return self
+
+    @property
+    def T(self):
+        from ..ops.dispatch import apply_op
+
+        axes = tuple(reversed(range(self.ndim)))
+        return apply_op("transpose", lambda x: jnp.transpose(x, axes), self)
+
+
+def builtins_bool(x):
+    return bool(x)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: python/paddle/base/framework.py Parameter /
+    EagerParamBase). ``stop_gradient`` defaults to False; ``trainable``
+    toggles it."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed", "placements", "process_mesh")
+
+    def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.placements = None
+        self.process_mesh = None
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not v

@@ -1,0 +1,42 @@
+"""paddle_tpu.distributed — the distributed capability surface.
+
+Layers (parity map, SURVEY §2.4-§2.5):
+- collective.py — ProcessGroup-shaped API over XLA collectives (#30-36)
+- env.py — init_parallel_env / rank / world (#36, TCPStore→PJRT coordination)
+- mesh.py / api.py — ProcessMesh, placements, shard_tensor/reshard (#45)
+- parallel.py — DataParallel wrapper (#37)
+- fleet/ — hybrid topology + TP/SP layers + distributed optimizer (#38-44)
+- sharding.py — ZeRO stage 1/2/3 semantics (#42)
+- checkpoint.py — distributed sharded checkpoint (§5.4)
+"""
+
+from .collective import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_concat,
+    all_reduce,
+    all_to_all,
+    alltoall_single,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    spmd,
+    stream,
+)
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized
+from .mesh import Partial, Placement, ProcessMesh, Replicate, Shard
+from .api import dtensor_from_fn, reshard, shard_layer, shard_tensor, unshard_dtensor
+from .parallel import DataParallel
+
+from . import fleet

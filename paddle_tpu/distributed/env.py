@@ -1,0 +1,72 @@
+"""Process-level distributed environment.
+
+Parity: python/paddle/distributed/parallel.py:978 init_parallel_env +
+ParallelEnv. TPU design: one *process per host*, SPMD across all chips —
+jax.distributed.initialize plays the role of the TCPStore rendezvous +
+ProcessGroup bootstrap (NCCL unique-id exchange is replaced by PJRT
+coordination service). Within a host-process, "ranks" of collective
+programs are mesh slots (see collective.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = [False]
+
+
+def init_parallel_env():
+    """Bootstrap multi-host execution from env vars (PADDLE_TRAINER_* /
+    MASTER_ADDR naming kept for parity; also accepts the launcher's
+    COORDINATOR_ADDRESS)."""
+    if _initialized[0]:
+        return ParallelEnv()
+    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("PADDLE_MASTER")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("NUM_PROCESSES", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PROCESS_ID", "0")))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord, num_processes=nprocs, process_id=pid)
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.world_size
+    # SPMD view: world size = number of participating devices.
+    return jax.device_count() if _initialized[0] else 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return jax.process_count()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return jax.process_count()
+
+    @property
+    def local_rank(self):
+        return 0

@@ -1,0 +1,16 @@
+"""fleet: hybrid-parallel orchestration (parity: python/paddle/distributed/fleet/).
+
+Round-1 surface: topology (CommunicateTopology/HybridCommunicateGroup),
+DistributedStrategy, fleet.init/distributed_model/distributed_optimizer,
+TP layers (mpu). Pipeline schedules and sharding stages land with the
+parallel training engine.
+"""
+
+from .base import DistributedStrategy, Fleet, fleet
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import mp_layers as meta_parallel
+
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

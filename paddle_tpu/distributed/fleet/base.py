@@ -1,0 +1,116 @@
+"""fleet.Fleet + DistributedStrategy.
+
+Parity: python/paddle/distributed/fleet/fleet.py:218 (init),
+fleet/base/distributed_strategy.py (DistributedStrategy — protobuf-backed
+in the reference; a plain config object here), fleet/model.py:32
+(distributed_model), hybrid_parallel_optimizer.py (distributed_optimizer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ...core.tensor import Tensor
+from ..env import get_rank, init_parallel_env
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {}
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {}
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid_configs={self.hybrid_configs})"
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._strategy: Optional[DistributedStrategy] = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+            dims=(
+                hc.get("dp_degree", 1),
+                hc.get("pp_degree", 1),
+                hc.get("sharding_degree", 1),
+                hc.get("sep_degree", 1),
+                hc.get("mp_degree", 1),
+            ),
+        )
+        self._hcg = HybridCommunicateGroup(topo, get_rank())
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        if self._hcg is None:
+            self.init()
+        return self._hcg
+
+    @property
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return self._hcg.nranks if self._hcg else 1
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def distributed_model(self, model):
+        """Wrap by topology (parity: fleet/model.py:32). On TPU the wrap is
+        a sharding recipe: TP layers already carry placements; DP is
+        GSPMD-by-batch-sharding; the wrapper keeps reference semantics for
+        per-rank spmd programs."""
+        from ..parallel import DataParallel
+
+        hcg = self.get_hybrid_communicate_group()
+        mode = hcg.get_parallel_mode()
+        if mode == "data_parallel" and hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model, group=hcg.get_data_parallel_group())
+        if mode == "pipeline":
+            from .pipeline_parallel import PipelineParallel
+
+            return PipelineParallel(model, hcg, self._strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        return HybridParallelOptimizer(optimizer, self.get_hybrid_communicate_group(),
+                                       strategy or self._strategy)
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()

@@ -1,0 +1,76 @@
+"""HybridParallelOptimizer.
+
+Parity: fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py
+(:266 wrapper, :42 grad-clip with cross-group norm allreduce, :525 step).
+
+TPU design: under pjit, gradient averaging/partial sums are GSPMD's job,
+so step() mostly delegates; the cross-group global-norm clip is made
+topology-aware for per-rank spmd programs by summing the local norm over
+mp/pp/sharding axes before clipping (same math as the reference's
+allreduce of square norms).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.clip import ClipGradByGlobalNorm
+from ..collective import ReduceOp, _current_spmd, all_reduce
+
+
+class HybridParallelClipGrad:
+    def __init__(self, clip: ClipGradByGlobalNorm, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        total = self._clip._global_norm_sq(params_grads)
+        if total is None:
+            return params_grads
+        if _current_spmd() is not None:
+            # sum squared norms across model-parallel-ish axes (params are
+            # disjoint shards there); dp/sharding replicas hold equal grads.
+            for g in (self._hcg.get_model_parallel_group(), self._hcg.get_pipe_parallel_group()):
+                if g.nranks and g.nranks != 1:
+                    t = Tensor(total, stop_gradient=True)
+                    all_reduce(t, op=ReduceOp.SUM, group=g)
+                    total = t._data
+        global_norm = jnp.sqrt(total)
+        scale = self._clip.clip_norm / jnp.maximum(global_norm, self._clip.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
+        return out
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if isinstance(optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        return self._inner_opt.minimize(loss, *a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)

@@ -1,0 +1,205 @@
+"""Tensor-parallel (mpu) layers.
+
+Parity: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding:47, ColumnParallelLinear:334,
+RowParallelLinear:541) + mpu/random.py RNGStatesTracker.
+
+TPU design: these are *sharding recipes*, not comm-op insertions. Each
+layer creates its weight as a DistTensor sharded over the ``mp`` mesh
+axis; under pjit, GSPMD inserts exactly the reference's collectives
+(column: all_gather on output if gather_output; row: psum of partial
+matmul — the reference's _mp_allreduce). In eager spmd per-rank programs
+the same layers call the collective API explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor
+from ...nn import functional as F
+from ...nn.initializer import Constant, XavierNormal
+from ...nn.layer import Layer
+from ..collective import ReduceOp, _current_spmd, all_gather_concat, all_reduce, reduce_scatter
+from ..mesh import ProcessMesh, Replicate, Shard
+from ..api import shard_tensor
+
+
+def _hcg():
+    from .base import fleet
+
+    return fleet._hcg
+
+
+def _mp_group():
+    h = _hcg()
+    return h.get_model_parallel_group() if h else None
+
+
+def _mp_degree():
+    h = _hcg()
+    return h.get_model_parallel_world_size() if h else 1
+
+
+def _mesh():
+    h = _hcg()
+    return h.process_mesh if h else None
+
+
+def _maybe_shard(param: Parameter, dim: Optional[int]) -> Parameter:
+    """Annotate a parameter with mp-axis sharding on ``dim`` (None =
+    replicated over mp)."""
+    mesh = _mesh()
+    if mesh is None or "mp" not in mesh.dim_names or mesh.get_dim_size("mp") == 1:
+        return param
+    placements = [Replicate()] * mesh.ndim
+    if dim is not None:
+        placements[mesh.dim_names.index("mp")] = Shard(dim)
+    return shard_tensor(param, mesh, placements)
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        w = self.create_parameter((num_embeddings, embedding_dim), attr=weight_attr,
+                                  default_initializer=XavierNormal())
+        self.weight = _maybe_shard(w, 0)  # shard vocab dim
+
+    def forward(self, x):
+        # GSPMD handles masked lookup + psum when the weight is vocab-sharded
+        # under pjit. (Reference: c_embedding op's masked lookup.)
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        w = self.create_parameter((in_features, out_features), attr=weight_attr)
+        self.weight = _maybe_shard(w, 1)  # shard output/column dim
+        if has_bias is False:
+            self.bias = None
+        else:
+            b = self.create_parameter((out_features,), attr=None, is_bias=True)
+            self.bias = _maybe_shard(b, 0)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and _current_spmd() is not None:
+            out = all_gather_concat(out, group=_mp_group(), axis=-1)
+        return out
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        w = self.create_parameter((in_features, out_features), attr=weight_attr)
+        self.weight = _maybe_shard(w, 0)  # shard input/row dim
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), attr=None, is_bias=True)
+            self.bias = _maybe_shard(self.bias, None)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if _current_spmd() is not None:
+            # per-rank program: local matmul then allreduce partial sums
+            out = F.linear(x, self.weight, None)
+            out = all_reduce(out, op=ReduceOp.SUM, group=_mp_group())
+            if self.bias is not None:
+                out = out + self.bias
+            return out
+        # pjit/GSPMD path: the contraction over the sharded dim emits psum.
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Parity: mpu/mp_layers.py ParallelCrossEntropy (vocab-parallel loss via
+    c_softmax_with_cross_entropy). Under GSPMD the standard cross_entropy
+    on a vocab-sharded logits tensor produces the same collective pattern."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
+
+
+class RNGStatesTracker:
+    """Seeded dropout across mp ranks (parity: mpu/random.py:34).
+
+    TPU design: jax PRNG keys are explicit, so 'states' are just distinct
+    fold_in'ed keys per name; local_seed folds in the mp rank so dropout
+    masks differ across tensor-parallel shards while global_seed is shared.
+    """
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from ...ops import random as rnd
+
+            if name not in self.states_:
+                raise ValueError(f"state {name} does not exist")
+            old = rnd._KEY[0]
+            rnd._KEY[0] = self.states_[name]
+            try:
+                yield
+            finally:
+                self.states_[name] = rnd._KEY[0]
+                rnd._KEY[0] = old
+
+        return _ctx()
+
+
+RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from .base import fleet
+
+    hcg = fleet._hcg
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    seed = seed or (pyrandom.randint(0, 100000) if False else 1024)
+    global RNG_STATE_TRACKER
+    RNG_STATE_TRACKER = RNGStatesTracker()
+    RNG_STATE_TRACKER.add("global_seed", seed)
+    RNG_STATE_TRACKER.add("local_seed", seed + 1024 + mp_rank)

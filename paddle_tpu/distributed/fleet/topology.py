@@ -1,0 +1,171 @@
+"""Hybrid-parallel topology.
+
+Parity: python/paddle/distributed/fleet/base/topology.py
+(CommunicateTopology:70, HybridCommunicateGroup:189; axis order
+pp→mp→sep→sharding→dp at :301).
+
+TPU design: the topology IS a device mesh. Axis order follows the
+reference (pp outermost … dp innermost maps pp to the slowest-varying mesh
+dim, dp to the fastest) but the communicators are mesh axes, not NCCL
+rings: each axis name is usable as a Group in collective.spmd programs and
+as a sharding dim under pjit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..collective import Group, new_group
+from ..mesh import ProcessMesh
+
+_AXIS_ORDER = ["pp", "sharding", "mp", "sep", "dp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world_size = int(np.prod(self._dims))
+        shape = tuple(self._dims)
+        self._coord_arr = np.arange(self._world_size).reshape(shape)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coords = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._coord_arr[coords])
+
+    def get_coord(self, rank):
+        pos = np.argwhere(self._coord_arr == rank)[0]
+        return tuple(int(v) for v in pos)
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        taken = np.take(self._coord_arr, index, axis=axis)
+        return sorted(int(v) for v in taken.reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._coord_arr, axis, -1)
+        return [list(map(int, row)) for row in moved.reshape(-1, self._dims[axis])]
+
+
+class HybridCommunicateGroup:
+    """Parity: topology.py:189. Exposes rank/world-size per axis and the
+    per-axis Groups; additionally exposes ``process_mesh`` — the
+    ProcessMesh whose dims are (pp, sharding, mp, sep, dp) — which is what
+    pjit-based training consumes."""
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data")
+        self._mp_degree = topology.get_dim("model")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep")
+
+        coord = topology.get_coord(global_rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+        # Mesh with reference's axis nesting; axis names match fleet configs.
+        dims = [topology.get_dim(n) for n in names]
+        ids = np.arange(self.nranks).reshape(tuple(dims))
+        mesh_names = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+        self.process_mesh = ProcessMesh(ids, [mesh_names[n] for n in names])
+
+        self._groups: Dict[str, Group] = {
+            mesh_names[n]: new_group(ranks=topology.get_axis_list(n, self._coord[n]), axis_name=mesh_names[n])
+            for n in names
+        }
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ranks within axes
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sep_parallel_rank(self):
+        return self._coord["sep"]
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups["mp"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("data", 0)[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("model", 0)[0]
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "model_parallel"
+        return "data_parallel"
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = dict(self._coord)
+        coord["pipe"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)

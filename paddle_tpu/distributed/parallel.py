@@ -1,0 +1,60 @@
+"""DataParallel wrapper.
+
+Parity: python/paddle/distributed/parallel.py:219 DataParallel + the C++
+Reducer (fluid/distributed/collective/reducer.h:107 — bucketed grad
+fusion/overlap).
+
+TPU design: in SPMD mode gradients are averaged by GSPMD (batch-sharded
+inputs + replicated params ⇒ psum in backward), so the wrapper's job is
+(a) marking params replicated, (b) providing the eager-mode grad
+all_reduce hook for spmd per-rank programs. Bucketing/overlap is XLA's
+job (it schedules the fused all-reduces), so comm_buffer_size_MB is
+accepted for parity but advisory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .collective import ReduceOp, all_reduce, _current_spmd
+from .env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+        # Register grad hooks: average gradients across the data-parallel
+        # group when running as a per-rank spmd program.
+        for p in layers.parameters():
+            if not p.stop_gradient:
+                p.register_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(grad: Tensor):
+            if _current_spmd() is None and get_world_size() <= 1:
+                return grad
+            return all_reduce(grad, op=ReduceOp.AVG, group=self._group)
+
+        return hook
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

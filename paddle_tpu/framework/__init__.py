@@ -1,0 +1,2 @@
+from . import io_utils, random_utils
+from .io_utils import load, save

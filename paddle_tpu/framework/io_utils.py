@@ -1,0 +1,90 @@
+"""paddle.save / paddle.load equivalent.
+
+Parity: python/paddle/framework/io.py:773 save, :1020 load — pickled
+nested state structures with tensors serialized by value. Tensors are
+stored as raw bytes + dtype/shape metadata (host transfer at save; device
+upload at load), matching the reference's DenseTensor serialization
+semantics. Extended dtypes (bfloat16, fp8) round-trip via ml_dtypes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+_PROTO = 4
+
+_EXT_DTYPES = {
+    "bfloat16": ml_dtypes.bfloat16,
+    "float8_e4m3fn": ml_dtypes.float8_e4m3fn,
+    "float8_e5m2": ml_dtypes.float8_e5m2,
+}
+
+
+def _np_dtype(name: str):
+    if name in _EXT_DTYPES:
+        return np.dtype(_EXT_DTYPES[name])
+    return np.dtype(name)
+
+
+class _TensorPayload:
+    """Pickle-stable tensor wrapper (raw bytes + metadata)."""
+
+    def __init__(self, array, trainable: bool = False, name=None):
+        a = np.asarray(array)
+        self.dtype_name = a.dtype.name
+        self.shape = a.shape
+        self.buf = a.tobytes()
+        self.trainable = trainable
+        self.name = name
+
+    def to_numpy(self) -> np.ndarray:
+        return np.frombuffer(self.buf, dtype=_np_dtype(self.dtype_name)).reshape(self.shape)
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return _TensorPayload(np.asarray(obj._data), obj.trainable, obj.name)
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data), False, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_numpy()
+        if return_numpy:
+            return arr
+        return Tensor(jnp.asarray(arr), name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

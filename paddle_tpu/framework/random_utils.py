@@ -1,0 +1,13 @@
+"""RNG state API parity (paddle.get_cuda_rng_state etc.)."""
+
+from __future__ import annotations
+
+from ..ops.random import get_rng_state, seed, set_rng_state
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

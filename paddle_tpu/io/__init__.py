@@ -1,0 +1,22 @@
+"""Data loading.
+
+Parity: python/paddle/io/ (Dataset, IterableDataset, TensorDataset,
+DataLoader with samplers/collate; multiprocess workers
+io/dataloader/dataloader_iter.py:370, worker.py:281).
+
+TPU design: workers produce numpy batches (host), transferred to device
+as a final step; prefetching overlaps host pipeline with device compute
+because jax dispatch is async. Multiprocess mode uses the same
+worker-process + queue design as the reference.
+"""
+
+from .dataset import ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset, Subset, TensorDataset, random_split
+from .sampler import BatchSampler, DistributedBatchSampler, RandomSampler, Sampler, SequenceSampler, WeightedRandomSampler
+from .dataloader import DataLoader, default_collate_fn, get_worker_info
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset", "ChainDataset",
+    "ConcatDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
+    "RandomSampler", "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "default_collate_fn", "get_worker_info",
+]

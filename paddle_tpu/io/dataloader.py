@@ -1,0 +1,193 @@
+"""DataLoader.
+
+Parity: python/paddle/io/dataloader/dataloader_iter.py:370
+(_DataLoaderIterMultiProcess), worker.py:281 (_worker_loop) — worker
+subprocesses pull index batches from a queue, run dataset.__getitem__ +
+collate, and push numpy batches back; the main process uploads to device.
+Single-process mode is the reference's _DataLoaderIterSingleProcess.
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return Tensor(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _to_device(batch):
+    if isinstance(batch, np.ndarray):
+        arr = batch
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        return Tensor(jnp.asarray(arr))
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_to_device(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _to_device(v) for k, v in batch.items()}
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, init_fn):
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = collate_fn(samples)
+            data_queue.put((batch_id, batch, None))
+        except Exception as e:  # propagate worker errors like the reference
+            data_queue.put((batch_id, None, e))
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield _to_device(self.collate_fn(batch))
+                batch = []
+        if batch and not self.drop_last:
+            yield _to_device(self.collate_fn(batch))
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield _to_device(self.collate_fn(samples))
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, data_queue, self.collate_fn, wid, self.num_workers,
+                      self.worker_init_fn),
+                daemon=True,
+            )
+            w.start()
+            workers.append(w)
+            index_queues.append(iq)
+
+        try:
+            sampler_iter = iter(self.batch_sampler)
+            batch_id = 0
+            sent = 0
+            reorder: dict = {}
+            next_yield = 0
+            # Prime the pipeline.
+            for _ in range(self.prefetch_factor * self.num_workers):
+                try:
+                    indices = next(sampler_iter)
+                except StopIteration:
+                    break
+                index_queues[batch_id % self.num_workers].put((batch_id, indices))
+                batch_id += 1
+                sent += 1
+
+            while next_yield < sent or True:
+                if next_yield >= sent:
+                    break
+                while next_yield not in reorder:
+                    bid, batch, err = data_queue.get(timeout=self.timeout or None)
+                    if err is not None:
+                        raise err
+                    reorder[bid] = batch
+                batch = reorder.pop(next_yield)
+                next_yield += 1
+                # Refill.
+                try:
+                    indices = next(sampler_iter)
+                    index_queues[batch_id % self.num_workers].put((batch_id, indices))
+                    batch_id += 1
+                    sent += 1
+                except StopIteration:
+                    pass
+                yield _to_device(batch)
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

@@ -1,0 +1,3 @@
+from .api import StaticFunction, ignore_module, in_to_static_mode, not_to_static, to_static
+
+__all__ = ["to_static", "not_to_static", "in_to_static_mode", "StaticFunction", "ignore_module"]

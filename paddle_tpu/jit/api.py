@@ -1,0 +1,137 @@
+"""Dynamic-to-static entry points.
+
+Parity: python/paddle/jit/api.py:195 ``to_static``. TPU design: the eager op
+layer is already jax-traceable (every op is a pure jax function on the
+Tensor payload), so ``to_static`` wraps the python function so its Tensor
+inputs carry tracers, and jits the whole thing — the analogue of the
+reference's SOT trace → whole-program PIR → compiled executable, with XLA
+as the compiler instead of CINN. Guards/cache are keyed by input spec
+(shape, dtype) exactly like ``ConcreteProgram`` caching.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+_tls = threading.local()
+
+
+def in_to_static_mode() -> bool:
+    return getattr(_tls, "tracing", 0) > 0
+
+
+class _TraceScope:
+    def __enter__(self):
+        _tls.tracing = getattr(_tls, "tracing", 0) + 1
+
+    def __exit__(self, *exc):
+        _tls.tracing -= 1
+        return False
+
+
+def _wrap_in(x):
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return Tensor(x, stop_gradient=True)
+    return x
+
+
+def _unwrap_out(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+class StaticFunction:
+    """Compiled-function wrapper (parity: program_translator.py
+    SymbolicStaticFunction). Cache key = jax.jit's trace cache (shapes,
+    dtypes, static args)."""
+
+    def __init__(self, fn: Callable, build_strategy=None, backend=None, donate_argnums=()):
+        self._fn = fn
+        functools.update_wrapper(self, fn, updated=[])
+
+        def runner(*datas, **kw):
+            with _TraceScope(), no_grad():
+                args = jax.tree.map(_wrap_in, datas, is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
+                kwargs = jax.tree.map(_wrap_in, kw, is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
+                out = fn(*args, **kwargs)
+                return jax.tree.map(_unwrap_out, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+        self._jitted = jax.jit(runner, donate_argnums=donate_argnums)
+
+    def __call__(self, *args, **kwargs):
+        datas = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x, args,
+                             is_leaf=lambda x: isinstance(x, Tensor))
+        kw = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
+                          is_leaf=lambda x: isinstance(x, Tensor))
+        out = self._jitted(*datas, **kw)
+        return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+    @property
+    def code(self):
+        return self._fn.__code__
+
+    def concrete_program(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator converting a dygraph function/Layer to a compiled program."""
+
+    def decorate(fn):
+        from ..nn.layer import Layer
+
+        if isinstance(fn, Layer):
+            return _LayerStaticWrapper(fn)
+        return StaticFunction(fn, build_strategy, backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class _LayerStaticWrapper:
+    """to_static over an nn.Layer: parameters become jit inputs so updates
+    don't retrigger compilation."""
+
+    def __init__(self, layer):
+        self._layer = layer
+
+        def runner(params, buffers, *datas, **kw):
+            with _TraceScope(), no_grad():
+                from ..utils.functional import functional_call
+
+                out = functional_call(layer, {**params, **buffers}, *[_wrap_in(d) for d in datas],
+                                      **{k: _wrap_in(v) for k, v in kw.items()})
+                return jax.tree.map(_unwrap_out, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+        self._jitted = jax.jit(runner)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    def __call__(self, *args, **kwargs):
+        params = {k: v._data for k, v in self._layer.named_parameters_dict().items()}
+        buffers = {k: v._data for k, v in self._layer.named_buffers_dict().items()}
+        datas = [a._data if isinstance(a, Tensor) else a for a in args]
+        kw = {k: (v._data if isinstance(v, Tensor) else v) for k, v in kwargs.items()}
+        out = self._jitted(params, buffers, *datas, **kw)
+        return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None

@@ -1,0 +1,27 @@
+"""paddle_tpu.nn — layers + functional (parity: python/paddle/nn/)."""
+
+from . import functional, initializer
+from .layer import Layer
+from .param_attr import ParamAttr
+from .layers_common import (
+    CELU, ELU, GELU, GLU, Dropout, Dropout2D, Embedding, Flatten, Hardshrink,
+    Hardsigmoid, Hardswish, Hardtanh, Identity, LayerDict, LayerList, LeakyReLU,
+    Linear, LogSigmoid, LogSoftmax, Maxout, Mish, ParameterList, PixelShuffle,
+    PReLU, ReLU, ReLU6, SELU, Sequential, Sigmoid, Silu, Softmax, Softplus,
+    Softshrink, Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU, Upsample,
+)
+from .layers_conv_norm import (
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, BatchNorm,
+    BatchNorm1D, BatchNorm2D, BatchNorm3D, Conv1D, Conv2D, Conv2DTranspose,
+    Conv3D, GroupNorm, InstanceNorm2D, LayerNorm, MaxPool1D, MaxPool2D,
+    RMSNorm, SyncBatchNorm,
+)
+from .layers_loss import (
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
+)
+from .layers_transformer import (
+    MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

@@ -1,0 +1,97 @@
+"""Gradient clipping.
+
+Parity: python/paddle/nn/clip.py (ClipGradByGlobalNorm:653, ClipGradByNorm,
+ClipGradByValue). Operates on (param, grad) lists like the reference;
+the distributed optimizer wraps ClipGradByGlobalNorm to allreduce the
+norm across model-parallel groups (reference:
+fleet/meta_parallel/.../hybrid_parallel_optimizer.py:42).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm_sq(self, params_grads):
+        sq = [jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+              for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not sq:
+            return None
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return total
+
+    def _dygraph_clip(self, params_grads):
+        total = self._global_norm_sq(params_grads)
+        if total is None:
+            return params_grads
+        global_norm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad_data for p in parameters if p._grad_data is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        norm = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        norm = jnp.sum(jnp.stack([jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in grads])) ** (1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (norm + 1e-6), 1.0)
+    for p in parameters:
+        if p._grad_data is not None:
+            p._grad_data = (p._grad_data.astype(jnp.float32) * clip_coef).astype(p._grad_data.dtype)
+    return Tensor(norm)

@@ -1,0 +1,1036 @@
+"""nn functional ops.
+
+Parity: python/paddle/nn/functional/ (activation.py, common.py, conv.py,
+pooling.py, norm.py, loss.py, input.py) lowered to XLA HLO — convs and
+matmuls hit the MXU via lax.conv_general_dilated/dot_general; everything
+else is fusable elementwise HLO.
+"""
+
+from __future__ import annotations
+
+import builtins
+import math as pymath
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+from ..ops.random import split_key
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+def _act(name, jfn):
+    def op(x, name=None):
+        return apply_op(name if isinstance(name, str) else op.__name__, jfn, ensure_tensor(x))
+
+    op.__name__ = name
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", jax.nn.relu6)
+sigmoid = _act("sigmoid", jax.nn.sigmoid)
+tanh = _act("tanh", jnp.tanh)
+silu = _act("silu", jax.nn.silu)
+swish = silu
+mish = _act("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+hardswish = _act("hardswish", jax.nn.hard_swish)
+hardsigmoid = _act("hardsigmoid", lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = _act("tanhshrink", lambda a: a - jnp.tanh(a))
+softsign = _act("softsign", jax.nn.soft_sign)
+selu_ = None
+
+
+def gelu(x, approximate=False, name=None) -> Tensor:
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), ensure_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None) -> Tensor:
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), ensure_tensor(x))
+
+
+def elu(x, alpha=1.0, name=None) -> Tensor:
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), ensure_tensor(x))
+
+
+def celu(x, alpha=1.0, name=None) -> Tensor:
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), ensure_tensor(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None) -> Tensor:
+    return apply_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), ensure_tensor(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def _f(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return apply_op("prelu", _f, x, weight)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None) -> Tensor:
+    return apply_op(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        ensure_tensor(x),
+    )
+
+
+def softshrink(x, threshold=0.5, name=None) -> Tensor:
+    return apply_op(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        ensure_tensor(x),
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None) -> Tensor:
+    return apply_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), ensure_tensor(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None) -> Tensor:
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), ensure_tensor(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None) -> Tensor:
+    return apply_op("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), ensure_tensor(x))
+
+
+def log_sigmoid(x, name=None) -> Tensor:
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, ensure_tensor(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+
+    def _f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply_op("softmax", _f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+
+    def _f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply_op("log_softmax", _f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    g = jax.random.gumbel(split_key(), x._data.shape, x._data.dtype)
+
+    def _f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis], dtype=y.dtype)
+            onehot = jnp.moveaxis(onehot, -1, axis)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op("gumbel_softmax", _f, x)
+
+
+def glu(x, axis=-1, name=None) -> Tensor:
+    return apply_op("glu", lambda a: jax.nn.glu(a, axis=axis), ensure_tensor(x))
+
+
+def maxout(x, groups, axis=1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        shp = list(a.shape)
+        c = shp[axis]
+        new = shp[:axis] + [c // groups, groups] + shp[axis + 1 :]
+        return jnp.max(a.reshape(new), axis=axis + 1)
+
+    return apply_op("maxout", _f, x)
+
+
+# ---------------------------------------------------------------------------
+# Linear / embedding / dropout
+# ---------------------------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None) -> Tensor:
+    """y = x @ W + b. Weight layout [in, out] (reference:
+    python/paddle/nn/functional/common.py linear; phi matmul kernel)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is None:
+        return apply_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+    bias = ensure_tensor(bias)
+    return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def _f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply_op("embedding", _f, x, weight)
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._data, num_classes, dtype=dtypes.get_default_dtype()))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout", lambda a: a * (1 - p), x)
+        return apply_op("dropout", lambda a: a, x)
+    shape = x._data.shape
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(split_key(), 1.0 - p, mask_shape)
+
+    def _f(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+    return apply_op("dropout", _f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None) -> Tensor:
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None) -> Tensor:
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return apply_op("alpha_dropout", lambda a: a, x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(split_key(), 1.0 - p, x._data.shape)
+    a_coef = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def _f(v):
+        return a_coef * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b_coef
+
+    return apply_op("alpha_dropout", _f, x)
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nsp):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding, nsp)
+    if len(p) == nsp:
+        return [(x, x) for x in p]
+    if len(p) == 2 * nsp:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(nsp)]
+    return [(x, x) for x in p]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _pair(stride)
+    dil = _pair(dilation)
+    pad = _conv_padding(padding, 2)
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC")
+
+    def _f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if b:
+            bb = b[0].reshape((1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1))
+            out = out + bb
+        return out
+
+    if bias is None:
+        return apply_op("conv2d", _f, x, weight)
+    return apply_op("conv2d", _f, x, weight, ensure_tensor(bias))
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _pair(stride, 1)
+    dil = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1)
+    dn = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC")
+
+    def _f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if b:
+            bb = b[0].reshape((1, -1, 1) if data_format == "NCL" else (1, 1, -1))
+            out = out + bb
+        return out
+
+    if bias is None:
+        return apply_op("conv1d", _f, x, weight)
+    return apply_op("conv1d", _f, x, weight, ensure_tensor(bias))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _pair(stride, 3)
+    dil = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3)
+    dn = ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "OIDHW", "NDHWC")
+
+    def _f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if b:
+            bb = b[0].reshape((1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1))
+            out = out + bb
+        return out
+
+    if bias is None:
+        return apply_op("conv3d", _f, x, weight)
+    return apply_op("conv3d", _f, x, weight, ensure_tensor(bias))
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+                     dilation=1, data_format="NCHW", output_size=None, name=None) -> Tensor:
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    strides = _pair(stride)
+    dil = _pair(dilation)
+    opad = _pair(output_padding)
+    p = _pair(padding)
+    dn = ("NCHW", "IOHW", "NCHW") if data_format == "NCHW" else ("NHWC", "IOHW", "NHWC")
+
+    def _f(a, w, *b):
+        kh = (w.shape[2] - 1) * dil[0] + 1
+        kw = (w.shape[3] - 1) * dil[1] + 1
+        pad = [
+            (kh - 1 - p[0], kh - 1 - p[0] + opad[0]),
+            (kw - 1 - p[1], kw - 1 - p[1] + opad[1]),
+        ]
+        out = jax.lax.conv_general_dilated(
+            a, jnp.flip(w, (2, 3)), window_strides=(1, 1), padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            bb = b[0].reshape((1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1))
+            out = out + bb
+        return out
+
+    if bias is None:
+        return apply_op("conv2d_transpose", _f, x, weight)
+    return apply_op("conv2d_transpose", _f, x, weight, ensure_tensor(bias))
+
+
+def _pool(x, kernel, stride, padding, reducer, init, data_format, count_include_pad=True, is_avg=False, ceil_mode=False):
+    ksize = _pair(kernel)
+    strides = _pair(stride if stride is not None else kernel)
+    nd = x.ndim
+
+    if data_format == "NCHW":
+        window = (1, 1) + ksize
+        ws = (1, 1) + strides
+        spatial = (2, 3)
+    else:
+        window = (1,) + ksize + (1,)
+        ws = (1,) + strides + (1,)
+        spatial = (1, 2)
+
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        p = _pair(padding)
+        pad_cfg = [(0, 0)] * nd
+        for i, ax in enumerate(spatial):
+            pad_cfg[ax] = (p[i], p[i])
+
+    def _f(a):
+        if is_avg:
+            ones = jnp.ones_like(a)
+            s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, ws, pad_cfg)
+            if count_include_pad and not isinstance(pad_cfg, str):
+                denom = float(np.prod(ksize))
+                return s / denom
+            c = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, ws, pad_cfg)
+            return s / c
+        return jax.lax.reduce_window(a, init, reducer, window, ws, pad_cfg)
+
+    return _f
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+               data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    f = _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format)
+    return apply_op("max_pool2d", f, x)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    f = _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, data_format,
+              count_include_pad=not exclusive, is_avg=True)
+    return apply_op("avg_pool2d", f, x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    out_hw = _pair(output_size)
+
+    def _f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a2 = a
+        else:
+            n, h, w, c = a.shape
+            a2 = jnp.transpose(a, (0, 3, 1, 2))
+        oh, ow = out_hw
+        # split into oh x ow regions via mean over reshaped blocks when divisible
+        if h % oh == 0 and w % ow == 0:
+            out = a2.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+        else:
+            # general adaptive: interpolate region means
+            hi = [int(pymath.floor(i * h / oh)) for i in range(oh)] + [h]
+            wi = [int(pymath.floor(i * w / ow)) for i in range(ow)] + [w]
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    cols.append(a2[:, :, hi[i]:hi[i + 1], wi[j]:wi[j + 1]].mean(axis=(2, 3)))
+                rows.append(jnp.stack(cols, axis=-1))
+            out = jnp.stack(rows, axis=-2)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("adaptive_avg_pool2d", _f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    out_hw = _pair(output_size)
+
+    def _f(a):
+        n, c, h, w = a.shape
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            return a.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+        hi = [int(pymath.floor(i * h / oh)) for i in range(oh)] + [h]
+        wi = [int(pymath.floor(i * w / ow)) for i in range(ow)] + [w]
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                cols.append(a[:, :, hi[i]:hi[i + 1], wi[j]:wi[j + 1]].max(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    return apply_op("adaptive_max_pool2d", _f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is not None else k
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def _f(a):
+        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)])
+
+    return apply_op("max_pool1d", _f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is not None else k
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def _f(a):
+        t = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)])
+        return t / k
+
+    return apply_op("avg_pool1d", _f, x)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    naxes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def _f(a, *wb):
+        mean = jnp.mean(a, axis=naxes, keepdims=True)
+        var = jnp.var(a, axis=naxes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    return apply_op("layer_norm", _f, *tensors)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None) -> Tensor:
+    """RMSNorm (reference: incubate fused_rms_norm,
+    phi/kernels/fusion/gpu/fused_rms_norm*). XLA fuses this chain."""
+    x = ensure_tensor(x)
+    tensors = [x]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+
+    def _f(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    return apply_op("rms_norm", _f, *tensors)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x._data.shape[ch_axis] if x.ndim > 1 else x._data.shape[0]
+
+    use_batch_stats = training and not use_global_stats
+
+    tensors = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    if use_batch_stats:
+        # Update running stats host-side (buffer mutation, like reference).
+        batch_mean = jnp.mean(x._data, axis=axes)
+        batch_var = jnp.var(x._data, axis=axes)
+        rm._data = momentum * rm._data + (1 - momentum) * batch_mean.astype(rm._data.dtype)
+        rv._data = momentum * rv._data + (1 - momentum) * batch_var.astype(rv._data.dtype)
+
+        def _f(a, *wb):
+            m = jnp.mean(a, axis=axes, keepdims=True)
+            v = jnp.var(a, axis=axes, keepdims=True)
+            out = (a - m) * jax.lax.rsqrt(v + epsilon)
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(bshape)
+                i += 1
+            if has_b:
+                out = out + wb[i].reshape(bshape)
+            return out
+
+        return apply_op("batch_norm", _f, *tensors)
+
+    mconst = rm._data.reshape(bshape)
+    vconst = rv._data.reshape(bshape)
+
+    def _f2(a, *wb):
+        out = (a - mconst) * jax.lax.rsqrt(vconst + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out
+
+    return apply_op("batch_norm", _f2, *tensors)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    tensors = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def _f(a, *wb):
+        if data_format != "NCHW":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[:2]
+        spatial = a.shape[2:]
+        g = a.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(n, c, *spatial)
+        bshape = (1, c) + (1,) * len(spatial)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        if data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op("group_norm", _f, *tensors)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    tensors = [x]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def _f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        c = a.shape[1]
+        bshape = (1, c) + (1,) * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out
+
+    return apply_op("instance_norm", _f, *tensors)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op(
+        "normalize",
+        lambda a: a / jnp.maximum(jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True), epsilon),
+        x,
+    )
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            (1, size, 1, 1), (1, 1, 1, 1),
+            [(0, 0), (half, size - 1 - half), (0, 0), (0, 0)],
+        )
+        return a / jnp.power(k + alpha * summed / size, beta)
+
+    return apply_op("local_response_norm", _f, x)
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+
+    def _f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_class = logits.shape[axis]
+        if soft_label:
+            target = lab
+            loss = -jnp.sum(target * logp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim and lab_i.shape[axis] == 1:
+                lab_i = jnp.squeeze(lab_i, axis)
+            onehot = jax.nn.one_hot(lab_i, n_class, dtype=logp.dtype, axis=axis)
+            if label_smoothing > 0.0:
+                onehot = onehot * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -jnp.sum(onehot * logp, axis=axis)
+            mask = (lab_i != ignore_index).astype(loss.dtype)
+            loss = loss * mask
+            if w:
+                wsel = jnp.take(w[0], jnp.clip(lab_i, 0, n_class - 1), axis=0)
+                loss = loss * wsel
+                if reduction == "mean":
+                    denom = jnp.sum(wsel * mask)
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.sum(mask)
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("cross_entropy", _f, *tensors)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    out = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                        reduction="none", axis=axis)
+    if return_softmax:
+        return out, softmax(logits, axis=axis)
+    return out
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _f(logp, lab):
+        lab_i = lab.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, lab_i[..., None] if logp.ndim > 1 else lab_i, axis=-1 if logp.ndim > 1 else 0)
+        loss = loss.squeeze(-1) if logp.ndim > 1 else loss
+        mask = (lab_i != ignore_index).astype(loss.dtype)
+        loss = loss * mask
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("nll_loss", _f, input, label)
+
+
+def mse_loss(input, label, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("mse_loss", lambda a, b: _reduce_loss(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply_op("l1_loss", lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("smooth_l1_loss", _f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+
+    def _f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("binary_cross_entropy", _f, *tensors)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None) -> Tensor:
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    tensors = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_pw:
+        tensors.append(ensure_tensor(pos_weight))
+
+    def _f(z, y, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        if pw is not None:
+            logw = (pw - 1) * y + 1
+            loss = (1 - y) * z + logw * jnp.logaddexp(0.0, -z)
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("bce_with_logits", _f, *tensors)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _f(logq, p):
+        if log_target:
+            loss = jnp.exp(p) * (p - logq)
+        else:
+            loss = p * (jnp.log(jnp.maximum(p, 1e-30)) - logq)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logq.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("kl_div", _f, input, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8) -> Tensor:
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def _f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_op("cosine_similarity", _f, x1, x2)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None) -> Tensor:
+    input, other, label = ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)
+
+    def _f(a, b, y):
+        return _reduce_loss(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return apply_op("margin_ranking_loss", _f, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None) -> Tensor:
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("hinge_embedding_loss", _f, input, label)
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None) -> Tensor:
+    """SDPA with [batch, seq, heads, head_dim] layout (reference:
+    python/paddle/nn/functional/flash_attention.py:248
+    scaled_dot_product_attention; CUDA flash_attn kernel
+    phi/kernels/gpu/flash_attn_kernel.cu). On TPU, XLA fuses this; the
+    Pallas flash kernel (paddle_tpu.pallas_kernels.flash_attention) is used
+    for long sequences via nn.functional.flash_attention."""
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    tensors = [q, k, v]
+    has_mask = attn_mask is not None
+    if has_mask:
+        tensors.append(ensure_tensor(attn_mask))
+
+    def _f(qq, kk, vv, *m):
+        scale = 1.0 / pymath.sqrt(qq.shape[-1])
+        # [b, s, h, d] -> [b, h, s, d]
+        qt = jnp.swapaxes(qq, 1, 2)
+        kt = jnp.swapaxes(kk, 1, 2)
+        vt = jnp.swapaxes(vv, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if is_causal:
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
+        if m:
+            mask = m[0]
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+            else:
+                scores = scores + mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(vt.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    out = apply_op("sdpa", _f, *tensors)
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def _f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patches.append(a[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0], j * d[1]: j * d[1] + ow * s[1]: s[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k0*k1, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply_op("unfold", _f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+        else:
+            n, h, w, c = a.shape
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        if size is not None:
+            oh, ow = _pair(size)
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+            oh, ow = int(h * sf[0]), int(w * sf[1])
+        method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "cubic", "area": "linear"}.get(mode, mode)
+        out = jax.image.resize(a, (a.shape[0], a.shape[1], oh, ow), method=method)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("interpolate", _f, x)
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def _f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op("pixel_shuffle", _f, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None) -> Tensor:
+    label = ensure_tensor(label)
+
+    def _f(y):
+        k = y.shape[-1]
+        return (1 - epsilon) * y + epsilon / k
+
+    return apply_op("label_smooth", _f, label)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..ops.manipulation import pad as _pad
+
+    return _pad(x, pad, mode, value, data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        out = jnp.zeros_like(a)
+        out = out.at[:, :-1, :fold].set(a[:, 1:, :fold])
+        out = out.at[:, 1:, fold:2 * fold].set(a[:, :-1, fold:2 * fold])
+        out = out.at[:, :, 2 * fold:].set(a[:, :, 2 * fold:])
+        return out.reshape(nt, c, h, w)
+
+    return apply_op("temporal_shift", _f, x)
+
+
+def linear_with_quant(*args, **kwargs):
+    raise NotImplementedError("quantized linear lands with the quantization subsystem")

@@ -1,0 +1,309 @@
+"""Layer: the module base class.
+
+Parity: python/paddle/nn/layer/layers.py:354 ``Layer`` — parameter/buffer
+/sublayer registries, state_dict/set_state_dict, train/eval, hooks, apply.
+TPU addition: ``named_parameters_dict``/``functional state`` accessors used
+by the jit/pjit paths to run layers functionally (params as pytree inputs),
+which is how GSPMD sees parameters as shardable arrays.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+        self._casted_by_pure_fp16 = False
+
+    # ------------------------------------------------------------------
+    # Registration
+    # ------------------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            if not isinstance(parameter, Parameter):
+                parameter = Parameter(parameter._data if isinstance(parameter, Tensor) else parameter)
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False, default_initializer=None):
+        from .initializer import Constant, XavierNormal
+
+        d = dtypes.convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            from .param_attr import ParamAttr
+
+            if isinstance(attr, ParamAttr):
+                init = attr.initializer or init
+                name = attr.name
+                trainable = attr.trainable
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierNormal()
+        data = init(tuple(int(s) for s in shape), d)
+        p = Parameter(data, trainable=trainable, name=name)
+        return p
+
+    # ------------------------------------------------------------------
+    # Attribute protocol
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            layers = self.__dict__.get("_sub_layers")
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                del params[name]
+            subs = self.__dict__.get("_sub_layers")
+            if subs is not None and name in subs:
+                del subs[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------------
+    # Iteration
+    # ------------------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else prefix + "." + name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = (prefix + "." + lname) if prefix else lname
+                for n, p in layer.named_parameters(prefix=sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def named_parameters_dict(self) -> Dict[str, Parameter]:
+        return dict(self.named_parameters())
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Tensor]]:
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (prefix + name if not prefix else prefix + "." + name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = (prefix + "." + lname) if prefix else lname
+                yield from layer.named_buffers(prefix=sub_prefix)
+
+    def named_buffers_dict(self) -> Dict[str, Tensor]:
+        return dict(self.named_buffers())
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for l in self.children():
+            out.extend(l.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if include_self:
+            yield prefix, self
+        for name, l in self.named_children():
+            sub_prefix = (prefix + "." + name) if prefix else name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------
+    # Modes / dtype movement
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.children():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.children():
+            l.eval()
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = dtypes.convert_dtype(dtype)
+            for p in self.parameters():
+                if dtypes.is_floating_point(p._data.dtype):
+                    p._data = p._data.astype(d)
+            for b in self.buffers():
+                if b is not None and dtypes.is_floating_point(b._data.dtype):
+                    b._data = b._data.astype(d)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # ------------------------------------------------------------------
+    # State dict
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="", use_hook=True):
+        out = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters():
+            out[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in self._non_persistable_buffer_names:
+                out[structured_name_prefix + name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                data = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                t._data = data.astype(t._data.dtype).reshape(t._data.shape)
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # Hooks / call
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        hid = self._hook_id
+        self._forward_pre_hooks[hid] = hook
+        return _HookRemover(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        hid = self._hook_id
+        self._forward_post_hooks[hid] = hook
+        return _HookRemover(self._forward_post_hooks, hid)
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            sub = repr(l).split("\n")
+            lines.append(f"({name}): " + ("\n  ".join(sub)))
+        body = ("\n  ".join([extra] if extra else []) + ("\n  " + "\n  ".join(lines) if lines else ""))
+        if body.strip():
+            return f"{type(self).__name__}(\n  {body}\n)"
+        return f"{type(self).__name__}()"
+
+    def full_name(self):
+        return type(self).__name__.lower()
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+class _HookRemover:
+    def __init__(self, store, hid):
+        self._store = store
+        self._hid = hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)

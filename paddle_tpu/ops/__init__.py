@@ -1,0 +1,207 @@
+"""Op namespace + Tensor method registration.
+
+Mirrors the reference's pattern of patching generated op methods onto the
+Tensor pytype (reference: python/paddle/tensor/__init__.py method
+registration; pybind eager_method.cc operator definitions).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor, OP_REGISTRY, register_op, set_amp_hook
+from .creation import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from . import creation, random, math, manipulation, logic, search
+
+
+def _norm_index(idx):
+    """Convert a Paddle-style index (Tensors allowed) to jnp-compatible index."""
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, (list,)):
+        return jnp.asarray(idx)
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    return idx
+
+
+def _getitem(self: Tensor, idx):
+    nidx = _norm_index(idx)
+    # Boolean-mask indexing yields dynamic shapes: eager host path.
+    def _has_bool(i):
+        if isinstance(i, tuple):
+            return builtins.any(_has_bool(v) for v in i)
+        return getattr(i, "dtype", None) == jnp.bool_ or isinstance(i, np.ndarray) and i.dtype == np.bool_
+
+    if _has_bool(nidx):
+        from .manipulation import masked_select
+
+        if not isinstance(nidx, tuple) and nidx.shape == self._data.shape:
+            return masked_select(self, Tensor(nidx))
+        data = np.asarray(self._data)[np.asarray(idx) if not isinstance(idx, tuple) else idx]
+        return Tensor(jnp.asarray(data))
+    return apply_op("getitem", lambda a: a[nidx], self)
+
+
+def _setitem(self: Tensor, idx, value):
+    nidx = _norm_index(idx)
+    if isinstance(value, Tensor):
+        out = apply_op("setitem", lambda a, v: a.at[nidx].set(v.astype(a.dtype)), self, value)
+    else:
+        v = jnp.asarray(value)
+        out = apply_op("setitem", lambda a: a.at[nidx].set(v.astype(a.dtype)), self)
+    self._replace_(out)
+    return self
+
+
+def _iter(self: Tensor):
+    for i in range(len(self)):
+        yield self[i]
+
+
+_BINOPS = {
+    "__add__": math.add,
+    "__radd__": lambda x, y: math.add(y, x),
+    "__sub__": math.subtract,
+    "__rsub__": lambda x, y: math.subtract(y, x),
+    "__mul__": math.multiply,
+    "__rmul__": lambda x, y: math.multiply(y, x),
+    "__truediv__": math.divide,
+    "__rtruediv__": lambda x, y: math.divide(y, x),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": lambda x, y: math.floor_divide(y, x),
+    "__mod__": math.mod,
+    "__rmod__": lambda x, y: math.mod(y, x),
+    "__pow__": math.pow,
+    "__rpow__": lambda x, y: math.pow(y, x),
+    "__matmul__": math.matmul,
+    "__rmatmul__": lambda x, y: math.matmul(y, x),
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__lshift__": logic.bitwise_left_shift,
+    "__rshift__": logic.bitwise_right_shift,
+}
+
+
+def _patch_tensor():
+    for name, fn in _BINOPS.items():
+        setattr(Tensor, name, fn)
+    Tensor.__neg__ = math.neg
+    Tensor.__abs__ = math.abs
+    Tensor.__invert__ = logic.bitwise_not
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__iter__ = _iter
+    Tensor.__hash__ = lambda self: id(self)
+
+    _methods = {
+        # math
+        "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+        "divide": math.divide, "floor_divide": math.floor_divide, "mod": math.mod,
+        "remainder": math.mod, "pow": math.pow, "matmul": math.matmul, "mm": math.mm,
+        "bmm": math.bmm, "dot": math.dot, "abs": math.abs, "neg": math.neg,
+        "sqrt": math.sqrt, "rsqrt": math.rsqrt, "square": math.square,
+        "reciprocal": math.reciprocal, "exp": math.exp, "log": math.log,
+        "log2": math.log2, "log10": math.log10, "log1p": math.log1p,
+        "sin": math.sin, "cos": math.cos, "tan": math.tan, "tanh": math.tanh,
+        "sigmoid": math.sigmoid, "erf": math.erf, "sign": math.sign,
+        "floor": math.floor, "ceil": math.ceil, "round": math.round, "trunc": math.trunc,
+        "clip": math.clip, "scale": math.scale, "maximum": math.maximum, "minimum": math.minimum,
+        "sum": math.sum, "mean": math.mean, "prod": math.prod, "max": math.max,
+        "min": math.min, "amax": math.amax, "amin": math.amin, "all": math.all, "any": math.any,
+        "std": math.std, "var": math.var, "median": math.median, "logsumexp": math.logsumexp,
+        "cumsum": math.cumsum, "cumprod": math.cumprod, "trace": math.trace,
+        "diagonal": math.diagonal, "inverse": math.inverse, "lerp": math.lerp,
+        "kron": math.kron, "outer": math.outer, "inner": math.inner, "cross": math.cross,
+        "atan2": math.atan2, "einsum": None,
+        # manipulation
+        "reshape": manipulation.reshape, "reshape_": manipulation.reshape_,
+        "flatten": manipulation.flatten, "transpose": manipulation.transpose,
+        "squeeze": manipulation.squeeze, "squeeze_": manipulation.squeeze_,
+        "unsqueeze": manipulation.unsqueeze, "unsqueeze_": manipulation.unsqueeze_,
+        "expand": manipulation.expand, "expand_as": manipulation.expand_as,
+        "broadcast_to": manipulation.broadcast_to, "tile": manipulation.tile,
+        "flip": manipulation.flip, "roll": manipulation.roll, "pad": manipulation.pad,
+        "gather": manipulation.gather, "gather_nd": manipulation.gather_nd,
+        "scatter": manipulation.scatter, "scatter_": manipulation.scatter_,
+        "scatter_nd_add": manipulation.scatter_nd_add,
+        "index_select": manipulation.index_select, "index_sample": manipulation.index_sample,
+        "index_add": manipulation.index_add, "index_put": manipulation.index_put,
+        "take_along_axis": manipulation.take_along_axis, "put_along_axis": manipulation.put_along_axis,
+        "masked_select": manipulation.masked_select, "masked_fill": manipulation.masked_fill,
+        "where": manipulation.where, "nonzero": manipulation.nonzero,
+        "unique": manipulation.unique, "split": manipulation.split, "chunk": manipulation.chunk,
+        "unstack": manipulation.unstack, "concat": None, "stack": None,
+        "repeat_interleave": manipulation.repeat_interleave,
+        "moveaxis": manipulation.moveaxis, "swapaxes": manipulation.swapaxes,
+        "view": manipulation.view, "view_as": manipulation.view_as,
+        "slice": manipulation.slice, "strided_slice": manipulation.strided_slice,
+        "fill_diagonal_": manipulation.fill_diagonal_, "tensor_split": manipulation.tensor_split,
+        # logic
+        "equal": logic.equal, "not_equal": logic.not_equal,
+        "greater_than": logic.greater_than, "greater_equal": logic.greater_equal,
+        "less_than": logic.less_than, "less_equal": logic.less_equal,
+        "logical_and": logic.logical_and, "logical_or": logic.logical_or,
+        "logical_not": logic.logical_not, "logical_xor": logic.logical_xor,
+        "bitwise_and": logic.bitwise_and, "bitwise_or": logic.bitwise_or,
+        "bitwise_not": logic.bitwise_not, "bitwise_xor": logic.bitwise_xor,
+        "equal_all": logic.equal_all, "allclose": logic.allclose, "isclose": logic.isclose,
+        "isnan": logic.isnan, "isinf": logic.isinf, "isfinite": logic.isfinite,
+        # search
+        "argmax": search.argmax, "argmin": search.argmin, "argsort": search.argsort,
+        "sort": search.sort, "topk": search.topk, "kthvalue": search.kthvalue,
+        "mode": search.mode, "searchsorted": None, "bucketize": search.bucketize,
+        # creation-ish
+        "tril": creation.tril, "triu": creation.triu, "diag": creation.diag,
+        "zero_": lambda self: self.set_value(jnp.zeros(self._data.shape, self._data.dtype)),
+        "fill_": lambda self, v: self.set_value(jnp.full(self._data.shape, v, self._data.dtype)),
+        # random inplace
+        "uniform_": random.uniform_, "normal_": random.normal_, "exponential_": random.exponential_,
+    }
+    for name, fn in _methods.items():
+        if fn is not None:
+            setattr(Tensor, name, fn)
+
+    # in-place arithmetic (rebind semantics)
+    def _make_inplace(op):
+        def f(self, y, name=None):
+            return self._replace_(op(self, y))
+
+        return f
+
+    for nm, op in (("add_", math.add), ("subtract_", math.subtract), ("multiply_", math.multiply),
+                   ("divide_", math.divide), ("remainder_", math.mod)):
+        setattr(Tensor, nm, _make_inplace(op))
+
+    Tensor.clip_ = lambda self, min=None, max=None, name=None: self._replace_(math.clip(self, min, max))
+    Tensor.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None: self._replace_(
+        math.scale(self, scale, bias, bias_after_scale))
+
+    def cast_(self, dtype):
+        from ..core.dtype import convert_dtype
+
+        self._data = self._data.astype(convert_dtype(dtype))
+        return self
+
+    Tensor.cast_ = cast_
+
+
+_patch_tensor()

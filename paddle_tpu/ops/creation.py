@@ -1,0 +1,164 @@
+"""Tensor creation ops.
+
+Parity: python/paddle/tensor/creation.py (to_tensor:796, zeros, ones, full,
+arange, linspace, eye, tril/triu, meshgrid, diag) over XLA arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    d = dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data if d is None else data._data.astype(d)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    arr = jnp.asarray(data, d)
+    if d is None and arr.dtype == jnp.float64:
+        arr = arr.astype(dtypes.get_default_dtype())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    return Tensor(jnp.zeros(_shape(shape), d))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    return Tensor(jnp.ones(_shape(shape), d))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._data
+    return Tensor(jnp.full(_shape(shape), fill_value, d))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.zeros(x._data.shape, d or x._data.dtype))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.ones(x._data.shape, d or x._data.dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.full(x._data.shape, fill_value, d or x._data.dtype))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype)
+    def _v(x):
+        return x._data.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    arr = jnp.arange(start, end, step, d)
+    if d is None and arr.dtype == jnp.float64:
+        arr = arr.astype(dtypes.get_default_dtype())
+    return Tensor(arr)
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    def _v(x):
+        return x._data.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=d))
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply_op("tril", lambda a: jnp.tril(a, diagonal), ensure_tensor(x))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply_op("triu", lambda a: jnp.triu(a, diagonal), ensure_tensor(x))
+
+
+def meshgrid(*args, **kwargs):
+    ts = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *ts)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, a.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return apply_op("diag", _diag, x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), ensure_tensor(x))
+
+
+def clone(x, name=None) -> Tensor:
+    return ensure_tensor(x).clone()
+
+
+def assign(x, output=None) -> Tensor:
+    x = ensure_tensor(x) if not isinstance(x, Tensor) else x
+    out = apply_op("assign", lambda a: a, x)
+    if output is not None:
+        output._replace_(out)
+        return output
+    return out
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x).size, jnp.int64))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    d = dtypes.convert_dtype(dtype)
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), d))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    d = dtypes.convert_dtype(dtype)
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), d))

@@ -1,0 +1,146 @@
+"""Eager op dispatch: the TPU analogue of the reference's generated ad_func path.
+
+Reference call stack being replaced (SURVEY §3.1): generated
+``matmul_ad_func`` (paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:316) → AMP autocast (fluid/eager/amp_auto_cast.h:23) →
+phi API kernel dispatch (phi/api/lib/kernel_dispatch.h:216) → grad-node
+creation (eager_gen.py:1096).
+
+TPU design: each op is a pure jax function. Dispatch =
+  1. AMP autocast hook (allow/block lists, like the reference's O1/O2),
+  2. ``jax.vjp`` when any input requires grad — the pullback IS the grad
+     node's kernel (XLA-traced, device-resident),
+  3. tape recording (GradNode/Edge),
+  4. optional NaN/Inf check (FLAGS_check_nan_inf parity).
+XLA/PJRT executes ops asynchronously, so dispatch returns immediately —
+the same async-enqueue property as the reference's stream model.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.autograd import Edge, GradNode, is_grad_enabled
+from ..core.flags import flag
+from ..core.tensor import Tensor
+
+# Set by paddle_tpu.amp at import; signature: (op_name, [jax arrays]) -> [jax arrays]
+_amp_cast_hook: Optional[Callable] = None
+
+# Op registry for introspection/testing (parity: phi/ops/yaml/ops.yaml registry role).
+OP_REGISTRY: dict = {}
+
+
+def register_op(name: str, **meta):
+    OP_REGISTRY[name] = meta
+
+
+def set_amp_hook(hook):
+    global _amp_cast_hook
+    _amp_cast_hook = hook
+
+
+def _check_finite(name: str, arrays):
+    for a in arrays:
+        if dtypes.is_floating_point(a.dtype):
+            if not bool(jnp.isfinite(a).all()):
+                if flag("check_nan_inf_level") >= 1:
+                    print(f"[check_nan_inf] WARNING: op {name} produced NaN/Inf")
+                else:
+                    raise FloatingPointError(f"op {name} produced NaN/Inf output")
+
+
+def _zeros_cotangent(shape, dtype):
+    if np.dtype(dtype) in (np.dtype(np.bool_),) or np.issubdtype(np.dtype(dtype), np.integer):
+        return np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)
+
+
+def apply_op(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = None):
+    """Execute ``fn(*arrays)`` with tape recording.
+
+    ``tensors`` are the Tensor inputs, positionally matching ``fn``'s args;
+    static attributes must be closed over in ``fn``. ``fn`` may return a
+    single array or a tuple of arrays.
+    """
+    datas = [t._data for t in tensors]
+
+    if _amp_cast_hook is not None:
+        datas = _amp_cast_hook(name, datas)
+
+    record = is_grad_enabled() and any(not t.stop_gradient for t in tensors)
+
+    if record:
+        sg_mask = [t.stop_gradient for t in tensors]
+
+        def wrapped(*xs):
+            xs = [jax.lax.stop_gradient(x) if sg else x for x, sg in zip(xs, sg_mask)]
+            return fn(*xs)
+
+        out_data, vjp_fn = jax.vjp(wrapped, *datas)
+    else:
+        out_data = fn(*datas)
+
+    multi = isinstance(out_data, (tuple, list))
+    outs_data = list(out_data) if multi else [out_data]
+
+    if flag("check_nan_inf"):
+        _check_finite(name, outs_data)
+
+    if not record:
+        outs = [Tensor(d, stop_gradient=True) for d in outs_data]
+        return outs if multi else outs[0]
+
+    edges: List[Edge] = []
+    for t in tensors:
+        if t.stop_gradient:
+            edges.append(Edge())
+        elif t._grad_node is not None:
+            edges.append(Edge(node=t._grad_node, slot=t._out_slot))
+        else:
+            edges.append(Edge(leaf=t))
+
+    out_specs = [(tuple(d.shape), d.dtype) for d in outs_data]
+
+    def vjp_with_zero_fill(cots):
+        # Replace int/bool-output cotangents with float0 zeros as jax.vjp requires.
+        if isinstance(cots, tuple):
+            cots = tuple(
+                c if dtypes.is_floating_point(spec[1]) or np.issubdtype(np.dtype(spec[1]), np.complexfloating)
+                else np.zeros(spec[0], jax.dtypes.float0)
+                for c, spec in zip(cots, out_specs)
+            )
+        return vjp_fn(cots)
+
+    node = GradNode(name, vjp_with_zero_fill, edges, out_specs)
+
+    outs = []
+    for i, d in enumerate(outs_data):
+        differentiable = dtypes.is_floating_point(d.dtype) or np.issubdtype(np.dtype(d.dtype), np.complexfloating)
+        t = Tensor(d, stop_gradient=not differentiable)
+        if differentiable:
+            t._grad_node = node
+            t._out_slot = i
+        outs.append(t)
+    return outs if multi else outs[0]
+
+
+def as_tensor_or_scalar(x):
+    """Normalize op operands: Tensors pass through; scalars/arrays stay raw
+    (closed over as constants so they don't enter the tape)."""
+    return x
+
+
+def ensure_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    arr = jnp.asarray(x, d)
+    if d is None and arr.dtype == jnp.float64:
+        arr = arr.astype(dtypes.get_default_dtype())
+    return Tensor(arr, stop_gradient=True)

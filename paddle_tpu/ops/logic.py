@@ -1,0 +1,91 @@
+"""Comparison / logical / bitwise ops.
+
+Parity: python/paddle/tensor/logic.py over XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+from .math import _promote
+
+
+def _cmp(name, jfn):
+    def op(x, y, name=None):
+        x, y = _promote(x, y)
+        return apply_op(name, jfn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, out=None, name=None) -> Tensor:
+    return apply_op("logical_not", jnp.logical_not, ensure_tensor(x))
+
+
+def bitwise_not(x, out=None, name=None) -> Tensor:
+    return apply_op("bitwise_not", jnp.bitwise_not, ensure_tensor(x))
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if x._data.shape != y._data.shape:
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def isnan(x, name=None) -> Tensor:
+    return apply_op("isnan", jnp.isnan, ensure_tensor(x))
+
+
+def isinf(x, name=None) -> Tensor:
+    return apply_op("isinf", jnp.isinf, ensure_tensor(x))
+
+
+def isfinite(x, name=None) -> Tensor:
+    return apply_op("isfinite", jnp.isfinite, ensure_tensor(x))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def in_dynamic_mode() -> bool:
+    from ..jit.api import in_to_static_mode
+
+    return not in_to_static_mode()

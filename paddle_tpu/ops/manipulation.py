@@ -1,0 +1,507 @@
+"""Shape/layout manipulation + indexing ops.
+
+Parity: python/paddle/tensor/manipulation.py (reshape, transpose, concat,
+split, stack, squeeze, gather, scatter, …) over XLA. Static shapes
+throughout — shape arguments are host ints so everything stays
+jit-compilable (XLA semantics: no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.asarray(seq._data))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(s._data.item()) if isinstance(s, Tensor) else int(s) for s in seq)
+
+
+def cast(x, dtype) -> Tensor:
+    return ensure_tensor(x).astype(dtype)
+
+
+def reshape(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shp = _ints(shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, shp), x)
+
+
+def reshape_(x, shape, name=None) -> Tensor:
+    return x._replace_(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shp = list(x._data.shape)
+    new = shp[:s] + [int(np.prod(shp[s : e + 1])) if shp else 1] + shp[e + 1 :]
+    return apply_op("flatten", lambda a: jnp.reshape(a, new), x)
+
+
+def transpose(x, perm, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    p = _ints(perm)
+    return apply_op("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+def t(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if x.ndim <= 1:
+        return apply_op("t", lambda a: a, x)
+    return apply_op("t", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def moveaxis(x, source, destination, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+transpose_ = None  # assigned below if needed
+
+
+def concat(x, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis._data.item())
+    return apply_op("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *ts)
+
+
+def stack(x, axis=0, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    return apply_op("stack", lambda *xs: jnp.stack(xs, axis=axis), *ts)
+
+
+def unstack(x, axis=0, num=None):
+    x = ensure_tensor(x)
+    n = num or x._data.shape[axis]
+    outs = apply_op("unstack", lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)), x)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis._data.item())
+    dim = x._data.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s._data.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            known = builtins.sum(s for s in sections if s >= 0)
+            sections[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sections)
+
+    def _f(a):
+        return tuple(jax.lax.slice_in_dim(a, int(offsets[i]), int(offsets[i + 1]), axis=axis) for i in range(len(sections)))
+
+    outs = apply_op("split", _f, x)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    outs = apply_op("tensor_split", lambda a: tuple(jnp.array_split(a, num_or_indices, axis=axis)), x)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def squeeze(x, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        ax = _ints(axis)
+        ax = tuple(a % builtins.max(x.ndim, 1) for a in ax if x._data.shape[a] == 1)
+
+    return apply_op("squeeze", lambda a: jnp.squeeze(a, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None) -> Tensor:
+    return x._replace_(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _ints(axis)
+    return apply_op("unsqueeze", lambda a: jnp.expand_dims(a, ax), x)
+
+
+def unsqueeze_(x, axis, name=None) -> Tensor:
+    return x._replace_(unsqueeze(x, axis))
+
+
+def expand(x, shape, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shp = list(_ints(shape))
+    xshape = list(x._data.shape)
+    # Paddle: -1 means keep dim
+    pad = len(shp) - len(xshape)
+    for i, s in enumerate(shp):
+        if s == -1 and i >= pad:
+            shp[i] = xshape[i - pad]
+    return apply_op("expand", lambda a: jnp.broadcast_to(a, tuple(shp)), x)
+
+
+def broadcast_to(x, shape, name=None) -> Tensor:
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None) -> Tensor:
+    y = ensure_tensor(y)
+    return expand(x, list(y._data.shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    outs = apply_op("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *ts)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def tile(x, repeat_times, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    reps = _ints(repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def flip(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _ints(axis)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = _ints(axis) if axis is not None and not isinstance(axis, int) else axis
+
+    def _f(a):
+        if ax is None:
+            return jnp.roll(a.reshape(-1), sh).reshape(a.shape)
+        return jnp.roll(a, sh, axis=ax)
+
+    return apply_op("roll", _f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    p = _ints(pad)
+
+    def _f(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # Paddle NCHW-style: pad applies to last len(p)//2 spatial dims,
+            # ordered (left, right, top, bottom, ...) from last dim backward.
+            width = [(0, 0)] * nd
+            nspatial = len(p) // 2
+            if data_format.endswith("C") and nd >= 3:  # NHWC / NDHWC
+                dims = list(range(1, 1 + nspatial))
+            else:
+                dims = list(range(nd - nspatial, nd))
+            for i, d in enumerate(dims):
+                width[d] = (p[2 * i], p[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=jnp.asarray(value, a.dtype))
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply_op("pad", _f, x)
+
+
+def gather(x, index, axis=0, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis._data.item())
+    return apply_op("gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def _f(a, idx):
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply_op("gather_nd", _f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None) -> Tensor:
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def _f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # Paddle overwrite=False: zero the rows then scatter-add
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply_op("scatter", _f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None) -> Tensor:
+    return x._replace_(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None) -> Tensor:
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def _f(a, i, u):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op("scatter_nd_add", _f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None) -> Tensor:
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shp = _ints(shape)
+
+    def _f(i, u):
+        return jnp.zeros(shp, u.dtype).at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op("scatter_nd", _f, index, updates)
+
+
+def index_select(x, index, axis=0, name=None) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply_op("index_select", lambda a, i: jnp.take(a, i.reshape(-1), axis=axis), x, index)
+
+
+def index_sample(x, index) -> Tensor:
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply_op("index_sample", lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None) -> Tensor:
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def _f(a, i, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[i.reshape(-1)].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op("index_add", _f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx_ts = [ensure_tensor(i) for i in indices]
+
+    def _f(a, v, *ix):
+        key = tuple(ix)
+        return a.at[key].add(v) if accumulate else a.at[key].set(v)
+
+    return apply_op("index_put", _f, x, value, *idx_ts)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True) -> Tensor:
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return apply_op("take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True) -> Tensor:
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def _f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if v.ndim < i.ndim or v.shape != i.shape else v
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        dims = list(range(a.ndim))
+        idx = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        idx[axis] = i
+        if reduce in ("add", "sum"):
+            return a.at[tuple(idx)].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[tuple(idx)].multiply(v)
+        if reduce == "amax":
+            return a.at[tuple(idx)].max(v)
+        if reduce == "amin":
+            return a.at[tuple(idx)].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return apply_op("put_along_axis", _f, arr, indices, values)
+
+
+def masked_select(x, mask, name=None) -> Tensor:
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # Dynamic output shape: host-side op (eager only), like reference CPU path.
+    data = np.asarray(x._data)[np.asarray(mask._data)]
+    return Tensor(jnp.asarray(data))
+
+
+def masked_fill(x, mask, value, name=None) -> Tensor:
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = value._data if isinstance(value, Tensor) else value
+    return apply_op("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None], jnp.int64)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(np.asarray(x._data), return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv, np.int64)))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.concatenate([idx, [len(arr)]]))
+            outs.append(Tensor(jnp.asarray(counts, np.int64)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        r = np.asarray(repeats._data)
+        data = np.repeat(np.asarray(x._data), r, axis=axis)
+        return Tensor(jnp.asarray(data))
+    return apply_op("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def as_real(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def as_complex(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def view(x, shape_or_dtype, name=None) -> Tensor:
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return ensure_tensor(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None) -> Tensor:
+    return reshape(x, list(ensure_tensor(other)._data.shape))
+
+
+def slice(input, axes, starts, ends) -> Tensor:
+    input = ensure_tensor(input)
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def _f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            dim = out.shape[ax]
+            s2 = builtins.max(s + dim, 0) if s < 0 else builtins.min(s, dim)
+            e2 = builtins.max(e + dim, 0) if e < 0 else builtins.min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+
+    return apply_op("slice", _f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    axes = _ints(axes)
+    starts, ends, strides = _ints(starts), _ints(ends), _ints(strides)
+
+    def _f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply_op("strided_slice", _f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else tuple(0 for _ in shp)
+
+    def _f(a):
+        return jax.lax.dynamic_slice(a, offs, shp)
+
+    return apply_op("crop", _f, x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    n = builtins.min(x._data.shape[0], x._data.shape[1])
+    idx = jnp.arange(n - builtins.max(offset, 0))
+    x._data = x._data.at[idx, idx + offset].set(jnp.asarray(value, x._data.dtype))
+    return x
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    return x._replace_(flatten(x, start_axis, stop_axis))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,426 @@
+"""Math ops: elementwise, reductions, matmul.
+
+Parity: python/paddle/tensor/math.py + phi kernels (phi/kernels/*.h elementwise
+/ reduce / matmul families). Every op lowers to one-or-few XLA HLO ops so the
+compiler can fuse; no hand scheduling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+
+def _promote(x, y):
+    """Tensor/scalar promotion: scalars keep tensor dtype (Paddle semantics);
+    tensor-tensor promotes via result_type."""
+    xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+    if xt and yt:
+        if x._data.dtype != y._data.dtype:
+            rd = jnp.promote_types(x._data.dtype, y._data.dtype)
+            x = x.astype(rd) if x._data.dtype != rd else x
+            y = y.astype(rd) if y._data.dtype != rd else y
+        return x, y
+    if xt:
+        if isinstance(y, (bool, int, float, complex)) or np.isscalar(y):
+            if isinstance(y, float) and not dtypes.is_floating_point(x._data.dtype):
+                x = x.astype(dtypes.get_default_dtype())
+            return x, ensure_tensor(jnp.asarray(y, x._data.dtype if not isinstance(y, complex) else None))
+        return x, ensure_tensor(y)
+    if yt:
+        if isinstance(x, (bool, int, float, complex)) or np.isscalar(x):
+            if isinstance(x, float) and not dtypes.is_floating_point(y._data.dtype):
+                y = y.astype(dtypes.get_default_dtype())
+            return ensure_tensor(jnp.asarray(x, y._data.dtype if not isinstance(x, complex) else None)), y
+        return ensure_tensor(x), y
+    return ensure_tensor(x), ensure_tensor(y)
+
+
+def _binop(name, jfn):
+    def op(x, y, name=None):
+        x, y = _promote(x, y)
+        return apply_op(name, jfn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+def _unop(name, jfn, float_only=False):
+    def op(x, name=None):
+        x = ensure_tensor(x)
+        if float_only and not dtypes.is_floating_point(x._data.dtype):
+            x = x.astype(dtypes.get_default_dtype())
+        return apply_op(name, jfn, x)
+
+    op.__name__ = name
+    return op
+
+
+# -- elementwise binary ------------------------------------------------------
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", lambda a, b: jnp.divide(a, b) if dtypes.is_floating_point(jnp.result_type(a, b)) or jnp.issubdtype(jnp.result_type(a, b), jnp.complexfloating) else jnp.true_divide(a, b).astype(dtypes.get_default_dtype()))
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+mod = _binop("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", jnp.hypot)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+ldexp = _binop("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
+
+# -- elementwise unary -------------------------------------------------------
+abs = _unop("abs", jnp.abs)
+neg = _unop("neg", jnp.negative)
+negative = neg
+sign = _unop("sign", jnp.sign)
+sqrt = _unop("sqrt", jnp.sqrt, float_only=True)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt, float_only=True)
+square = _unop("square", jnp.square)
+reciprocal = _unop("reciprocal", jnp.reciprocal, float_only=True)
+exp = _unop("exp", jnp.exp, float_only=True)
+expm1 = _unop("expm1", jnp.expm1, float_only=True)
+log = _unop("log", jnp.log, float_only=True)
+log2 = _unop("log2", jnp.log2, float_only=True)
+log10 = _unop("log10", jnp.log10, float_only=True)
+log1p = _unop("log1p", jnp.log1p, float_only=True)
+sin = _unop("sin", jnp.sin, float_only=True)
+cos = _unop("cos", jnp.cos, float_only=True)
+tan = _unop("tan", jnp.tan, float_only=True)
+asin = _unop("asin", jnp.arcsin, float_only=True)
+acos = _unop("acos", jnp.arccos, float_only=True)
+atan = _unop("atan", jnp.arctan, float_only=True)
+sinh = _unop("sinh", jnp.sinh, float_only=True)
+cosh = _unop("cosh", jnp.cosh, float_only=True)
+tanh = _unop("tanh", jnp.tanh, float_only=True)
+asinh = _unop("asinh", jnp.arcsinh, float_only=True)
+acosh = _unop("acosh", jnp.arccosh, float_only=True)
+atanh = _unop("atanh", jnp.arctanh, float_only=True)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda a: a - jnp.trunc(a))
+erf = _unop("erf", jax.scipy.special.erf, float_only=True)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv, float_only=True)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid, float_only=True)
+logit = _unop("logit", lambda a: jnp.log(a / (1 - a)), float_only=True)
+digamma = _unop("digamma", jax.scipy.special.digamma, float_only=True)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln, float_only=True)
+i0 = _unop("i0", lambda a: jax.scipy.special.i0(a), float_only=True)
+i1 = _unop("i1", lambda a: jax.scipy.special.i1(a), float_only=True)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad, float_only=True)
+rad2deg = _unop("rad2deg", jnp.rad2deg, float_only=True)
+exp2 = _unop("exp2", jnp.exp2, float_only=True)
+
+
+def clip(x, min=None, max=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    s = scale._data if isinstance(scale, Tensor) else scale
+
+    def _f(a):
+        out = a * jnp.asarray(s, a.dtype) + jnp.asarray(bias, a.dtype) if bias_after_scale else (a + jnp.asarray(bias, a.dtype)) * jnp.asarray(s, a.dtype)
+        return out
+
+    return apply_op("scale", _f, x)
+
+
+def lerp(x, y, weight, name=None) -> Tensor:
+    x, y = _promote(x, y)
+    w = weight._data if isinstance(weight, Tensor) else weight
+    return apply_op("lerp", lambda a, b: a + w * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None) -> Tensor:
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), ensure_tensor(x))
+
+
+def multiplex(inputs, index, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def _f(ix, *xs):
+        stacked = jnp.stack(xs, 0)
+        return jnp.take_along_axis(stacked, ix.reshape(1, -1, *([1] * (xs[0].ndim - 1))), axis=0)[0]
+
+    return apply_op("multiplex", _f, idx, *ts)
+
+
+# -- reductions --------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._data)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, jfn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = ensure_tensor(x)
+        ax = _axis(axis)
+
+        def _f(a):
+            out = jfn(a, axis=ax, keepdims=keepdim)
+            return out
+
+        return apply_op(name, _f, x)
+
+    op.__name__ = name
+    return op
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    d = dtypes.convert_dtype(dtype)
+
+    def _f(a):
+        if a.dtype == jnp.bool_:
+            a = a.astype(jnp.int64)
+        return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=d)
+
+    return apply_op("sum", _f, x)
+
+
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+all = _reduce("all", jnp.all)
+any = _reduce("any", jnp.any)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    return apply_op("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    return apply_op("std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    return apply_op("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    return apply_op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    return apply_op("nanmean", lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    d = dtypes.convert_dtype(dtype)
+    return apply_op("nansum", lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim, dtype=d), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+
+    def _f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+
+    return apply_op("cumsum", _f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    xd = x._data.reshape(-1) if axis is None else x._data
+
+    def _f(a):
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+        return vals
+
+    vals = apply_op("cummax", _f, Tensor(xd, stop_gradient=x.stop_gradient) if axis is None else x)
+    inds = _running_argext(xd, ax, jnp.greater_equal)
+    return vals, Tensor(inds.astype(dtypes.convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    xd = x._data.reshape(-1) if axis is None else x._data
+    vals = apply_op("cummin", lambda a: jax.lax.associative_scan(jnp.minimum, a, axis=ax),
+                    Tensor(xd, stop_gradient=x.stop_gradient) if axis is None else x)
+    inds = _running_argext(xd, ax, jnp.less_equal)
+    return vals, Tensor(inds.astype(dtypes.convert_dtype(dtype)))
+
+
+def _running_argext(a, ax, cmp):
+    n = a.shape[ax]
+    ar = jnp.moveaxis(a, ax, -1)
+    best, besti = ar[..., 0], jnp.zeros(ar.shape[:-1], jnp.int64)
+    outs = [besti]
+    for i in range(1, n):
+        x = ar[..., i]
+        take = cmp(x, best)
+        best = jnp.where(take, x, best)
+        besti = jnp.where(take, jnp.asarray(i, jnp.int64), besti)
+        outs.append(besti)
+    return jnp.moveaxis(jnp.stack(outs, -1), -1, ax)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    ax = _axis(axis)
+    return Tensor(jnp.count_nonzero(x._data, axis=ax, keepdims=keepdim).astype(jnp.int64))
+
+
+# -- matmul family -----------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _f(a, b):
+        if transpose_x and a.ndim >= 2:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y and b.ndim >= 2:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", _f, x, y)
+
+
+def mm(x, y, name=None) -> Tensor:
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None) -> Tensor:
+    return matmul(x, y)
+
+
+def dot(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def inner(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y)
+
+
+def mv(x, vec, name=None) -> Tensor:
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None) -> Tensor:
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+def einsum(equation, *operands) -> Tensor:
+    ts = [ensure_tensor(o) for o in operands]
+    return apply_op("einsum", lambda *xs: jnp.einsum(equation, *xs), *ts)
+
+
+def kron(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("kron", jnp.kron, x, y)
+
+
+def cross(x, y, axis=9, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else None
+    if ax is None:
+        # find first dim of size 3 (Paddle semantics)
+        for i, s in enumerate(x._data.shape):
+            if s == 3:
+                ax = i
+                break
+    return apply_op("cross", lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    p = prepend._data if isinstance(prepend, Tensor) else prepend
+    ap = append._data if isinstance(append, Tensor) else append
+    return apply_op("diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap), x)
+
+
+def inverse(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("inverse", jnp.linalg.inv, x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None) -> Tensor:
+    x._data = x._data + jnp.asarray(value, x._data.dtype)
+    return x

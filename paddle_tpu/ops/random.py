@@ -1,0 +1,148 @@
+"""Random ops with a global generator.
+
+Parity: python/paddle/tensor/random.py + phi/core/generator.h (global RNG
+state). TPU design: a single root ``jax.random`` key, split per call —
+deterministic under ``seed()`` like the reference's Generator, and usable
+inside jit via explicit key threading (``split_key``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import ensure_tensor
+
+_lock = threading.Lock()
+_KEY = [jax.random.key(0)]
+
+
+def seed(s: int):
+    with _lock:
+        _KEY[0] = jax.random.key(int(s))
+    return None
+
+
+def split_key():
+    """Pop a fresh subkey from the global generator (host-side state update)."""
+    with _lock:
+        _KEY[0], sub = jax.random.split(_KEY[0])
+    return sub
+
+
+def get_rng_state():
+    return [jax.random.key_data(_KEY[0])]
+
+
+def set_rng_state(state):
+    with _lock:
+        _KEY[0] = jax.random.wrap_key_data(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    from .creation import _shape
+
+    return Tensor(jax.random.uniform(split_key(), _shape(shape), d))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    from .creation import _shape
+
+    return Tensor(jax.random.normal(split_key(), _shape(shape), d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    from .creation import _shape
+
+    return Tensor(jax.random.uniform(split_key(), _shape(shape), d, minval=min, maxval=max))
+
+
+def uniform_(x: Tensor, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x._data = jax.random.uniform(split_key(), x._data.shape, x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    from .creation import _shape
+
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(split_key(), shp, dtypes.get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(split_key(), shp, dtypes.get_default_dtype()) * std + mean)
+
+
+def normal_(x: Tensor, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._data = jax.random.normal(split_key(), x._data.shape, x._data.dtype) * std + mean
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    from .creation import _shape
+
+    return Tensor(jax.random.normal(split_key(), _shape(shape), d) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype)
+    from .creation import _shape
+
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(split_key(), _shape(shape), low, high, d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype) or x._data.dtype
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(split_key(), x._data.shape, low, high, d))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jax.random.permutation(split_key(), n).astype(d))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(split_key(), x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(split_key(), logits, axis=-1, shape=(*logits.shape[:-1], num_samples))
+    else:
+        k = split_key()
+        z = jax.random.gumbel(k, logits.shape, logits.dtype) + logits
+        _, out = jax.lax.top_k(z, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def shuffle(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.permutation(split_key(), x._data, axis=0))
+
+
+def poisson(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(split_key(), x._data).astype(x._data.dtype))
+
+
+def exponential_(x: Tensor, lam=1.0, name=None) -> Tensor:
+    x._data = jax.random.exponential(split_key(), x._data.shape, x._data.dtype) / lam
+    return x

@@ -1,0 +1,130 @@
+"""Search / sort ops.
+
+Parity: python/paddle/tensor/search.py (argmax, argsort, topk, sort,
+searchsorted, kthvalue, mode) over XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.argmax(x._data, axis=axis, keepdims=keepdim).astype(d))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = ensure_tensor(x)
+    d = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.argmin(x._data, axis=axis, keepdims=keepdim).astype(d))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    idx = jnp.argsort(x._data, axis=axis, stable=stable, descending=descending)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return apply_op("sort", _f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k._data.item())
+    ax = -1 if axis is None else axis
+
+    def _f(a):
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(am, k)
+        else:
+            vals, idx = jax.lax.top_k(-am, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply_op("topk", _f, x)
+    return vals, Tensor(idx._data.astype(jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None) -> Tensor:
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def _f(s, val):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, val, side=side)
+        else:
+            flat_s = s.reshape(-1, s.shape[-1])
+            flat_v = val.reshape(-1, val.shape[-1])
+            out = jnp.stack([jnp.searchsorted(flat_s[i], flat_v[i], side=side) for i in range(flat_s.shape[0])])
+            out = out.reshape(val.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return Tensor(_f(ss._data, v._data))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def _f(a):
+        am = jnp.moveaxis(a, axis, -1)
+        vals, idx = jax.lax.top_k(-am, k)
+        v = -vals[..., -1]
+        i = idx[..., -1]
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+
+    vals, idx = apply_op("kthvalue", _f, x)
+    return vals, Tensor(idx._data.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    arr_m = np.moveaxis(arr, axis, -1)
+    flat = arr_m.reshape(-1, arr_m.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shp = arr_m.shape[:-1]
+    v = vals.reshape(shp)
+    ix = idxs.reshape(shp)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        ix = np.expand_dims(ix, axis)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(ix))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None) -> Tensor:
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None) -> Tensor:
+    input = ensure_tensor(input)
+    arr = np.asarray(input._data)
+    lo, hi = (float(arr.min()), float(arr.max())) if min == 0 and max == 0 else (min, max)
+    w = np.asarray(weight._data) if weight is not None else None
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None else hist.astype(np.int64)))

@@ -1,0 +1,423 @@
+"""Optimizers.
+
+Parity: python/paddle/optimizer/ (optimizer.py Optimizer base, sgd.py,
+momentum.py, adam.py, adamw.py, adagrad.py, adadelta.py, rmsprop.py,
+adamax.py, lamb.py). TPU design: each parameter's update is a jitted pure
+function over (param, grad, state) arrays — XLA fuses the whole update
+chain; state lives as device arrays keyed per-parameter, which maps
+directly onto optimizer-state sharding for ZeRO (distributed/sharding).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided in eager mode (parity: dygraph optimizer)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay-like object
+            self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._step_count = 0
+
+    # -- lr --
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state --
+    def _acc(self, name: str, p: Parameter, init=jnp.zeros_like) -> jax.Array:
+        store = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in store:
+            store[key] = init(p._data)
+        return store[key]
+
+    def _set_acc(self, name: str, p: Parameter, value):
+        self._accumulators[name][id(p)] = value
+
+    def state_dict(self):
+        out = {}
+        for name, store in self._accumulators.items():
+            for p in self._parameter_list:
+                if id(p) in store:
+                    out[f"{p.name}_{name}"] = Tensor(store[id(p)])
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        for name, store in list(self._accumulators.items()):
+            store.clear()
+        for p in self._parameter_list:
+            for name in self._known_accumulators():
+                k = f"{p.name}_{name}"
+                if k in state:
+                    v = state[k]
+                    self._accumulators.setdefault(name, {})[id(p)] = (
+                        v._data if isinstance(v, Tensor) else jnp.asarray(v))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+    def _known_accumulators(self) -> Sequence[str]:
+        return list(self._accumulators.keys()) or ["moment", "moment1", "moment2", "velocity", "avg_squared"]
+
+    # -- step --
+    def _collect_params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            g = p.grad
+            pg.append((p, g))
+        return pg
+
+    @no_grad()
+    def step(self):
+        pg = self._collect_params_grads()
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        self._step_count += 1
+        for p, g in pg:
+            if g is None:
+                continue
+            self._update_param(p, g._data)
+
+    def _update_param(self, p: Parameter, g: jax.Array):
+        raise NotImplementedError
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def _apply_decay(self, p, g):
+        """Coupled L2 (SGD/Momentum/Adam semantics of `weight_decay` regularizer)."""
+        if self._weight_decay:
+            return g + self._weight_decay * p._data.astype(g.dtype)
+        return g
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_update(param, grad, lr):
+    return param - lr.astype(param.dtype) * grad.astype(param.dtype)
+
+
+class SGD(Optimizer):
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        p._data = _sgd_update(p._data, g, jnp.asarray(self.get_lr(), jnp.float32))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2), static_argnums=(4, 5))
+def _momentum_update(param, grad, velocity, lr, mu, use_nesterov):
+    g = grad.astype(param.dtype)
+    v = mu * velocity + g
+    if use_nesterov:
+        new_p = param - lr.astype(param.dtype) * (g + mu * v)
+    else:
+        new_p = param - lr.astype(param.dtype) * v
+    return new_p, v
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        v = self._acc("velocity", p)
+        p._data, v = _momentum_update(p._data, g, v, jnp.asarray(self.get_lr(), jnp.float32),
+                                      self._momentum, self._use_nesterov)
+        self._set_acc("velocity", p, v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adam_update(param, grad, m, v, lr, beta1, beta2, eps, t):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1**t)
+    vhat = v / (1 - beta2**t)
+    new_p = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return new_p.astype(param.dtype), m, v
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _f32_zeros(self, x):
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        m = self._acc("moment1", p, self._f32_zeros)
+        v = self._acc("moment2", p, self._f32_zeros)
+        p._data, m, v = _adam_update(
+            p._data, g, m, v,
+            jnp.asarray(self.get_lr(), jnp.float32),
+            jnp.asarray(self._beta1, jnp.float32), jnp.asarray(self._beta2, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32), jnp.asarray(self._step_count, jnp.float32))
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adamw_update(param, grad, m, v, lr, beta1, beta2, eps, t, wd, lr_ratio):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    p32 = p32 * (1 - lr * lr_ratio * wd)  # decoupled decay
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1**t)
+    vhat = v / (1 - beta2**t)
+    new_p = p32 - lr * lr_ratio * mhat / (jnp.sqrt(vhat) + eps)
+    return new_p.astype(param.dtype), m, v
+
+
+class AdamW(Optimizer):
+    """Parity: python/paddle/optimizer/adamw.py (decoupled weight decay,
+    apply_decay_param_fun filter, lr_ratio)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else float(getattr(weight_decay, "_coeff", 0.01))
+        self._lr_ratio = lr_ratio
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _f32_zeros(self, x):
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def _update_param(self, p, g):
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        lr_ratio = 1.0 if self._lr_ratio is None else float(self._lr_ratio(p))
+        m = self._acc("moment1", p, self._f32_zeros)
+        v = self._acc("moment2", p, self._f32_zeros)
+        p._data, m, v = _adamw_update(
+            p._data, g, m, v,
+            jnp.asarray(self.get_lr(), jnp.float32),
+            jnp.asarray(self._beta1, jnp.float32), jnp.asarray(self._beta2, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32), jnp.asarray(self._step_count, jnp.float32),
+            jnp.asarray(wd, jnp.float32), jnp.asarray(lr_ratio, jnp.float32))
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _adagrad_update(param, grad, moment, lr, eps):
+    g = grad.astype(jnp.float32)
+    moment = moment + jnp.square(g)
+    new_p = param.astype(jnp.float32) - lr * g / (jnp.sqrt(moment) + eps)
+    return new_p.astype(param.dtype), moment
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        mom = self._acc("moment", p, lambda x: jnp.full(x.shape, self._init_val, jnp.float32))
+        p._data, mom = _adagrad_update(p._data, g, mom, jnp.asarray(self.get_lr(), jnp.float32),
+                                       jnp.asarray(self._epsilon, jnp.float32))
+        self._set_acc("moment", p, mom)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _rmsprop_update(param, grad, mean_sq, lr, rho, eps, centered, mean_g, momentum, velocity):
+    g = grad.astype(jnp.float32)
+    mean_sq = rho * mean_sq + (1 - rho) * jnp.square(g)
+    denom = mean_sq
+    mean_g = rho * mean_g + (1 - rho) * g
+    denom = jnp.where(centered, mean_sq - jnp.square(mean_g), mean_sq)
+    v = momentum * velocity + lr * g / jnp.sqrt(denom + eps)
+    new_p = param.astype(jnp.float32) - v
+    return new_p.astype(param.dtype), mean_sq, mean_g, v
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _f32_zeros(self, x):
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        ms = self._acc("mean_square", p, self._f32_zeros)
+        mg = self._acc("mean_grad", p, self._f32_zeros)
+        v = self._acc("velocity", p, self._f32_zeros)
+        p._data, ms, mg, v = _rmsprop_update(
+            p._data, g, ms, jnp.asarray(self.get_lr(), jnp.float32),
+            jnp.asarray(self._rho, jnp.float32), jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(self._centered), mg, jnp.asarray(self._momentum, jnp.float32), v)
+        self._set_acc("mean_square", p, ms)
+        self._set_acc("mean_grad", p, mg)
+        self._set_acc("velocity", p, v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adadelta_update(param, grad, avg_sq_grad, avg_sq_update, rho, eps):
+    g = grad.astype(jnp.float32)
+    avg_sq_grad = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = jnp.sqrt(avg_sq_update + eps) / jnp.sqrt(avg_sq_grad + eps) * g
+    avg_sq_update = rho * avg_sq_update + (1 - rho) * jnp.square(update)
+    new_p = param.astype(jnp.float32) - update
+    return new_p.astype(param.dtype), avg_sq_grad, avg_sq_update
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+
+    def _f32_zeros(self, x):
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        asg = self._acc("avg_squared_grad", p, self._f32_zeros)
+        asu = self._acc("avg_squared_update", p, self._f32_zeros)
+        p._data, asg, asu = _adadelta_update(p._data, g, asg, asu,
+                                             jnp.asarray(self._rho, jnp.float32),
+                                             jnp.asarray(self._epsilon, jnp.float32))
+        self._set_acc("avg_squared_grad", p, asg)
+        self._set_acc("avg_squared_update", p, asu)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adamax_update(param, grad, m, inf_norm, lr, beta1, beta2, eps, t):
+    g = grad.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    inf_norm = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    new_p = param.astype(jnp.float32) - (lr / (1 - beta1**t)) * m / (inf_norm + eps)
+    return new_p.astype(param.dtype), m, inf_norm
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _f32_zeros(self, x):
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g)
+        m = self._acc("moment", p, self._f32_zeros)
+        inf = self._acc("inf_norm", p, self._f32_zeros)
+        p._data, m, inf = _adamax_update(p._data, g, m, inf,
+                                         jnp.asarray(self.get_lr(), jnp.float32),
+                                         jnp.asarray(self._beta1, jnp.float32),
+                                         jnp.asarray(self._beta2, jnp.float32),
+                                         jnp.asarray(self._epsilon, jnp.float32),
+                                         jnp.asarray(self._step_count, jnp.float32))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, inf)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _lamb_update(param, grad, m, v, lr, beta1, beta2, eps, t, wd):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1**t)
+    vhat = v / (1 - beta2**t)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    new_p = p32 - lr * ratio * r
+    return new_p.astype(param.dtype), m, v
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _f32_zeros(self, x):
+        return jnp.zeros(x.shape, jnp.float32)
+
+    def _update_param(self, p, g):
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m = self._acc("moment1", p, self._f32_zeros)
+        v = self._acc("moment2", p, self._f32_zeros)
+        p._data, m, v = _lamb_update(p._data, g, m, v,
+                                     jnp.asarray(self.get_lr(), jnp.float32),
+                                     jnp.asarray(self._beta1, jnp.float32),
+                                     jnp.asarray(self._beta2, jnp.float32),
+                                     jnp.asarray(self._epsilon, jnp.float32),
+                                     jnp.asarray(self._step_count, jnp.float32),
+                                     jnp.asarray(wd, jnp.float32))
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)

@@ -1,0 +1,48 @@
+"""Functional execution of Layers: run a Layer with externally supplied
+parameter/buffer arrays.
+
+This is the bridge between the Paddle-shaped object API (mutable Layer
+holding Parameters) and JAX's functional world (params as pytree inputs to
+jit/pjit/grad). The static-graph reference equivalent is the
+ProgramDesc/PIR partial program holding parameters as graph inputs
+(reference: jit/dy2static/pir_partial_program.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+@contextlib.contextmanager
+def _swapped_state(layer, state: Dict[str, Any]):
+    """Temporarily replace Parameter/buffer payloads with the given arrays."""
+    own = {}
+    for name, t in layer.state_dict().items():
+        own[name] = t
+    saved = {}
+    try:
+        for name, value in state.items():
+            if name in own:
+                t = own[name]
+                saved[name] = t._data
+                t._data = value._data if isinstance(value, Tensor) else value
+        yield
+    finally:
+        for name, data in saved.items():
+            own[name]._data = data
+
+
+def functional_call(layer, state: Dict[str, Any], *args, **kwargs):
+    """Call ``layer(*args)`` with its parameters/buffers replaced by
+    ``state`` (arrays or Tensors). Used by to_static and pjit train steps."""
+    with _swapped_state(layer, state):
+        return layer(*args, **kwargs)
+
+
+def tree_arrays(state: Dict[str, Tensor]):
+    return {k: (v._data if isinstance(v, Tensor) else v) for k, v in state.items()}

@@ -1,0 +1,127 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/).
+
+Real dataset downloads need network; in this zero-egress environment the
+loaders read local files when present (MNIST idx / cifar pickle formats,
+same file formats as the reference) and otherwise raise with instructions.
+``FakeData`` generates synthetic samples for pipelines and benchmarks
+(reference analogue: paddle.vision datasets used in tests with small
+slices).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic dataset with a fixed seed (deterministic)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10, transform=None,
+                 dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+        self._rng = np.random.RandomState(42)
+        self._labels = self._rng.randint(0, num_classes, size)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(self._labels[idx])
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """Reads the standard idx-ubyte files (same format as reference's
+    python/paddle/vision/datasets/mnist.py expects)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=False, backend=None, root=None):
+        self.transform = transform
+        root = root or os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/mnist"))
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(root, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(root, f"{prefix}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"MNIST files not found under {root}; place idx-ubyte(.gz) files there "
+                "(no network access in this environment), or use vision.datasets.FakeData")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, 1, rows, cols)
+        return data.astype(np.float32) / 255.0
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None):
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu")),
+            "cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found (no network access); use vision.datasets.FakeData")
+        names = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
+        xs, ys = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32).astype(np.float32) / 255.0
+        self.labels = np.asarray(ys, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass

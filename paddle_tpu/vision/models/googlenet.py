@@ -1,0 +1,72 @@
+"""GoogLeNet / Inception v1 (parity: python/paddle/vision/models/googlenet.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_channels, ch1x1, ch3x3red, ch3x3, ch5x5red, ch5x5, pool_proj):
+        super().__init__()
+        self.branch1 = nn.Sequential(nn.Conv2D(in_channels, ch1x1, 1), nn.ReLU())
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_channels, ch3x3red, 1), nn.ReLU(),
+            nn.Conv2D(ch3x3red, ch3x3, 3, padding=1), nn.ReLU())
+        self.branch3 = nn.Sequential(
+            nn.Conv2D(in_channels, ch5x5red, 1), nn.ReLU(),
+            nn.Conv2D(ch5x5red, ch5x5, 5, padding=2), nn.ReLU())
+        self.branch4 = nn.Sequential(
+            nn.MaxPool2D(3, stride=1, padding=1),
+            nn.Conv2D(in_channels, pool_proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x), self.branch4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU())
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.conv2 = nn.Sequential(nn.Conv2D(64, 64, 1), nn.ReLU())
+        self.conv3 = nn.Sequential(nn.Conv2D(64, 192, 3, padding=1), nn.ReLU())
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inception3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inception3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inception4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inception4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inception4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inception4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inception4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool5 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inception5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inception5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool3(self.conv3(self.conv2(x)))
+        x = self.pool4(self.inception3b(self.inception3a(x)))
+        x = self.inception4e(self.inception4d(self.inception4c(self.inception4b(self.inception4a(x)))))
+        x = self.pool5(x)
+        x = self.inception5b(self.inception5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("load pretrained weights via set_state_dict")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,20 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of running all distributed tests
+multi-process on one host (SURVEY §4): here, multi-chip is simulated with
+8 XLA:CPU devices, so sharding/collective logic is exercised without TPU
+hardware. Must run before any jax array is created.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+# The axon TPU plugin pins jax_platforms; force CPU for unit tests.
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: XLA_FLAGS above covers it

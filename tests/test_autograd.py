@@ -1,0 +1,163 @@
+"""Autograd engine tests (reference patterns: test/legacy_test/
+test_imperative_basic.py, test_custom_grad_*, py_layer tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+
+def test_branching_accumulation():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    a = x * 2
+    b = x * 5
+    y = a + b  # dy/dx = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+
+def test_grad_accumulate_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0], rtol=1e-6)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([4.0], stop_gradient=True)
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * 2).detach() * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * x
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_non_scalar_backward_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = paddle.to_tensor([4.0], stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad([z], [x, y])
+    np.testing.assert_allclose(gx.numpy(), [24.0])
+    np.testing.assert_allclose(gy.numpy(), [9.0])
+    # leaf .grad not polluted by paddle.grad
+    assert x.grad is None
+
+
+def test_hook():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = parts[0].sum() + (parts[2] * 2).sum()
+    loss.backward()
+    expected = np.array([[1, 0, 2], [1, 0, 2]], np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2 + x * 0
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    (y * 5).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_backward_through_nn():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(42)
+    layer = nn.Linear(3, 2)
+    x = paddle.to_tensor(np.ones((4, 3), np.float32), stop_gradient=False)
+    out = layer(x).sum()
+    out.backward()
+    assert layer.weight.grad is not None
+    assert layer.bias.grad is not None
+    np.testing.assert_allclose(layer.bias.grad.numpy(), [4.0, 4.0])
+    np.testing.assert_allclose(layer.weight.grad.numpy(), np.full((3, 2), 4.0))
+
+
+def test_inplace_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])

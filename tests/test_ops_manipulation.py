@@ -1,0 +1,196 @@
+"""Op tests: shape manipulation + indexing (reference:
+test/legacy_test/test_reshape_op.py, test_concat_op.py, test_gather_op.py...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from optest import check_grad, check_output
+
+RNG = np.random.RandomState(1)
+
+
+def a(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+class TestShape:
+    def test_reshape(self):
+        check_output(lambda x: paddle.reshape(x, [2, 6]), lambda v: v.reshape(2, 6), [a(3, 4)])
+        check_output(lambda x: paddle.reshape(x, [-1]), lambda v: v.reshape(-1), [a(3, 4)])
+        check_grad(lambda x: paddle.reshape(x, [6]), [a(2, 3)])
+
+    def test_flatten(self):
+        check_output(lambda x: paddle.flatten(x, 1, 2), lambda v: v.reshape(2, 12, 5), [a(2, 3, 4, 5)])
+
+    def test_squeeze_unsqueeze(self):
+        check_output(lambda x: paddle.squeeze(x, 1), lambda v: v.squeeze(1), [a(3, 1, 4)])
+        check_output(lambda x: paddle.unsqueeze(x, [0, 2]), lambda v: v[None, :, None], [a(3, 4)][:1])
+
+    def test_concat_stack_split(self):
+        x, y = a(2, 3), a(2, 3)
+        check_output(lambda u, v: paddle.concat([u, v], axis=0), lambda u, v: np.concatenate([u, v], 0), [x, y])
+        check_output(lambda u, v: paddle.stack([u, v], axis=1), lambda u, v: np.stack([u, v], 1), [x, y])
+        outs = paddle.split(paddle.to_tensor(a(6, 4)), 3, axis=0)
+        assert len(outs) == 3 and outs[0].shape == [2, 4]
+        outs = paddle.split(paddle.to_tensor(a(7, 4)), [2, 5], axis=0)
+        assert outs[1].shape == [5, 4]
+        outs = paddle.split(paddle.to_tensor(a(7, 4)), [2, -1], axis=0)
+        assert outs[1].shape == [5, 4]
+
+    def test_concat_grad(self):
+        check_grad(lambda u, v: paddle.concat([u, v], axis=1), [a(2, 2), a(2, 3)])
+
+    def test_tile_expand(self):
+        check_output(lambda x: paddle.tile(x, [2, 3]), lambda v: np.tile(v, (2, 3)), [a(2, 2)])
+        check_output(lambda x: paddle.expand(x, [3, 2, 4]),
+                     lambda v: np.broadcast_to(v, (3, 2, 4)), [a(2, 4)])
+        check_output(lambda x: paddle.expand(x, [3, -1, -1]),
+                     lambda v: np.broadcast_to(v, (3, 2, 4)), [a(2, 4)])
+
+    def test_flip_roll(self):
+        check_output(lambda x: paddle.flip(x, [0]), lambda v: np.flip(v, 0), [a(3, 4)])
+        check_output(lambda x: paddle.roll(x, 2, 0), lambda v: np.roll(v, 2, 0), [a(3, 4)])
+
+    def test_pad(self):
+        check_output(lambda x: paddle.nn.functional.pad(x, [1, 2], value=1.0),
+                     lambda v: np.pad(v, [(0, 0), (1, 2)], constant_values=1.0), [a(3, 4)])
+
+
+class TestIndexing:
+    def test_gather(self):
+        x = a(5, 4)
+        idx = np.array([0, 2, 4], np.int32)
+        check_output(paddle.gather, lambda v, i: v[i], [x, idx], to_static=False)
+        check_output(lambda v, i: paddle.gather(v, i, axis=1),
+                     lambda v, i: v[:, i], [x, np.array([1, 3], np.int32)], to_static=False)
+
+    def test_gather_nd(self):
+        x = a(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]], np.int32)
+        check_output(paddle.gather_nd, lambda v, i: v[tuple(i.T)], [x, idx], to_static=False)
+
+    def test_scatter(self):
+        x = a(5, 3)
+        idx = np.array([1, 3], np.int64)
+        upd = a(2, 3)
+
+        def np_scatter(v, i, u):
+            out = v.copy()
+            out[i] = u
+            return out
+
+        check_output(paddle.scatter, np_scatter, [x, idx, upd], to_static=False)
+
+    def test_index_select(self):
+        x = a(4, 5)
+        check_output(lambda v, i: paddle.index_select(v, i, axis=0), lambda v, i: v[i],
+                     [x, np.array([3, 1], np.int32)], to_static=False)
+
+    def test_take_along_put_along(self):
+        x = a(3, 5)
+        idx = RNG.randint(0, 5, (3, 2)).astype(np.int64)
+        check_output(lambda v, i: paddle.take_along_axis(v, i, 1),
+                     lambda v, i: np.take_along_axis(v, i, 1), [x, idx], to_static=False)
+
+    def test_getitem(self):
+        x = paddle.to_tensor(a(4, 5, 6))
+        np_x = x.numpy()
+        np.testing.assert_allclose(x[1].numpy(), np_x[1])
+        np.testing.assert_allclose(x[1:3, ::2].numpy(), np_x[1:3, ::2])
+        np.testing.assert_allclose(x[..., -1].numpy(), np_x[..., -1])
+        np.testing.assert_allclose(x[paddle.to_tensor([0, 2])].numpy(), np_x[[0, 2]])
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor(a(4, 5), stop_gradient=False)
+        y = x[1:3].sum()
+        y.backward()
+        g = x.grad.numpy()
+        assert g[1:3].sum() == 10.0 and g[0].sum() == 0
+
+    def test_setitem(self):
+        x = paddle.to_tensor(a(4, 5))
+        np_x = x.numpy().copy()
+        x[1] = 0.0
+        np_x[1] = 0.0
+        np.testing.assert_allclose(x.numpy(), np_x)
+
+    def test_where_masked(self):
+        x, y = a(3, 4), a(3, 4)
+        cond = x > 0
+        check_output(lambda c, u, v: paddle.where(c, u, v), lambda c, u, v: np.where(c, u, v),
+                     [cond, x, y], to_static=False)
+        mx = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond))
+        np.testing.assert_allclose(mx.numpy(), x[cond])
+
+    def test_masked_fill(self):
+        x = a(3, 4)
+        m = x > 0
+        check_output(lambda v, mm: paddle.masked_fill(v, mm, -1.0),
+                     lambda v, mm: np.where(mm, -1.0, v), [x, m], to_static=False)
+
+
+class TestSearchSort:
+    def test_argmax_argmin(self):
+        x = a(3, 5)
+        assert (paddle.argmax(paddle.to_tensor(x), axis=1).numpy() == x.argmax(1)).all()
+        assert (paddle.argmin(paddle.to_tensor(x), axis=0).numpy() == x.argmin(0)).all()
+
+    def test_sort_argsort(self):
+        x = a(3, 5)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), axis=1).numpy(), np.sort(x, 1))
+        assert (paddle.argsort(paddle.to_tensor(x), axis=1).numpy() == np.argsort(x, 1)).all()
+
+    def test_topk(self):
+        x = a(3, 6)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        expv = -np.sort(-x, 1)[:, :2]
+        np.testing.assert_allclose(vals.numpy(), expv, rtol=1e-6)
+        np.testing.assert_allclose(np.take_along_axis(x, idx.numpy(), 1), expv, rtol=1e-6)
+
+    def test_nonzero_unique(self):
+        x = np.array([[1, 0], [0, 3]], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        assert (nz.numpy() == np.stack(np.nonzero(x), 1)).all()
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 1, 2])))
+        assert (u.numpy() == np.array([1, 2, 3])).all()
+
+    def test_searchsorted(self):
+        seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        vals = np.array([0.5, 3.0, 8.0], np.float32)
+        out = paddle.searchsorted(paddle.to_tensor(seq), paddle.to_tensor(vals))
+        assert (out.numpy() == np.searchsorted(seq, vals)).all()
+
+
+class TestCreation:
+    def test_creation_basics(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int32").dtype == np.int32
+        assert float(paddle.full([1], 3.5)[0]) == 3.5
+        np.testing.assert_allclose(paddle.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like_family(self):
+        x = paddle.to_tensor(a(2, 3))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x).numpy().sum() == 6
+        assert paddle.full_like(x, 2.0).numpy().mean() == 2.0
+
+    def test_tril_triu(self):
+        x = a(4, 4)
+        check_output(paddle.tril, np.tril, [x])
+        check_output(paddle.triu, np.triu, [x])
+
+    def test_random_determinism(self):
+        paddle.seed(7)
+        r1 = paddle.randn([4, 4]).numpy()
+        paddle.seed(7)
+        r2 = paddle.randn([4, 4]).numpy()
+        np.testing.assert_allclose(r1, r2)
+
+    def test_randint_randperm(self):
+        r = paddle.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = paddle.randperm(16).numpy()
+        assert sorted(p.tolist()) == list(range(16))

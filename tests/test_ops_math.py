@@ -1,0 +1,157 @@
+"""Op tests: math/elementwise/reduction/matmul vs numpy (OpTest pattern,
+reference: test/legacy_test/test_elementwise_*_op.py, test_matmul_v2_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from optest import check_grad, check_output
+
+RNG = np.random.RandomState(0)
+
+
+def a(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add(self):
+        check_output(paddle.add, np.add, [a(3, 4), a(3, 4)])
+        check_grad(paddle.add, [a(2, 3), a(2, 3)])
+
+    def test_add_broadcast(self):
+        check_output(paddle.add, np.add, [a(3, 4), a(4)])
+        check_grad(paddle.add, [a(3, 2), a(2)])
+
+    def test_subtract(self):
+        check_output(paddle.subtract, np.subtract, [a(3, 4), a(3, 4)])
+
+    def test_multiply(self):
+        check_output(paddle.multiply, np.multiply, [a(3, 4), a(3, 4)])
+        check_grad(paddle.multiply, [a(2, 2), a(2, 2)])
+
+    def test_divide(self):
+        x, y = a(3, 4), a(3, 4) + 2.0
+        check_output(paddle.divide, np.divide, [x, y])
+        check_grad(paddle.divide, [x, y])
+
+    def test_pow(self):
+        x = np.abs(a(3, 4)) + 0.5
+        check_output(paddle.pow, np.power, [x, np.full_like(x, 2.0)])
+
+    def test_maximum_minimum(self):
+        check_output(paddle.maximum, np.maximum, [a(3, 4), a(3, 4)])
+        check_output(paddle.minimum, np.minimum, [a(3, 4), a(3, 4)])
+
+    def test_scalar_ops(self):
+        x = paddle.to_tensor(a(2, 3))
+        np.testing.assert_allclose((x + 1.0).numpy(), x.numpy() + 1.0, rtol=1e-6)
+        np.testing.assert_allclose((2.0 * x).numpy(), 2.0 * x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((1.0 - x).numpy(), 1.0 - x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((x / 2).numpy(), x.numpy() / 2, rtol=1e-6)
+
+    def test_mod_floor_divide(self):
+        x = RNG.randint(1, 20, (3, 4)).astype(np.int32)
+        y = RNG.randint(1, 5, (3, 4)).astype(np.int32)
+        check_output(paddle.mod, np.mod, [x, y], to_static=False)
+        check_output(paddle.floor_divide, np.floor_divide, [x, y], to_static=False)
+
+
+class TestUnary:
+    @pytest.mark.parametrize("pfn,nfn", [
+        (paddle.exp, np.exp), (paddle.tanh, np.tanh), (paddle.sin, np.sin),
+        (paddle.cos, np.cos), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+        (paddle.abs, np.abs), (paddle.square, np.square), (paddle.sign, np.sign),
+    ])
+    def test_simple(self, pfn, nfn):
+        check_output(pfn, nfn, [a(3, 4)])
+
+    def test_sqrt_log(self):
+        x = np.abs(a(3, 4)) + 0.1
+        check_output(paddle.sqrt, np.sqrt, [x])
+        check_output(paddle.log, np.log, [x])
+        check_output(paddle.rsqrt, lambda v: 1.0 / np.sqrt(v), [x])
+        check_grad(paddle.sqrt, [x])
+
+    def test_sigmoid(self):
+        check_output(paddle.sigmoid, lambda v: 1 / (1 + np.exp(-v)), [a(3, 4)])
+        check_grad(paddle.sigmoid, [a(2, 3)])
+
+    def test_erf(self):
+        from scipy.special import erf as scipy_erf
+
+        check_output(paddle.erf, scipy_erf, [a(3, 4)], atol=1e-4)
+
+    def test_clip(self):
+        check_output(lambda x: paddle.clip(x, -0.5, 0.5), lambda v: np.clip(v, -0.5, 0.5), [a(3, 4)])
+
+    def test_tanh_grad(self):
+        check_grad(paddle.tanh, [a(2, 3)])
+
+
+class TestReduce:
+    def test_sum(self):
+        check_output(lambda x: paddle.sum(x), lambda v: v.sum(), [a(3, 4)])
+        check_output(lambda x: paddle.sum(x, axis=1), lambda v: v.sum(1), [a(3, 4)])
+        check_output(lambda x: paddle.sum(x, axis=[0, 2], keepdim=True),
+                     lambda v: v.sum((0, 2), keepdims=True), [a(2, 3, 4)])
+        check_grad(lambda x: paddle.sum(x, axis=1), [a(2, 3)])
+
+    def test_mean(self):
+        check_output(lambda x: paddle.mean(x, axis=-1), lambda v: v.mean(-1), [a(3, 4)])
+        check_grad(paddle.mean, [a(2, 3)])
+
+    def test_max_min(self):
+        check_output(lambda x: paddle.max(x, axis=0), lambda v: v.max(0), [a(3, 4)])
+        check_output(lambda x: paddle.min(x, axis=1), lambda v: v.min(1), [a(3, 4)])
+        check_grad(lambda x: paddle.max(x, axis=1), [a(2, 3)])
+
+    def test_prod_std_var(self):
+        check_output(lambda x: paddle.prod(x, axis=1), lambda v: v.prod(1), [a(3, 4)])
+        check_output(lambda x: paddle.std(x, axis=1), lambda v: v.std(1, ddof=1), [a(3, 4)], atol=1e-4)
+        check_output(lambda x: paddle.var(x, axis=1), lambda v: v.var(1, ddof=1), [a(3, 4)], atol=1e-4)
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+
+        check_output(lambda x: paddle.logsumexp(x, axis=1), lambda v: np_lse(v, axis=1), [a(3, 4)], atol=1e-5)
+
+    def test_cumsum(self):
+        check_output(lambda x: paddle.cumsum(x, axis=1), lambda v: v.cumsum(1), [a(3, 4)])
+
+    def test_all_any(self):
+        x = RNG.rand(3, 4) > 0.5
+        check_output(lambda t: paddle.all(t, axis=1), lambda v: v.all(1), [x], to_static=False)
+        check_output(lambda t: paddle.any(t, axis=1), lambda v: v.any(1), [x], to_static=False)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [a(3, 4), a(4, 5)])
+        check_grad(paddle.matmul, [a(2, 3), a(3, 2)])
+
+    def test_matmul_batched(self):
+        check_output(paddle.matmul, np.matmul, [a(2, 3, 4), a(2, 4, 5)])
+
+    def test_matmul_transpose(self):
+        check_output(lambda x, y: paddle.matmul(x, y, transpose_y=True),
+                     lambda x, y: x @ y.T, [a(3, 4), a(5, 4)])
+        check_output(lambda x, y: paddle.matmul(x, y, transpose_x=True),
+                     lambda x, y: x.T @ y, [a(4, 3), a(4, 5)])
+
+    def test_dot_outer(self):
+        check_output(paddle.dot, lambda x, y: (x * y).sum(-1), [a(5), a(5)])
+        check_output(paddle.outer, np.outer, [a(3), a(4)])
+
+    def test_einsum(self):
+        check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                     lambda x, y: np.einsum("ij,jk->ik", x, y), [a(3, 4), a(4, 5)])
+
+    def test_addmm(self):
+        check_output(lambda i, x, y: paddle.addmm(i, x, y, beta=0.5, alpha=2.0),
+                     lambda i, x, y: 0.5 * i + 2.0 * (x @ y), [a(3, 5), a(3, 4), a(4, 5)])
+
+    def test_t_transpose(self):
+        check_output(paddle.t, lambda v: v.T, [a(3, 4)])
+        check_output(lambda x: paddle.transpose(x, [2, 0, 1]),
+                     lambda v: v.transpose(2, 0, 1), [a(2, 3, 4)])
