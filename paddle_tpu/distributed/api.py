@@ -66,15 +66,6 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements: Sequence[Placeme
     return out
 
 
-# Tensor gets DistTensor-flavored attributes lazily.
-def _tensor_placements(self):
-    return getattr(self, "_placements_attr", None)
-
-
-Tensor.process_mesh = None
-Tensor.placements = None
-
-
 def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Optional[Callable] = None,
                 input_fn: Optional[Callable] = None, output_fn: Optional[Callable] = None):
     """Shard every parameter of ``layer`` across ``process_mesh``.

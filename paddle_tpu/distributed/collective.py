@@ -138,10 +138,16 @@ def spmd(fn: Callable, mesh, in_specs=None, out_specs=None, check_vma=False):
     axis_names = tuple(jmesh.axis_names)
 
     def wrapper(*args, **kwargs):
-        from jax.shard_map import shard_map
-
         spec_in = in_specs if in_specs is not None else PartitionSpec(axis_names)
         spec_out = out_specs if out_specs is not None else PartitionSpec(axis_names)
+
+        # Flatten arbitrary pytree args (Tensors as leaves) to a flat tensor
+        # list so the program can route through the dispatch layer as ONE
+        # tape node — gradients then flow through shard_map via jax.vjp.
+        is_t = lambda x: isinstance(x, Tensor)
+        flat_args, in_tree = jax.tree.flatten(args, is_leaf=is_t)
+        tensor_args = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a)) for a in flat_args]
+        out_tree_cell = []
 
         def inner(*datas):
             stack = getattr(_tls, "spmd_stack", None)
@@ -149,18 +155,25 @@ def spmd(fn: Callable, mesh, in_specs=None, out_specs=None, check_vma=False):
                 stack = _tls.spmd_stack = []
             stack.append(_SpmdCtx(jmesh, axis_names))
             try:
-                targs = jax.tree.map(lambda d: Tensor(d), datas)
+                targs = jax.tree.unflatten(in_tree, [Tensor(d) for d in datas])
                 out = fn(*targs, **kwargs)
-                return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, out,
-                                    is_leaf=lambda x: isinstance(x, Tensor))
+                out_datas = jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, out,
+                                         is_leaf=is_t)
+                flat_out, out_tree = jax.tree.flatten(out_datas)
+                out_tree_cell.clear()
+                out_tree_cell.append(out_tree)
+                return tuple(flat_out) if len(flat_out) != 1 else flat_out[0]
             finally:
                 stack.pop()
 
-        datas = jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t), args,
-                             is_leaf=lambda x: isinstance(x, Tensor))
-        sm = shard_map(inner, mesh=jmesh, in_specs=spec_in, out_specs=spec_out, check_vma=check_vma)
-        out = sm(*datas)
-        return jax.tree.map(lambda d: Tensor(d) if isinstance(d, jax.Array) else d, out)
+        sm = jax.shard_map(inner, mesh=jmesh, in_specs=spec_in, out_specs=spec_out,
+                           check_vma=check_vma)
+        from ..ops.dispatch import apply_op
+
+        outs = apply_op(f"spmd:{getattr(fn, '__name__', 'program')}", sm, *tensor_args)
+        out_tree = out_tree_cell[0]
+        flat_outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return jax.tree.unflatten(out_tree, list(flat_outs))
 
     return wrapper
 

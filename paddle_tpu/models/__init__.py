@@ -1,0 +1,12 @@
+"""Model families (large-model kit; reference analogue: the PaddleNLP-facing
+capability surface built on fleet + fused kernels)."""
+
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_pretrain_loss,
+    llama_shard_fn,
+)
+from .gpt import GPTConfig, GPTForCausalLM
+from .bert import BertConfig, BertForPretraining, BertModel

@@ -1,0 +1,98 @@
+"""GPT-2/3-style model (learned positions, pre-LN, GELU MLP).
+
+Capability target: the reference's GPT-3 hybrid-parallel path
+(SURVEY §7.2 milestone 4: GPT-3 1.3B TP+PP).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+
+    @staticmethod
+    def gpt3_1p3b(**overrides):
+        cfg = GPTConfig(hidden_size=2048, num_hidden_layers=24, num_attention_heads=16,
+                        intermediate_size=8192, max_position_embeddings=2048)
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg
+
+    @staticmethod
+    def tiny(**overrides):
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128, max_position_embeddings=128)
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = nn.MultiHeadAttention(config.hidden_size, config.num_attention_heads,
+                                          dropout=config.dropout)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x, attn_mask=None):
+        h = self.ln_1(x)
+        b, s, _ = h.shape
+        nh = self.attn.num_heads
+        hd = self.attn.head_dim
+        q = self.attn.q_proj(h).reshape([b, s, nh, hd])
+        k = self.attn.k_proj(h).reshape([b, s, nh, hd])
+        v = self.attn.v_proj(h).reshape([b, s, nh, hd])
+        a = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+        x = x + self.attn.out_proj(a.reshape([b, s, nh * hd]))
+        x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        from ..ops.creation import arange
+
+        b, s = input_ids.shape
+        pos = arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        for block in self.h:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        return self.lm_head(self.gpt(input_ids, attn_mask))

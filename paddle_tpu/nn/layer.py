@@ -238,7 +238,10 @@ class Layer:
             if name in state_dict:
                 v = state_dict[name]
                 data = v._data if isinstance(v, Tensor) else jnp.asarray(v)
-                t._data = data.astype(t._data.dtype).reshape(t._data.shape)
+                # copy: optimizer update kernels donate parameter buffers, so
+                # aliasing the source model's arrays would let its next step
+                # delete ours (PJRT buffer donation semantics)
+                t._data = jnp.array(data.astype(t._data.dtype).reshape(t._data.shape), copy=True)
             else:
                 missing.append(name)
         for k in state_dict:

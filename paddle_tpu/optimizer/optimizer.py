@@ -67,7 +67,9 @@ class Optimizer:
         for name, store in self._accumulators.items():
             for p in self._parameter_list:
                 if id(p) in store:
-                    out[f"{p.name}_{name}"] = Tensor(store[id(p)])
+                    # copy: update kernels donate state buffers, so aliasing
+                    # the live accumulator would invalidate the checkpoint
+                    out[f"{p.name}_{name}"] = Tensor(jnp.copy(store[id(p)]))
         out["@step"] = self._step_count
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
@@ -82,7 +84,7 @@ class Optimizer:
                 k = f"{p.name}_{name}"
                 if k in state:
                     v = state[k]
-                    self._accumulators.setdefault(name, {})[id(p)] = (
+                    self._accumulators.setdefault(name, {})[id(p)] = jnp.copy(
                         v._data if isinstance(v, Tensor) else jnp.asarray(v))
         if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state["LR_Scheduler"])

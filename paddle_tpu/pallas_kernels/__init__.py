@@ -1,0 +1,8 @@
+"""Hand-written Pallas TPU kernels for the ops XLA fusion can't cover.
+
+Reference analogue: paddle/phi/kernels/fusion/ (fused attention/transformer
+CUDA kernels) and the dynloaded flash-attention library
+(phi/kernels/gpu/flash_attn_kernel.cu).
+"""
+
+from .flash_attention import flash_attention

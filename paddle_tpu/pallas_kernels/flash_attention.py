@@ -1,0 +1,168 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Reference analogue: phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention-2
+via dynloaded libflashattn). TPU-native design: blockwise online-softmax
+attention with q-blocks on the grid and a fori_loop over k-blocks held in
+VMEM; the causal variant skips fully-masked k-blocks. The custom VJP
+recomputes attention blockwise (flash backward) so no O(s²) tensor is ever
+materialized — this is the long-context workhorse that XLA's fused SDPA
+can't provide at large s.
+
+Layout: [batch, seq, heads, head_dim] (Paddle convention); internally
+blocked as [b*h, s, d].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; CPU tests run in interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_TPU_PALLAS = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_TPU_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sm_scale: float, causal: bool,
+                q_block: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+    bq = q.shape[0]
+
+    m = jnp.full((bq,), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc = jnp.zeros((bq, q_ref.shape[-1]), jnp.float32)
+
+    num_kb = seq_len // block_k
+    if causal:
+        # only k-blocks up to the diagonal contribute
+        last_kb = jnp.minimum(num_kb, ((qi + 1) * q_block + block_k - 1) // block_k)
+    else:
+        last_kb = num_kb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        # slice through the ref (Pallas TPU requires pl.ds on refs, not
+        # dynamic_slice on loaded values)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T  # [bq, bk]
+        if causal:
+            qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + p @ v
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal: bool, sm_scale: float, block_q: int, block_k: int):
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (bh, s // block_q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, sm_scale=sm_scale, causal=causal,
+                          q_block=block_q, seq_len=s),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash_fwd(q, k, v, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, res, do):
+    """Blockwise recomputation backward (flash-attention backward pass) in
+    plain jnp — XLA fuses/tiles this well; a dedicated Pallas backward
+    kernel can replace it without API change."""
+    q, k, v, out = res
+    qf = q.astype(jnp.float32) * sm_scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    m = s.max(-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(-1, keepdims=True)
+    p = p / jnp.maximum(l, 1e-30)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = (dof * out.astype(jnp.float32)).sum(-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * sm_scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale=None, block_q: int = 128,
+                    block_k: int = 128):
+    """Flash attention on [b, s, h, d] Tensors or arrays. Returns same layout.
+
+    Parity: paddle.nn.functional.flash_attention.flash_attention
+    (python/paddle/nn/functional/flash_attention.py).
+    """
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import apply_op
+
+    is_tensor = isinstance(q, Tensor)
+
+    def _f(qa, ka, va):
+        b, s, h, d = qa.shape
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+        qm = jnp.moveaxis(qa, 2, 1).reshape(b * h, s, d)
+        km = jnp.moveaxis(ka, 2, 1).reshape(b * h, s, d)
+        vm = jnp.moveaxis(va, 2, 1).reshape(b * h, s, d)
+        bq = block_q
+        while s % bq and bq > 1:
+            bq //= 2
+        bk = block_k
+        while s % bk and bk > 1:
+            bk //= 2
+        out = _flash(qm, km, vm, causal, scale, bq, bk)
+        return jnp.moveaxis(out.reshape(b, h, s, d), 1, 2)
+
+    if is_tensor:
+        return apply_op("flash_attention", _f, q, k, v)
+    return _f(q, k, v)

@@ -1,0 +1,233 @@
+"""AMP, IO (DataLoader), jit.to_static, save/load tests.
+
+Reference patterns: test/amp/test_amp_api.py, test/legacy_test/
+test_dataloader_*.py, test/dygraph_to_static/ (Dy2StTestBase parity
+pattern), test_paddle_save_load.py.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.vision.datasets import FakeData
+
+
+class TestAMP:
+    def test_autocast_o1_matmul_bf16(self):
+        x = paddle.randn([4, 4])
+        y = paddle.randn([4, 4])
+        with paddle.amp.auto_cast():
+            z = paddle.matmul(x, y)
+        assert str(z.dtype) == "bfloat16"
+        z2 = paddle.matmul(x, y)
+        assert str(z2.dtype) == "float32"
+
+    def test_autocast_blacklist_stays_fp32(self):
+        x = paddle.randn([4, 4]).astype("bfloat16")
+        with paddle.amp.auto_cast():
+            s = F.softmax(x)
+        assert str(s.dtype) == "float32"
+
+    def test_autocast_custom_lists(self):
+        x, y = paddle.randn([2, 2]), paddle.randn([2, 2])
+        with paddle.amp.auto_cast(custom_black_list={"matmul"}):
+            z = paddle.matmul(x, y)
+        assert str(z.dtype) == "float32"
+
+    def test_decorate_o2(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8))
+        model = paddle.amp.decorate(model, level="O2")
+        assert str(model[0].weight.dtype) == "bfloat16"
+        assert str(model[1].weight.dtype) == "float32"  # norms excluded
+
+    def test_grad_scaler_flow(self):
+        model = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        x = paddle.randn([3, 4])
+        loss = model(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        w_before = model.weight.numpy().copy()
+        scaler.step(opt)
+        assert not np.allclose(model.weight.numpy(), w_before)
+
+    def test_grad_scaler_skips_on_inf(self):
+        model = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        model.weight.grad = paddle.to_tensor(np.full((2, 2), np.inf, np.float32))
+        model.bias.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        w_before = model.weight.numpy().copy()
+        scaler.step(opt)
+        np.testing.assert_allclose(model.weight.numpy(), w_before)
+        assert scaler.get_loss_scaling() == 2.0  # halved
+
+
+class TestDataLoader:
+    def test_tensor_dataset_loader(self):
+        xs = paddle.to_tensor(np.arange(20, dtype=np.float32).reshape(10, 2))
+        ys = paddle.to_tensor(np.arange(10, dtype=np.int32))
+        ds = TensorDataset([xs, ys])
+        loader = DataLoader(ds, batch_size=4, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 3
+        xb, yb = batches[0]
+        assert xb.shape == [4, 2]
+        assert batches[-1][0].shape == [2, 2]
+
+    def test_shuffle_covers_all(self):
+        ds = FakeData(size=16, image_shape=(2,), num_classes=3)
+        loader = DataLoader(ds, batch_size=4, shuffle=True)
+        seen = []
+        for xb, yb in loader:
+            seen.extend(yb.numpy().tolist())
+        assert len(seen) == 16
+
+    def test_multiprocess_loader(self):
+        ds = FakeData(size=12, image_shape=(3,), num_classes=2)
+        single = [x.numpy() for x, _ in DataLoader(ds, batch_size=4)]
+        multi = [x.numpy() for x, _ in DataLoader(ds, batch_size=4, num_workers=2)]
+        assert len(single) == len(multi)
+        for s, m in zip(single, multi):
+            np.testing.assert_allclose(s, m)
+
+    def test_collate_dict(self):
+        class D(paddle.io.Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.ones(2, np.float32) * i}
+
+            def __len__(self):
+                return 4
+
+        batch = next(iter(DataLoader(D(), batch_size=4)))
+        assert batch["a"].shape == [4]
+        assert batch["b"].shape == [4, 2]
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        eager_out = model(x)
+        static_model = paddle.jit.to_static(model)
+        static_out = static_model(x)
+        np.testing.assert_allclose(eager_out.numpy(), static_out.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_param_update_reflected(self):
+        model = nn.Linear(2, 2)
+        static_model = paddle.jit.to_static(model)
+        x = paddle.ones([1, 2])
+        out1 = static_model(x).numpy()
+        model.weight.set_value(model.weight.numpy() * 2)
+        out2 = static_model(x).numpy()
+        assert not np.allclose(out1, out2)
+
+    def test_function_decorator(self):
+        @paddle.jit.to_static
+        def f(x, y):
+            return paddle.matmul(x, y) + 1.0
+
+        x, y = paddle.randn([2, 3]), paddle.randn([3, 2])
+        np.testing.assert_allclose(f(x, y).numpy(), x.numpy() @ y.numpy() + 1.0, rtol=1e-5)
+
+    def test_control_flow_python(self):
+        @paddle.jit.to_static
+        def f(x, flag=True):
+            if flag:  # python-level branch, traced per static arg
+                return x * 2
+            return x * 3
+
+        x = paddle.ones([2])
+        np.testing.assert_allclose(f(x).numpy(), [2.0, 2.0])
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8, data_format="NCL"))
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model.pdparams")
+            paddle.save(model.state_dict(), path)
+            loaded = paddle.load(path)
+            model2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8, data_format="NCL"))
+            model2.set_state_dict(loaded)
+            np.testing.assert_allclose(model2[0].weight.numpy(), model[0].weight.numpy())
+
+    def test_bfloat16_roundtrip(self):
+        t = paddle.randn([3, 3]).astype("bfloat16")
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.pdtensor")
+            paddle.save({"t": t}, path)
+            loaded = paddle.load(path)
+            assert str(loaded["t"].dtype) == "bfloat16"
+            np.testing.assert_allclose(loaded["t"].astype("float32").numpy(),
+                                       t.astype("float32").numpy())
+
+    def test_optimizer_state_roundtrip(self):
+        model = nn.Linear(3, 3)
+        opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+        loss = model(paddle.randn([2, 3])).sum()
+        loss.backward()
+        opt.step()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "opt.pdopt")
+            paddle.save(opt.state_dict(), path)
+            state = paddle.load(path)
+            opt2 = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+            opt2.set_state_dict(state)
+            assert opt2._step_count == 1
+
+    def test_nested_structures(self):
+        obj = {"a": [paddle.ones([2]), {"b": paddle.zeros([3])}], "c": 42, "d": "text"}
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "obj")
+            paddle.save(obj, path)
+            loaded = paddle.load(path)
+            assert loaded["c"] == 42 and loaded["d"] == "text"
+            np.testing.assert_allclose(loaded["a"][0].numpy(), [1, 1])
+
+
+class TestEndToEndLeNet:
+    def test_lenet_mnist_training_converges(self):
+        """The v0 gate (SURVEY §7.2 step 3): LeNet, dygraph, synthetic MNIST."""
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        model = LeNet(num_classes=10)
+        opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+        lossfn = nn.CrossEntropyLoss()
+        # learnable synthetic data: class mean + small noise
+        rng = np.random.RandomState(0)
+        means = rng.randn(10, 1, 28, 28).astype(np.float32)
+        labels = rng.randint(0, 10, 64)
+        images = means[labels] + 0.05 * rng.randn(64, 1, 28, 28).astype(np.float32)
+        ds = TensorDataset([paddle.to_tensor(images), paddle.to_tensor(labels.astype(np.int64))])
+        loader = DataLoader(ds, batch_size=16, shuffle=True)
+        first_loss = last_loss = None
+        for epoch in range(6):
+            for xb, yb in loader:
+                logits = model(xb)
+                loss = lossfn(logits, yb)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                if first_loss is None:
+                    first_loss = float(loss)
+                last_loss = float(loss)
+        assert last_loss < first_loss * 0.3, (first_loss, last_loss)
+
+    def test_eval_mode_accuracy(self):
+        from paddle_tpu.metric import Accuracy
+
+        logits = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+        labels = paddle.to_tensor(np.array([0, 1], np.int64))
+        acc = Accuracy()
+        correct = acc.compute(logits, labels)
+        acc.update(correct)
+        assert acc.accumulate() == 1.0

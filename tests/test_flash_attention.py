@@ -1,0 +1,61 @@
+"""Flash attention kernel tests (interpret mode on CPU; the real lowering
+is exercised on TPU — see .claude/skills/verify).
+
+Reference: test/legacy_test/test_flash_attention.py (compare fused kernel
+vs plain attention)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.pallas_kernels.flash_attention import flash_attention
+
+RNG = np.random.RandomState(0)
+
+
+def qkv(b=2, s=128, h=2, d=32):
+    return (RNG.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+
+
+def sdpa_ref(q, k, v, causal):
+    return F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), is_causal=causal).numpy()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_sdpa(causal):
+    q, k, v = qkv()
+    out = flash_attention(paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+                          causal=causal)
+    np.testing.assert_allclose(out.numpy(), sdpa_ref(q, k, v, causal), atol=2e-3, rtol=1e-2)
+
+
+def test_flash_odd_seq():
+    q, k, v = qkv(s=96)
+    out = flash_attention(paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), causal=True)
+    np.testing.assert_allclose(out.numpy(), sdpa_ref(q, k, v, True), atol=2e-3, rtol=1e-2)
+
+
+def test_flash_gradients_match_sdpa():
+    q, k, v = qkv(b=1, s=64, h=1, d=16)
+    tq1, tk1, tv1 = (paddle.to_tensor(x, stop_gradient=False) for x in (q, k, v))
+    flash_attention(tq1, tk1, tv1, causal=True).sum().backward()
+    tq2, tk2, tv2 = (paddle.to_tensor(x, stop_gradient=False) for x in (q, k, v))
+    F.scaled_dot_product_attention(tq2, tk2, tv2, is_causal=True).sum().backward()
+    np.testing.assert_allclose(tq1.grad.numpy(), tq2.grad.numpy(), atol=5e-3, rtol=1e-2)
+    np.testing.assert_allclose(tk1.grad.numpy(), tk2.grad.numpy(), atol=5e-3, rtol=1e-2)
+    np.testing.assert_allclose(tv1.grad.numpy(), tv2.grad.numpy(), atol=5e-3, rtol=1e-2)
+
+
+def test_llama_with_flash_matches_sdpa_path():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    m1 = LlamaForCausalLM(cfg)
+    cfg2 = LlamaConfig.tiny(use_flash_attention=True)
+    m2 = LlamaForCausalLM(cfg2)
+    m2.set_state_dict(m1.state_dict())
+    ids = paddle.to_tensor(RNG.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32))
+    np.testing.assert_allclose(m1(ids).numpy(), m2(ids).numpy(), atol=2e-3, rtol=1e-2)
