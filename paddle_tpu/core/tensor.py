@@ -263,7 +263,7 @@ class Parameter(Tensor):
     EagerParamBase). ``stop_gradient`` defaults to False; ``trainable``
     toggles it."""
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed", "sequence_parallel")
 
     def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -272,6 +272,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        self.sequence_parallel = False
         self.placements = None
         self.process_mesh = None
 

@@ -322,6 +322,30 @@ def alltoall_single(tensor: Tensor, output=None, in_split_sizes=None, out_split_
     )
 
 
+def local_slice(tensor: Tensor, dim: int, group: Optional[Group] = None) -> Tensor:
+    """This rank's slice of a replicated tensor along ``dim`` (the shared
+    per-rank shard recipe used by TP layers and sequence-parallel scatter).
+    No-ops outside spmd or when the group's axis isn't bound on the mesh.
+    Requires the dimension to divide the group size."""
+    ax = _axis(group)
+    if ax is None or isinstance(ax, tuple):
+        return tensor
+    g = group or _WORLD
+    n = g.nranks
+    size = tensor._data.shape[dim]
+    if size % n != 0:
+        raise ValueError(
+            f"local_slice: dim {dim} of size {size} not divisible by group size {n} "
+            "(reference asserts divisibility at layer construction)")
+
+    def _f(a):
+        idx = jax.lax.axis_index(ax)
+        per = a.shape[dim] // n
+        return jax.lax.dynamic_slice_in_dim(a, idx * per, per, axis=dim)
+
+    return apply_op("local_slice", _f, tensor)
+
+
 def ppermute(tensor: Tensor, perm, group: Optional[Group] = None):
     """collective-permute (TPU-native P2P: reference isend/irecv pairs map
     to ppermute rings on ICI; reference: pp_utils/p2p_communication.py)."""

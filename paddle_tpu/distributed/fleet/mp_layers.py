@@ -49,6 +49,18 @@ def _mesh():
     return h.process_mesh if h else None
 
 
+def _local_shard(t, dim: int, group):
+    """Per-rank shard of a closed-over (global) array inside an spmd
+    program: shard_map closures are replicated, so each rank slices its own
+    piece — the moral equivalent of the reference's rank-local weight.
+    No-op when the group is absent / its axis isn't bound on the mesh."""
+    from ..collective import local_slice
+
+    if group is None:
+        return t
+    return local_slice(t, dim, group)
+
+
 def _maybe_shard(param: Parameter, dim: Optional[int]) -> Parameter:
     """Annotate a parameter with mp-axis sharding on ``dim`` (None =
     replicated over mp)."""
@@ -66,11 +78,34 @@ class VocabParallelEmbedding(Layer):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
+        deg = _mp_degree()
+        if deg > 1 and num_embeddings % deg != 0:
+            raise ValueError(
+                f"num_embeddings ({num_embeddings}) must be divisible by the model-parallel "
+                f"degree ({deg}) (reference: mp_layers.py VocabParallelEmbedding assert)")
         w = self.create_parameter((num_embeddings, embedding_dim), attr=weight_attr,
                                   default_initializer=XavierNormal())
         self.weight = _maybe_shard(w, 0)  # shard vocab dim
 
     def forward(self, x):
+        from ..collective import _axis
+
+        g = _mp_group()
+        if _current_spmd() is not None and g is not None and _axis(g) is not None:
+            # per-rank masked lookup + allreduce (reference: c_embedding op)
+            w = _local_shard(self.weight, 0, g)
+            from ...ops.dispatch import apply_op
+
+            def _f(ids, wl):
+                idx = jax.lax.axis_index(g.axis_name)
+                per = self.num_embeddings // g.nranks
+                local = ids - idx * per
+                valid = (local >= 0) & (local < per)
+                out = jnp.take(wl, jnp.clip(local, 0, per - 1), axis=0)
+                return jnp.where(valid[..., None], out, jnp.zeros((), out.dtype))
+
+            out = apply_op("vocab_parallel_embedding", _f, x, w)
+            return all_reduce(out, group=g)
         # GSPMD handles masked lookup + psum when the weight is vocab-sharded
         # under pjit. (Reference: c_embedding op's masked lookup.)
         return F.embedding(x, self.weight)
@@ -83,6 +118,9 @@ class ColumnParallelLinear(Layer):
         self.in_features = in_features
         self.out_features = out_features
         self.gather_output = gather_output
+        deg = _mp_degree()
+        if deg > 1 and out_features % deg != 0:
+            raise ValueError(f"out_features ({out_features}) must be divisible by mp degree ({deg})")
         w = self.create_parameter((in_features, out_features), attr=weight_attr)
         self.weight = _maybe_shard(w, 1)  # shard output/column dim
         if has_bias is False:
@@ -92,9 +130,15 @@ class ColumnParallelLinear(Layer):
             self.bias = _maybe_shard(b, 0)
 
     def forward(self, x):
+        if _current_spmd() is not None:
+            g = _mp_group()
+            w = _local_shard(self.weight, 1, g)
+            b = _local_shard(self.bias, 0, g) if self.bias is not None else None
+            out = F.linear(x, w, b)
+            if self.gather_output:
+                out = all_gather_concat(out, group=g, axis=-1)
+            return out
         out = F.linear(x, self.weight, self.bias)
-        if self.gather_output and _current_spmd() is not None:
-            out = all_gather_concat(out, group=_mp_group(), axis=-1)
         return out
 
 
@@ -105,6 +149,9 @@ class RowParallelLinear(Layer):
         self.in_features = in_features
         self.out_features = out_features
         self.input_is_parallel = input_is_parallel
+        deg = _mp_degree()
+        if deg > 1 and in_features % deg != 0:
+            raise ValueError(f"in_features ({in_features}) must be divisible by mp degree ({deg})")
         w = self.create_parameter((in_features, out_features), attr=weight_attr)
         self.weight = _maybe_shard(w, 0)  # shard input/row dim
         if has_bias:
@@ -115,9 +162,16 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if _current_spmd() is not None:
-            # per-rank program: local matmul then allreduce partial sums
-            out = F.linear(x, self.weight, None)
-            out = all_reduce(out, op=ReduceOp.SUM, group=_mp_group())
+            # per-rank program: local matmul on this rank's row shard, then
+            # allreduce partial sums (reference: _mp_allreduce)
+            g = _mp_group()
+            w = _local_shard(self.weight, 0, g)
+            if not self.input_is_parallel:
+                # full activation supplied: take this rank's feature slice
+                # (reference: c_split on the input when not parallel)
+                x = _local_shard(x, -1, g) if w is not self.weight else x
+            out = F.linear(x, w, None)
+            out = all_reduce(out, op=ReduceOp.SUM, group=g)
             if self.bias is not None:
                 out = out + self.bias
             return out

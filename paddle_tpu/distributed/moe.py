@@ -1,0 +1,217 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Parity targets (SURVEY §2.5 #43):
+- gates (reference: incubate/distributed/models/moe/gate/{naive_gate,
+  switch_gate,gshard_gate}.py),
+- MoELayer (moe_layer.py:263) with all-to-all dispatch (reference:
+  global_scatter/global_gather collective ops),
+- fused expert compute (reference: phi/kernels/fusion fused MoE).
+
+TPU-native design: GShard-style dense dispatch — tokens are routed with
+one-hot capacity-slot dispatch/combine tensors and experts computed as a
+single batched einsum over stacked expert weights [E, ...]. Under pjit
+with E sharded over the ``ep`` mesh axis, GSPMD emits exactly the
+reference's all-to-all pattern over ICI; there is no per-token host loop
+and no dynamic shapes (dropped tokens beyond capacity, GShard semantics).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import XavierNormal
+from ..nn.layer import Layer
+from ..ops.dispatch import apply_op
+from .api import shard_tensor
+from .mesh import ProcessMesh, Replicate, Shard
+
+
+class BaseGate(Layer):
+    """Parity: gate/base_gate.py."""
+
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.loss = None
+
+    def get_loss(self):
+        return self.loss
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate (parity: gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.topk = topk
+        self.gate_weight = self.create_parameter((d_model, self.tot_expert))
+
+    def forward(self, x):
+        from ..ops.search import topk as topk_op
+
+        logits = F.linear(x, self.gate_weight)
+        vals, idx = topk_op(logits, self.topk, axis=-1)
+        return logits, vals, idx
+
+
+class SwitchGate(BaseGate):
+    """Top-1 gate with load-balancing loss (parity: gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1, switch_eps=0.1, capacity=(1.2, 2.4)):
+        super().__init__(num_expert, world_size)
+        self.gate_weight = self.create_parameter((d_model, self.tot_expert))
+        self.eps = switch_eps
+
+    def forward(self, x):
+        logits = F.linear(x, self.gate_weight)
+        return logits
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with capacity + aux loss (parity: gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4)):
+        super().__init__(num_expert, world_size)
+        self.gate_weight = self.create_parameter((d_model, self.tot_expert))
+        self.capacity_factor = capacity[0]
+
+    def forward(self, x):
+        return F.linear(x, self.gate_weight)
+
+
+def _one_hot(x, n, dtype):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def gshard_routing(gate_logits, num_experts: int, capacity: int, topk: int = 2):
+    """Dense top-2 routing (pure jnp, used inside the MoE op).
+
+    Returns (dispatch [t, E, C] bool, combine [t, E, C], aux_loss scalar).
+    Tokens over capacity are dropped (GShard semantics; the reference's
+    capacity clamp in gshard_gate.py).
+    """
+    t = gate_logits.shape[0]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # [t, E]
+
+    # aux load-balance loss (GShard eq.)
+    top1 = jnp.argmax(probs, axis=-1)
+    top1_mask = _one_hot(top1, num_experts, jnp.float32)
+    density = top1_mask.mean(0)
+    density_proxy = probs.mean(0)
+    aux_loss = (density * density_proxy).sum() * num_experts * num_experts
+
+    dispatch = jnp.zeros((t, num_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, num_experts, capacity), jnp.float32)
+    # cumulative position of each token within its expert (offset across
+    # top-k rounds so round-2 assignments don't collide with round-1 slots)
+    used = jnp.zeros((num_experts,), jnp.float32)
+    remaining_probs = probs
+    for k in range(topk):
+        idx = jnp.argmax(remaining_probs, axis=-1)  # [t]
+        mask = _one_hot(idx, num_experts, jnp.float32)  # [t, E]
+        pos = (jnp.cumsum(mask, axis=0) - 1.0 + used[None, :]) * mask
+        in_cap = (pos < capacity) & (mask > 0)
+        used = used + mask.sum(0)
+        gate_val = (remaining_probs * mask).sum(-1)  # [t]
+        pos_i = jnp.clip(pos.sum(-1).astype(jnp.int32), 0, capacity - 1)
+        slot = _one_hot(pos_i, capacity, jnp.float32)  # [t, C]
+        sel = in_cap.sum(-1).astype(jnp.float32)  # [t] 1 if within capacity
+        contrib = mask[:, :, None] * slot[:, None, :] * sel[:, None, None]
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate_val[:, None, None]
+        remaining_probs = remaining_probs * (1.0 - mask)
+
+    # renormalize combine weights over chosen experts
+    denom = combine.sum(axis=(1, 2), keepdims=True)
+    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), combine)
+    return dispatch, combine, aux_loss
+
+
+class ExpertMLP(Layer):
+    """Stacked-expert SwiGLU/ReLU MLP: weights [E, ...] so expert compute is
+    one batched einsum (the fused-MoE analogue; E shards over 'ep')."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter((num_experts, d_model, d_hidden))
+        self.b1 = self.create_parameter((num_experts, d_hidden), is_bias=True)
+        self.w2 = self.create_parameter((num_experts, d_hidden, d_model))
+        self.b2 = self.create_parameter((num_experts, d_model), is_bias=True)
+        self.activation = activation
+
+    def forward(self, expert_inputs):
+        """expert_inputs: [E, C, M] -> [E, C, M]."""
+
+        def _f(x, w1, b1, w2, b2):
+            h = jnp.einsum("ecm,emh->ech", x, w1) + b1[:, None, :]
+            h = jax.nn.gelu(h) if self.activation == "gelu" else jax.nn.relu(h)
+            return jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+
+        return apply_op("expert_mlp", _f, expert_inputs, self.w1, self.b1, self.w2, self.b2)
+
+
+class MoELayer(Layer):
+    """GShard-style MoE layer (parity: moe_layer.py:263 MoELayer).
+
+    forward(x): x [b, s, M] -> [b, s, M]; sets ``self.aux_loss``.
+    With ``ep_mesh``, expert weights are sharded over the 'ep' axis and
+    GSPMD turns dispatch/combine einsums into all-to-alls (reference:
+    global_scatter/global_gather).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, topk=2, capacity_factor=1.25,
+                 gate: str = "gshard", ep_mesh: Optional[ProcessMesh] = None,
+                 ep_axis: str = "ep", activation="gelu"):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.topk = topk
+        self.capacity_factor = capacity_factor
+        self.gate_weight = self.create_parameter((d_model, num_experts))
+        self.experts = ExpertMLP(num_experts, d_model, d_hidden, activation)
+        self.aux_loss = None
+        if ep_mesh is not None and ep_axis in ep_mesh.dim_names:
+            idx = ep_mesh.dim_names.index(ep_axis)
+            pl = [Replicate()] * ep_mesh.ndim
+            pl[idx] = Shard(0)
+            for name in ("w1", "b1", "w2", "b2"):
+                self.experts._parameters[name] = shard_tensor(
+                    self.experts._parameters[name], ep_mesh, pl)
+
+    def forward(self, x):
+        b, s, m = x.shape
+        t = b * s
+        capacity = max(int(self.capacity_factor * self.topk * t / self.num_experts), 1)
+        from ..ops.manipulation import reshape
+
+        flat = reshape(x, [t, m])
+        logits = F.linear(flat, self.gate_weight)
+
+        n_exp, topk = self.num_experts, self.topk
+
+        def _route(lg):
+            return gshard_routing(lg, n_exp, capacity, topk)
+
+        dispatch, combine, aux = apply_op("moe_route", _route, logits)
+        self.aux_loss = aux
+
+        def _dispatch(xx, d):
+            return jnp.einsum("tm,tec->ecm", xx, d)
+
+        expert_in = apply_op("moe_dispatch", _dispatch, flat, dispatch)
+        expert_out = self.experts(expert_in)
+
+        def _combine(eo, c):
+            return jnp.einsum("ecm,tec->tm", eo, c)
+
+        out = apply_op("moe_combine", _combine, expert_out, combine)
+        return reshape(out, [b, s, m])

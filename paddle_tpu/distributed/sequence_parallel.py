@@ -1,0 +1,253 @@
+"""Sequence/context parallelism: Megatron-SP, Ulysses, ring attention.
+
+Parity targets (SURVEY §5.7):
+1. Megatron-SP (reference: fleet/utils/sequence_parallel_utils.py —
+   ScatterOp:85, GatherOp:97, AllGatherOp:111, ReduceScatterOp:127,
+   ColumnSequenceParallelLinear:427) — activations sharded on the seq dim
+   between TP regions.
+2. SEP/Ulysses (reference: topology.py:77 sep axis,
+   meta_parallel/segment_parallel.py:26; head-regrouping done in model
+   code downstream) — here in-framework: all-to-all seq⇄head regroup.
+3. Ring attention — NOT in the reference snapshot; the TPU-native
+   long-context capability: KV blocks rotate around the sp ring via
+   collective-permute over ICI while each rank accumulates blockwise
+   online-softmax attention for its local queries.
+
+All three run inside spmd per-rank programs (shard_map), so the
+collectives are XLA collectives; under pjit the Megatron-SP layers are
+pure sharding constraints and GSPMD inserts the same comms.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..ops.dispatch import apply_op, ensure_tensor
+from .collective import (
+    Group,
+    _current_spmd,
+    all_gather_concat,
+    all_reduce,
+    alltoall_single,
+    ppermute,
+    reduce_scatter,
+)
+
+
+# ---------------------------------------------------------------------------
+# Megatron-SP primitives (per-rank spmd forms)
+# ---------------------------------------------------------------------------
+
+
+def scatter(x: Tensor, group: Optional[Group] = None, axis: int = 0) -> Tensor:
+    """Split along seq dim, keep this rank's shard (reference ScatterOp:
+    backward = all-gather). Inside spmd only."""
+    from .collective import local_slice
+
+    return local_slice(ensure_tensor(x), axis, group)
+
+
+def gather(x: Tensor, group: Optional[Group] = None, axis: int = 0) -> Tensor:
+    """All-gather along seq dim (reference GatherOp; backward = scatter)."""
+    return all_gather_concat(x, group=group, axis=axis)
+
+
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(gather)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, group=None, axis=0):
+        return reduce_scatter(x, group=group, axis=axis)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear fed by seq-sharded activations: all-gather
+    seq → matmul (column shard) (reference :427)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=False, mp_group=None, sp_group=None, name=None):
+        super().__init__()
+        from .fleet.mp_layers import ColumnParallelLinear
+
+        self.inner = ColumnParallelLinear(in_features, out_features, weight_attr=weight_attr,
+                                          has_bias=has_bias, gather_output=gather_output)
+        self.sp_group = sp_group
+
+    def forward(self, x):
+        x = gather(x, group=self.sp_group, axis=1)  # [b, s/n, h] -> [b, s, h]
+        return self.inner(x)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose partial output is reduce-scattered back to
+    seq shards (reference RowSequenceParallelLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, mp_group=None, sp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        w = self.create_parameter((in_features, out_features), attr=weight_attr)
+        from .fleet.mp_layers import _maybe_shard, _mp_group
+
+        self.weight = _maybe_shard(w, 0)
+        self.bias = self.create_parameter((out_features,), is_bias=True) if has_bias else None
+        self.sp_group = sp_group
+        self._mp_group_fn = _mp_group
+
+    def forward(self, x):
+        if _current_spmd() is not None:
+            from .fleet.mp_layers import _local_shard
+
+            w = _local_shard(self.weight, 0, self._mp_group_fn())
+        else:
+            w = self.weight
+        out = F.linear(x, w, None)
+        if _current_spmd() is not None:
+            mp_g = self._mp_group_fn()
+            if mp_g is not None and self.sp_group is not None and self.sp_group.axis_name == mp_g.axis_name:
+                # Megatron-SP: reduce partial sums AND scatter seq in one op
+                out = reduce_scatter(out, group=mp_g, axis=1)
+            elif mp_g is not None:
+                out = all_reduce(out, group=mp_g)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (DeepSpeed-style) all-to-all attention
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(q: Tensor, k: Tensor, v: Tensor, group: Group,
+                      causal: bool = True, attn_fn=None) -> Tensor:
+    """Sequence-parallel attention by head regrouping.
+
+    Inputs are seq-sharded: [b, s/n, h, d]. all-to-all converts to
+    head-sharded full-seq [b, s, h/n, d]; local full-attention runs per
+    head group; all-to-all back. (The sep-axis capability the reference
+    leaves to model code — here a framework primitive.)
+    """
+    ctx = _current_spmd()
+    if ctx is None:
+        return (attn_fn or _plain_attention)(q, k, v, causal)
+    n = group.nranks
+
+    def regroup_fwd(t):
+        # [b, s/n, h, d] -> [b, s, h/n, d]: head-group j goes to rank j;
+        # received seq blocks concat in source-rank order = global seq order.
+        return apply_op(
+            "ulysses_fwd",
+            lambda a: jax.lax.all_to_all(a, group.axis_name, split_axis=2, concat_axis=1, tiled=True),
+            t)
+
+    def regroup_bwd(t):
+        # [b, s, h/n, d] -> [b, s/n, h, d]
+        return apply_op(
+            "ulysses_bwd",
+            lambda a: jax.lax.all_to_all(a, group.axis_name, split_axis=1, concat_axis=2, tiled=True),
+            t)
+
+    qh, kh, vh = regroup_fwd(q), regroup_fwd(k), regroup_fwd(v)
+    out = (attn_fn or _plain_attention)(qh, kh, vh, causal)
+    return regroup_bwd(out)
+
+
+def _plain_attention(q, k, v, causal):
+    return F.scaled_dot_product_attention(q, k, v, is_causal=causal)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (blockwise, KV rotation over the sp ring)
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(q: Tensor, k: Tensor, v: Tensor, group: Group, causal: bool = True) -> Tensor:
+    """Ring flash attention over the ``group`` axis.
+
+    Inputs seq-sharded [b, s/n, h, d]. Each of n steps computes blockwise
+    attention of local Q against the resident KV block (online-softmax
+    accumulation), then rotates KV to the next rank with
+    collective-permute (ICI neighbor exchange). Peak memory O(s/n); the
+    full s×s score matrix never exists. Causal masking uses global block
+    offsets so the result is exactly causal attention over the full
+    sequence.
+    """
+    ctx = _current_spmd()
+    if ctx is None:
+        return _plain_attention(q, k, v, causal)
+    n = group.nranks
+    axis = group.axis_name
+
+    def _f(qa, ka, va):
+        b, s_loc, h, d = qa.shape
+        scale = 1.0 / math.sqrt(d)
+        qt = jnp.moveaxis(qa, 2, 1).astype(jnp.float32) * scale  # [b,h,sl,d]
+        my = jax.lax.axis_index(axis)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def block(carry, step):
+            kv, m, l, acc = carry
+            kb, vb = kv
+            kt = jnp.moveaxis(kb, 2, 1).astype(jnp.float32)
+            vt = jnp.moveaxis(vb, 2, 1).astype(jnp.float32)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+            if causal:
+                src = (my - step) % n  # rank whose KV we now hold
+                qpos = my * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+                kpos = src * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+                s = jnp.where((qpos >= kpos)[None, None], s, -1e30)
+            m_new = jnp.maximum(m, s.max(-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + p.sum(-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            kv_next = (jax.lax.ppermute(kb, axis, perm), jax.lax.ppermute(vb, axis, perm))
+            return (kv_next, m_new, l_new, acc_new), None
+
+        m0 = jnp.full((b, h, s_loc), -1e30, jnp.float32)
+        l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+        acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+        (kv, m, l, acc), _ = jax.lax.scan(block, ((ka, va), m0, l0, acc0),
+                                          jnp.arange(n), length=n)
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.moveaxis(out, 1, 2).astype(qa.dtype)
+
+    return apply_op("ring_attention", _f, ensure_tensor(q), ensure_tensor(k), ensure_tensor(v))
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse=False):
+    """Parity: sequence_parallel_utils.py:192 — SP-region params (norms,
+    biases) whose grads are computed per seq-shard need an mp-group
+    allreduce. Under GSPMD this is automatic; for spmd per-rank programs
+    register leaf hooks."""
+    from .fleet.mp_layers import _mp_group
+
+    for p in model.parameters():
+        if not p.stop_gradient and getattr(p, "sequence_parallel", False):
+            p.register_hook(lambda g: all_reduce(g, group=_mp_group()))
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+    return param

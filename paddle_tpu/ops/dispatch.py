@@ -76,13 +76,35 @@ def apply_op(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = N
     record = is_grad_enabled() and any(not t.stop_gradient for t in tensors)
 
     if record:
+        # Integer/bool inputs are closed over as constants rather than vjp
+        # arguments (their cotangents would be float0; some tracer contexts
+        # — e.g. shard_map — don't support differentiating through them).
+        diff_mask = [
+            dtypes.is_floating_point(d.dtype) or np.issubdtype(np.dtype(d.dtype), np.complexfloating)
+            for d in datas
+        ]
         sg_mask = [t.stop_gradient for t in tensors]
+        diff_idx = [i for i, m in enumerate(diff_mask) if m]
 
-        def wrapped(*xs):
-            xs = [jax.lax.stop_gradient(x) if sg else x for x, sg in zip(xs, sg_mask)]
+        def wrapped(*diff_xs):
+            xs = list(datas)
+            for i, x in zip(diff_idx, diff_xs):
+                xs[i] = jax.lax.stop_gradient(x) if sg_mask[i] else x
             return fn(*xs)
 
-        out_data, vjp_fn = jax.vjp(wrapped, *datas)
+        diff_datas = [datas[i] for i in diff_idx]
+        if not diff_datas:
+            record = False
+            out_data = fn(*datas)
+        else:
+            out_data, inner_vjp = jax.vjp(wrapped, *diff_datas)
+
+            def vjp_fn(cots):
+                diff_cots = inner_vjp(cots)
+                full = [None] * len(datas)
+                for i, g in zip(diff_idx, diff_cots):
+                    full[i] = g
+                return tuple(full)
     else:
         out_data = fn(*datas)
 

@@ -52,18 +52,23 @@ def momentum(mu: float = 0.9, weight_decay: float = 0.0, use_nesterov: bool = Fa
         return {"velocity": _tree_f32_zeros(params)}
 
     def update(grads, state, params, lr):
-        def upd(p, g, v):
+        p_flat, treedef = jax.tree.flatten(params)
+        g_flat = treedef.flatten_up_to(grads)
+        v_flat = treedef.flatten_up_to(state["velocity"])
+        new_p, new_v = [], []
+        for p, g, v in zip(p_flat, g_flat, v_flat):
+            if g is None:
+                new_p.append(p)
+                new_v.append(v)
+                continue
             g32 = g.astype(jnp.float32)
             if weight_decay:
                 g32 = g32 + weight_decay * p.astype(jnp.float32)
-            v_new = mu * v + g32
-            step = (g32 + mu * v_new) if use_nesterov else v_new
-            return (p.astype(jnp.float32) - lr * step).astype(p.dtype), v_new
-
-        flat = jax.tree.map(upd, params, grads, state["velocity"])
-        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda x: isinstance(x, tuple))
-        new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
-        return new_params, {"velocity": new_v}
+            v_n = mu * v + g32
+            step = (g32 + mu * v_n) if use_nesterov else v_n
+            new_p.append((p.astype(jnp.float32) - lr * step).astype(p.dtype))
+            new_v.append(v_n)
+        return treedef.unflatten(new_p), {"velocity": treedef.unflatten(new_v)}
 
     return FunctionalOptimizer(init, update)
 
@@ -88,27 +93,32 @@ def adamw(beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
             # for dict trees; fall back to keystr-ish for others)
             return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
-        def upd(path, p, g, m, v):
+        p_flat_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+        g_flat = treedef.flatten_up_to(grads)
+        m_flat = treedef.flatten_up_to(state["m"])
+        v_flat = treedef.flatten_up_to(state["v"])
+        new_p, new_m, new_v = [], [], []
+        for (path, p), g, m, v in zip(p_flat_path, g_flat, m_flat, v_flat):
             if g is None:
-                return p, m, v
+                new_p.append(p)
+                new_m.append(m)
+                new_v.append(v)
+                continue
             g32 = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             wd = weight_decay
             if decay_mask_fn is not None and not decay_mask_fn(_path_name(path)):
                 wd = 0.0
             p32 = p32 * (1.0 - lr * wd)
-            m_new = beta1 * m + (1 - beta1) * g32
-            v_new = beta2 * v + (1 - beta2) * jnp.square(g32)
-            mhat = m_new / (1 - beta1**t)
-            vhat = v_new / (1 - beta2**t)
-            p_out = p32 - lr * mhat / (jnp.sqrt(vhat) + epsilon)
-            return p_out.astype(p.dtype), m_new, v_new
-
-        out = jax.tree_util.tree_map_with_path(upd, params, grads, state["m"], state["v"])
-        new_params = jax.tree.map(lambda x: x[0], out, is_leaf=lambda x: isinstance(x, tuple))
-        new_m = jax.tree.map(lambda x: x[1], out, is_leaf=lambda x: isinstance(x, tuple))
-        new_v = jax.tree.map(lambda x: x[2], out, is_leaf=lambda x: isinstance(x, tuple))
-        return new_params, {"m": new_m, "v": new_v, "t": t}
+            m_n = beta1 * m + (1 - beta1) * g32
+            v_n = beta2 * v + (1 - beta2) * jnp.square(g32)
+            mhat = m_n / (1 - beta1**t)
+            vhat = v_n / (1 - beta2**t)
+            new_p.append((p32 - lr * mhat / (jnp.sqrt(vhat) + epsilon)).astype(p.dtype))
+            new_m.append(m_n)
+            new_v.append(v_n)
+        return treedef.unflatten(new_p), {"m": treedef.unflatten(new_m),
+                                          "v": treedef.unflatten(new_v), "t": t}
 
     return FunctionalOptimizer(init, update)
 

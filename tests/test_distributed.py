@@ -173,7 +173,7 @@ class TestFleet:
         strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
         fleet.init(is_collective=True, strategy=strategy)
         col = ColumnParallelLinear(8, 16, gather_output=False)
-        row = RowParallelLinear(16, 8)
+        row = RowParallelLinear(16, 8, input_is_parallel=True)
         # weights carry mp placements
         assert col.weight.placements is not None
         x = paddle.to_tensor(a(4, 8))

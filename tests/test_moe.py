@@ -1,0 +1,118 @@
+"""MoE / expert parallelism tests (reference pattern:
+test/collective/collective_global_gather.py + moe unit tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.moe import ExpertMLP, MoELayer, gshard_routing
+
+import jax
+import jax.numpy as jnp
+
+RNG = np.random.RandomState(0)
+
+
+class TestRouting:
+    def test_dispatch_combine_shapes_and_capacity(self):
+        t, e, c = 16, 4, 4
+        logits = jnp.asarray(RNG.randn(t, e), jnp.float32)
+        dispatch, combine, aux = gshard_routing(logits, e, c, topk=2)
+        assert dispatch.shape == (t, e, c)
+        # no slot is used twice
+        slot_usage = np.asarray(dispatch).sum(0)  # [e, c]
+        assert slot_usage.max() <= 1.0 + 1e-6
+        # each token dispatched at most topk times
+        per_token = np.asarray(dispatch).sum((1, 2))
+        assert per_token.max() <= 2 + 1e-6
+        # combine weights nonnegative, normalized per token (when routed)
+        cw = np.asarray(combine).sum((1, 2))
+        assert ((cw > 0.99) | (cw < 1e-6)).all()
+        assert float(aux) > 0
+
+    def test_top1_routing(self):
+        t, e, c = 8, 2, 8
+        logits = jnp.asarray(RNG.randn(t, e), jnp.float32)
+        dispatch, combine, aux = gshard_routing(logits, e, c, topk=1)
+        # ample capacity: every token routed exactly once
+        np.testing.assert_allclose(np.asarray(dispatch).sum((1, 2)), np.ones(t))
+
+
+class TestMoELayer:
+    def test_forward_shape_and_aux(self):
+        paddle.seed(0)
+        layer = MoELayer(d_model=16, d_hidden=32, num_experts=4, topk=2)
+        x = paddle.to_tensor(RNG.randn(2, 8, 16).astype(np.float32))
+        out = layer(x)
+        assert out.shape == [2, 8, 16]
+        assert layer.aux_loss is not None and float(layer.aux_loss) > 0
+
+    def test_single_expert_equals_dense_mlp(self):
+        """1 expert + ample capacity == plain MLP (routing is identity)."""
+        paddle.seed(1)
+        layer = MoELayer(d_model=8, d_hidden=16, num_experts=1, topk=1, capacity_factor=4.0)
+        x = paddle.to_tensor(RNG.randn(1, 4, 8).astype(np.float32))
+        out = layer(x).numpy()
+        w1 = layer.experts.w1.numpy()[0]
+        b1 = layer.experts.b1.numpy()[0]
+        w2 = layer.experts.w2.numpy()[0]
+        b2 = layer.experts.b2.numpy()[0]
+        flat = x.numpy().reshape(4, 8)
+        import scipy.stats
+
+        def gelu(v):
+            return v * scipy.stats.norm.cdf(v)
+
+        ref = gelu(flat @ w1 + b1) @ w2 + b2
+        np.testing.assert_allclose(out.reshape(4, 8), ref, atol=1e-4, rtol=1e-4)
+
+    def test_gradients_flow_to_gate_and_experts(self):
+        paddle.seed(2)
+        layer = MoELayer(d_model=8, d_hidden=16, num_experts=4, topk=2)
+        x = paddle.to_tensor(RNG.randn(1, 8, 8).astype(np.float32), stop_gradient=False)
+        out = layer(x)
+        loss = out.sum() + 0.01 * layer.aux_loss
+        loss.backward()
+        assert layer.gate_weight.grad is not None
+        assert layer.experts.w1.grad is not None
+        assert x.grad is not None
+        assert float(paddle.abs(layer.gate_weight.grad).sum()) > 0
+
+    def test_expert_parallel_sharding(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "ep"])
+        layer = MoELayer(d_model=16, d_hidden=32, num_experts=8, topk=2, ep_mesh=mesh)
+        # expert weights sharded over ep axis
+        shard_shapes = {tuple(s.data.shape) for s in layer.experts.w1._data.addressable_shards}
+        assert shard_shapes == {(2, 16, 32)}
+        x = paddle.to_tensor(RNG.randn(2, 8, 16).astype(np.float32))
+        out = layer(x)
+        assert out.shape == [2, 8, 16]
+
+    def test_moe_in_engine_train_step(self):
+        from paddle_tpu.distributed.engine import ShardedTrainStep
+
+        paddle.seed(3)
+
+        class MoEModel(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.inp = nn.Linear(8, 16)
+                self.moe = MoELayer(d_model=16, d_hidden=32, num_experts=4, topk=2)
+                self.out = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.out(self.moe(self.inp(x)))
+
+        model = MoEModel()
+        mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        lossfn = nn.CrossEntropyLoss()
+        step = ShardedTrainStep(model, lambda o, l: lossfn(o, l), opt, mesh)
+        x = paddle.to_tensor(RNG.randn(16, 4, 8).astype(np.float32))
+        y = paddle.to_tensor(RNG.randint(0, 4, (16, 4)).astype(np.int64))
+        l0 = float(step.step(x, y))
+        for _ in range(4):
+            l1 = float(step.step(x, y))
+        assert np.isfinite(l1) and l1 < l0
