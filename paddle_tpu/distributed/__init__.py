@@ -40,3 +40,5 @@ from .api import dtensor_from_fn, reshard, shard_layer, shard_tensor, unshard_dt
 from .parallel import DataParallel
 
 from . import fleet
+from . import checkpoint
+from .checkpoint import load_state_dict, save_state_dict

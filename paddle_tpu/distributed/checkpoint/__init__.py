@@ -1,0 +1,27 @@
+"""Distributed checkpoint: sharded save with metadata + reshard-on-load.
+
+Parity: python/paddle/distributed/checkpoint/ —
+``save_state_dict`` (save_state_dict.py:145) writes each process's local
+shards plus ``Metadata`` describing every shard's global offset/shape
+(metadata.py:41 LocalTensorMetadata / LocalTensorIndex), deduplicating
+replicated shards across ranks (utils dedup_tensor:117);
+``load_state_dict`` re-shards on load onto an arbitrary target
+mesh/placement using the metadata.
+
+TPU design: shards are jax.Array addressable shards; dedup is
+``shard.replica_id == 0``; reshard-on-load assembles the requested global
+regions from saved pieces and ``jax.device_put``s them with the target
+NamedSharding (the runtime moves bytes over ICI/DCN — the reference's
+metadata+P2P resharding collapses into one device_put).
+"""
+
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import save_state_dict
+from .load_state_dict import load_state_dict
+from .utils import flatten_state_dict, unflatten_state_dict
+
+__all__ = [
+    "save_state_dict", "load_state_dict", "Metadata",
+    "LocalTensorMetadata", "LocalTensorIndex",
+    "flatten_state_dict", "unflatten_state_dict",
+]

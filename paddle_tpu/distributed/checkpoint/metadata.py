@@ -1,0 +1,35 @@
+"""Checkpoint metadata records.
+
+Parity: python/paddle/distributed/checkpoint/metadata.py:41 —
+LocalTensorMetadata (global_offset + local_shape per saved shard),
+LocalTensorIndex (tensor key + offset, the storage lookup key), Metadata
+(per-key shard lists + storage-file mapping).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # flat tensor key -> all shards that exist for it (across every rank)
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(default_factory=dict)
+    # shard -> (data file, key inside the file)
+    storage_metadata: Dict[LocalTensorIndex, Tuple[str, str]] = field(default_factory=dict)
+    # flat key -> original nested path (for unflatten)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)

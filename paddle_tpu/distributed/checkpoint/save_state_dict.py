@@ -1,0 +1,69 @@
+"""Sharded checkpoint save.
+
+Parity: python/paddle/distributed/checkpoint/save_state_dict.py:145 —
+each process writes exactly the shards it owns into
+``{path}/{proc}_0.distcp`` plus a ``{proc}.metadata`` file; replicated
+shards are written once (dedup). The union of metadata files is the global
+checkpoint Metadata the loader plans against.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import jax
+import ml_dtypes  # noqa: F401  (ensures bf16/fp8 numpy dtypes exist)
+import numpy as np
+
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .utils import flatten_state_dict, local_shards
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
+                    coordinator_rank: int = 0) -> None:
+    """Save a (possibly nested) state dict of (possibly sharded) tensors.
+
+    Every process calls this with the same keys; each writes only the
+    shards it owns. Safe to call single-process (saves everything).
+    """
+    os.makedirs(path, exist_ok=True)
+    flat, mapping = flatten_state_dict(state_dict)
+    proc = jax.process_index()
+
+    # Manifest pins the file set for this save so a later load never merges
+    # stale metadata/data from a previous save with more processes.
+    if proc == coordinator_rank:
+        with open(os.path.join(path, "manifest.pkl"), "wb") as f:
+            pickle.dump({"process_count": jax.process_count()}, f, protocol=4)
+
+    data_file = f"{proc}_0.distcp"
+    datas: Dict[str, np.ndarray] = {}
+    meta = Metadata(flat_mapping=dict(mapping))
+
+    for key, value in flat.items():
+        if value is None:
+            continue
+        if not hasattr(value, "_data") and not isinstance(value, (jax.Array, np.ndarray)):
+            # python scalars / opt hyperparams: rank coordinator keeps them
+            if proc == coordinator_rank:
+                storage_key = f"{key}@obj"
+                datas[storage_key] = value
+                idx = LocalTensorIndex(key, ())
+                meta.state_dict_metadata.setdefault(key, []).append(
+                    LocalTensorMetadata((), (), "object"))
+                meta.storage_metadata[idx] = (data_file, storage_key)
+            continue
+        for offset, arr in local_shards(value):
+            storage_key = f"{key}@{'_'.join(map(str, offset))}"
+            datas[storage_key] = arr
+            idx = LocalTensorIndex(key, offset)
+            meta.state_dict_metadata.setdefault(key, []).append(
+                LocalTensorMetadata(offset, tuple(arr.shape), arr.dtype.name))
+            meta.storage_metadata[idx] = (data_file, storage_key)
+
+    with open(os.path.join(path, data_file), "wb") as f:
+        pickle.dump(datas, f, protocol=4)
+    with open(os.path.join(path, f"{proc}.metadata"), "wb") as f:
+        pickle.dump(meta, f, protocol=4)

@@ -1,0 +1,86 @@
+"""Checkpoint helpers: state-dict flattening and shard extraction.
+
+Parity: python/paddle/distributed/checkpoint/utils.py (flatten_state_dict,
+dedup via replica ownership) — flattening at save_state_dict.py:180.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def flatten_state_dict(state_dict: Dict[str, Any]):
+    """Flatten nested dicts/lists of tensors to {flat_key: tensor} plus a
+    mapping flat_key -> nested path (list indices kept as ints so the
+    structure is recoverable). Flat-key collisions (a dict key containing
+    '.') are disambiguated with a '#N' suffix."""
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, Tuple] = {}
+
+    def rec(obj, path):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                rec(v, path + (str(k),))
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                rec(v, path + (i,))
+        else:
+            key = ".".join(str(p) for p in path)
+            n = 0
+            while key in flat:
+                n += 1
+                key = ".".join(str(p) for p in path) + f"#{n}"
+            flat[key] = obj
+            mapping[key] = path
+    rec(state_dict, ())
+    return flat, mapping
+
+
+def unflatten_state_dict(flat: Dict[str, Any], mapping: Dict[str, Tuple]):
+    out: Dict[str, Any] = {}
+    for key, value in flat.items():
+        path = mapping.get(key, (key,))
+        cur = out
+        for p, nxt in zip(path[:-1], path[1:]):
+            nxt_container = [] if isinstance(nxt, int) else {}
+            if isinstance(cur, list):
+                while len(cur) <= p:
+                    cur.append(None)
+                if cur[p] is None:
+                    cur[p] = nxt_container
+                cur = cur[p]
+            else:
+                cur = cur.setdefault(p, nxt_container)
+        last = path[-1]
+        if isinstance(cur, list):
+            while len(cur) <= last:
+                cur.append(None)
+            cur[last] = value
+        else:
+            cur[last] = value
+    return out
+
+
+def local_shards(array) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
+    """Owned (replica_id==0) addressable shards of a jax array as
+    (global_offset, numpy_data). Replicas are deduplicated: on a global
+    mesh via replica_id==0; host-local (fully-addressable) arrays — which
+    every process holds in full — are saved by process 0 only (reference
+    dedup_tensor:117 semantics)."""
+    if isinstance(array, Tensor):
+        array = array._data
+    arr = jax.numpy.asarray(array) if not isinstance(array, jax.Array) else array
+    if jax.process_count() > 1 and arr.is_fully_addressable and jax.process_index() != 0:
+        return []
+    out = []
+    for shard in arr.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        offset = tuple(0 if idx.start is None else int(idx.start) for idx in shard.index)
+        out.append((offset, np.asarray(shard.data)))
+    return out
