@@ -1,0 +1,17 @@
+"""Cluster launcher.
+
+Parity: python/paddle/distributed/launch/ — ``python -m
+paddle_tpu.distributed.launch train.py`` (reference __main__.py:17,
+main.py:23 launch): controller selection, Pod/Container subprocess
+management with per-rank log capture, HTTP master rendezvous for
+multi-node, elastic restart.
+
+TPU design: one trainer process per host (PJRT owns all local chips), so
+``--nproc_per_node`` defaults to 1 on TPU; the HTTP master doubles as the
+JAX coordination-service rendezvous (rank-0's endpoint becomes
+COORDINATOR_ADDRESS for jax.distributed.initialize).
+"""
+
+from .main import launch
+
+__all__ = ["launch"]

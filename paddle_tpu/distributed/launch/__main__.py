@@ -1,0 +1,6 @@
+"""Parity: python/paddle/distributed/launch/__main__.py:17."""
+
+from .main import launch
+
+if __name__ == "__main__":
+    raise SystemExit(launch())

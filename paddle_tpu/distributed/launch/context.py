@@ -1,0 +1,65 @@
+"""Launch context: args + node discovery.
+
+Parity: python/paddle/distributed/launch/context/ (Context with node /
+args / env). Deliberately imports no jax — the launcher stays a light
+process manager.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def host_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def parse_args(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (reference: paddle.distributed.launch)")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint ip:port (http:// KV master); "
+                        "required for multi-node")
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or min:max range for elastic")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="processes per node (default: 1 — PJRT owns all local chips)")
+    p.add_argument("--rank", type=int, default=-1,
+                   help="pin this node's rank (default: master assigns by arrival order)")
+    p.add_argument("--log_dir", default="log", help="per-rank log directory")
+    p.add_argument("--job_id", default="default", help="job name / rendezvous namespace")
+    p.add_argument("--devices", default=None, help="visible device ids (informational on TPU)")
+    p.add_argument("--max_restart", type=int, default=0, help="elastic: max pod restarts")
+    p.add_argument("training_script", help="python script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Context:
+    def __init__(self, argv: Optional[List[str]] = None):
+        self.args = parse_args(argv)
+        self.envs = dict(os.environ)
+        nnodes = str(self.args.nnodes)
+        if ":" in nnodes:
+            lo, hi = nnodes.split(":")
+            self.min_nodes, self.max_nodes = int(lo), int(hi)
+        else:
+            self.min_nodes = self.max_nodes = int(nnodes)
+        self.nproc_per_node = self.args.nproc_per_node or 1
+        self.node_ip = host_ip()
+        self.is_elastic = self.max_nodes > self.min_nodes or self.args.max_restart > 0

@@ -1,0 +1,127 @@
+"""Collective controller: build the pod, rendezvous, run, (elastically) restart.
+
+Parity: python/paddle/distributed/launch/controllers/collective.py —
+CollectiveController.build_pod (`:37`; single-node `:91`, multi-node via
+master `_build_pod_with_master:157`) and CollectiveElasticController
+(`:262` — here folded into the same class via ``max_restart``, the etcd
+lease machinery of fleet/elastic/manager.py:125 replaced by launcher-side
+failure watch + pod relaunch).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List
+
+from ..context import Context, free_port
+from ..job.container import Container, Pod, Status
+from .master import HTTPMaster
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.pod = Pod()
+        self.master = None
+        self.node_rank = 0
+        self.node_count = ctx.min_nodes
+        self.peers: List[str] = [f"{ctx.node_ip}"]
+
+    # -- pod construction --------------------------------------------------
+    def _rendezvous(self):
+        ctx = self.ctx
+        if ctx.max_nodes == 1 and ctx.args.master is None:
+            self.node_rank, self.node_count = 0, 1
+            self.coordinator = f"127.0.0.1:{free_port()}"
+            return
+        assert ctx.args.master, "--master ip:port is required for multi-node launch"
+        self.master = HTTPMaster(ctx.args.master)
+        my_ep = f"{ctx.node_ip}:{free_port()}"
+        self.peers, self.node_rank = self.master.sync_peers(
+            f"{ctx.args.job_id}/{self.pod.restarts}", my_ep, ctx.min_nodes,
+            requested_rank=ctx.args.rank)
+        self.node_count = len(self.peers)
+        # JAX coordination service lives on node-0's advertised port
+        self.coordinator = self.peers[0]
+
+    def build_pod(self):
+        ctx = self.ctx
+        self._rendezvous()
+        nproc = ctx.nproc_per_node
+        world = self.node_count * nproc
+        endpoints = list(self.peers) if self.master is not None else [self.coordinator]
+        base_cmd = [sys.executable, "-u", ctx.args.training_script]
+        script_args = ctx.args.training_script_args
+        for local_rank in range(nproc):
+            rank = self.node_rank * nproc + local_rank
+            env = {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_LOCAL_SIZE": str(nproc),
+                "PADDLE_NNODES": str(self.node_count),
+                "PADDLE_NODE_RANK": str(self.node_rank),
+                "PADDLE_MASTER": self.coordinator,
+                "COORDINATOR_ADDRESS": self.coordinator,
+                "NUM_PROCESSES": str(world),
+                "PROCESS_ID": str(rank),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "FLAGS_selected_devices": str(local_rank),
+            }
+            if ctx.args.devices:
+                env["PADDLE_DEVICES"] = ctx.args.devices
+            log_file = os.path.join(ctx.args.log_dir,
+                                    f"workerlog.{self.pod.restarts}.{rank}")
+            self.pod.add(Container(base_cmd + script_args, env, log_file, rank))
+
+    # -- run loop ----------------------------------------------------------
+    def run(self) -> int:
+        ctx = self.ctx
+        try:
+            while True:
+                self.build_pod()
+                self.pod.deploy()
+                status = self.pod.join()
+                if status == Status.COMPLETED:
+                    return 0
+                # failure: elastic restart budget?
+                failed = [c for c in self.pod.containers if c.status == Status.FAILED]
+                for c in failed[:1]:
+                    sys.stderr.write(
+                        f"[launch] rank {c.rank} failed (exit {c.exit_code}); "
+                        f"last log lines:\n{c.tail_log()}\n")
+                # Elastic restart is launcher-local: only coherent when this
+                # launcher owns the whole job (single node). Multi-node
+                # restart needs the etcd-lease membership protocol
+                # (reference ElasticManager) — fail fast instead of letting
+                # nodes re-rendezvous against peers that already exited.
+                if self.pod.restarts < ctx.args.max_restart and self.node_count == 1:
+                    self.pod.stop(force=True)
+                    restarts = self.pod.restarts + 1
+                    self.pod = Pod()
+                    self.pod.restarts = restarts
+                    sys.stderr.write(
+                        f"[launch] elastic restart {restarts}/{ctx.args.max_restart}\n")
+                    time.sleep(1.0)
+                    continue
+                self.pod.stop(force=True)
+                return 1
+        except (TimeoutError, OSError) as e:
+            sys.stderr.write(f"[launch] fatal: {e}\n")
+            self.pod.stop(force=True)
+            return 1
+        finally:
+            self._finalize()
+
+    def _finalize(self):
+        if self.master is not None:
+            self.master.stop()
+
+
+def init_controller(ctx: Context) -> CollectiveController:
+    """Reference main.py:503 picks collective/ps/rpc/ipu controllers; on TPU
+    the collective controller is the only meaningful one (PS is stubbed at
+    the API layer)."""
+    return CollectiveController(ctx)

@@ -1,0 +1,194 @@
+"""Rendezvous master: in-launcher HTTP KV store.
+
+Parity: python/paddle/distributed/launch/controllers/master.py:73
+HTTPMaster (launcher-hosted KV + sync_peers peer/rank assignment; the
+reference's ETCDMaster `:186` is the etcd-backed variant — out of scope
+here, the HTTP master covers single- and multi-node on TPU pods).
+
+Endpoints: PUT /kv/<key>, GET /kv/<key>, GET /prefix/<p> (json dict of all
+keys under p), POST /add/<key> (atomic counter). sync_peers barriers all
+nodes and assigns stable ranks by sorted endpoint.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    # per-server state is installed on a subclass by HTTPMaster._maybe_host,
+    # so two masters in one process never share (or leak) keys
+    store: Dict[str, bytes]
+    counters: Dict[str, int]
+    lock: threading.Lock
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+    def _send(self, code: int, body: bytes = b""):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        key = urllib.parse.unquote(self.path)
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n)
+        with self.lock:
+            self.store[key] = val
+        self._send(200)
+
+    def do_POST(self):
+        key = urllib.parse.unquote(self.path)
+        if key.startswith("/add/"):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            delta = int(self.rfile.read(n) or b"1")
+            with self.lock:
+                self.counters[key] = self.counters.get(key, 0) + delta
+                out = str(self.counters[key]).encode()
+            self._send(200, out)
+        else:
+            self._send(404)
+
+    def do_GET(self):
+        key = urllib.parse.unquote(self.path)
+        with self.lock:
+            if key.startswith("/prefix/"):
+                prefix = "/kv/" + key[len("/prefix/"):]
+                out = {k[len("/kv/"):]: v.decode("latin1")
+                       for k, v in self.store.items() if k.startswith(prefix)}
+                self._send(200, json.dumps(out).encode())
+            elif key in self.store:
+                self._send(200, self.store[key])
+            else:
+                self._send(404)
+
+    def do_DELETE(self):
+        key = urllib.parse.unquote(self.path)
+        with self.lock:
+            self.store.pop(key, None)
+        self._send(200)
+
+
+class HTTPMaster:
+    """KV client; lazily hosts the server if the endpoint is local and free."""
+
+    def __init__(self, endpoint: str, try_host: bool = True):
+        self.endpoint = endpoint.replace("http://", "")
+        self.ip, port = self.endpoint.split(":")
+        self.port = int(port)
+        self.server: Optional[ThreadingHTTPServer] = None
+        if try_host:
+            self._maybe_host()
+
+    def _maybe_host(self):
+        import socket as _socket
+
+        local = {"127.0.0.1", "localhost", "0.0.0.0"}
+        try:
+            local.add(_socket.gethostbyname(_socket.gethostname()))
+        except OSError:
+            pass
+        from ..context import host_ip
+
+        local.add(host_ip())
+        if self.ip not in local:
+            return  # endpoint is on another node; stay client-only
+        handler = type("_KV", (_KVHandler,),
+                       {"store": {}, "counters": {}, "lock": threading.Lock()})
+        try:
+            self.server = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        except OSError:
+            return  # someone else (another launcher on this node) is hosting
+        t = threading.Thread(target=self.server.serve_forever, daemon=True)
+        t.start()
+
+    def stop(self):
+        if self.server is not None:
+            self.server.shutdown()
+            self.server = None
+
+    # -- KV ops ------------------------------------------------------------
+    # Every op retries while the master comes up: nodes may start before
+    # the master-hosting launcher (reference tolerates this via TCPStore
+    # connect retries, tcp_utils.cc).
+    def _request(self, method: str, path: str, body=None, retry_for: float = 60.0):
+        deadline = time.time() + retry_for
+        last_err = None
+        while time.time() < deadline:
+            try:
+                c = http.client.HTTPConnection(self.ip, self.port, timeout=10)
+                c.request(method, path, body=body)
+                r = c.getresponse()
+                return r.status, r.read()
+            except (ConnectionError, OSError, http.client.HTTPException) as e:
+                last_err = e
+                time.sleep(0.5)
+        raise TimeoutError(f"master {self.endpoint} unreachable for {retry_for}s: {last_err}")
+
+    def put(self, key: str, value: str):
+        self._request("PUT", "/kv/" + urllib.parse.quote(key), value.encode("latin1"))
+
+    def get(self, key: str) -> Optional[str]:
+        status, body = self._request("GET", "/kv/" + urllib.parse.quote(key))
+        return body.decode("latin1") if status == 200 else None
+
+    def prefix(self, p: str) -> Dict[str, str]:
+        status, body = self._request("GET", "/prefix/" + urllib.parse.quote(p))
+        return json.loads(body or b"{}") if status == 200 else {}
+
+    def add(self, key: str, delta: int = 1) -> int:
+        _, body = self._request("POST", "/add/" + urllib.parse.quote(key), str(delta).encode())
+        return int(body)
+
+    def wait(self, key: str, timeout: float = 300.0, interval: float = 0.2) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"master.wait({key!r}) timed out after {timeout}s")
+
+    # -- rendezvous --------------------------------------------------------
+    def sync_peers(self, job_id: str, my_endpoint: str, size: int,
+                   timeout: float = 300.0, requested_rank: int = -1,
+                   settle: float = 1.0) -> Tuple[List[str], int]:
+        """Register and barrier until ``size`` peers; the first-arrived node
+        freezes and publishes the final peer list (after a short settle
+        window so late joiners within an elastic nnodes range are included),
+        and every node reads that single list — all nodes therefore agree on
+        node_count even when more than ``size`` peers race in. Rank honors
+        ``requested_rank`` when given, else arrival order (reference
+        sync_peers semantics)."""
+        seq = self.add(f"{job_id}/seq") - 1
+        self.put(f"{job_id}/peer/{seq:06d}", f"{requested_rank}|{my_endpoint}")
+        deadline = time.time() + timeout
+        if seq == 0:
+            # coordinator: wait for quorum, settle, freeze the list
+            while time.time() < deadline:
+                peers = self.prefix(f"{job_id}/peer/")
+                if len(peers) >= size:
+                    break
+                time.sleep(0.2)
+            else:
+                raise TimeoutError(
+                    f"rendezvous for job {job_id}: have "
+                    f"{len(self.prefix(f'{job_id}/peer/'))}/{size} peers")
+            time.sleep(settle)
+            peers = self.prefix(f"{job_id}/peer/")
+            entries = [peers[k].split("|", 1) for k in sorted(peers)]
+            # requested ranks first (stable by arrival), then the rest
+            entries.sort(key=lambda e: (int(e[0]) < 0, int(e[0])))
+            ordered = [ep for _, ep in entries]
+            self.put(f"{job_id}/final", json.dumps(ordered))
+        final = self.wait(f"{job_id}/final", timeout=max(deadline - time.time(), 1.0))
+        ordered = json.loads(final)
+        return ordered, ordered.index(my_endpoint)

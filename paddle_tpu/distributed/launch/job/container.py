@@ -1,0 +1,111 @@
+"""Pod / Container process management.
+
+Parity: python/paddle/distributed/launch/job/{pod,container}.py — a Pod is
+the per-node set of trainer Containers (subprocesses) with env injection,
+log redirection, status polling and group kill.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Status:
+    UNINIT = "uninit"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+
+class Container:
+    def __init__(self, entrypoint: List[str], env: Dict[str, str], log_file: str, rank: int):
+        self.entrypoint = entrypoint
+        self.env = env
+        self.log_file = log_file
+        self.rank = rank
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_handle = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_file) or ".", exist_ok=True)
+        self._log_handle = open(self.log_file, "ab")
+        full_env = {**os.environ, **self.env}
+        self.proc = subprocess.Popen(
+            self.entrypoint, env=full_env,
+            stdout=self._log_handle, stderr=subprocess.STDOUT)
+
+    @property
+    def status(self) -> str:
+        if self.proc is None:
+            return Status.UNINIT
+        rc = self.proc.poll()
+        if rc is None:
+            return Status.RUNNING
+        return Status.COMPLETED if rc == 0 else Status.FAILED
+
+    @property
+    def exit_code(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, force: bool = False):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL if force else signal.SIGTERM)
+        if self._log_handle is not None:
+            self._log_handle.close()
+            self._log_handle = None
+
+    def wait(self, timeout=None):
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def tail_log(self, n: int = 20) -> str:
+        try:
+            with open(self.log_file, "rb") as f:
+                return b"\n".join(f.read().splitlines()[-n:]).decode(errors="replace")
+        except OSError:
+            return ""
+
+
+class Pod:
+    def __init__(self):
+        self.containers: List[Container] = []
+        self.restarts = 0
+
+    def add(self, c: Container):
+        self.containers.append(c)
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def poll(self) -> str:
+        """Aggregate status: FAILED if any failed, COMPLETED if all done."""
+        states = [c.status for c in self.containers]
+        if Status.FAILED in states:
+            return Status.FAILED
+        if all(s == Status.COMPLETED for s in states):
+            return Status.COMPLETED
+        return Status.RUNNING
+
+    def join(self, poll_interval: float = 0.5) -> str:
+        while True:
+            st = self.poll()
+            if st != Status.RUNNING:
+                return st
+            time.sleep(poll_interval)
+
+    def stop(self, force: bool = False):
+        for c in self.containers:
+            c.terminate(force=force)
+
+    def clear(self):
+        self.stop(force=True)
+        self.containers = []

@@ -1,0 +1,14 @@
+"""Launcher entry. Parity: python/paddle/distributed/launch/main.py:23."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .context import Context
+from .controllers.collective import init_controller
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    ctx = Context(argv)
+    controller = init_controller(ctx)
+    return controller.run()

@@ -1,0 +1,109 @@
+"""Launcher: pod/container mgmt, HTTP master rendezvous, elastic restart.
+
+Mirrors the reference pattern of exercising launch on localhost
+(test/collective/test_communication_api_base.py spawns
+``python -m paddle.distributed.launch`` subprocesses).
+"""
+
+import os
+import sys
+import threading
+
+from paddle_tpu.distributed.launch.context import Context, free_port
+from paddle_tpu.distributed.launch.controllers.collective import CollectiveController
+from paddle_tpu.distributed.launch.controllers.master import HTTPMaster
+
+
+def _write_script(tmp_path, body: str) -> str:
+    p = tmp_path / "train.py"
+    p.write_text(body)
+    return str(p)
+
+
+def test_single_node_two_procs(tmp_path):
+    script = _write_script(tmp_path, (
+        "import os\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "open(os.path.join(r'%s', 'out'+rank), 'w').write(\n"
+        "    os.environ['PADDLE_TRAINERS_NUM'])\n" % tmp_path))
+    ctx = Context(["--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"), script])
+    rc = CollectiveController(ctx).run()
+    assert rc == 0
+    assert (tmp_path / "out0").read_text() == "2"
+    assert (tmp_path / "out1").read_text() == "2"
+    assert os.path.exists(tmp_path / "logs" / "workerlog.0.0")
+
+
+def test_failure_propagates_nonzero_exit(tmp_path):
+    script = _write_script(tmp_path, "import sys; sys.exit(3)\n")
+    ctx = Context(["--nproc_per_node", "1", "--log_dir", str(tmp_path / "logs"), script])
+    rc = CollectiveController(ctx).run()
+    assert rc == 1
+
+
+def test_elastic_restart_recovers(tmp_path):
+    # first attempt fails, second succeeds (marker-file state machine)
+    script = _write_script(tmp_path, (
+        "import os, sys\n"
+        "m = os.path.join(r'%s', 'marker')\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close(); sys.exit(1)\n"
+        "sys.exit(0)\n" % tmp_path))
+    ctx = Context(["--nproc_per_node", "1", "--max_restart", "2",
+                   "--log_dir", str(tmp_path / "logs"), script])
+    rc = CollectiveController(ctx).run()
+    assert rc == 0
+    assert os.path.exists(tmp_path / "logs" / "workerlog.1.0")  # restarted pod logs
+
+
+def test_multi_node_simulated_on_localhost(tmp_path):
+    # reference pattern: multi-node is simulated by multiple launch
+    # invocations on localhost sharing one master port
+    script = _write_script(tmp_path, (
+        "import os\n"
+        "open(os.path.join(r'%s', 'node'+os.environ['PADDLE_NODE_RANK']), 'w')"
+        ".write(os.environ['PROCESS_ID']+'/'+os.environ['NUM_PROCESSES'])\n" % tmp_path))
+    port = free_port()
+    rcs = {}
+
+    def run_node(i):
+        ctx = Context(["--master", f"127.0.0.1:{port}", "--nnodes", "2",
+                       "--log_dir", str(tmp_path / f"logs{i}"), "--job_id", "mn", script])
+        rcs[i] = CollectiveController(ctx).run()
+
+    ts = [threading.Thread(target=run_node, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert rcs == {0: 0, 1: 0}
+    vals = sorted((tmp_path / f"node{i}").read_text() for i in range(2))
+    assert vals == ["0/2", "1/2"]
+
+
+def test_http_master_kv_and_rendezvous():
+    port = free_port()
+    master = HTTPMaster(f"127.0.0.1:{port}")
+    try:
+        master.put("k1", "v1")
+        assert master.get("k1") == "v1"
+        assert master.get("nope") is None
+        assert master.add("cnt") == 1
+        assert master.add("cnt", 5) == 6
+
+        results = {}
+
+        def join(name):
+            m = HTTPMaster(f"127.0.0.1:{port}", try_host=False)
+            peers, rank = m.sync_peers("job0", name, 2)
+            results[name] = (peers, rank)
+
+        t1 = threading.Thread(target=join, args=("10.0.0.1:1",))
+        t2 = threading.Thread(target=join, args=("10.0.0.2:2",))
+        t1.start(); t2.start(); t1.join(10); t2.join(10)
+        assert len(results) == 2
+        (p1, r1), (p2, r2) = results["10.0.0.1:1"], results["10.0.0.2:2"]
+        assert p1 == p2 and len(p1) == 2
+        assert {r1, r2} == {0, 1}
+    finally:
+        master.stop()
