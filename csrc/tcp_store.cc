@@ -1,0 +1,417 @@
+// TCPStore: rendezvous key-value store for multi-host bootstrap.
+//
+// Native C++ equivalent of the reference's store
+// (paddle/phi/core/distributed/store/tcp_store.h:121, tcp_utils.cc):
+// a master-hosted KV with blocking get/wait and atomic add, used for
+// rank rendezvous, barriers and checkpoint coordination. The TPU build
+// keeps the same semantics but is transport-only — collective setup
+// itself rides the PJRT coordination service.
+//
+// Wire protocol (little-endian, shared with the Python fallback client
+// in paddle_tpu/distributed/store.py):
+//   request : u8 cmd | u32 keylen | key bytes | payload
+//   SET(1)  : payload = u32 vallen | bytes          -> reply u8 1
+//   GET(2)  : payload = i64 timeout_ms              -> reply u32 len | bytes
+//                                                      (len=0xFFFFFFFF on timeout)
+//   ADD(3)  : payload = i64 delta                   -> reply i64 new value
+//   WAIT(4) : payload = i64 timeout_ms              -> reply u8 (1 ok / 0 timeout)
+//   CHECK(5): no payload                            -> reply u8 exists
+//   DEL(6)  : no payload                            -> reply u8 existed
+//   NKEYS(7): no payload (key ignored)              -> reply i64 count
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <climits>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 1,
+  kGet = 2,
+  kAdd = 3,
+  kWait = 4,
+  kCheck = 5,
+  kDelete = 6,
+  kNumKeys = 7,
+};
+
+constexpr uint32_t kTimeoutLen = 0xFFFFFFFFu;
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Server {
+ public:
+  explicit Server(int port) : stop_(false), listen_fd_(-1), port_(0) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    sockaddr_in got{};
+    socklen_t len = sizeof(got);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&got), &len);
+    port_ = ntohs(got.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~Server() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stop_.compare_exchange_strong(expected, true)) return;
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop_.load()) break;
+        if (errno == EINTR) continue;
+        break;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      conn_fds_.push_back(fd);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stop_.load()) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      uint32_t keylen;
+      if (!recv_all(fd, &keylen, 4) || keylen > (64u << 20)) break;
+      std::string key(keylen, '\0');
+      if (keylen && !recv_all(fd, &key[0], keylen)) break;
+      if (!Dispatch(fd, static_cast<Cmd>(cmd), key)) break;
+    }
+    ::close(fd);
+  }
+
+  bool Dispatch(int fd, Cmd cmd, const std::string& key) {
+    switch (cmd) {
+      case kSet: {
+        uint32_t vallen;
+        if (!recv_all(fd, &vallen, 4) || vallen > (256u << 20)) return false;
+        std::string val(vallen, '\0');
+        if (vallen && !recv_all(fd, &val[0], vallen)) return false;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_[key] = std::move(val);
+          cv_.notify_all();
+        }
+        uint8_t ok = 1;
+        return send_all(fd, &ok, 1);
+      }
+      case kGet: {
+        int64_t timeout_ms;
+        if (!recv_all(fd, &timeout_ms, 8)) return false;
+        std::string val;
+        if (!WaitKey(key, timeout_ms, &val)) {
+          uint32_t len = kTimeoutLen;
+          return send_all(fd, &len, 4);
+        }
+        uint32_t len = static_cast<uint32_t>(val.size());
+        return send_all(fd, &len, 4) && (val.empty() || send_all(fd, val.data(), val.size()));
+      }
+      case kAdd: {
+        int64_t delta;
+        if (!recv_all(fd, &delta, 8)) return false;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end() && !it->second.empty()) cur = std::stoll(it->second);
+          result = cur + delta;
+          kv_[key] = std::to_string(result);
+          cv_.notify_all();
+        }
+        return send_all(fd, &result, 8);
+      }
+      case kWait: {
+        int64_t timeout_ms;
+        if (!recv_all(fd, &timeout_ms, 8)) return false;
+        uint8_t ok = WaitKey(key, timeout_ms, nullptr) ? 1 : 0;
+        return send_all(fd, &ok, 1);
+      }
+      case kCheck: {
+        std::lock_guard<std::mutex> lk(mu_);
+        uint8_t ok = kv_.count(key) ? 1 : 0;
+        return send_all(fd, &ok, 1);
+      }
+      case kDelete: {
+        std::lock_guard<std::mutex> lk(mu_);
+        uint8_t existed = kv_.erase(key) ? 1 : 0;
+        return send_all(fd, &existed, 1);
+      }
+      case kNumKeys: {
+        int64_t n;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          n = static_cast<int64_t>(kv_.size());
+        }
+        return send_all(fd, &n, 8);
+      }
+    }
+    return false;
+  }
+
+  // Blocks until `key` exists (or timeout / shutdown). timeout_ms < 0 = forever.
+  bool WaitKey(const std::string& key, int64_t timeout_ms, std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return stop_.load() || kv_.count(key) > 0; };
+    if (timeout_ms < 0) {
+      cv_.wait(lk, pred);
+    } else if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+      return false;
+    }
+    auto it = kv_.find(key);
+    if (it == kv_.end()) return false;
+    if (out) *out = it->second;
+    return true;
+  }
+
+  std::atomic<bool> stop_;
+  int listen_fd_;
+  int port_;
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+  std::unordered_map<std::string, std::string> kv_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+};
+
+class Client {
+ public:
+  Client(const char* host, int port, long timeout_ms) : fd_(-1) {
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    // Retry until the server comes up (ranks race the master at startup).
+    while (std::chrono::steady_clock::now() < deadline) {
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(host, std::to_string(port).c_str(), &hints, &res) == 0) {
+        int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+        if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          fd_ = fd;
+          ::freeaddrinfo(res);
+          return;
+        }
+        if (fd >= 0) ::close(fd);
+        ::freeaddrinfo(res);
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  bool SendReq(Cmd cmd, const std::string& key, const void* payload, size_t plen) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t c = cmd;
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    return send_all(fd_, &c, 1) && send_all(fd_, &klen, 4) &&
+           (key.empty() || send_all(fd_, key.data(), key.size())) &&
+           (plen == 0 || send_all(fd_, payload, plen));
+  }
+
+  int fd() const { return fd_; }
+  std::mutex& mu() { return mu_; }
+
+ private:
+  int fd_;
+  std::mutex mu_;  // one request/response at a time per client
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_start(int port) {
+  auto* s = new Server(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pts_server_port(void* h) { return h ? static_cast<Server*>(h)->port() : -1; }
+
+void pts_server_stop(void* h) {
+  if (!h) return;
+  auto* s = static_cast<Server*>(h);
+  s->Stop();
+  delete s;
+}
+
+void* pts_client_new(const char* host, int port, long timeout_ms) {
+  auto* c = new Client(host, port, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pts_client_free(void* h) { delete static_cast<Client*>(h); }
+
+int pts_set(void* h, const char* key, const void* data, int len) {
+  auto* c = static_cast<Client*>(h);
+  std::string k(key);
+  std::vector<char> payload(4 + (len > 0 ? len : 0));
+  uint32_t vallen = static_cast<uint32_t>(len);
+  std::memcpy(payload.data(), &vallen, 4);
+  if (len > 0) std::memcpy(payload.data() + 4, data, len);
+  if (!c->SendReq(kSet, k, payload.data(), payload.size())) return -1;
+  uint8_t ok;
+  std::lock_guard<std::mutex> lk(c->mu());
+  return recv_all(c->fd(), &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// Returns 0 on success (caller frees *out with pts_buf_free), -1 timeout/error.
+int pts_get(void* h, const char* key, long timeout_ms, void** out, int* outlen) {
+  auto* c = static_cast<Client*>(h);
+  int64_t t = timeout_ms;
+  if (!c->SendReq(kGet, key, &t, 8)) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  uint32_t len;
+  if (!recv_all(c->fd(), &len, 4)) return -1;
+  if (len == kTimeoutLen) return -1;
+  char* buf = static_cast<char*>(::malloc(len ? len : 1));
+  if (len && !recv_all(c->fd(), buf, len)) {
+    ::free(buf);
+    return -1;
+  }
+  *out = buf;
+  *outlen = static_cast<int>(len);
+  return 0;
+}
+
+void pts_buf_free(void* p) { ::free(p); }
+
+long long pts_add(void* h, const char* key, long long delta) {
+  auto* c = static_cast<Client*>(h);
+  int64_t d = delta;
+  if (!c->SendReq(kAdd, key, &d, 8)) return LLONG_MIN;
+  std::lock_guard<std::mutex> lk(c->mu());
+  int64_t result;
+  if (!recv_all(c->fd(), &result, 8)) return LLONG_MIN;
+  return result;
+}
+
+int pts_wait(void* h, const char* key, long timeout_ms) {
+  auto* c = static_cast<Client*>(h);
+  int64_t t = timeout_ms;
+  if (!c->SendReq(kWait, key, &t, 8)) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  uint8_t ok;
+  if (!recv_all(c->fd(), &ok, 1)) return -1;
+  return ok == 1 ? 0 : -1;
+}
+
+int pts_check(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  if (!c->SendReq(kCheck, key, nullptr, 0)) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  uint8_t ok;
+  if (!recv_all(c->fd(), &ok, 1)) return -1;
+  return ok;
+}
+
+int pts_delete_key(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  if (!c->SendReq(kDelete, key, nullptr, 0)) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  uint8_t existed;
+  if (!recv_all(c->fd(), &existed, 1)) return -1;
+  return existed;
+}
+
+long long pts_num_keys(void* h) {
+  auto* c = static_cast<Client*>(h);
+  if (!c->SendReq(kNumKeys, "", nullptr, 0)) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  int64_t n;
+  if (!recv_all(c->fd(), &n, 8)) return -1;
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+"""Loader for the native C++ runtime library (csrc/).
+
+The reference framework's runtime substrate (store, allocators, tracer)
+is C++ (paddle/phi/core/...); ours is too — csrc/ builds
+libpaddle_tpu_native.so, bound here via ctypes (no pybind11 in the
+image). The library is built lazily on first use and cached; every
+consumer has a pure-Python fallback so the framework still works where
+no C++ toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
+
+
+def _build() -> bool:
+    if not os.path.isdir(_CSRC) or shutil.which("make") is None:
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _CSRC, f"-j{os.cpu_count() or 2}"],
+            check=True, capture_output=True, timeout=300)
+        return os.path.exists(_SO)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.pts_server_start.restype = c.c_void_p
+    lib.pts_server_start.argtypes = [c.c_int]
+    lib.pts_server_port.restype = c.c_int
+    lib.pts_server_port.argtypes = [c.c_void_p]
+    lib.pts_server_stop.argtypes = [c.c_void_p]
+    lib.pts_client_new.restype = c.c_void_p
+    lib.pts_client_new.argtypes = [c.c_char_p, c.c_int, c.c_long]
+    lib.pts_client_free.argtypes = [c.c_void_p]
+    lib.pts_set.restype = c.c_int
+    lib.pts_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pts_get.restype = c.c_int
+    lib.pts_get.argtypes = [c.c_void_p, c.c_char_p, c.c_long,
+                            c.POINTER(c.c_void_p), c.POINTER(c.c_int)]
+    lib.pts_buf_free.argtypes = [c.c_void_p]
+    lib.pts_add.restype = c.c_longlong
+    lib.pts_add.argtypes = [c.c_void_p, c.c_char_p, c.c_longlong]
+    lib.pts_wait.restype = c.c_int
+    lib.pts_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_long]
+    lib.pts_check.restype = c.c_int
+    lib.pts_check.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pts_delete_key.restype = c.c_int
+    lib.pts_delete_key.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pts_num_keys.restype = c.c_longlong
+    lib.pts_num_keys.argtypes = [c.c_void_p]
+
+
+def get_native():
+    """Return the loaded CDLL, building it if needed; None if unavailable.
+
+    Disable with PADDLE_TPU_DISABLE_NATIVE=1 (forces Python fallbacks)."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE", "0") == "1":
+            return None
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            _declare(lib)
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_native() is not None

@@ -35,6 +35,7 @@ from .collective import (
     stream,
 )
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized
+from .store import TCPStore, create_or_get_global_tcp_store
 from .mesh import Partial, Placement, ProcessMesh, Replicate, Shard
 from .api import dtensor_from_fn, reshard, shard_layer, shard_tensor, unshard_dtensor
 from .parallel import DataParallel

@@ -19,6 +19,23 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def free_port_pair() -> int:
+    """First port of two consecutive free ports: port for the JAX
+    coordination service, port+1 for the rank-0 TCPStore server (they must
+    not contend — both are derived from the one advertised endpoint)."""
+    for _ in range(64):
+        p = free_port()
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                s.bind(("", p + 1))
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                s.bind(("", p))
+            return p
+        except OSError:
+            continue
+    return free_port()  # give up on adjacency; store will pick its own port
+
+
 def host_ip() -> str:
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:

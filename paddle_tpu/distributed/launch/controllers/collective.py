@@ -15,7 +15,7 @@ import sys
 import time
 from typing import List
 
-from ..context import Context, free_port
+from ..context import Context, free_port, free_port_pair
 from ..job.container import Container, Pod, Status
 from .master import HTTPMaster
 
@@ -34,11 +34,11 @@ class CollectiveController:
         ctx = self.ctx
         if ctx.max_nodes == 1 and ctx.args.master is None:
             self.node_rank, self.node_count = 0, 1
-            self.coordinator = f"127.0.0.1:{free_port()}"
+            self.coordinator = f"127.0.0.1:{free_port_pair()}"
             return
         assert ctx.args.master, "--master ip:port is required for multi-node launch"
         self.master = HTTPMaster(ctx.args.master)
-        my_ep = f"{ctx.node_ip}:{free_port()}"
+        my_ep = f"{ctx.node_ip}:{free_port_pair()}"
         self.peers, self.node_rank = self.master.sync_peers(
             f"{ctx.args.job_id}/{self.pod.restarts}", my_ep, ctx.min_nodes,
             requested_rank=ctx.args.rank)
@@ -65,6 +65,11 @@ class CollectiveController:
                 "PADDLE_NODE_RANK": str(self.node_rank),
                 "PADDLE_MASTER": self.coordinator,
                 "COORDINATOR_ADDRESS": self.coordinator,
+                # TCPStore lives next to (not on) the coordinator port —
+                # jax.distributed binds the coordinator port on rank 0
+                "PADDLE_STORE_ENDPOINT": "{}:{}".format(
+                    self.coordinator.rsplit(":", 1)[0],
+                    int(self.coordinator.rsplit(":", 1)[1]) + 1),
                 "NUM_PROCESSES": str(world),
                 "PROCESS_ID": str(rank),
                 "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),

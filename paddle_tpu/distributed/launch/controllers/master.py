@@ -113,6 +113,7 @@ class HTTPMaster:
     def stop(self):
         if self.server is not None:
             self.server.shutdown()
+            self.server.server_close()
             self.server = None
 
     # -- KV ops ------------------------------------------------------------
@@ -123,14 +124,16 @@ class HTTPMaster:
         deadline = time.time() + retry_for
         last_err = None
         while time.time() < deadline:
+            c = http.client.HTTPConnection(self.ip, self.port, timeout=10)
             try:
-                c = http.client.HTTPConnection(self.ip, self.port, timeout=10)
                 c.request(method, path, body=body)
                 r = c.getresponse()
                 return r.status, r.read()
             except (ConnectionError, OSError, http.client.HTTPException) as e:
                 last_err = e
                 time.sleep(0.5)
+            finally:
+                c.close()
         raise TimeoutError(f"master {self.endpoint} unreachable for {retry_for}s: {last_err}")
 
     def put(self, key: str, value: str):
@@ -185,10 +188,25 @@ class HTTPMaster:
             time.sleep(settle)
             peers = self.prefix(f"{job_id}/peer/")
             entries = [peers[k].split("|", 1) for k in sorted(peers)]
-            # requested ranks first (stable by arrival), then the rest
-            entries.sort(key=lambda e: (int(e[0]) < 0, int(e[0])))
-            ordered = [ep for _, ep in entries]
+            # pinned nodes sit at exactly their requested rank; unpinned (and
+            # invalid/conflicting requests) fill remaining slots by arrival
+            n = len(entries)
+            ordered: List[Optional[str]] = [None] * n
+            spill = []
+            for req, ep in entries:
+                r = int(req)
+                if 0 <= r < n and ordered[r] is None:
+                    ordered[r] = ep
+                else:
+                    spill.append(ep)
+            free = iter(i for i in range(n) if ordered[i] is None)
+            for ep in spill:
+                ordered[next(free)] = ep
             self.put(f"{job_id}/final", json.dumps(ordered))
         final = self.wait(f"{job_id}/final", timeout=max(deadline - time.time(), 1.0))
         ordered = json.loads(final)
+        if my_endpoint not in ordered:
+            raise RuntimeError(
+                f"rendezvous for job {job_id}: this node ({my_endpoint}) arrived "
+                f"after the peer list was frozen ({ordered}); relaunch to rejoin")
         return ordered, ordered.index(my_endpoint)

@@ -1,0 +1,423 @@
+"""TCPStore: rendezvous KV store (master-hosted) for multi-host jobs.
+
+API parity with the reference's `core.TCPStore` / store_utils
+(paddle/phi/core/distributed/store/tcp_store.h:121, store.h) as used by
+init_parallel_env (python/paddle/distributed/parallel.py:1134
+create_or_get_global_tcp_store). Backed by the native C++ server/client
+in csrc/tcp_store.cc; a pure-Python client/server speaking the same wire
+protocol is the fallback, so mixed native/Python fleets interoperate.
+
+On TPU the store does NOT carry collective setup (PJRT's coordination
+service does that); it serves rank rendezvous, barriers, elastic
+membership, and checkpoint coordination.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+from ..core.native import get_native
+
+_CMD_SET, _CMD_GET, _CMD_ADD, _CMD_WAIT, _CMD_CHECK, _CMD_DEL, _CMD_NKEYS = range(1, 8)
+_TIMEOUT_LEN = 0xFFFFFFFF
+
+
+def _to_bytes(v: Union[bytes, str, int]) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, int):
+        return str(v).encode()
+    return v.encode()
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python server (same protocol as csrc/tcp_store.cc)
+# ---------------------------------------------------------------------------
+
+
+class _PyServer:
+    def __init__(self, port: int):
+        self._kv: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _recv(self, conn, n) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        with conn:
+            while not self._stop:
+                hdr = self._recv(conn, 5)
+                if hdr is None:
+                    return
+                cmd, keylen = struct.unpack("<BI", hdr)
+                key_b = self._recv(conn, keylen) if keylen else b""
+                if key_b is None:
+                    return
+                key = key_b.decode()
+                if not self._dispatch(conn, cmd, key):
+                    return
+
+    def _wait_key(self, key, timeout_ms) -> Optional[bytes]:
+        deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._kv and not self._stop:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._kv.get(key)
+
+    def _dispatch(self, conn, cmd, key) -> bool:
+        try:
+            if cmd == _CMD_SET:
+                raw = self._recv(conn, 4)
+                if raw is None:
+                    return False
+                (vallen,) = struct.unpack("<I", raw)
+                val = self._recv(conn, vallen) if vallen else b""
+                if val is None:
+                    return False
+                with self._cv:
+                    self._kv[key] = val
+                    self._cv.notify_all()
+                conn.sendall(b"\x01")
+            elif cmd == _CMD_GET:
+                raw = self._recv(conn, 8)
+                if raw is None:
+                    return False
+                (timeout_ms,) = struct.unpack("<q", raw)
+                val = self._wait_key(key, timeout_ms)
+                if val is None:
+                    conn.sendall(struct.pack("<I", _TIMEOUT_LEN))
+                else:
+                    conn.sendall(struct.pack("<I", len(val)) + val)
+            elif cmd == _CMD_ADD:
+                raw = self._recv(conn, 8)
+                if raw is None:
+                    return False
+                (delta,) = struct.unpack("<q", raw)
+                with self._cv:
+                    cur = int(self._kv.get(key, b"0") or b"0")
+                    new = cur + delta
+                    self._kv[key] = str(new).encode()
+                    self._cv.notify_all()
+                conn.sendall(struct.pack("<q", new))
+            elif cmd == _CMD_WAIT:
+                raw = self._recv(conn, 8)
+                if raw is None:
+                    return False
+                (timeout_ms,) = struct.unpack("<q", raw)
+                ok = self._wait_key(key, timeout_ms) is not None
+                conn.sendall(b"\x01" if ok else b"\x00")
+            elif cmd == _CMD_CHECK:
+                with self._cv:
+                    ok = key in self._kv
+                conn.sendall(b"\x01" if ok else b"\x00")
+            elif cmd == _CMD_DEL:
+                with self._cv:
+                    existed = self._kv.pop(key, None) is not None
+                conn.sendall(b"\x01" if existed else b"\x00")
+            elif cmd == _CMD_NKEYS:
+                with self._cv:
+                    n = len(self._kv)
+                conn.sendall(struct.pack("<q", n))
+            else:
+                return False
+        except OSError:
+            return False
+        return True
+
+    def stop(self):
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PyClient:
+    def __init__(self, host: str, port: int, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        self._lock = threading.Lock()
+        self._sock = None
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                s.settimeout(None)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = s
+                return
+            except OSError as e:  # master may not be up yet
+                last_err = e
+                time.sleep(0.05)
+        raise TimeoutError(f"TCPStore: cannot connect to {host}:{port}: {last_err}")
+
+    def _recv(self, n) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("TCPStore: server closed connection")
+            buf += chunk
+        return buf
+
+    def _req(self, cmd: int, key: str, payload: bytes = b"") -> None:
+        kb = key.encode()
+        self._sock.sendall(struct.pack("<BI", cmd, len(kb)) + kb + payload)
+
+    def set(self, key, value):
+        with self._lock:
+            self._req(_CMD_SET, key, struct.pack("<I", len(value)) + value)
+            if self._recv(1) != b"\x01":
+                raise RuntimeError("TCPStore set failed")
+
+    def get(self, key, timeout_ms) -> Optional[bytes]:
+        with self._lock:
+            self._req(_CMD_GET, key, struct.pack("<q", timeout_ms))
+            (length,) = struct.unpack("<I", self._recv(4))
+            if length == _TIMEOUT_LEN:
+                return None
+            return self._recv(length) if length else b""
+
+    def add(self, key, delta) -> int:
+        with self._lock:
+            self._req(_CMD_ADD, key, struct.pack("<q", delta))
+            return struct.unpack("<q", self._recv(8))[0]
+
+    def wait_key(self, key, timeout_ms) -> bool:
+        with self._lock:
+            self._req(_CMD_WAIT, key, struct.pack("<q", timeout_ms))
+            return self._recv(1) == b"\x01"
+
+    def check(self, key) -> bool:
+        with self._lock:
+            self._req(_CMD_CHECK, key)
+            return self._recv(1) == b"\x01"
+
+    def delete_key(self, key) -> bool:
+        with self._lock:
+            self._req(_CMD_DEL, key)
+            return self._recv(1) == b"\x01"
+
+    def num_keys(self) -> int:
+        with self._lock:
+            self._req(_CMD_NKEYS, "")
+            return struct.unpack("<q", self._recv(8))[0]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Public TCPStore
+# ---------------------------------------------------------------------------
+
+
+class TCPStore:
+    """Reference-shaped store: the master rank hosts the server in-process;
+    every rank (master included) talks to it through a client.
+
+    Args match core.TCPStore(host, port, is_master, world_size, timeout).
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 900.0,
+                 use_native: Optional[bool] = None):
+        self.host = host
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        self._server_native = False
+        lib = get_native() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("native TCPStore requested but csrc build unavailable")
+        self._lib = lib
+
+        if is_master:
+            if lib is not None:
+                self._server = lib.pts_server_start(port)
+                if self._server:
+                    self._server_native = True
+                    port = lib.pts_server_port(self._server)
+            if self._server is None:
+                py_server = _PyServer(port)
+                self._server = py_server
+                port = py_server.port
+        self.port = port
+
+        if lib is not None:
+            self._client = lib.pts_client_new(host.encode(), port, int(timeout * 1000))
+            self._client_native = self._client is not None and self._client != 0
+            if not self._client_native:
+                self._client = _PyClient(host, port, timeout)
+        else:
+            self._client = _PyClient(host, port, timeout)
+            self._client_native = False
+
+    @property
+    def is_native(self) -> bool:
+        return self._client_native
+
+    def set(self, key: str, value: Union[bytes, str, int]) -> None:
+        data = _to_bytes(value)
+        if self._client_native:
+            if self._lib.pts_set(self._client, key.encode(), data, len(data)) != 0:
+                raise RuntimeError(f"TCPStore set({key}) failed")
+        else:
+            self._client.set(key, data)
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Blocking get: waits until the key exists (reference semantics)."""
+        t_ms = int((timeout if timeout is not None else self.timeout) * 1000)
+        if self._client_native:
+            out = ctypes.c_void_p()
+            outlen = ctypes.c_int()
+            rc = self._lib.pts_get(self._client, key.encode(), t_ms,
+                                   ctypes.byref(out), ctypes.byref(outlen))
+            if rc != 0:
+                raise TimeoutError(f"TCPStore get({key}) timed out after {t_ms}ms")
+            try:
+                return ctypes.string_at(out, outlen.value)
+            finally:
+                self._lib.pts_buf_free(out)
+        val = self._client.get(key, t_ms)
+        if val is None:
+            raise TimeoutError(f"TCPStore get({key}) timed out after {t_ms}ms")
+        return val
+
+    def add(self, key: str, amount: int) -> int:
+        if self._client_native:
+            rc = self._lib.pts_add(self._client, key.encode(), amount)
+            if rc == -(2**63):
+                raise RuntimeError(f"TCPStore add({key}) failed")
+            return rc
+        return self._client.add(key, amount)
+
+    def wait(self, keys: Union[str, List[str]], timeout: Optional[float] = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        t_ms = int((timeout if timeout is not None else self.timeout) * 1000)
+        for k in keys:
+            if self._client_native:
+                if self._lib.pts_wait(self._client, k.encode(), t_ms) != 0:
+                    raise TimeoutError(f"TCPStore wait({k}) timed out")
+            else:
+                if not self._client.wait_key(k, t_ms):
+                    raise TimeoutError(f"TCPStore wait({k}) timed out")
+
+    def check(self, key: str) -> bool:
+        if self._client_native:
+            return self._lib.pts_check(self._client, key.encode()) == 1
+        return self._client.check(key)
+
+    def delete_key(self, key: str) -> bool:
+        if self._client_native:
+            return self._lib.pts_delete_key(self._client, key.encode()) == 1
+        return self._client.delete_key(key)
+
+    def num_keys(self) -> int:
+        if self._client_native:
+            return int(self._lib.pts_num_keys(self._client))
+        return self._client.num_keys()
+
+    def barrier(self, prefix: str = "barrier", timeout: Optional[float] = None) -> None:
+        """All `world_size` participants rendezvous (arrive-then-wait)."""
+        n = self.add(f"{prefix}/count", 1)
+        epoch = (n - 1) // self.world_size  # support repeated barriers on one prefix
+        target = (epoch + 1) * self.world_size
+        if n == target:
+            self.set(f"{prefix}/done/{epoch}", b"1")
+        self.wait([f"{prefix}/done/{epoch}"], timeout)
+
+    def close(self) -> None:
+        if self._client is not None:
+            if self._client_native:
+                self._lib.pts_client_free(self._client)
+            else:
+                self._client.close()
+            self._client = None
+        if self._server is not None:
+            if self._server_native:
+                self._lib.pts_server_stop(self._server)
+            else:
+                self._server.stop()
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_global_store: Optional[TCPStore] = None
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Reference: parallel.py:1134. Master endpoint from PADDLE_MASTER /
+    MASTER_ADDR:MASTER_PORT; rank 0 hosts the server."""
+    global _global_store
+    if _global_store is not None:
+        return _global_store
+    store_ep = os.environ.get("PADDLE_STORE_ENDPOINT")
+    ep = os.environ.get("PADDLE_MASTER") or os.environ.get("COORDINATOR_ADDRESS")
+    if store_ep:
+        host, port_s = store_ep.rsplit(":", 1)
+        port = int(port_s)
+    elif ep:
+        host, port_s = ep.rsplit(":", 1)
+        port = int(port_s)
+        if os.environ.get("COORDINATOR_ADDRESS"):
+            # jax.distributed binds the coordinator port itself; the store
+            # sits one above it (launcher reserves the pair, context.py)
+            port += 1
+    else:
+        host = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = int(os.environ.get("MASTER_PORT", "6170"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PROCESS_ID", "0")))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("NUM_PROCESSES", "1")))
+    timeout = float(os.environ.get("FLAGS_stop_check_timeout", "900"))
+    _global_store = TCPStore(host, port, is_master=(rank == 0),
+                             world_size=world, timeout=timeout)
+    return _global_store
