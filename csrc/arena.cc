@@ -1,0 +1,175 @@
+// Host staging arena: best-fit-with-coalescing allocator over one slab.
+//
+// Native equivalent of the reference's host allocator layer
+// (paddle/phi/core/memory/allocation/auto_growth_best_fit_allocator.cc,
+// buddy_allocator.cc, stats.h). On TPU there is no device allocator zoo —
+// PJRT owns HBM — so the native allocator's job is host-side staging
+// (checkpoint IO, batch collation, host transfers) with the reference's
+// stats semantics (allocated / peak, memory/stats.h).
+//
+// Layout: every block has a 32-byte header {size, prev_size, free, magic}.
+// Free blocks are kept in a size-ordered multimap (best-fit); physical
+// neighbors coalesce on free via the prev_size back-link.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+constexpr uint64_t kMagic = 0xA110CA7EDB10C35Full;
+constexpr size_t kAlign = 64;  // cache line; also good for vectorized memcpy
+
+struct BlockHeader {
+  uint64_t size;       // payload bytes (excluding header)
+  uint64_t prev_size;  // payload bytes of the physically-previous block (0 = first)
+  uint64_t free;
+  uint64_t magic;
+};
+
+static_assert(sizeof(BlockHeader) == 32, "header must stay 32 bytes");
+
+inline size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+class Arena {
+ public:
+  explicit Arena(size_t capacity)
+      : capacity_(align_up(capacity)), allocated_(0), peak_(0) {
+    slab_ = static_cast<char*>(::aligned_alloc(kAlign, capacity_));
+    if (!slab_) throw std::bad_alloc();
+    auto* h = reinterpret_cast<BlockHeader*>(slab_);
+    h->size = capacity_ - sizeof(BlockHeader);
+    h->prev_size = 0;
+    h->free = 1;
+    h->magic = kMagic;
+    free_blocks_.emplace(h->size, h);
+  }
+
+  ~Arena() { ::free(slab_); }
+
+  void* Alloc(size_t nbytes) {
+    if (nbytes == 0) nbytes = kAlign;
+    nbytes = align_up(nbytes);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = free_blocks_.lower_bound(nbytes);  // best fit
+    if (it == free_blocks_.end()) return nullptr;
+    BlockHeader* h = it->second;
+    free_blocks_.erase(it);
+    // split if the remainder can hold a header + one aligned unit
+    if (h->size >= nbytes + sizeof(BlockHeader) + kAlign) {
+      auto* rest = reinterpret_cast<BlockHeader*>(
+          reinterpret_cast<char*>(h + 1) + nbytes);
+      rest->size = h->size - nbytes - sizeof(BlockHeader);
+      rest->prev_size = nbytes;
+      rest->free = 1;
+      rest->magic = kMagic;
+      BlockHeader* after = Next(rest);
+      if (after) after->prev_size = rest->size;
+      h->size = nbytes;
+      free_blocks_.emplace(rest->size, rest);
+    }
+    h->free = 0;
+    allocated_ += h->size;
+    if (allocated_ > peak_) peak_ = allocated_;
+    return h + 1;
+  }
+
+  bool Free(void* p) {
+    if (!p) return true;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto* h = static_cast<BlockHeader*>(p) - 1;
+    if (h->magic != kMagic || h->free) return false;
+    allocated_ -= h->size;
+    h->free = 1;
+    // coalesce with next
+    BlockHeader* nxt = Next(h);
+    if (nxt && nxt->free) {
+      EraseFree(nxt);
+      h->size += sizeof(BlockHeader) + nxt->size;
+      nxt->magic = 0;
+    }
+    // coalesce with prev
+    if (h->prev_size != 0) {
+      auto* prev = reinterpret_cast<BlockHeader*>(
+          reinterpret_cast<char*>(h) - sizeof(BlockHeader) - h->prev_size);
+      if (prev->free) {
+        EraseFree(prev);
+        prev->size += sizeof(BlockHeader) + h->size;
+        h->magic = 0;
+        h = prev;
+      }
+    }
+    BlockHeader* after = Next(h);
+    if (after) after->prev_size = h->size;
+    free_blocks_.emplace(h->size, h);
+    return true;
+  }
+
+  uint64_t allocated() const { return allocated_; }
+  uint64_t peak() const { return peak_; }
+  uint64_t capacity() const { return capacity_; }
+  void reset_peak() {
+    std::lock_guard<std::mutex> lk(mu_);
+    peak_ = allocated_;
+  }
+  uint64_t largest_free() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return free_blocks_.empty() ? 0 : free_blocks_.rbegin()->first;
+  }
+
+ private:
+  BlockHeader* Next(BlockHeader* h) {
+    char* end = reinterpret_cast<char*>(h + 1) + h->size;
+    if (end >= slab_ + capacity_) return nullptr;
+    return reinterpret_cast<BlockHeader*>(end);
+  }
+
+  void EraseFree(BlockHeader* h) {
+    auto range = free_blocks_.equal_range(h->size);
+    for (auto it = range.first; it != range.second; ++it) {
+      if (it->second == h) {
+        free_blocks_.erase(it);
+        return;
+      }
+    }
+  }
+
+  char* slab_;
+  size_t capacity_;
+  uint64_t allocated_, peak_;
+  std::multimap<uint64_t, BlockHeader*> free_blocks_;  // size -> block
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pta_create(uint64_t capacity) {
+  try {
+    return new Arena(capacity);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void pta_destroy(void* h) { delete static_cast<Arena*>(h); }
+
+void* pta_alloc(void* h, uint64_t nbytes) {
+  return static_cast<Arena*>(h)->Alloc(nbytes);
+}
+
+int pta_free(void* h, void* p) {
+  return static_cast<Arena*>(h)->Free(p) ? 0 : -1;
+}
+
+uint64_t pta_allocated(void* h) { return static_cast<Arena*>(h)->allocated(); }
+uint64_t pta_peak(void* h) { return static_cast<Arena*>(h)->peak(); }
+uint64_t pta_capacity(void* h) { return static_cast<Arena*>(h)->capacity(); }
+uint64_t pta_largest_free(void* h) { return static_cast<Arena*>(h)->largest_free(); }
+void pta_reset_peak(void* h) { static_cast<Arena*>(h)->reset_peak(); }
+
+}  // extern "C"

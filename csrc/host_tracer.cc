@@ -1,0 +1,170 @@
+// Host tracer: low-overhead RecordEvent ring buffer.
+//
+// Native equivalent of the reference's HostTracer
+// (paddle/fluid/platform/profiler/host_tracer.h:26, event instrumentation
+// via RecordEvent event_tracing.h:43): host-side spans recorded from the
+// dispatch layer / user code with ns timestamps + thread ids, drained by
+// the Python profiler and merged with PJRT/XLA device traces into a
+// chrome-trace export (chrometracing_logger.h:32 equivalent).
+//
+// Events live in a fixed ring (overwrite-oldest) guarded by a spinlock-ish
+// mutex; Begin/End pair via returned slot ids so nesting is preserved.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kNameLen = 64;
+
+struct Event {
+  char name[kNameLen];
+  uint64_t tid;
+  uint64_t start_ns;
+  uint64_t end_ns;  // 0 while open
+  uint32_t category;
+  uint32_t consumed;  // drained already (not part of the exported payload)
+};
+
+static_assert(sizeof(Event) == kNameLen + 32, "Event layout is ABI");
+
+uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t this_tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id());
+}
+
+class Tracer {
+ public:
+  explicit Tracer(size_t capacity) : events_(capacity), head_(0), base_(0),
+                                     dropped_(0), enabled_(true) {}
+
+  int64_t Begin(const char* name, uint32_t category) {
+    if (!enabled_.load(std::memory_order_relaxed)) return -1;
+    std::lock_guard<std::mutex> lk(mu_);
+    size_t slot = head_ % events_.size();
+    if (head_ - base_ >= events_.size()) dropped_++;
+    Event& e = events_[slot];
+    std::strncpy(e.name, name, kNameLen - 1);
+    e.name[kNameLen - 1] = '\0';
+    e.tid = this_tid();
+    e.start_ns = now_ns();
+    e.end_ns = 0;
+    e.category = category;
+    e.consumed = 0;
+    return static_cast<int64_t>(head_++);
+  }
+
+  void End(int64_t id) {
+    if (id < 0) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t uid = static_cast<uint64_t>(id);
+    if (uid < base_) return;  // span drained before it ended (ids stay monotonic)
+    if (head_ > events_.size() && uid < head_ - events_.size())
+      return;  // slot already overwritten by ring wraparound
+    events_[uid % events_.size()].end_ns = now_ns();
+  }
+
+  void Instant(const char* name, uint32_t category) {
+    int64_t id = Begin(name, category);
+    End(id);
+  }
+
+  // Copies completed, not-yet-consumed events (oldest first) into out.
+  // Spans still open stay in the ring (they complete and drain later), so
+  // base_ only advances past fully-consumed prefixes. head_ stays monotonic,
+  // so outstanding Begin() ids never alias a post-drain slot.
+  size_t Drain(Event* out, size_t max) {
+    std::lock_guard<std::mutex> lk(mu_);
+    size_t n = head_ - base_;
+    if (n > events_.size()) n = events_.size();
+    size_t start = head_ - n;
+    size_t written = 0;
+    for (size_t i = 0; i < n && written < max; ++i) {
+      Event& e = events_[(start + i) % events_.size()];
+      if (e.end_ns != 0 && !e.consumed) {
+        out[written++] = e;
+        e.consumed = 1;
+      }
+    }
+    while (base_ < head_) {  // advance past the consumed prefix only
+      Event& e = events_[base_ % events_.size()];
+      if (head_ - base_ <= events_.size() && e.end_ns == 0) break;  // still open
+      if (head_ - base_ <= events_.size() && !e.consumed) break;    // not copied (max hit)
+      ++base_;
+    }
+    return written;
+  }
+
+  size_t Count() {
+    std::lock_guard<std::mutex> lk(mu_);
+    size_t n = head_ - base_;
+    return n < events_.size() ? n : events_.size();
+  }
+
+  uint64_t Dropped() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return dropped_;
+  }
+
+  void SetEnabled(bool on) { enabled_.store(on, std::memory_order_relaxed); }
+  bool Enabled() { return enabled_.load(std::memory_order_relaxed); }
+
+ private:
+  std::vector<Event> events_;
+  size_t head_;
+  size_t base_;  // events below this index have been drained
+  uint64_t dropped_;
+  std::atomic<bool> enabled_;
+  std::mutex mu_;
+};
+
+Tracer* g_tracer = nullptr;
+std::mutex g_tracer_mu;
+
+}  // namespace
+
+extern "C" {
+
+int pth_tracer_init(uint64_t capacity) {
+  std::lock_guard<std::mutex> lk(g_tracer_mu);
+  if (!g_tracer) g_tracer = new Tracer(capacity ? capacity : (1u << 20));
+  return 0;
+}
+
+void pth_tracer_enable(int on) {
+  if (g_tracer) g_tracer->SetEnabled(on != 0);
+}
+
+int pth_tracer_enabled() { return g_tracer && g_tracer->Enabled() ? 1 : 0; }
+
+int64_t pth_record_begin(const char* name, uint32_t category) {
+  return g_tracer ? g_tracer->Begin(name, category) : -1;
+}
+
+void pth_record_end(int64_t id) {
+  if (g_tracer) g_tracer->End(id);
+}
+
+void pth_record_instant(const char* name, uint32_t category) {
+  if (g_tracer) g_tracer->Instant(name, category);
+}
+
+uint64_t pth_tracer_count() { return g_tracer ? g_tracer->Count() : 0; }
+uint64_t pth_tracer_dropped() { return g_tracer ? g_tracer->Dropped() : 0; }
+
+// out must hold max * sizeof(Event) = max * 96 bytes.
+uint64_t pth_tracer_drain(void* out, uint64_t max) {
+  return g_tracer ? g_tracer->Drain(static_cast<Event*>(out), max) : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,180 @@
+"""Host staging memory + device memory stats.
+
+Reference parity: paddle/phi/core/memory/ (malloc.h, stats.h — allocated /
+max-allocated counters, memory_allocated / max_memory_allocated python
+surface in paddle.device.cuda). TPU design: PJRT owns HBM, so the native
+allocator (csrc/arena.cc, BFC-style best-fit + coalescing) serves *host*
+staging — checkpoint IO, batch collation, H2D transfer buffers — while
+device stats are read from PJRT's memory_stats().
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .native import get_native
+
+_DEFAULT_CAPACITY = 256 << 20  # 256 MiB staging slab
+
+
+class HostArena:
+    """Best-fit host arena with stats; numpy views over its allocations.
+
+    Falls back to plain numpy allocation (with the same stats accounting)
+    when the native library is unavailable.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._lib = get_native()
+        self._lock = threading.Lock()
+        self._fallback_allocated = 0
+        self._fallback_peak = 0
+        self.capacity = capacity
+        if self._lib is not None:
+            self._h = self._lib.pta_create(capacity)
+            if not self._h:
+                raise MemoryError(f"HostArena: cannot reserve {capacity} bytes")
+        else:
+            self._h = None
+        self._live = {}  # ptr-or-id -> (array ref kept alive only by caller)
+
+    @property
+    def is_native(self) -> bool:
+        return self._h is not None
+
+    def alloc_array(self, shape, dtype) -> np.ndarray:
+        """Allocate a numpy array backed by the arena (native) or the heap
+        (fallback). Free with `free_array` when staging is done."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if self._h is not None:
+            ptr = self._lib.pta_alloc(self._h, max(nbytes, 1))
+            if not ptr:
+                raise MemoryError(
+                    f"HostArena: {nbytes} bytes exceeds largest free block "
+                    f"({self.largest_free()} of {self.capacity})")
+            buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
+            arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape))).reshape(shape)
+            arr.flags.writeable = True
+            with self._lock:
+                self._live[arr.__array_interface__["data"][0]] = ptr
+            return arr
+        arr = np.empty(shape, dtype)
+        with self._lock:
+            self._fallback_allocated += nbytes
+            self._fallback_peak = max(self._fallback_peak, self._fallback_allocated)
+            self._live[arr.__array_interface__["data"][0]] = nbytes
+        return arr
+
+    def free_array(self, arr: np.ndarray) -> None:
+        key = arr.__array_interface__["data"][0]
+        with self._lock:
+            handle = self._live.pop(key, None)
+        if handle is None:
+            return
+        if self._h is not None:
+            self._lib.pta_free(self._h, handle)
+        else:
+            with self._lock:
+                self._fallback_allocated -= handle
+
+    def allocated(self) -> int:
+        if self._h is not None:
+            return int(self._lib.pta_allocated(self._h))
+        return self._fallback_allocated
+
+    def peak(self) -> int:
+        if self._h is not None:
+            return int(self._lib.pta_peak(self._h))
+        return self._fallback_peak
+
+    def largest_free(self) -> int:
+        if self._h is not None:
+            return int(self._lib.pta_largest_free(self._h))
+        return self.capacity - self._fallback_allocated
+
+    def reset_peak(self) -> None:
+        if self._h is not None:
+            self._lib.pta_reset_peak(self._h)
+        else:
+            self._fallback_peak = self._fallback_allocated
+
+    def close(self, force: bool = False) -> None:
+        with self._lock:
+            live = len(self._live)
+        if live and not force:
+            import warnings
+
+            warnings.warn(
+                f"HostArena.close(): {live} allocation(s) still alive — "
+                "slab kept to avoid use-after-free; free them or pass force=True")
+            return
+        if self._h is not None:
+            self._lib.pta_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close(force=True)  # interpreter teardown: nothing can use it now
+        except Exception:
+            pass
+
+
+_global_arena: Optional[HostArena] = None
+_arena_lock = threading.Lock()
+
+
+def get_host_arena() -> HostArena:
+    global _global_arena
+    if _global_arena is None:
+        with _arena_lock:
+            if _global_arena is None:
+                _global_arena = HostArena()
+    return _global_arena
+
+
+# ---------------------------------------------------------------------------
+# Device memory stats (paddle.device.cuda.memory_allocated parity, via PJRT)
+# ---------------------------------------------------------------------------
+
+
+def device_memory_stats(device=None) -> dict:
+    import jax
+
+    dev = device if device is not None else jax.devices()[0]
+    try:
+        return dict(dev.memory_stats() or {})
+    except (AttributeError, RuntimeError, jax.errors.JaxRuntimeError):
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference:
+    paddle.device.cuda.memory_allocated)."""
+    return int(device_memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(device_memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    stats = device_memory_stats(device)
+    return int(stats.get("peak_bytes_in_use", stats.get("bytes_limit", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    return int(device_memory_stats(device).get("bytes_limit", 0))
+
+
+def host_memory_stat_current_value(stat: str = "Allocated") -> int:
+    """Reference: memory/stats.h HostMemoryStatCurrentValue."""
+    arena = get_host_arena()
+    return arena.allocated() if stat == "Allocated" else arena.peak()
+
+def host_memory_stat_peak_value(stat: str = "Allocated") -> int:
+    return get_host_arena().peak()

@@ -24,6 +24,15 @@ _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abs
 _SO = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
 
 
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(
+        f.endswith(".cc") and os.path.getmtime(os.path.join(_CSRC, f)) > so_mtime
+        for f in os.listdir(_CSRC))
+
+
 def _build() -> bool:
     if not os.path.isdir(_CSRC) or shutil.which("make") is None:
         return False
@@ -62,6 +71,31 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pts_delete_key.argtypes = [c.c_void_p, c.c_char_p]
     lib.pts_num_keys.restype = c.c_longlong
     lib.pts_num_keys.argtypes = [c.c_void_p]
+    # arena allocator (csrc/arena.cc)
+    lib.pta_create.restype = c.c_void_p
+    lib.pta_create.argtypes = [c.c_uint64]
+    lib.pta_destroy.argtypes = [c.c_void_p]
+    lib.pta_alloc.restype = c.c_void_p
+    lib.pta_alloc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pta_free.restype = c.c_int
+    lib.pta_free.argtypes = [c.c_void_p, c.c_void_p]
+    for fn in ("pta_allocated", "pta_peak", "pta_capacity", "pta_largest_free"):
+        getattr(lib, fn).restype = c.c_uint64
+        getattr(lib, fn).argtypes = [c.c_void_p]
+    lib.pta_reset_peak.argtypes = [c.c_void_p]
+    # host tracer (csrc/host_tracer.cc)
+    lib.pth_tracer_init.restype = c.c_int
+    lib.pth_tracer_init.argtypes = [c.c_uint64]
+    lib.pth_tracer_enable.argtypes = [c.c_int]
+    lib.pth_tracer_enabled.restype = c.c_int
+    lib.pth_record_begin.restype = c.c_int64
+    lib.pth_record_begin.argtypes = [c.c_char_p, c.c_uint32]
+    lib.pth_record_end.argtypes = [c.c_int64]
+    lib.pth_record_instant.argtypes = [c.c_char_p, c.c_uint32]
+    lib.pth_tracer_count.restype = c.c_uint64
+    lib.pth_tracer_dropped.restype = c.c_uint64
+    lib.pth_tracer_drain.restype = c.c_uint64
+    lib.pth_tracer_drain.argtypes = [c.c_void_p, c.c_uint64]
 
 
 def get_native():
@@ -77,13 +111,15 @@ def get_native():
         _tried = True
         if os.environ.get("PADDLE_TPU_DISABLE_NATIVE", "0") == "1":
             return None
-        if not os.path.exists(_SO) and not _build():
+        if _stale() and not _build() and not os.path.exists(_SO):
             return None
         try:
             lib = ctypes.CDLL(_SO)
             _declare(lib)
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: stale .so missing newer symbols and the
+            # rebuild failed — use the pure-Python fallbacks instead
             _lib = None
     return _lib
 

@@ -28,6 +28,7 @@ from ..core import dtype as dtypes
 from ..core.autograd import Edge, GradNode, is_grad_enabled
 from ..core.flags import flag
 from ..core.tensor import Tensor
+from ..profiler import _recording as _prof_recording  # shared mutable flag; zero-cost check
 
 # Set by paddle_tpu.amp at import; signature: (op_name, [jax arrays]) -> [jax arrays]
 _amp_cast_hook: Optional[Callable] = None
@@ -68,6 +69,15 @@ def apply_op(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = N
     static attributes must be closed over in ``fn``. ``fn`` may return a
     single array or a tuple of arrays.
     """
+    if _prof_recording[0]:  # host tracer span per op (RecordEvent parity)
+        from .. import profiler as _prof
+
+        with _prof.RecordEvent(name, _prof.TracerEventType.Operator):
+            return _apply_op_impl(name, fn, *tensors, nouts=nouts)
+    return _apply_op_impl(name, fn, *tensors, nouts=nouts)
+
+
+def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = None):
     datas = [t._data for t in tensors]
 
     if _amp_cast_hook is not None:

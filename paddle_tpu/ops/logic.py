@@ -12,12 +12,12 @@ from .dispatch import apply_op, ensure_tensor
 from .math import _promote
 
 
-def _cmp(name, jfn):
+def _cmp(opname, jfn):
     def op(x, y, name=None):
         x, y = _promote(x, y)
-        return apply_op(name, jfn, x, y)
+        return apply_op(opname, jfn, x, y)
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 

@@ -41,23 +41,23 @@ def _promote(x, y):
     return ensure_tensor(x), ensure_tensor(y)
 
 
-def _binop(name, jfn):
+def _binop(opname, jfn):
     def op(x, y, name=None):
         x, y = _promote(x, y)
-        return apply_op(name, jfn, x, y)
+        return apply_op(opname, jfn, x, y)
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 
-def _unop(name, jfn, float_only=False):
+def _unop(opname, jfn, float_only=False):
     def op(x, name=None):
         x = ensure_tensor(x)
         if float_only and not dtypes.is_floating_point(x._data.dtype):
             x = x.astype(dtypes.get_default_dtype())
-        return apply_op(name, jfn, x)
+        return apply_op(opname, jfn, x)
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 
@@ -185,7 +185,7 @@ def _axis(axis):
     return int(axis)
 
 
-def _reduce(name, jfn, int_promote=False):
+def _reduce(opname, jfn, int_promote=False):
     def op(x, axis=None, keepdim=False, name=None):
         x = ensure_tensor(x)
         ax = _axis(axis)
@@ -194,9 +194,9 @@ def _reduce(name, jfn, int_promote=False):
             out = jfn(a, axis=ax, keepdims=keepdim)
             return out
 
-        return apply_op(name, _f, x)
+        return apply_op(opname, _f, x)
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 

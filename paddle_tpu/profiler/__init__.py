@@ -1,0 +1,410 @@
+"""paddle_tpu.profiler — unified host+device profiler.
+
+Reference parity (SURVEY §5.1): python/paddle/profiler/profiler.py:358
+(Profiler with scheduler states ProfilerState:89, targets), RecordEvent
+instrumentation (paddle/fluid/platform/profiler/event_tracing.h:43),
+ChromeTracingLogger export (chrometracing_logger.h:32), summary statistics
+(profiler_statistic.py) and the benchmark ips timer (timer.py).
+
+TPU design: host spans go through the native C++ ring-buffer tracer
+(csrc/host_tracer.cc) — the HostTracer equivalent; device activity comes
+from jax.profiler (XLA/PJRT xplane traces, the CudaTracer slot). Both are
+surfaced as chrome-trace JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import IntEnum
+from typing import Callable, Dict, List, Optional
+
+from ..core.native import get_native
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "TracerEventType", "make_scheduler", "export_chrome_tracing", "benchmark",
+]
+
+
+class ProfilerState(IntEnum):
+    # reference: profiler.py ProfilerState:89
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(IntEnum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class TracerEventType(IntEnum):
+    # reference: paddle/fluid/platform/profiler/trace_event.h categories
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonUserDefined = 7
+
+
+# ---------------------------------------------------------------------------
+# RecordEvent: host span instrumentation
+# ---------------------------------------------------------------------------
+
+_py_events: List[tuple] = []  # fallback when no native tracer
+_py_events_lock = threading.Lock()
+_recording = [False]
+
+
+def _tracer_on() -> bool:
+    return _recording[0]
+
+
+class RecordEvent:
+    """Span context manager/decorator (reference event_tracing.h RecordEvent).
+
+    with profiler.RecordEvent("data_load"):
+        ...
+    """
+
+    def __init__(self, name: str, event_type: TracerEventType = TracerEventType.PythonUserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._id = None
+        self._t0 = None
+
+    def begin(self):
+        if not _recording[0]:
+            return
+        lib = get_native()
+        if lib is not None:
+            self._id = lib.pth_record_begin(self.name.encode(), int(self.event_type))
+        else:
+            self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if not _recording[0]:
+            return
+        lib = get_native()
+        if lib is not None:
+            if self._id is not None:
+                lib.pth_record_end(self._id)
+                self._id = None
+        elif self._t0 is not None:
+            with _py_events_lock:
+                _py_events.append((self.name, threading.get_ident(),
+                                   self._t0, time.perf_counter_ns(),
+                                   int(self.event_type)))
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name, self.event_type):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def _drain_events() -> List[Dict]:
+    """Drain all completed spans → list of dicts (ns timestamps)."""
+    out = []
+    lib = get_native()
+    if lib is not None:
+        import ctypes
+
+        class _Event(ctypes.Structure):
+            _fields_ = [("name", ctypes.c_char * 64), ("tid", ctypes.c_uint64),
+                        ("start_ns", ctypes.c_uint64), ("end_ns", ctypes.c_uint64),
+                        ("category", ctypes.c_uint32), ("_pad", ctypes.c_uint32)]
+
+        n = lib.pth_tracer_count()
+        if n:
+            buf = (_Event * n)()
+            got = lib.pth_tracer_drain(buf, n)
+            for e in buf[:got]:
+                out.append({"name": e.name.decode(), "tid": int(e.tid),
+                            "start_ns": int(e.start_ns), "end_ns": int(e.end_ns),
+                            "category": int(e.category)})
+    with _py_events_lock:
+        for name, tid, t0, t1, cat in _py_events:
+            out.append({"name": name, "tid": tid, "start_ns": t0, "end_ns": t1,
+                        "category": cat})
+        _py_events.clear()
+    out.sort(key=lambda e: e["start_ns"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scheduler / export helpers
+# ---------------------------------------------------------------------------
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Reference: profiler.py make_scheduler — step-indexed state machine."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_scheduler(_step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready callback writing chrome://tracing JSON."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        prof.export(path)
+
+    return handler
+
+
+def _to_chrome_trace(events: List[Dict]) -> Dict:
+    pid = os.getpid()
+    trace = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+              "args": {"name": "paddle_tpu host"}}]
+    for e in events:
+        cat = e.get("category", 7)
+        try:
+            cat = TracerEventType(cat).name
+        except ValueError:
+            cat = str(cat)
+        trace.append({
+            "name": e["name"], "ph": "X", "pid": pid, "tid": e["tid"] % 100000,
+            "ts": e["start_ns"] / 1000.0,
+            "dur": max(e["end_ns"] - e["start_ns"], 0) / 1000.0,
+            "cat": cat,
+        })
+    return {"traceEvents": trace}
+
+
+# ---------------------------------------------------------------------------
+# Profiler
+# ---------------------------------------------------------------------------
+
+
+class Profiler:
+    """Reference-shaped profiler (profiler.py:358).
+
+    prof = Profiler(scheduler=make_scheduler(closed=1, ready=1, record=2))
+    prof.start(); loop: work; prof.step(); prof.stop()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False):
+        self.targets = targets or [ProfilerTarget.CPU]
+        if scheduler is None:
+            self.scheduler = _default_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=max(lo, 0), ready=0,
+                                            record=hi - lo, repeat=1)
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._events: List[Dict] = []
+        self._device_trace_dir: Optional[str] = None
+        self._timer = benchmark()
+
+    # -- state machine -----------------------------------------------------
+    def start(self):
+        self._timer.begin()
+        if self.timer_only:
+            return
+        lib = get_native()
+        if lib is not None:
+            lib.pth_tracer_init(1 << 20)
+        self._apply_state(self.scheduler(self.step_num))
+
+    def _apply_state(self, state: ProfilerState):
+        prev = self.current_state
+        self.current_state = state
+        should_record = state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        was_recording = prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if should_record and not was_recording:
+            _recording[0] = True
+            lib = get_native()
+            if lib is not None:
+                lib.pth_tracer_enable(1)
+        elif was_recording and not should_record:
+            self._collect()
+        if state == ProfilerState.RECORD_AND_RETURN and was_recording:
+            # boundary handled at next step()
+            pass
+
+    def _collect(self):
+        _recording[0] = False
+        lib = get_native()
+        if lib is not None:
+            lib.pth_tracer_enable(0)
+        self._events.extend(_drain_events())
+
+    def step(self, num_samples: Optional[int] = None):
+        self._timer.step(num_samples)
+        if self.timer_only:
+            return
+        if self.current_state == ProfilerState.RECORD_AND_RETURN:
+            self._collect()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            _recording[0] = False
+            # cycle boundary: next _apply_state must see "not recording" so
+            # back-to-back record phases re-enable the tracer
+            self.current_state = ProfilerState.CLOSED
+        self.step_num += 1
+        self._apply_state(self.scheduler(self.step_num))
+
+    def stop(self):
+        self._timer.end()
+        if self.timer_only:
+            return
+        if self.current_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+        _recording[0] = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results -----------------------------------------------------------
+    def events(self) -> List[Dict]:
+        return list(self._events)
+
+    def export(self, path: str, format: str = "json"):
+        with open(path, "w") as f:
+            json.dump(_to_chrome_trace(self._events), f)
+
+    def summary(self, sorted_by: str = "total", **kwargs) -> str:
+        """Op-level aggregate table (reference profiler_statistic.py)."""
+        agg: Dict[str, List[float]] = {}
+        for e in self._events:
+            dur_us = (e["end_ns"] - e["start_ns"]) / 1000.0
+            agg.setdefault(e["name"], []).append(dur_us)
+        rows = [(name, len(ds), sum(ds), sum(ds) / len(ds), max(ds), min(ds))
+                for name, ds in agg.items()]
+        key = {"total": 2, "calls": 1, "avg": 3, "max": 4, "min": 5}.get(sorted_by, 2)
+        rows.sort(key=lambda r: r[key], reverse=True)
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"
+                 f"{'Max(us)':>12}{'Min(us)':>12}"]
+        for r in rows:
+            lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>14.1f}{r[3]:>12.1f}"
+                         f"{r[4]:>12.1f}{r[5]:>12.1f}")
+        return "\n".join(lines)
+
+    # -- device (XLA/PJRT) traces -------------------------------------------
+    def start_device_trace(self, log_dir: str):
+        """Capture XLA device activity via jax.profiler (xplane), viewable in
+        TensorBoard/XProf — the CudaTracer slot of the reference design."""
+        import jax
+
+        self._device_trace_dir = log_dir
+        jax.profiler.start_trace(log_dir)
+
+    def stop_device_trace(self):
+        if self._device_trace_dir is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._device_trace_dir = None
+
+
+# ---------------------------------------------------------------------------
+# benchmark timer (reference profiler/timer.py — ips with warmup skip)
+# ---------------------------------------------------------------------------
+
+
+class _Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._last = None
+        self._step_times: List[float] = []
+        self._samples: List[Optional[int]] = []
+        self._running = False
+
+    def begin(self):
+        self.reset()
+        self._running = True
+        self._last = time.perf_counter()
+
+    def step(self, num_samples: Optional[int] = None):
+        if not self._running:
+            return
+        now = time.perf_counter()
+        self._step_times.append(now - self._last)
+        self._samples.append(num_samples)
+        self._last = now
+
+    def end(self):
+        self._running = False
+
+    def step_info(self, unit: str = "samples") -> str:
+        s = self.speed_average()
+        avg = (sum(self._step_times) / len(self._step_times)) if self._step_times else 0.0
+        return f"avg_step_time: {avg*1000:.2f} ms, ips: {s:.2f} {unit}/s"
+
+    def speed_average(self, skip: int = 1) -> float:
+        """ips, skipping the first `skip` (warmup/compile) steps."""
+        times = self._step_times[skip:] or self._step_times
+        samples = self._samples[skip:] or self._samples
+        if not times:
+            return 0.0
+        total_t = sum(times)
+        if any(s is None for s in samples):
+            return len(times) / total_t if total_t else 0.0
+        return sum(samples) / total_t if total_t else 0.0
+
+
+_benchmark = _Benchmark()
+
+
+def benchmark() -> _Benchmark:
+    return _benchmark

@@ -1,0 +1,125 @@
+"""Native runtime tests: BFC-style host arena + host tracer ring buffer.
+
+Reference semantics: memory/allocation/auto_growth_best_fit_allocator
+(split/coalesce/best-fit), memory/stats.h (allocated/peak), profiler
+host_tracer.h (RecordEvent spans)."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.memory import HostArena
+from paddle_tpu.core.native import get_native, native_available
+
+NATIVE = native_available()
+
+
+@pytest.mark.parametrize("native", [False] + ([True] if NATIVE else []))
+def test_arena_alloc_free_stats(native, monkeypatch):
+    if not native:
+        monkeypatch.setattr("paddle_tpu.core.memory.get_native", lambda: None)
+    arena = HostArena(capacity=1 << 20)
+    assert arena.is_native == native
+    a = arena.alloc_array((1000,), np.float32)
+    b = arena.alloc_array((200, 50), np.int32)
+    a[:] = 1.5
+    b[:] = 7
+    assert arena.allocated() >= 4000 + 40000
+    peak1 = arena.peak()
+    assert peak1 >= arena.allocated()
+    assert float(a.sum()) == 1500.0 and int(b.sum()) == 70000
+    arena.free_array(a)
+    arena.free_array(b)
+    assert arena.allocated() == 0
+    assert arena.peak() == peak1  # peak survives frees
+    arena.reset_peak()
+    assert arena.peak() == 0
+    arena.close()
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native build")
+def test_arena_coalescing_and_oom():
+    arena = HostArena(capacity=1 << 20)  # 1 MiB
+    # carve the slab into three ~300 KiB blocks
+    blocks = [arena.alloc_array((300 * 1024,), np.uint8) for _ in range(3)]
+    with pytest.raises(MemoryError):
+        arena.alloc_array((600 * 1024,), np.uint8)
+    # free two adjacent blocks -> coalesced hole fits 600 KiB again
+    arena.free_array(blocks[0])
+    arena.free_array(blocks[1])
+    big = arena.alloc_array((600 * 1024,), np.uint8)
+    big[:] = 9
+    assert int(big[0]) == 9 and int(big[-1]) == 9
+    arena.free_array(big)
+    arena.free_array(blocks[2])
+    assert arena.allocated() == 0
+    # fully coalesced: one free block spanning (almost) the whole slab
+    assert arena.largest_free() >= (1 << 20) - 128
+    arena.close()
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native build")
+def test_arena_double_free_rejected():
+    lib = get_native()
+    h = lib.pta_create(1 << 16)
+    p = lib.pta_alloc(h, 128)
+    assert lib.pta_free(h, p) == 0
+    assert lib.pta_free(h, p) == -1  # second free rejected via header flag
+    lib.pta_destroy(h)
+
+
+class _Event(ctypes.Structure):
+    _fields_ = [("name", ctypes.c_char * 64), ("tid", ctypes.c_uint64),
+                ("start_ns", ctypes.c_uint64), ("end_ns", ctypes.c_uint64),
+                ("category", ctypes.c_uint32), ("_pad", ctypes.c_uint32)]
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native build")
+def test_host_tracer_spans():
+    lib = get_native()
+    assert lib.pth_tracer_init(4096) == 0
+    lib.pth_tracer_enable(1)
+    outer = lib.pth_record_begin(b"matmul_dispatch", 1)
+    inner = lib.pth_record_begin(b"hlo_build", 2)
+    lib.pth_record_end(inner)
+    lib.pth_record_end(outer)
+    lib.pth_record_instant(b"marker", 0)
+    buf = (_Event * 16)()
+    n = lib.pth_tracer_drain(buf, 16)
+    assert n == 3
+    ev = {e.name.decode(): e for e in buf[:n]}
+    assert set(ev) == {"matmul_dispatch", "hlo_build", "marker"}
+    m, h = ev["matmul_dispatch"], ev["hlo_build"]
+    # nesting: inner span contained in outer span
+    assert m.start_ns <= h.start_ns <= h.end_ns <= m.end_ns
+    assert m.category == 1 and h.category == 2
+    # drained -> empty
+    assert lib.pth_tracer_drain(buf, 16) == 0
+    lib.pth_tracer_enable(0)
+    assert lib.pth_record_begin(b"disabled", 0) == -1
+    lib.pth_tracer_enable(1)
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native build")
+def test_host_tracer_open_span_survives_drain():
+    """A span still open at drain time is neither lost nor corrupted: it stays
+    in the ring, completes on its real End(), and drains exactly once
+    (monotonic ids + consumed-prefix base advance)."""
+    lib = get_native()
+    lib.pth_tracer_init(4096)
+    lib.pth_tracer_enable(1)
+    buf = (_Event * 8)()
+    lib.pth_tracer_drain(buf, 8)  # clean slate
+    open_id = lib.pth_record_begin(b"spanning", 0)
+    assert lib.pth_tracer_drain(buf, 8) == 0  # open span not drained, not lost
+    fresh = lib.pth_record_begin(b"fresh", 0)
+    lib.pth_record_end(open_id)   # completes the pre-drain span
+    n = lib.pth_tracer_drain(buf, 8)
+    assert n == 1 and buf[0].name == b"spanning"
+    lib.pth_record_end(fresh)
+    n = lib.pth_tracer_drain(buf, 8)
+    assert n == 1 and buf[0].name == b"fresh"
+    assert fresh != open_id  # ids stay monotonic across drains
+    # nothing duplicates on a further drain
+    assert lib.pth_tracer_drain(buf, 8) == 0
