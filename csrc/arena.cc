@@ -28,9 +28,11 @@ struct BlockHeader {
   uint64_t prev_size;  // payload bytes of the physically-previous block (0 = first)
   uint64_t free;
   uint64_t magic;
+  uint64_t pad_[4];    // pad header to kAlign so payloads stay 64-aligned
 };
 
-static_assert(sizeof(BlockHeader) == 32, "header must stay 32 bytes");
+static_assert(sizeof(BlockHeader) == kAlign,
+              "header must equal kAlign so every payload is 64-byte aligned");
 
 inline size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
 

@@ -137,7 +137,7 @@ extern "C" {
 
 int pth_tracer_init(uint64_t capacity) {
   std::lock_guard<std::mutex> lk(g_tracer_mu);
-  if (!g_tracer) g_tracer = new Tracer(capacity ? capacity : (1u << 20));
+  if (!g_tracer) g_tracer = new Tracer(capacity ? capacity : (1u << 16));
   return 0;
 }
 

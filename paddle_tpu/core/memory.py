@@ -119,7 +119,11 @@ class HostArena:
 
     def __del__(self):
         try:
-            self.close(force=True)  # interpreter teardown: nothing can use it now
+            import sys
+
+            # Only force-free at interpreter teardown; a GC'd arena with live
+            # alloc_array views must keep its slab (use-after-free otherwise).
+            self.close(force=sys.is_finalizing())
         except Exception:
             pass
 
@@ -174,7 +178,17 @@ def memory_reserved(device=None) -> int:
 def host_memory_stat_current_value(stat: str = "Allocated") -> int:
     """Reference: memory/stats.h HostMemoryStatCurrentValue."""
     arena = get_host_arena()
-    return arena.allocated() if stat == "Allocated" else arena.peak()
+    if stat == "Allocated":
+        return arena.allocated()
+    if stat == "Reserved":
+        return arena.capacity
+    raise ValueError(f"unknown host memory stat {stat!r}")
+
 
 def host_memory_stat_peak_value(stat: str = "Allocated") -> int:
-    return get_host_arena().peak()
+    arena = get_host_arena()
+    if stat == "Allocated":
+        return arena.peak()
+    if stat == "Reserved":
+        return arena.capacity
+    raise ValueError(f"unknown host memory stat {stat!r}")

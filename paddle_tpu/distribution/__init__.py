@@ -1,0 +1,38 @@
+"""paddle.distribution — probability distributions, transforms, KL registry.
+
+Parity: python/paddle/distribution/ (distribution.py Distribution base,
+normal/uniform/categorical/bernoulli/beta/gamma/dirichlet/exponential/
+geometric/gumbel/laplace/lognormal/cauchy/chi2/poisson/binomial/
+multinomial/student_t, transform.py, transformed_distribution.py,
+independent.py, kl.py kl_divergence registry, exponential_family.py).
+
+TPU design: sampling via jax.random (explicit keys from the global
+generator, ops/random.py), densities as jnp expressions so log_prob /
+entropy are jit-able and differentiable through the tape.
+"""
+
+from .distribution import (
+    Bernoulli, Beta, Binomial, Categorical, Cauchy, Chi2, ContinuousBernoulli,
+    Dirichlet, Distribution, Exponential, ExponentialFamily, Gamma, Geometric,
+    Gumbel, Independent, Laplace, LogNormal, Multinomial, MultivariateNormal,
+    Normal, Poisson, StudentT, TransformedDistribution, Uniform,
+    kl_divergence, register_kl,
+)
+from .transform import (
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Bernoulli",
+    "Categorical", "Beta", "Gamma", "Dirichlet", "Exponential", "Geometric",
+    "Gumbel", "Laplace", "LogNormal", "Cauchy", "Chi2", "Poisson", "Binomial",
+    "ContinuousBernoulli", "Multinomial", "MultivariateNormal", "StudentT",
+    "Independent", "TransformedDistribution", "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]

@@ -1,0 +1,1050 @@
+"""Distribution classes (see package docstring for parity map)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy import special as jsp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+from ..ops.random import split_key
+
+__all__ = []  # re-exported by the package __init__
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x._data
+    a = jnp.asarray(x)
+    if jnp.issubdtype(a.dtype, jnp.integer) and dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+def _t(x, dtype=jnp.float32) -> Tensor:
+    """Parameter as a Tensor, preserving the autograd tape when the caller
+    passed one (reference distributions differentiate through loc/scale)."""
+    return x if isinstance(x, Tensor) else Tensor(_arr(x, dtype))
+
+
+def _shape(shape) -> Tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Reference: python/paddle/distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape=()) -> Tensor:
+        t = self.rsample(shape)
+        t.stop_gradient = True
+        return t
+
+    def rsample(self, shape=()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        return kl_divergence(self, other)
+
+    def _extend(self, a, shape):
+        """Broadcast a parameter-shaped array to sample_shape + batch_shape."""
+        return jnp.broadcast_to(a, _shape(shape) + self._batch_shape + self._event_shape)
+
+
+class ExponentialFamily(Distribution):
+    """Marker base with the natural-parameter protocol (reference
+    exponential_family.py derives entropy by differentiating the
+    log-normalizer; concrete classes here ship closed forms instead)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# Continuous
+# ---------------------------------------------------------------------------
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = _t(loc)
+        self._scale_t = _t(scale)
+        self.loc = self._loc_t._data
+        self.scale = self._scale_t._data
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale**2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        eps = jax.random.normal(split_key(), _shape(shape) + self._batch_shape,
+                                self.loc.dtype)
+        return apply_op("normal_rsample", lambda l, s: l + s * eps,
+                        self._loc_t, self._scale_t)
+
+    def log_prob(self, value) -> Tensor:
+        return apply_op(
+            "normal_log_prob",
+            lambda v, l, s: -((v - l) ** 2) / (2 * s**2) - jnp.log(s)
+            - 0.5 * math.log(2 * math.pi),
+            ensure_tensor(value), self._loc_t, self._scale_t)
+
+    def entropy(self) -> Tensor:
+        return apply_op(
+            "normal_entropy",
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), self._batch_shape),
+            self._scale_t)
+
+    def cdf(self, value) -> Tensor:
+        v = _arr(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value) -> Tensor:
+        v = _arr(value)
+        return Tensor(self.loc + self.scale * math.sqrt(2)
+                      * jax.scipy.special.erfinv(2 * v - 1))
+
+    def probs(self, value):  # reference Normal.probs alias
+        return self.prob(value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale**2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()) -> Tensor:
+        return Tensor(jnp.exp(self._base.rsample(shape)._data))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        logv = jnp.log(v)
+        return Tensor(self._base.log_prob(Tensor(logv))._data - logv)
+
+    def entropy(self) -> Tensor:
+        return Tensor(self._base.entropy()._data + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to((self.low + self.high) / 2, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to((self.high - self.low) ** 2 / 12, self._batch_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        u = jax.random.uniform(split_key(), _shape(shape) + self._batch_shape,
+                               self.low.dtype)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return Tensor(lp)
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low), self._batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale**2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(math.sqrt(2) * self.scale, self._batch_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        u = jax.random.uniform(split_key(), _shape(shape) + self._batch_shape,
+                               self.loc.dtype, minval=-0.5 + 1e-7, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale), self._batch_shape))
+
+    def cdf(self, value) -> Tensor:
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value) -> Tensor:
+        p = _arr(value)
+        term = p - 0.5
+        return Tensor(self.loc - self.scale * jnp.sign(term) * jnp.log1p(-2 * jnp.abs(term)))
+
+
+class Gumbel(Distribution):
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc + self.scale * self._EULER,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to((math.pi**2 / 6) * self.scale**2,
+                                       self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(self.variance._data))
+
+    def rsample(self, shape=()) -> Tensor:
+        g = jax.random.gumbel(split_key(), _shape(shape) + self._batch_shape,
+                              self.loc.dtype)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value) -> Tensor:
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(jnp.log(self.scale) + 1 + self._EULER,
+                                       self._batch_shape))
+
+    def cdf(self, value) -> Tensor:
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.exp(-jnp.exp(-z)))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        u = jax.random.uniform(split_key(), _shape(shape) + self._batch_shape,
+                               self.loc.dtype, minval=1e-7, maxval=1 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value) -> Tensor:
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(z**2))
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                       self._batch_shape))
+
+    def cdf(self, value) -> Tensor:
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate**-2)
+
+    def rsample(self, shape=()) -> Tensor:
+        e = jax.random.exponential(split_key(), _shape(shape) + self._batch_shape,
+                                   self.rate.dtype)
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(1 - jnp.log(self.rate), self._batch_shape))
+
+    def cdf(self, value) -> Tensor:
+        return Tensor(-jnp.expm1(-self.rate * _arr(value)))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self._conc_t = _t(concentration)
+        self._rate_t = _t(rate)
+        self.concentration = self._conc_t._data
+        self.rate = self._rate_t._data
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.concentration / self.rate,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.concentration / self.rate**2,
+                                       self._batch_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        key = split_key()
+        sh = _shape(shape) + self._batch_shape
+
+        def f(a, b):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, self._batch_shape),
+                                 sh, a.dtype)
+            return g / b
+
+        return apply_op("gamma_rsample", f, self._conc_t, self._rate_t)
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jsp.gammaln(a))
+
+    def entropy(self) -> Tensor:
+        a, b = self.concentration, self.rate
+        e = a - jnp.log(b) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a)
+        return Tensor(jnp.broadcast_to(e, self._batch_shape))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _arr(df)
+        self.df = df
+        super().__init__(df / 2, jnp.asarray(0.5, df.dtype))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.alpha / (self.alpha + self.beta),
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(jnp.broadcast_to(
+            self.alpha * self.beta / (s**2 * (s + 1)), self._batch_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        sh = _shape(shape) + self._batch_shape
+        ga = jax.random.gamma(split_key(), jnp.broadcast_to(self.alpha, self._batch_shape), sh)
+        gb = jax.random.gamma(split_key(), jnp.broadcast_to(self.beta, self._batch_shape), sh)
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        betaln = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - betaln)
+
+    def entropy(self) -> Tensor:
+        a, b = self.alpha, self.beta
+        betaln = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        e = (betaln - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+             + (a + b - 2) * jsp.digamma(a + b))
+        return Tensor(jnp.broadcast_to(e, self._batch_shape))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(-1, keepdims=True)
+        m = self.concentration / a0
+        return Tensor(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=()) -> Tensor:
+        sh = _shape(shape) + self._batch_shape + self._event_shape
+        g = jax.random.gamma(split_key(),
+                             jnp.broadcast_to(self.concentration, sh))
+        return Tensor(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        a = self.concentration
+        lognorm = jsp.gammaln(a).sum(-1) - jsp.gammaln(a.sum(-1))
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1) - lognorm)
+
+    def entropy(self) -> Tensor:
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        lognorm = jsp.gammaln(a).sum(-1) - jsp.gammaln(a0)
+        e = (lognorm + (a0 - k) * jsp.digamma(a0)
+             - ((a - 1) * jsp.digamma(a)).sum(-1))
+        return Tensor(e)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.where(self.df > 1, self.loc, jnp.nan), self._batch_shape))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2, self.scale**2 * self.df / (self.df - 2),
+                      jnp.where(self.df > 1, jnp.inf, jnp.nan))
+        return Tensor(jnp.broadcast_to(v, self._batch_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        sh = _shape(shape) + self._batch_shape
+        t = jax.random.t(split_key(), jnp.broadcast_to(self.df, self._batch_shape), sh)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        df = self.df
+        z = (v - self.loc) / self.scale
+        lp = (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+              - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+              - (df + 1) / 2 * jnp.log1p(z**2 / df))
+        return Tensor(lp)
+
+    def entropy(self) -> Tensor:
+        df = self.df
+        e = ((df + 1) / 2 * (jsp.digamma((df + 1) / 2) - jsp.digamma(df / 2))
+             + 0.5 * jnp.log(df) + jsp.gammaln(df / 2)
+             + jsp.gammaln(0.5) - jsp.gammaln((df + 1) / 2) + jnp.log(self.scale))
+        return Tensor(jnp.broadcast_to(e, self._batch_shape))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        if scale_tril is not None:
+            self._tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        super().__init__(jnp.broadcast_shapes(self.loc.shape[:-1],
+                                              self._tril.shape[:-2]),
+                         self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape + self._event_shape))
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self._tril).sum(-1),
+                                       self._batch_shape + self._event_shape))
+
+    def rsample(self, shape=()) -> Tensor:
+        sh = _shape(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(split_key(), sh, self.loc.dtype)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        d = v.shape[-1]
+        diff = v - self.loc
+        sol = jax.lax.linalg.triangular_solve(
+            self._tril, diff[..., None], left_side=True, lower=True)[..., 0]
+        maha = jnp.sum(sol**2, -1)
+        logdet = jnp.log(jnp.abs(jnp.diagonal(self._tril, axis1=-2, axis2=-1))).sum(-1)
+        return Tensor(-0.5 * (d * math.log(2 * math.pi) + maha) - logdet)
+
+    def entropy(self) -> Tensor:
+        d = self._event_shape[0]
+        logdet = jnp.log(jnp.abs(jnp.diagonal(self._tril, axis1=-2, axis2=-1))).sum(-1)
+        e = 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+        return Tensor(jnp.broadcast_to(e, self._batch_shape))
+
+
+# ---------------------------------------------------------------------------
+# Discrete
+# ---------------------------------------------------------------------------
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self._probs_t = _t(probs)
+        self.probs = self._probs_t._data
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()) -> Tensor:
+        u = jax.random.uniform(split_key(), _shape(shape) + self._batch_shape)
+        return Tensor((u < self.probs).astype(self.probs.dtype), stop_gradient=True)
+
+    def rsample(self, shape=(), temperature: float = 1.0) -> Tensor:
+        """Gumbel-softmax relaxation (reference Bernoulli.rsample)."""
+        sh = _shape(shape) + self._batch_shape
+        logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        g1 = jax.random.gumbel(split_key(), sh)
+        g2 = jax.random.gumbel(split_key(), sh)
+        return Tensor(jax.nn.sigmoid((logits + g1 - g2) / temperature))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+
+        def f(pr):
+            p = jnp.clip(pr, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op("bernoulli_log_prob", f, self._probs_t)
+
+    def entropy(self) -> Tensor:
+        def f(pr):
+            p = jnp.clip(pr, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return apply_op("bernoulli_entropy", f, self._probs_t)
+
+    def cdf(self, value) -> Tensor:
+        v = _arr(value)
+        return Tensor(jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - self.probs, 1.0)))
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm(self):
+        p = self.probs
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        out = jnp.log(jnp.abs(jnp.arctanh(1 - 2 * safe))) - jnp.log(jnp.abs(1 - 2 * safe))
+        taylor = math.log(2.0) + 4 / 3 * (p - 0.5) ** 2
+        return jnp.where(near_half, taylor, out)
+
+    @property
+    def mean(self):
+        p = self.probs
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        taylor = 0.5 + (p - 0.5) / 3
+        return Tensor(jnp.where(near_half, taylor, m))
+
+    @property
+    def variance(self):
+        # numeric fallback via moments of the density
+        x = jnp.linspace(1e-4, 1 - 1e-4, 2001)
+        lp = self.log_prob(Tensor(x.reshape((-1,) + (1,) * self.probs.ndim)))._data
+        w = jnp.exp(lp)
+        w = w / w.sum(0)
+        m = (w * x.reshape((-1,) + (1,) * self.probs.ndim)).sum(0)
+        v = (w * (x.reshape((-1,) + (1,) * self.probs.ndim) - m) ** 2).sum(0)
+        return Tensor(v)
+
+    def rsample(self, shape=()) -> Tensor:
+        u = jax.random.uniform(split_key(), _shape(shape) + self._batch_shape,
+                               minval=1e-6, maxval=1 - 1e-6)
+        p = self.probs
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where(near_half, u, s))
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) + self._log_norm())
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0,1,2,… (reference geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs**2)
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(self.variance._data))
+
+    def sample(self, shape=()) -> Tensor:
+        u = jax.random.uniform(split_key(), _shape(shape) + self._batch_shape,
+                               minval=1e-7)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)),
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value) -> Tensor:
+        k = _arr(value)
+        return Tensor(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def pmf(self, k):
+        return self.prob(k)
+
+    def entropy(self) -> Tensor:
+        p = self.probs
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+    def cdf(self, k) -> Tensor:
+        kk = _arr(k)
+        return Tensor(1 - jnp.power(1 - self.probs, kk + 1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()) -> Tensor:
+        s = jax.random.poisson(split_key(), self.rate,
+                               _shape(shape) + self._batch_shape)
+        return Tensor(s.astype(self.rate.dtype), stop_gradient=True)
+
+    def log_prob(self, value) -> Tensor:
+        k = _arr(value)
+        return Tensor(k * jnp.log(self.rate) - self.rate - jsp.gammaln(k + 1))
+
+    def entropy(self) -> Tensor:
+        # series approximation (reference uses the same truncated form)
+        r = self.rate
+        e = r * (1 - jnp.log(r)) + 0.5 * jnp.log(2 * math.pi * jnp.e * r) \
+            - 1 / (12 * r) - 1 / (24 * r**2)
+        small = jnp.exp(-r) * r * (1 - jnp.log(jnp.clip(r, 1e-8)))
+        return Tensor(jnp.where(r > 1.0, e, jnp.maximum(small, 0.0)))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count, None)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.total_count),
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.total_count * self.probs,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.total_count * self.probs * (1 - self.probs), self._batch_shape))
+
+    def sample(self, shape=()) -> Tensor:
+        n = int(np.max(np.asarray(self.total_count)))
+        u = jax.random.uniform(split_key(),
+                               _shape(shape) + self._batch_shape + (n,))
+        mask = jnp.arange(n) < jnp.asarray(self.total_count)[..., None]
+        draws = ((u < self.probs[..., None]) & mask).sum(-1)
+        return Tensor(draws.astype(self.probs.dtype), stop_gradient=True)
+
+    def log_prob(self, value) -> Tensor:
+        k = _arr(value)
+        n = jnp.asarray(self.total_count, k.dtype)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        logc = jsp.gammaln(n + 1) - jsp.gammaln(k + 1) - jsp.gammaln(n - k + 1)
+        return Tensor(logc + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        # reference Categorical takes unnormalized `logits` as event weights
+        if logits is not None:
+            lt = _t(logits)
+            if not jnp.issubdtype(lt._data.dtype, jnp.floating):
+                lt = Tensor(jnp.log(lt._data.astype(jnp.float32)))
+            self._logits_t = lt
+        elif probs is not None:
+            pt = _t(probs)
+            self._logits_t = apply_op("log", jnp.log, pt)
+        else:
+            raise ValueError("need logits or probs")
+        self._logits = self._logits_t._data
+        super().__init__(self._logits.shape[:-1])
+        self._n = self._logits.shape[-1]
+
+    @property
+    def probs(self) -> Tensor:
+        return Tensor(jax.nn.softmax(self._logits, -1))
+
+    @property
+    def logits(self) -> Tensor:
+        return Tensor(self._logits)
+
+    def sample(self, shape=()) -> Tensor:
+        s = jax.random.categorical(split_key(), self._logits,
+                                   shape=_shape(shape) + self._batch_shape)
+        return Tensor(s.astype(jnp.int64), stop_gradient=True)
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value, None).astype(jnp.int32)
+
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(
+                logp, jnp.broadcast_to(v, logp.shape[:-1])[..., None], -1)[..., 0]
+
+        return apply_op("categorical_log_prob", f, self._logits_t)
+
+    def probs_of(self, value) -> Tensor:
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self) -> Tensor:
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+
+        return apply_op("categorical_entropy", f, self._logits_t)
+
+    def kl_divergence_categorical(self, other: "Categorical") -> Tensor:
+        logp = jax.nn.log_softmax(self._logits, -1)
+        logq = jax.nn.log_softmax(other._logits, -1)
+        return Tensor((jnp.exp(logp) * (logp - logq)).sum(-1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()) -> Tensor:
+        sh = _shape(shape) + self._batch_shape
+        cat = jax.random.categorical(
+            split_key(), jnp.log(self.probs), axis=-1,
+            shape=(self.total_count,) + sh)
+        onehot = jax.nn.one_hot(cat, self.probs.shape[-1], dtype=self.probs.dtype)
+        return Tensor(onehot.sum(0), stop_gradient=True)
+
+    def log_prob(self, value) -> Tensor:
+        v = _arr(value)
+        logc = (jsp.gammaln(jnp.asarray(float(self.total_count + 1)))
+                - jsp.gammaln(v + 1).sum(-1))
+        return Tensor(logc + (v * jnp.log(self.probs)).sum(-1))
+
+    def entropy(self) -> Tensor:
+        # exact via enumeration is exponential; use the Categorical bound
+        p = self.probs
+        return Tensor(-(p * jnp.log(p)).sum(-1) * self.total_count)
+
+
+# ---------------------------------------------------------------------------
+# Combinators
+# ---------------------------------------------------------------------------
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference independent.py)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = reinterpreted_batch_rank
+        b = base.batch_shape
+        k = reinterpreted_batch_rank
+        super().__init__(b[: len(b) - k], b[len(b) - k:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value) -> Tensor:
+        lp = self.base.log_prob(value)._data
+        for _ in range(self.reinterpreted_batch_rank):
+            lp = lp.sum(-1)
+        return Tensor(lp)
+
+    def entropy(self) -> Tensor:
+        e = self.base.entropy()._data
+        for _ in range(self.reinterpreted_batch_rank):
+            e = e.sum(-1)
+        return Tensor(e)
+
+
+class TransformedDistribution(Distribution):
+    """Reference transformed_distribution.py: y = T(x), x ~ base."""
+
+    def __init__(self, base: Distribution, transforms):
+        from .transform import ChainTransform
+
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms) if len(self.transforms) != 1 \
+            else self.transforms[0]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()) -> Tensor:
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def sample(self, shape=()) -> Tensor:
+        t = self.rsample(shape)
+        t.stop_gradient = True
+        return t
+
+    def log_prob(self, value) -> Tensor:
+        y = ensure_tensor(value)
+        x = self._chain.inverse(y)
+        lp = self.base.log_prob(x)._data
+        ladj = self._chain.forward_log_det_jacobian(x)._data
+        return Tensor(lp - ladj)
+
+
+# ---------------------------------------------------------------------------
+# KL registry (reference kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls: type, q_cls: type):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    best, fn = None, None
+    for (pc, qc), f in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            depth = _mro_depth(type(p), pc) + _mro_depth(type(q), qc)
+            if best is None or depth < best:
+                best, fn = depth, f
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+def _mro_depth(cls, ancestor):
+    return cls.__mro__.index(ancestor)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p: Normal, q: Normal) -> Tensor:
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p: Categorical, q: Categorical) -> Tensor:
+    return p.kl_divergence_categorical(q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p: Uniform, q: Uniform) -> Tensor:
+    r = (p.high - p.low) / (q.high - q.low)
+    kl = -jnp.log(r)
+    outside = (p.low < q.low) | (p.high > q.high)
+    return Tensor(jnp.where(outside, jnp.inf, kl))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p: Bernoulli, q: Bernoulli) -> Tensor:
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p: Exponential, q: Exponential) -> Tensor:
+    r = p.rate / q.rate
+    return Tensor(jnp.log(r) + q.rate / p.rate - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p: Gamma, q: Gamma) -> Tensor:
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    kl = ((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1) + jsp.gammaln(a2)
+          + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 / b1 - 1))
+    return Tensor(kl)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p: Beta, q: Beta) -> Tensor:
+    def betaln(a, b):
+        return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    kl = (betaln(a2, b2) - betaln(a1, b1)
+          + (a1 - a2) * jsp.digamma(a1) + (b1 - b2) * jsp.digamma(b1)
+          + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+    return Tensor(kl)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p: Dirichlet, q: Dirichlet) -> Tensor:
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    kl = (jsp.gammaln(a0) - jsp.gammaln(b.sum(-1))
+          - (jsp.gammaln(a) - jsp.gammaln(b)).sum(-1)
+          + ((a - b) * (jsp.digamma(a) - jsp.digamma(a0)[..., None])).sum(-1))
+    return Tensor(kl)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p: Laplace, q: Laplace) -> Tensor:
+    r = p.scale / q.scale
+    t = jnp.abs(p.loc - q.loc) / q.scale
+    return Tensor(-jnp.log(r) + r * jnp.exp(-jnp.abs(p.loc - q.loc) / p.scale)
+                  + t - 1)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geom_geom(p: Geometric, q: Geometric) -> Tensor:
+    pp, qq = p.probs, q.probs
+    return Tensor((jnp.log(pp) - jnp.log(qq)) +
+                  (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq)))

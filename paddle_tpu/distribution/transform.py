@@ -1,0 +1,314 @@
+"""Bijective transforms (reference python/paddle/distribution/transform.py).
+
+Each Transform provides forward / inverse / forward_log_det_jacobian /
+inverse_log_det_jacobian over Tensors, composable via ChainTransform and
+liftable over batch dims via IndependentTransform.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import ensure_tensor
+
+__all__ = []  # re-exported by the package __init__
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    def forward(self, x) -> Tensor:
+        return Tensor(self._forward(ensure_tensor(x)._data))
+
+    def inverse(self, y) -> Tensor:
+        return Tensor(self._inverse(ensure_tensor(y)._data))
+
+    def forward_log_det_jacobian(self, x) -> Tensor:
+        return Tensor(self._forward_log_det_jacobian(ensure_tensor(x)._data))
+
+    def inverse_log_det_jacobian(self, y) -> Tensor:
+        yd = ensure_tensor(y)._data
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(yd)))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # event dims consumed/produced (0 = elementwise)
+    @property
+    def _domain_event_rank(self):
+        return 0
+
+    @property
+    def _codomain_event_rank(self):
+        return 0
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)._data
+        self.scale = ensure_tensor(scale)._data
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = ensure_tensor(power)._data
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    @property
+    def _domain_event_rank(self):
+        return 1
+
+    @property
+    def _codomain_event_rank(self):
+        return 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("softmax is not injective; no log|detJ|")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex^K (reference StickBreakingTransform)."""
+
+    _type = Type.BIJECTION
+
+    @property
+    def _domain_event_rank(self):
+        return 1
+
+    @property
+    def _codomain_event_rank(self):
+        return 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1).astype(x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+        cum = jnp.cumprod(1 - z, -1)
+        cumpad = jnp.concatenate([jnp.ones_like(z[..., :1]), cum], -1)
+        return zpad * cumpad
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        rem = 1 - jnp.cumsum(y_crop, -1)
+        sf = jnp.clip(rem, 1e-30)
+        k = y_crop.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1).astype(y.dtype))
+        z = y_crop / jnp.concatenate(
+            [jnp.ones_like(y_crop[..., :1]), sf[..., :-1]], -1)
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        # y_k = z_k · Π_{j<k}(1-z_j), z_k = σ(x_k - offset_k): the Jacobian is
+        # triangular with diag z_k(1-z_k)·Π_{j<k}(1-z_j)
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1).astype(x.dtype))
+        u = x - offset
+        z = jax.nn.sigmoid(u)
+        cum = jnp.cumprod(1 - z, -1)
+        cumpad = jnp.concatenate([jnp.ones_like(z[..., :1]), cum[..., :-1]], -1)
+        log_z_1mz = -jax.nn.softplus(-u) - jax.nn.softplus(u)  # log z + log(1-z)
+        return (log_z_1mz + jnp.log(cumpad)).sum(-1)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    @property
+    def _domain_event_rank(self):
+        return len(self.in_event_shape)
+
+    @property
+    def _codomain_event_rank(self):
+        return len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Sum the log-det over trailing batch dims (reference)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = reinterpreted_batch_rank
+
+    @property
+    def _domain_event_rank(self):
+        return self.base._domain_event_rank + self.reinterpreted_batch_rank
+
+    @property
+    def _codomain_event_rank(self):
+        return self.base._codomain_event_rank + self.reinterpreted_batch_rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ladj = self.base._forward_log_det_jacobian(x)
+        for _ in range(self.reinterpreted_batch_rank):
+            ladj = ladj.sum(-1)
+        return ladj
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    @property
+    def _domain_event_rank(self):
+        return max((t._domain_event_rank for t in self.transforms), default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ladj = t._forward_log_det_jacobian(x)
+            # reduce elementwise ladj over event dims introduced by later ops
+            total = ladj if total is None else total + ladj
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along slices of `axis` (reference)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, method, x):
+        parts = [
+            getattr(t, method)(jnp.take(x, i, self.axis))
+            for i, t in enumerate(self.transforms)
+        ]
+        return jnp.stack(parts, self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

@@ -1,0 +1,405 @@
+"""paddle.linalg — linear-algebra operator surface.
+
+Parity: python/paddle/linalg.py re-exporting python/paddle/tensor/linalg.py
+(cholesky, svd, qr, lu, eigh, norm family, solve family, …; kernels under
+paddle/phi/kernels/*/{svd,qr,cholesky,...}_kernel).
+
+TPU design: everything lowers to XLA's native decompositions via
+jax.numpy.linalg / jax.scipy.linalg (MXU-friendly blocked algorithms,
+differentiable through jax.vjp so the tape gets gradients for free).
+`eig`/`eigvals` (general non-symmetric) have no TPU lowering — they run
+as host callbacks like the reference's CPU-only eig kernel
+(paddle/phi/kernels/cpu/eig_kernel.cc).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .ops.dispatch import apply_op, ensure_tensor
+
+__all__ = [
+    "cholesky", "cholesky_inverse", "cholesky_solve", "cond", "corrcoef",
+    "cov", "det", "eig", "eigh", "eigvals", "eigvalsh", "householder_product",
+    "inv", "lstsq", "lu", "lu_unpack", "matrix_exp", "matrix_norm",
+    "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv", "qr",
+    "slogdet", "solve", "svd", "svd_lowrank", "pca_lowrank",
+    "triangular_solve", "vector_norm", "ormqr",
+]
+
+
+def cholesky(x, upper: bool = False, name=None) -> Tensor:
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply_op("cholesky", f, ensure_tensor(x))
+
+
+def cholesky_solve(x, y, upper: bool = False, name=None) -> Tensor:
+    """Solve A X = B given the Cholesky factor ``y`` of A (paddle argument
+    order: x = B, y = factor)."""
+
+    def f(b, factor):
+        return jax.scipy.linalg.cho_solve((factor, not upper), b)
+
+    return apply_op("cholesky_solve", f, ensure_tensor(x), ensure_tensor(y))
+
+
+def cholesky_inverse(x, upper: bool = False, name=None) -> Tensor:
+    def f(factor):
+        eye = jnp.eye(factor.shape[-1], dtype=factor.dtype)
+        return jax.scipy.linalg.cho_solve((factor, not upper), eye)
+
+    return apply_op("cholesky_inverse", f, ensure_tensor(x))
+
+
+def inv(x, name=None) -> Tensor:
+    return apply_op("inv", jnp.linalg.inv, ensure_tensor(x))
+
+
+inverse = inv
+
+
+def det(x, name=None) -> Tensor:
+    return apply_op("det", jnp.linalg.det, ensure_tensor(x))
+
+
+def slogdet(x, name=None):
+    t = ensure_tensor(x)
+
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return sign, logabs
+
+    return apply_op("slogdet", f, t)
+
+
+def solve(x, y, name=None) -> Tensor:
+    def f(a, b):
+        # paddle solves a @ out = b with 1-D b treated as a column
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return apply_op("solve", f, ensure_tensor(x), ensure_tensor(y))
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False, name=None) -> Tensor:
+    def f(a, b):
+        return jax.lax.linalg.triangular_solve(
+            a, b, left_side=True, lower=not upper, transpose_a=transpose,
+            unit_diagonal=unitriangular)
+
+    return apply_op("triangular_solve", f, ensure_tensor(x), ensure_tensor(y))
+
+
+def svd(x, full_matrices: bool = False, name=None):
+    return apply_op(
+        "svd", lambda a: jnp.linalg.svd(a, full_matrices=full_matrices),
+        ensure_tensor(x))
+
+
+def qr(x, mode: str = "reduced", name=None):
+    t = ensure_tensor(x)
+    if mode == "r":
+        return apply_op("qr", lambda a: jnp.linalg.qr(a, mode="r"), t)
+    return apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), t)
+
+
+def lu(x, pivot: bool = True, get_infos: bool = False, name=None):
+    """Packed LU with 1-based pivots (reference lu_op semantics)."""
+    t = ensure_tensor(x)
+
+    def f(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+
+    lu_mat, piv = apply_op("lu", f, t)
+    if get_infos:
+        info = Tensor(jnp.zeros(t.shape[:-2] or (1,), jnp.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def lu_unpack(x, y, unpack_ludata: bool = True, unpack_pivots: bool = True, name=None):
+    """(LU packed, pivots) -> P, L, U (reference lu_unpack)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def f(lu_mat, piv):
+        m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        def perm_from_pivots(p):
+            perm = jnp.arange(m)
+
+            def body(i, perm):
+                j = p[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+                return perm
+
+            perm = jax.lax.fori_loop(0, p.shape[0], body, perm)
+            return jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+
+        if piv.ndim == 1:
+            P = perm_from_pivots(piv)
+        else:
+            P = jnp.vectorize(perm_from_pivots, signature="(k)->(m,m)")(piv)
+        return P, L, U
+
+    return apply_op("lu_unpack", f, xt, yt)
+
+
+def eigh(x, UPLO: str = "L", name=None):
+    return apply_op(
+        "eigh", lambda a: jnp.linalg.eigh(a, symmetrize_input=False,
+                                          UPLO=UPLO), ensure_tensor(x))
+
+
+def eigvalsh(x, UPLO: str = "L", name=None) -> Tensor:
+    return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO),
+                    ensure_tensor(x))
+
+
+def _np_eig(a):
+    w, v = np.linalg.eig(a)
+    return w.astype(np.complex64 if a.dtype in (np.float32, np.complex64)
+                    else np.complex128), \
+           v.astype(np.complex64 if a.dtype in (np.float32, np.complex64)
+                    else np.complex128)
+
+
+def eig(x, name=None):
+    """General eigendecomposition — host callback (no TPU lowering exists;
+    reference also restricts eig to CPU, phi/kernels/cpu/eig_kernel.cc)."""
+    t = ensure_tensor(x)
+    a = t._data
+    cdt = jnp.complex64 if a.dtype in (jnp.float32, jnp.complex64) else jnp.complex128
+    out_shapes = (jax.ShapeDtypeStruct(a.shape[:-1], cdt),
+                  jax.ShapeDtypeStruct(a.shape, cdt))
+    w, v = jax.pure_callback(_np_eig, out_shapes, a, vmap_method="sequential")
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None) -> Tensor:
+    w, _ = eig(x)
+    return w
+
+
+def matrix_power(x, n: int, name=None) -> Tensor:
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n),
+                    ensure_tensor(x))
+
+
+def matrix_exp(x, name=None) -> Tensor:
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, ensure_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian: bool = False, rtol=None, atol=None, name=None) -> Tensor:
+    t = ensure_tensor(x)
+
+    def f(a):
+        s = (jnp.abs(jnp.linalg.eigvalsh(a)) if hermitian
+             else jnp.linalg.svd(a, compute_uv=False))
+        smax = jnp.max(s, axis=-1, keepdims=True)
+        if tol is not None:
+            thr = jnp.asarray(tol, s.dtype)
+            thr = jnp.broadcast_to(thr, smax.shape) if jnp.ndim(thr) == 0 else thr[..., None]
+        elif rtol is not None or atol is not None:
+            r = jnp.asarray(0.0 if rtol is None else rtol, s.dtype)
+            a_ = jnp.asarray(0.0 if atol is None else atol, s.dtype)
+            thr = jnp.maximum(a_, r * smax)
+        else:
+            eps = jnp.finfo(s.dtype).eps
+            thr = smax * max(a.shape[-2], a.shape[-1]) * eps
+        return jnp.sum(s > thr, axis=-1).astype(jnp.int64)
+
+    return apply_op("matrix_rank", f, t)
+
+
+def multi_dot(x: Sequence, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+    return apply_op("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *ts)
+
+
+def pinv(x, rcond=1e-15, hermitian: bool = False, name=None) -> Tensor:
+    return apply_op(
+        "pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+        ensure_tensor(x))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """Returns (solution, residuals, rank, singular_values) like the
+    reference lstsq_op."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        b2 = b[..., None] if b.ndim == a.ndim - 1 else b
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b2, rcond=rcond)
+        if b.ndim == a.ndim - 1:
+            sol = sol[..., 0]
+        return sol, res, rank.astype(jnp.int32), sv
+
+    return apply_op("lstsq", f, xt, yt)
+
+
+def householder_product(x, tau, name=None) -> Tensor:
+    """Accumulate Q from Householder reflectors (geqrf layout)."""
+    xt, tt = ensure_tensor(x), ensure_tensor(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        k = t.shape[-1]
+
+        def one(a2, t2):
+            Q = jnp.eye(m, dtype=a2.dtype)
+
+            def body(i, Q):
+                v = jnp.where(jnp.arange(m) < i, 0.0, a2[:, i]).at[i].set(1.0)
+                H = jnp.eye(m, dtype=a2.dtype) - t2[i] * jnp.outer(v, v.conj())
+                return Q @ H
+
+            Q = jax.lax.fori_loop(0, k, body, Q)
+            return Q[:, :n]
+
+        if a.ndim == 2:
+            return one(a, t)
+        batch = a.shape[:-2]
+        a_f = a.reshape((-1, m, n))
+        t_f = t.reshape((-1, k))
+        out = jax.vmap(one)(a_f, t_f)
+        return out.reshape(batch + (m, n))
+
+    return apply_op("householder_product", f, xt, tt)
+
+
+def ormqr(x, tau, other, left: bool = True, transpose: bool = False, name=None) -> Tensor:
+    """Multiply ``other`` by Q from a geqrf factorization (reference ormqr)."""
+    q = householder_product(x, tau)
+    qd = q._data
+
+    def f(qm, c):
+        qm2 = jnp.swapaxes(qm, -1, -2).conj() if transpose else qm
+        return qm2 @ c if left else c @ qm2
+
+    return apply_op("ormqr", f, Tensor(qd), ensure_tensor(other))
+
+
+# ---------------------------------------------------------------------------
+# Norms / statistics
+# ---------------------------------------------------------------------------
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim: bool = False, name=None) -> Tensor:
+    t = ensure_tensor(x)
+
+    def f(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op("vector_norm", f, t)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim: bool = False, name=None) -> Tensor:
+    t = ensure_tensor(x)
+
+    def f(a):
+        return jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+    return apply_op("matrix_norm", f, t)
+
+
+def norm(x, p=None, axis=None, keepdim: bool = False, name=None) -> Tensor:
+    """paddle.linalg.norm dispatch: fro/nuc/p-vector/p-matrix by axis arity."""
+    t = ensure_tensor(x)
+    if isinstance(p, str):  # 'fro' / 'nuc' imply a matrix norm over trailing dims
+        return matrix_norm(t, p, axis if axis is not None else (-2, -1), keepdim)
+    if axis is None and p is None:
+        flat = t.reshape([-1]) if t.ndim != 1 else t
+        return vector_norm(flat, 2.0, None, keepdim)
+    if axis is None:
+        return vector_norm(t.reshape([-1]) if t.ndim != 1 else t, p, None, keepdim)
+    if isinstance(axis, (tuple, list)) and len(axis) == 2:
+        return matrix_norm(t, "fro" if p is None else p, axis, keepdim)
+    return vector_norm(t, 2.0 if p is None else p, axis, keepdim)
+
+
+def cond(x, p=None, name=None) -> Tensor:
+    return apply_op("cond",
+                    lambda a: jnp.linalg.cond(a, p=p), ensure_tensor(x))
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None, name=None) -> Tensor:
+    t = ensure_tensor(x)
+    fw = None if fweights is None else ensure_tensor(fweights)._data
+    aw = None if aweights is None else ensure_tensor(aweights)._data
+
+    def f(a):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    return apply_op("cov", f, t)
+
+
+def corrcoef(x, rowvar: bool = True, name=None) -> Tensor:
+    return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar),
+                    ensure_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# Low-rank (randomized) decompositions — reference pca_lowrank/svd_lowrank
+# ---------------------------------------------------------------------------
+
+
+def svd_lowrank(x, q: Optional[int] = 6, niter: int = 2, M=None, name=None):
+    t = ensure_tensor(x)
+    from .ops.random import split_key
+
+    key = split_key()
+    Md = None if M is None else ensure_tensor(M)._data
+
+    def f(a):
+        a2 = a - Md if Md is not None else a
+        m, n = a2.shape[-2], a2.shape[-1]
+        k = min(q or 6, m, n)
+        omega = jax.random.normal(key, a2.shape[:-2] + (n, k), a2.dtype)
+        Y = a2 @ omega
+        Q, _ = jnp.linalg.qr(Y)
+        for _ in range(niter):
+            Z = jnp.swapaxes(a2, -1, -2) @ Q
+            Qz, _ = jnp.linalg.qr(Z)
+            Y = a2 @ Qz
+            Q, _ = jnp.linalg.qr(Y)
+        B = jnp.swapaxes(Q, -1, -2) @ a2
+        Ub, s, Vh = jnp.linalg.svd(B, full_matrices=False)
+        U = Q @ Ub
+        return U, s, jnp.swapaxes(Vh, -1, -2)
+
+    return apply_op("svd_lowrank", f, t)
+
+
+def pca_lowrank(x, q: Optional[int] = None, center: bool = True, niter: int = 2, name=None):
+    t = ensure_tensor(x)
+    if q is None:
+        q = min(6, t.shape[-2], t.shape[-1])
+    if center:
+        mean = t._data.mean(axis=-2, keepdims=True)
+        return svd_lowrank(Tensor(t._data - mean), q=q, niter=niter)
+    return svd_lowrank(t, q=q, niter=niter)

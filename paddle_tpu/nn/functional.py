@@ -26,11 +26,11 @@ from ..ops.random import split_key
 # ---------------------------------------------------------------------------
 
 
-def _act(name, jfn):
+def _act(opname, jfn):
     def op(x, name=None):
-        return apply_op(name if isinstance(name, str) else op.__name__, jfn, ensure_tensor(x))
+        return apply_op(opname, jfn, ensure_tensor(x))
 
-    op.__name__ = name
+    op.__name__ = opname
     return op
 
 

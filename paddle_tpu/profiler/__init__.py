@@ -63,11 +63,7 @@ class TracerEventType(IntEnum):
 
 _py_events: List[tuple] = []  # fallback when no native tracer
 _py_events_lock = threading.Lock()
-_recording = [False]
-
-
-def _tracer_on() -> bool:
-    return _recording[0]
+_recording = [False]  # single source of truth; dispatch.py imports this list
 
 
 class RecordEvent:
@@ -228,7 +224,8 @@ class Profiler:
 
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only: bool = False, record_shapes: bool = False,
-                 profile_memory: bool = False, with_flops: bool = False):
+                 profile_memory: bool = False, with_flops: bool = False,
+                 ring_capacity: int = 1 << 16):
         self.targets = targets or [ProfilerTarget.CPU]
         if scheduler is None:
             self.scheduler = _default_scheduler
@@ -240,6 +237,7 @@ class Profiler:
             self.scheduler = scheduler
         self.on_trace_ready = on_trace_ready
         self.timer_only = timer_only
+        self._ring_capacity = ring_capacity
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._events: List[Dict] = []
@@ -253,7 +251,7 @@ class Profiler:
             return
         lib = get_native()
         if lib is not None:
-            lib.pth_tracer_init(1 << 20)
+            lib.pth_tracer_init(self._ring_capacity)
         self._apply_state(self.scheduler(self.step_num))
 
     def _apply_state(self, state: ProfilerState):
@@ -268,9 +266,8 @@ class Profiler:
                 lib.pth_tracer_enable(1)
         elif was_recording and not should_record:
             self._collect()
-        if state == ProfilerState.RECORD_AND_RETURN and was_recording:
-            # boundary handled at next step()
-            pass
+        # RECORD -> RECORD_AND_RETURN needs no action here; the cycle
+        # boundary (collect + on_trace_ready) happens in step()
 
     def _collect(self):
         _recording[0] = False

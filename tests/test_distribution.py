@@ -1,0 +1,260 @@
+"""paddle.distribution tests: moments vs Monte-Carlo, log_prob vs closed
+forms, KL registry, transforms (round-trip + log-det), combinators.
+
+Reference model: test/distribution/test_distribution_*.py (scipy-free here:
+numpy closed forms as oracles)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    AffineTransform, Bernoulli, Beta, Categorical, Cauchy, ChainTransform,
+    Chi2, Dirichlet, Distribution, ExpTransform, Exponential, Gamma,
+    Geometric, Gumbel, Independent, Laplace, LogNormal, Multinomial,
+    MultivariateNormal, Normal, Poisson, SigmoidTransform,
+    StickBreakingTransform, StudentT, TanhTransform, TransformedDistribution,
+    Uniform, kl_divergence,
+)
+
+paddle.seed(1234)
+N = 20000
+
+
+def _mc_check(dist, mean_ref, var_ref, rtol=0.1, atol=0.05):
+    s = dist.sample((N,)).numpy()
+    np.testing.assert_allclose(s.mean(0), mean_ref, rtol=rtol, atol=atol)
+    np.testing.assert_allclose(s.var(0), var_ref, rtol=max(rtol, 0.15), atol=atol)
+
+
+class TestContinuous:
+    def test_normal(self):
+        d = Normal(1.5, 2.0)
+        _mc_check(d, 1.5, 4.0)
+        lp = d.log_prob(paddle.to_tensor(1.5)).numpy()
+        np.testing.assert_allclose(lp, -math.log(2.0 * math.sqrt(2 * math.pi)), rtol=1e-5)
+        np.testing.assert_allclose(d.entropy().numpy(),
+                                   0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(d.cdf(paddle.to_tensor(1.5)).numpy(), 0.5, atol=1e-6)
+        np.testing.assert_allclose(d.icdf(paddle.to_tensor(0.5)).numpy(), 1.5, atol=1e-5)
+        # rsample is differentiable wrt nothing here, but shape contract holds
+        assert d.sample((3, 2)).shape == [3, 2]
+
+    def test_uniform_laplace_gumbel_cauchy(self):
+        u = Uniform(-1.0, 3.0)
+        _mc_check(u, 1.0, 16 / 12)
+        np.testing.assert_allclose(u.entropy().numpy(), math.log(4.0), rtol=1e-6)
+        assert np.isneginf(u.log_prob(paddle.to_tensor(5.0)).numpy())
+
+        l = Laplace(0.0, 1.0)
+        _mc_check(l, 0.0, 2.0)
+        np.testing.assert_allclose(
+            l.log_prob(paddle.to_tensor(1.0)).numpy(), -1 - math.log(2), rtol=1e-5)
+        np.testing.assert_allclose(l.icdf(l.cdf(paddle.to_tensor(0.7))).numpy(), 0.7, rtol=1e-4)
+
+        g = Gumbel(0.5, 1.0)
+        _mc_check(g, 0.5 + 0.5772156649, math.pi**2 / 6)
+
+        c = Cauchy(0.0, 1.0)
+        np.testing.assert_allclose(
+            c.log_prob(paddle.to_tensor(0.0)).numpy(), -math.log(math.pi), rtol=1e-5)
+        np.testing.assert_allclose(c.cdf(paddle.to_tensor(1.0)).numpy(), 0.75, rtol=1e-5)
+
+    def test_exponential_gamma_beta_chi2(self):
+        e = Exponential(2.0)
+        _mc_check(e, 0.5, 0.25)
+        np.testing.assert_allclose(e.entropy().numpy(), 1 - math.log(2.0), rtol=1e-5)
+
+        g = Gamma(3.0, 2.0)
+        _mc_check(g, 1.5, 0.75)
+        # log_prob at mode (a-1)/b = 1.0
+        lp = g.log_prob(paddle.to_tensor(1.0)).numpy()
+        ref = 3 * math.log(2) + 2 * math.log(1.0) - 2.0 - math.lgamma(3.0)
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+
+        b = Beta(2.0, 3.0)
+        _mc_check(b, 0.4, 0.04)
+
+        chi = Chi2(4.0)
+        _mc_check(chi, 4.0, 8.0, rtol=0.15)
+
+    def test_lognormal_studentt(self):
+        ln = LogNormal(0.0, 0.5)
+        _mc_check(ln, math.exp(0.125), (math.exp(0.25) - 1) * math.exp(0.25), rtol=0.15)
+        t = StudentT(10.0, 0.0, 1.0)
+        _mc_check(t, 0.0, 10 / 8, rtol=0.2)
+
+    def test_multivariate_normal(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = MultivariateNormal(np.zeros(2, np.float32), covariance_matrix=cov)
+        s = mvn.sample((N,)).numpy()
+        np.testing.assert_allclose(np.cov(s.T), cov, rtol=0.1, atol=0.05)
+        # log_prob vs explicit formula
+        x = np.array([0.3, -0.2], np.float32)
+        ref = (-0.5 * x @ np.linalg.inv(cov) @ x
+               - 0.5 * math.log((2 * math.pi) ** 2 * np.linalg.det(cov)))
+        np.testing.assert_allclose(mvn.log_prob(paddle.to_tensor(x)).numpy(), ref, rtol=1e-4)
+        np.testing.assert_allclose(
+            mvn.entropy().numpy(),
+            0.5 * 2 * (1 + math.log(2 * math.pi)) + 0.5 * math.log(np.linalg.det(cov)),
+            rtol=1e-5)
+
+
+class TestDiscrete:
+    def test_bernoulli_geometric_poisson(self):
+        b = Bernoulli(0.3)
+        _mc_check(b, 0.3, 0.21)
+        np.testing.assert_allclose(
+            b.log_prob(paddle.to_tensor(1.0)).numpy(), math.log(0.3), rtol=1e-4)
+
+        g = Geometric(0.25)
+        _mc_check(g, 3.0, 12.0, rtol=0.2)
+        np.testing.assert_allclose(
+            g.log_prob(paddle.to_tensor(2.0)).numpy(),
+            2 * math.log(0.75) + math.log(0.25), rtol=1e-5)
+
+        p = Poisson(4.0)
+        _mc_check(p, 4.0, 4.0, rtol=0.15)
+        np.testing.assert_allclose(
+            p.log_prob(paddle.to_tensor(3.0)).numpy(),
+            3 * math.log(4.0) - 4.0 - math.log(6.0), rtol=1e-4)
+
+    def test_categorical_multinomial(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        c = Categorical(logits=logits)
+        s = c.sample((N,)).numpy()
+        freqs = np.bincount(s.astype(int), minlength=3) / N
+        np.testing.assert_allclose(freqs, [0.2, 0.3, 0.5], atol=0.02)
+        np.testing.assert_allclose(
+            c.log_prob(paddle.to_tensor(np.int64(2))).numpy(), math.log(0.5), rtol=1e-4)
+        ent_ref = -(0.2 * math.log(0.2) + 0.3 * math.log(0.3) + 0.5 * math.log(0.5))
+        np.testing.assert_allclose(c.entropy().numpy(), ent_ref, rtol=1e-4)
+
+        m = Multinomial(10, np.array([0.3, 0.7], np.float32))
+        s = m.sample((N // 10,)).numpy()
+        assert (s.sum(-1) == 10).all()
+        np.testing.assert_allclose(s.mean(0), [3.0, 7.0], rtol=0.05)
+        np.testing.assert_allclose(
+            m.log_prob(paddle.to_tensor(np.array([3.0, 7.0], np.float32))).numpy(),
+            math.log(math.comb(10, 3) * 0.3**3 * 0.7**7), rtol=1e-3)
+
+
+class TestKL:
+    def test_normal_normal(self):
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        ref = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl_divergence(p, q).numpy(), ref, rtol=1e-5)
+        assert kl_divergence(p, p).numpy() == pytest.approx(0.0, abs=1e-6)
+
+    def test_registered_pairs(self):
+        pairs = [
+            (Beta(2.0, 3.0), Beta(3.0, 2.0)),
+            (Gamma(2.0, 1.0), Gamma(3.0, 2.0)),
+            (Bernoulli(0.3), Bernoulli(0.6)),
+            (Exponential(1.0), Exponential(2.0)),
+            (Dirichlet(np.array([1.0, 2.0], np.float32)),
+             Dirichlet(np.array([2.0, 1.0], np.float32))),
+            (Geometric(0.3), Geometric(0.5)),
+            (Laplace(0.0, 1.0), Laplace(1.0, 2.0)),
+            (Uniform(0.0, 1.0), Uniform(-1.0, 2.0)),
+            (Categorical(logits=np.zeros(3, np.float32)),
+             Categorical(logits=np.arange(3, dtype=np.float32))),
+        ]
+        for p, q in pairs:
+            kl = kl_divergence(p, q).numpy()
+            assert np.all(kl >= -1e-5), (type(p).__name__, kl)
+            same = kl_divergence(p, p).numpy()
+            np.testing.assert_allclose(same, 0.0, atol=1e-5)
+
+    def test_kl_mc_agreement(self):
+        """KL(p||q) ≈ E_p[log p - log q] (Monte-Carlo oracle)."""
+        p, q = Gamma(3.0, 2.0), Gamma(2.0, 1.0)
+        s = p.sample((N,))
+        mc = (p.log_prob(s).numpy() - q.log_prob(s).numpy()).mean()
+        np.testing.assert_allclose(kl_divergence(p, q).numpy(), mc, rtol=0.1)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            kl_divergence(Normal(0.0, 1.0), Gamma(1.0, 1.0))
+
+
+class TestTransformsAndCombinators:
+    def test_transform_roundtrip_and_ldj(self):
+        x = paddle.to_tensor(np.linspace(-2, 2, 7).astype(np.float32))
+        for t in (ExpTransform(), SigmoidTransform(), TanhTransform(),
+                  AffineTransform(1.0, 3.0)):
+            y = t.forward(x)
+            back = t.inverse(y).numpy()
+            np.testing.assert_allclose(back, x.numpy(), rtol=1e-4, atol=1e-5)
+            # ldj vs numeric derivative
+            eps = 1e-3
+            num = (t.forward(paddle.to_tensor(x.numpy() + eps)).numpy()
+                   - t.forward(paddle.to_tensor(x.numpy() - eps)).numpy()) / (2 * eps)
+            np.testing.assert_allclose(
+                t.forward_log_det_jacobian(x).numpy(), np.log(np.abs(num)),
+                rtol=1e-2, atol=1e-2)
+            np.testing.assert_allclose(
+                t.inverse_log_det_jacobian(y).numpy(),
+                -t.forward_log_det_jacobian(x).numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking(self):
+        sb = StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.2, -0.5, 1.0], np.float32))
+        y = sb.forward(x)
+        yn = y.numpy()
+        assert yn.shape == (4,) and yn.min() > 0
+        np.testing.assert_allclose(yn.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(sb.inverse(y).numpy(), x.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_transformed_distribution_lognormal_equiv(self):
+        """exp(Normal) must match LogNormal exactly."""
+        td = TransformedDistribution(Normal(0.2, 0.4), [ExpTransform()])
+        ln = LogNormal(0.2, 0.4)
+        v = paddle.to_tensor(np.array([0.5, 1.0, 2.3], np.float32))
+        np.testing.assert_allclose(td.log_prob(v).numpy(), ln.log_prob(v).numpy(),
+                                   rtol=1e-4)
+        s = td.sample((N,)).numpy()
+        np.testing.assert_allclose(s.mean(), math.exp(0.2 + 0.08), rtol=0.1)
+
+    def test_chain_affine(self):
+        chain = ChainTransform([AffineTransform(0.0, 2.0), ExpTransform()])
+        x = paddle.to_tensor(np.array([0.1, 0.5], np.float32))
+        np.testing.assert_allclose(chain.forward(x).numpy(), np.exp(2 * x.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(chain.inverse(chain.forward(x)).numpy(), x.numpy(),
+                                   rtol=1e-5)
+
+    def test_independent(self):
+        base = Normal(np.zeros((3, 4), np.float32), np.ones((3, 4), np.float32))
+        ind = Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        v = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        np.testing.assert_allclose(
+            ind.log_prob(v).numpy(), base.log_prob(v).numpy().sum(-1), rtol=1e-5)
+        np.testing.assert_allclose(
+            ind.entropy().numpy(), base.entropy().numpy().sum(-1), rtol=1e-5)
+
+    def test_dirichlet(self):
+        d = Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+        s = d.sample((N,)).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.01)
+        np.testing.assert_allclose(
+            d.mean.numpy(), [0.2, 0.3, 0.5], rtol=1e-5)
+
+
+def test_rsample_is_differentiable():
+    loc = paddle.to_tensor(np.float32(0.5))
+    loc.stop_gradient = False
+    # pathwise gradient through rsample: d E[x]/d loc = 1
+    grads = []
+    for _ in range(200):
+        d = Normal(loc, paddle.to_tensor(np.float32(1.0)))
+        x = d.rsample()
+        x.backward()
+        grads.append(loc.grad.numpy())
+        loc.clear_grad()
+    np.testing.assert_allclose(np.mean(grads), 1.0, rtol=1e-6)
