@@ -39,6 +39,8 @@ from .core import device
 from .core.device import CPUPlace, CUDAPlace, TPUPlace, get_device, is_compiled_with_cuda, set_device
 
 from . import amp, autograd, distribution, fft, io, jit, linalg, metric, nn, optimizer, profiler, vision
+from . import hapi
+from .hapi import Model, callbacks, summary
 from .core import memory
 from .core.memory import max_memory_allocated, memory_allocated
 from . import distributed

@@ -1,0 +1,8 @@
+"""paddle_tpu.hapi — high-level training API (reference python/paddle/hapi)."""
+
+from . import callbacks
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger
+from .model import Model
+from .model_summary import summary
+
+__all__ = ["Model", "summary", "callbacks"]

@@ -1,0 +1,277 @@
+"""hapi Model: prepare/fit/evaluate/predict high-level loop.
+
+Parity: python/paddle/hapi/model.py (Model:325 — train_batch:713,
+eval_batch, predict_batch, save/load:1196, fit:1472, evaluate:2200,
+predict, summary). TPU design: dygraph adapter only (dygraph is the
+default and only eager mode here); the static-graph adapter's role is
+covered by jit.to_static on the train step.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from ..nn.layer import Layer
+from ..ops.dispatch import ensure_tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._input_spec = inputs
+        self._label_spec = labels
+
+    # -- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _to_list(metrics)
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle.metric.Metric, got {type(m)}")
+        self._metrics = ms
+
+    # -- single-batch ops (reference train_batch:713) ----------------------
+    def train_batch(self, inputs, labels=None, update: bool = True):
+        self.network.train()
+        inputs = [ensure_tensor(x) for x in _to_list(inputs)]
+        labels = [ensure_tensor(y) for y in _to_list(labels)]
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        losses = self._compute_loss(outs, labels)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        loss_vals = [float(l.numpy()) for l in losses]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        import paddle_tpu as paddle
+
+        with paddle.no_grad():
+            inputs = [ensure_tensor(x) for x in _to_list(inputs)]
+            labels = [ensure_tensor(y) for y in _to_list(labels)]
+            outs = _to_list(self.network(*inputs))
+            losses = self._compute_loss(outs, labels) if self._loss else []
+            metrics = self._update_metrics(outs, labels)
+        loss_vals = [float(l.numpy()) for l in losses]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        import paddle_tpu as paddle
+
+        with paddle.no_grad():
+            inputs = [ensure_tensor(x) for x in _to_list(inputs)]
+            outs = _to_list(self.network(*inputs))
+        return [o.numpy() for o in outs]
+
+    def _compute_loss(self, outs, labels):
+        if self._loss is None:
+            # network returns loss directly
+            return [outs[0]]
+        res = self._loss(*(outs + labels))
+        return _to_list(res)
+
+    def _update_metrics(self, outs, labels):
+        vals = {}
+        for m in self._metrics:
+            if hasattr(m, "compute"):
+                pred = m.compute(*(outs + labels))
+                m.update(*[np.asarray(p.numpy() if isinstance(p, Tensor) else p)
+                           for p in _to_list(pred)])
+            else:
+                m.update(*[np.asarray(t.numpy()) for t in outs + labels])
+            vals[m.name() if callable(getattr(m, "name", None)) else str(m)] = m.accumulate()
+        return vals
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert train_data is not None, "train_data must be given"
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir, metrics=self._metrics)
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, labs, update=update)
+                logs = self._result_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
+                              num_workers=num_workers, callbacks=cbks,
+                              _inner=True)
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None, _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, batch_size=batch_size, verbose=verbose,
+            metrics=self._metrics, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = self._result_logs(res, prefix="eval_")
+            cbks.on_eval_batch_end(step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose, mode="predict")
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # transpose: list over steps of list over outputs -> list over outputs
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    def _forward_arity(self) -> Optional[int]:
+        """Positional inputs network.forward accepts (the reference uses the
+        inputs spec for this; without one, the forward signature decides)."""
+        import inspect
+
+        try:
+            sig = inspect.signature(self.network.forward)
+        except (TypeError, ValueError):
+            return None
+        n, variadic = 0, False
+        for p in sig.parameters.values():
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                n += 1
+            elif p.kind == p.VAR_POSITIONAL:
+                variadic = True
+        return None if variadic else n
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if len(batch) == 1:
+                return batch, []
+            n_in = self._forward_arity()
+            if n_in is not None and 0 < n_in < len(batch):
+                return batch[:n_in], batch[n_in:] if has_labels else []
+            if has_labels:
+                return batch[:-1], batch[-1:]
+            return batch, []
+        return [batch], []
+
+    def _result_logs(self, res, prefix=""):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs.update({f"{prefix}loss": losses})
+            for k, v in metrics.items():
+                logs[f"{prefix}{k}"] = v
+        else:
+            logs[f"{prefix}loss"] = res
+        return logs
+
+    # -- persistence (reference save:1196 / load) --------------------------
+    def save(self, path: str, training: bool = True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        import paddle_tpu as paddle
+
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            state = getattr(self._optimizer, "state_dict", lambda: {})()
+            paddle.save(state, path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+
+        params = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            state = paddle.load(opt_path)
+            if hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(state)
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,76 @@
+"""paddle.summary (reference python/paddle/hapi/model_summary.py):
+layer-by-layer table of output shapes + parameter counts via forward hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Returns {'total_params': int, 'trainable_params': int} and prints the
+    per-layer table (reference summary contract)."""
+    import paddle_tpu as paddle
+
+    if input is None:
+        assert input_size is not None, "input_size or input required"
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        sizes = [s if isinstance(s, (list, tuple)) else (s,) for s in sizes]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        inputs = [
+            paddle.to_tensor(np.ones([d if d and d > 0 else 1 for d in s],
+                                     dtype=dt or "float32"))
+            for s, dt in zip(sizes, dts)
+        ]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, ins, outs):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(int(np.prod(p.shape)) for p in l.parameters(include_sublayers=False))
+            rows.append((f"{type(l).__name__}-{len(rows)}", shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if not list(sub.named_children()):  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        with paddle.no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    width = 76
+    print("-" * width)
+    print(f"{'Layer (type)':<30}{'Output Shape':<28}{'Param #':>12}")
+    print("=" * width)
+    for name, shape, n in rows:
+        print(f"{name:<30}{str(shape):<28}{n:>12,}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}

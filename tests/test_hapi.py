@@ -1,0 +1,154 @@
+"""hapi Model tests: fit/evaluate/predict loop, metrics, callbacks
+(checkpoint, early stopping, LR scheduler), save/load, summary.
+
+Reference model: test/legacy_test/test_model.py (fit on a small dataset,
+loss decreases, accuracy accumulates, save/load round-trip)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi import EarlyStopping, Model, ModelCheckpoint
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.nn import CrossEntropyLoss
+
+
+class ToyClassification(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=256, seed=0):
+        rs = np.random.RandomState(seed)
+        self.x = rs.randn(n, 8).astype(np.float32)
+        w = rs.randn(8)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 2))
+
+
+def _prepared_model(lr=0.1):
+    paddle.seed(42)
+    net = _mlp()
+    model = Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    model.prepare(opt, CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_loss_decreases_and_metrics(capsys):
+    model = _prepared_model()
+    ds = ToyClassification()
+    first = model.train_batch([ds.x[:32]], [ds.y[:32]])
+    model.fit(ds, batch_size=32, epochs=3, verbose=0)
+    res = model.evaluate(ds, batch_size=64, verbose=0)
+    assert res["eval_acc"] > 0.9, res
+    assert res["eval_loss"][0] < first[0][0][0] if isinstance(first, tuple) else True
+
+
+def test_evaluate_and_predict_shapes():
+    model = _prepared_model()
+    ds = ToyClassification(n=100)
+    model.fit(ds, batch_size=25, epochs=1, verbose=0)
+    out = model.predict(ds, batch_size=25, stack_outputs=True)
+    assert len(out) == 1 and out[0].shape == (100, 2)
+    out_steps = model.predict(ds, batch_size=25)
+    assert len(out_steps[0]) == 4  # 4 batches
+
+
+def test_train_batch_eval_batch():
+    model = _prepared_model()
+    ds = ToyClassification(n=64)
+    losses, metrics = model.train_batch([ds.x], [ds.y])
+    assert np.isfinite(losses[0]) and "acc" in metrics
+    eval_losses, eval_metrics = model.eval_batch([ds.x], [ds.y])
+    assert np.isfinite(eval_losses[0]) and 0 <= eval_metrics["acc"] <= 1
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = _prepared_model()
+    ds = ToyClassification(n=64)
+    model.fit(ds, batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams") and os.path.exists(path + ".pdopt")
+
+    model2 = _prepared_model()
+    model2.load(path)
+    x = paddle.to_tensor(ds.x[:8])
+    np.testing.assert_allclose(
+        model.predict_batch([x])[0], model2.predict_batch([x])[0],
+        rtol=1e-5, atol=1e-6)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    model = _prepared_model()
+    ds = ToyClassification(n=64)
+    model.fit(ds, batch_size=32, epochs=2, verbose=0, save_dir=str(tmp_path))
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+
+
+def test_early_stopping_stops():
+    model = _prepared_model(lr=0.0)  # lr 0: nothing ever improves
+    ds = ToyClassification(n=64)
+    es = EarlyStopping(monitor="eval_loss", patience=0, verbose=0,
+                       save_best_model=False, min_delta=1e-9)
+    model.fit(ds, eval_data=ds, batch_size=32, epochs=10, verbose=0,
+              callbacks=[es])
+    # stopped long before 10 epochs (after 2 evals at most)
+    assert model.stop_training
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(0)
+    net = _mlp()
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    model = Model(net)
+    model.prepare(opt, CrossEntropyLoss())
+    ds = ToyClassification(n=64)
+    model.fit(ds, batch_size=16, epochs=1, verbose=0)  # 4 steps
+    assert opt.get_lr() == pytest.approx(0.1 * 0.5**2)
+
+
+def test_summary(capsys):
+    net = _mlp()
+    info = paddle.summary(net, (4, 8))
+    out = capsys.readouterr().out
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+    assert info["trainable_params"] == info["total_params"]
+    assert "Linear" in out and "Total params" in out
+
+
+def test_network_returning_loss_directly():
+    """prepare(loss=None): network output treated as the loss."""
+
+    class LossNet(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 1)
+
+        def forward(self, x):
+            return self.fc(x).square().mean()
+
+    paddle.seed(0)
+    net = LossNet()
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters()))
+    x = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+    l0 = model.train_batch([x])
+    for _ in range(10):
+        l1 = model.train_batch([x])
+    assert l1[0] < l0[0]
