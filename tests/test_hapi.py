@@ -50,7 +50,7 @@ def test_fit_loss_decreases_and_metrics(capsys):
     model = _prepared_model()
     ds = ToyClassification()
     first = model.train_batch([ds.x[:32]], [ds.y[:32]])
-    model.fit(ds, batch_size=32, epochs=3, verbose=0)
+    model.fit(ds, batch_size=32, epochs=8, verbose=0)
     res = model.evaluate(ds, batch_size=64, verbose=0)
     assert res["eval_acc"] > 0.9, res
     assert res["eval_loss"][0] < first[0][0][0] if isinstance(first, tuple) else True
