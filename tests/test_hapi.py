@@ -53,7 +53,8 @@ def test_fit_loss_decreases_and_metrics(capsys):
     model.fit(ds, batch_size=32, epochs=8, verbose=0)
     res = model.evaluate(ds, batch_size=64, verbose=0)
     assert res["eval_acc"] > 0.9, res
-    assert res["eval_loss"][0] < first[0][0][0] if isinstance(first, tuple) else True
+    first_loss = np.asarray(first[0] if isinstance(first, tuple) else first).ravel()[0]
+    assert res["eval_loss"][0] < first_loss
 
 
 def test_evaluate_and_predict_shapes():
