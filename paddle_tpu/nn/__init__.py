@@ -24,4 +24,8 @@ from .layers_transformer import (
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
+from .layers_rnn import (
+    BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
+    SimpleRNNCell,
+)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

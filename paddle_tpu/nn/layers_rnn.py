@@ -1,0 +1,492 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU cells and sequence layers.
+
+Parity: python/paddle/nn/layer/rnn.py (RNNCellBase, SimpleRNNCell:413,
+LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU — reference layouts:
+weight_ih (gate_size, input_size), weight_hh (gate_size, hidden_size),
+gate order i,f,g,o for LSTM and r,z,c for GRU, uniform(-1/sqrt(h), 1/sqrt(h))
+init, outputs (B,T,H*dirs) + final states (L*dirs, B, H)).
+
+TPU design: the whole time recurrence runs inside ONE tape op as a
+``lax.scan`` — a single XLA while-loop the compiler can pipeline on the
+MXU — instead of the reference's per-timestep kernel launches
+(paddle/phi/kernels/gpu/rnn_kernel.cu drives cuDNN; here XLA is the
+fused implementation). Variable-length sequences are handled by masking
+inside the scan (no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+from ..ops import random as rnd
+from .initializer import Uniform
+from .layer import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _act(name):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu}[name]
+
+
+def _simple_rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    pre = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        pre = pre + b_ih + b_hh
+    return _act(activation)(pre)
+
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c_new = _sigmoid(f) * c + _sigmoid(i) * jnp.tanh(g)
+    h_new = _sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x @ w_ih.T
+    hg = h @ w_hh.T
+    if b_ih is not None:
+        xg = xg + b_ih
+        hg = hg + b_hh
+    xr, xz, xc = jnp.split(xg, 3, axis=-1)
+    hr, hz, hc = jnp.split(hg, 3, axis=-1)
+    r = _sigmoid(xr + hr)
+    z = _sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return z * h + (1.0 - z) * c
+
+
+class RNNCellBase(Layer):
+    """Base for single-step cells (parity: rnn.py RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx] if isinstance(batch_ref, Tensor) else int(batch_ref)
+        shape = shape if shape is not None else self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value, dtype or jnp.float32))
+                for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value, dtype or jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation for SimpleRNNCell should be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size), weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size), weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((hidden_size,), bias_ih_attr, is_bias=True,
+                                              default_initializer=init))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((hidden_size,), bias_hh_attr, is_bias=True,
+                                              default_initializer=init))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+        act = self.activation
+        if self.bias_ih is not None:
+            h = apply_op(
+                "simple_rnn_cell",
+                lambda x, hp, wi, wh, bi, bh: _simple_rnn_step(x, hp, wi, wh, bi, bh, act),
+                inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        else:
+            h = apply_op(
+                "simple_rnn_cell",
+                lambda x, hp, wi, wh: _simple_rnn_step(x, hp, wi, wh, None, None, act),
+                inputs, states, self.weight_ih, self.weight_hh)
+        return h, h
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}, activation={self.activation}"
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        if proj_size:
+            raise NotImplementedError("proj_size != 0 is not supported yet")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size), weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size), weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((4 * hidden_size,), bias_ih_attr, is_bias=True,
+                                              default_initializer=init))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((4 * hidden_size,), bias_hh_attr, is_bias=True,
+                                              default_initializer=init))
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+        h_prev, c_prev = states
+        if self.bias_ih is not None:
+            h, c = apply_op(
+                "lstm_cell",
+                lambda x, hp, cp, wi, wh, bi, bh: _lstm_step(x, hp, cp, wi, wh, bi, bh),
+                inputs, h_prev, c_prev, self.weight_ih, self.weight_hh,
+                self.bias_ih, self.bias_hh, nouts=2)
+        else:
+            h, c = apply_op(
+                "lstm_cell",
+                lambda x, hp, cp, wi, wh: _lstm_step(x, hp, cp, wi, wh, None, None),
+                inputs, h_prev, c_prev, self.weight_ih, self.weight_hh, nouts=2)
+        return h, (h, c)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size), weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size), weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((3 * hidden_size,), bias_ih_attr, is_bias=True,
+                                              default_initializer=init))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((3 * hidden_size,), bias_hh_attr, is_bias=True,
+                                              default_initializer=init))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+        if self.bias_ih is not None:
+            h = apply_op(
+                "gru_cell",
+                lambda x, hp, wi, wh, bi, bh: _gru_step(x, hp, wi, wh, bi, bh),
+                inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        else:
+            h = apply_op(
+                "gru_cell",
+                lambda x, hp, wi, wh: _gru_step(x, hp, wi, wh, None, None),
+                inputs, states, self.weight_ih, self.weight_hh)
+        return h, h
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+def _scan_layer(mode, activation, reverse, x, h0, c0, seq_len, w_ih, w_hh, b_ih, b_hh):
+    """Run one direction of one layer over time with lax.scan.
+
+    x: (T, B, I) time-major inside the scan. seq_len: (B,) int or None.
+    Returns (outputs (T, B, H), h_T, c_T).
+    """
+    T = x.shape[0]
+    if reverse:
+        x = jnp.flip(x, axis=0)
+
+    if reverse and seq_len is not None:
+        # reversed input places padding first: step t touches original index T-1-t
+        valid = lambda t: (T - 1 - t) < seq_len  # noqa: E731
+    elif seq_len is not None:
+        valid = lambda t: t < seq_len  # noqa: E731
+    else:
+        valid = None
+
+    def step(carry, xt):
+        h, c, t = carry
+        if mode == "LSTM":
+            h_new, c_new = _lstm_step(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+        elif mode == "GRU":
+            h_new = _gru_step(xt, h, w_ih, w_hh, b_ih, b_hh)
+            c_new = c
+        else:
+            h_new = _simple_rnn_step(xt, h, w_ih, w_hh, b_ih, b_hh, activation)
+            c_new = c
+        if valid is not None:
+            m = valid(t)[:, None].astype(h.dtype)
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+            out = m * h_new
+        else:
+            out = h_new
+        return (h_new, c_new, t + 1), out
+
+    (h_T, c_T, _), outs = jax.lax.scan(step, (h0, c0, jnp.asarray(0)), x)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    return outs, h_T, c_T
+
+
+class RNN(Layer):
+    """Wrap a single-step cell into a sequence layer (parity: rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import manipulation as man
+
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        steps = man.unstack(inputs, axis=time_axis)
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        if sequence_length is not None and states is None:
+            states = self.cell.get_initial_states(steps[0], dtype=steps[0].dtype)
+        outs = [None] * T
+        for t in order:
+            out, new_states = self.cell(steps[t], states)
+            if sequence_length is not None:
+                m = Tensor((t < sequence_length._data)[:, None].astype(out._data.dtype))
+                out = out * m
+                states = jax.tree_util.tree_map(
+                    lambda new, old: new * m + old * (1 - m), new_states, states,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+            else:
+                states = new_states
+            outs[t] = out
+        outputs = man.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import manipulation as man
+
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        outputs = man.concat([out_fw, out_bw], axis=-1)
+        return outputs, (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent network, fused scan per
+    layer-direction (parity: rnn.py RNNBase)."""
+
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"direction should be forward or bidirect, got {direction}")
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"activation should be tanh or relu, got {activation}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._use_bias = not (bias_ih_attr is False or bias_hh_attr is False)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = "_reverse" if d == 1 else ""
+                names = [f"weight_ih_l{layer}{suffix}", f"weight_hh_l{layer}{suffix}"]
+                self.add_parameter(names[0], self.create_parameter(
+                    (gate_mult * hidden_size, in_sz), weight_ih_attr, default_initializer=init))
+                self.add_parameter(names[1], self.create_parameter(
+                    (gate_mult * hidden_size, hidden_size), weight_hh_attr, default_initializer=init))
+                if self._use_bias:
+                    names += [f"bias_ih_l{layer}{suffix}", f"bias_hh_l{layer}{suffix}"]
+                    self.add_parameter(names[2], self.create_parameter(
+                        (gate_mult * hidden_size,), bias_ih_attr, is_bias=True, default_initializer=init))
+                    self.add_parameter(names[3], self.create_parameter(
+                        (gate_mult * hidden_size,), bias_hh_attr, is_bias=True, default_initializer=init))
+                self._param_names.append(names)
+
+    @property
+    def state_components(self):
+        return 2 if self.MODE == "LSTM" else 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        B = inputs.shape[1 if self.time_major else 0]
+        dt = inputs.dtype
+
+        if initial_states is None:
+            z = Tensor(jnp.zeros((L * D, B, H), dt))
+            initial_states = (z, z.clone()) if self.MODE == "LSTM" else z
+        if self.MODE == "LSTM":
+            h0_all, c0_all = initial_states
+        else:
+            h0_all, c0_all = initial_states, None
+
+        mode, act, tm = self.MODE, self.activation, self.time_major
+        use_bias = self._use_bias
+        drop = self.dropout if self.training else 0.0
+        seq = sequence_length
+
+        params = []
+        for names in self._param_names:
+            params.extend(self._parameters[n] for n in names)
+
+        tensors = [inputs, h0_all] + ([c0_all] if c0_all is not None else []) \
+            + ([seq] if seq is not None else []) + params
+        n_fixed = 2 + (1 if c0_all is not None else 0) + (1 if seq is not None else 0)
+
+        # Per-layer dropout masks are sampled eagerly (host RNG state parity)
+        # and closed over as constants; per-element over (T, B, H*D) like
+        # the reference's F.dropout between stacked layers.
+        masks = []
+        if drop > 0 and L > 1:
+            T = inputs.shape[0 if tm else 1]
+            masks = [
+                (rnd.uniform([T, B, H * D], min=0.0, max=1.0)._data >= drop).astype(np.float32)
+                for _ in range(L - 1)
+            ]
+
+        def run(*arrays):
+            x = arrays[0]
+            h0s = arrays[1]
+            idx = 2
+            c0s = None
+            if mode == "LSTM":
+                c0s = arrays[idx]; idx += 1
+            sl = None
+            if seq is not None:
+                sl = arrays[idx]; idx += 1
+            ws = arrays[idx:]
+            if not tm:
+                x = jnp.swapaxes(x, 0, 1)  # (T, B, I)
+            stride = 4 if use_bias else 2
+            layer_in = x
+            h_finals, c_finals = [], []
+            for layer in range(L):
+                outs_dir = []
+                for d in range(D):
+                    k = layer * D + d
+                    chunk = ws[stride * k:stride * k + stride]
+                    w_ih, w_hh = chunk[0], chunk[1]
+                    b_ih, b_hh = (chunk[2], chunk[3]) if use_bias else (None, None)
+                    h0 = h0s[k]
+                    c0 = c0s[k] if c0s is not None else jnp.zeros_like(h0)
+                    outs, h_T, c_T = _scan_layer(mode, act, d == 1, layer_in, h0, c0,
+                                                 sl, w_ih, w_hh, b_ih, b_hh)
+                    outs_dir.append(outs)
+                    h_finals.append(h_T)
+                    c_finals.append(c_T)
+                layer_in = outs_dir[0] if D == 1 else jnp.concatenate(outs_dir, axis=-1)
+                if drop > 0 and layer < L - 1:
+                    # masks are sampled time-major (T, B, H*D), matching layer_in here
+                    keep = masks[layer].astype(layer_in.dtype)
+                    layer_in = layer_in * keep / jnp.asarray(1.0 - drop, layer_in.dtype)
+            y = layer_in if tm else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_finals, axis=0)
+            if mode == "LSTM":
+                return y, h_stack, jnp.stack(c_finals, axis=0)
+            return y, h_stack
+
+        nouts = 3 if mode == "LSTM" else 2
+        results = apply_op(f"rnn_{mode.lower()}", run, *tensors, nouts=nouts)
+        if mode == "LSTM":
+            y, h_T, c_T = results
+            return y, (h_T, c_T)
+        y, h_T = results
+        return y, h_T
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}, num_layers={self.num_layers}"
+                f", direction={self.direction}")
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, proj_size=0, **kwargs):
+        if proj_size:
+            raise NotImplementedError("proj_size != 0 is not supported yet")
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)

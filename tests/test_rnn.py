@@ -1,0 +1,167 @@
+"""RNN layers: parity vs torch with copied weights + grad smoke.
+
+Oracle pattern follows the reference OpTest idea (numpy/reference
+implementation comparison, test/legacy_test/op_test.py) with torch-cpu as
+the reference implementation for cuDNN-layout recurrences.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _copy_rnnbase_weights(pd_layer, th_layer):
+    sd = {}
+    for name, p in th_layer.named_parameters():
+        sd[name] = p.detach().numpy()
+    own = pd_layer.state_dict()
+    for name in own:
+        assert name in sd, f"missing torch param {name}"
+    pd_layer.set_state_dict(sd)
+
+
+@pytest.mark.parametrize("mode", ["RNN", "LSTM", "GRU"])
+@pytest.mark.parametrize("direction,num_layers", [("forward", 1), ("forward", 2), ("bidirect", 2)])
+def test_rnn_layer_parity_torch(mode, direction, num_layers):
+    paddle.seed(42)
+    B, T, I, H = 3, 7, 5, 6
+    bidir = direction == "bidirect"
+    if mode == "RNN":
+        pd = nn.SimpleRNN(I, H, num_layers=num_layers, direction=direction)
+        th = torch.nn.RNN(I, H, num_layers=num_layers, bidirectional=bidir, batch_first=True)
+    elif mode == "LSTM":
+        pd = nn.LSTM(I, H, num_layers=num_layers, direction=direction)
+        th = torch.nn.LSTM(I, H, num_layers=num_layers, bidirectional=bidir, batch_first=True)
+    else:
+        pd = nn.GRU(I, H, num_layers=num_layers, direction=direction)
+        th = torch.nn.GRU(I, H, num_layers=num_layers, bidirectional=bidir, batch_first=True)
+    _copy_rnnbase_weights(pd, th)
+
+    x = np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+    y_pd, st_pd = pd(paddle.to_tensor(x))
+    y_th, st_th = th(torch.tensor(x))
+
+    np.testing.assert_allclose(y_pd.numpy(), y_th.detach().numpy(), rtol=1e-5, atol=1e-5)
+    if mode == "LSTM":
+        np.testing.assert_allclose(st_pd[0].numpy(), st_th[0].detach().numpy(), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(st_pd[1].numpy(), st_th[1].detach().numpy(), rtol=1e-5, atol=1e-5)
+    else:
+        np.testing.assert_allclose(st_pd.numpy(), st_th.detach().numpy(), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("cell_cls,th_cls", [
+    (nn.SimpleRNNCell, torch.nn.RNNCell),
+    (nn.LSTMCell, torch.nn.LSTMCell),
+    (nn.GRUCell, torch.nn.GRUCell),
+])
+def test_cells_parity_torch(cell_cls, th_cls):
+    paddle.seed(1)
+    B, I, H = 4, 5, 6
+    pd = cell_cls(I, H)
+    th = th_cls(I, H)
+    sd = {n: p.detach().numpy() for n, p in th.named_parameters()}
+    pd.set_state_dict(sd)
+    x = np.random.RandomState(1).randn(B, I).astype(np.float32)
+    if cell_cls is nn.LSTMCell:
+        out, (h, c) = pd(paddle.to_tensor(x))
+        h_th, c_th = th(torch.tensor(x))
+        np.testing.assert_allclose(h.numpy(), h_th.detach().numpy(), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), c_th.detach().numpy(), rtol=1e-5, atol=1e-5)
+    else:
+        out, h = pd(paddle.to_tensor(x))
+        h_th = th(torch.tensor(x))
+        np.testing.assert_allclose(h.numpy(), h_th.detach().numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_sequence_length_masking():
+    paddle.seed(7)
+    B, T, I, H = 2, 6, 4, 5
+    lstm = nn.LSTM(I, H)
+    x = np.random.RandomState(3).randn(B, T, I).astype(np.float32)
+    seq_len = np.array([4, 6], np.int32)
+    y, (h, c) = lstm(paddle.to_tensor(x), sequence_length=paddle.to_tensor(seq_len))
+    # padded steps emit zeros
+    np.testing.assert_allclose(y.numpy()[0, 4:], 0.0, atol=0)
+    # final state for row 0 equals output at its last valid step
+    np.testing.assert_allclose(h.numpy()[0, 0], y.numpy()[0, 3], rtol=1e-6, atol=1e-6)
+    # full-length row matches the unmasked run
+    y_full, _ = lstm(paddle.to_tensor(x))
+    np.testing.assert_allclose(y.numpy()[1], y_full.numpy()[1], rtol=1e-6, atol=1e-6)
+
+
+def test_rnn_backward_grads():
+    paddle.seed(11)
+    B, T, I, H = 2, 5, 3, 4
+    gru = nn.GRU(I, H, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.RandomState(5).randn(B, T, I).astype(np.float32))
+    x.stop_gradient = False
+    y, h = gru(x)
+    loss = (y * y).mean() + (h * h).sum()
+    loss.backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+    for name, p in gru.named_parameters():
+        assert p.grad is not None and np.isfinite(p.grad.numpy()).all(), name
+
+
+def test_rnn_wrapper_and_birnn_match_fused():
+    paddle.seed(21)
+    B, T, I, H = 2, 5, 3, 4
+    cell = nn.LSTMCell(I, H)
+    wrapper = nn.RNN(cell)
+    fused = nn.LSTM(I, H)
+    fused.set_state_dict({
+        "weight_ih_l0": cell.weight_ih.numpy(), "weight_hh_l0": cell.weight_hh.numpy(),
+        "bias_ih_l0": cell.bias_ih.numpy(), "bias_hh_l0": cell.bias_hh.numpy(),
+    })
+    x = paddle.to_tensor(np.random.RandomState(9).randn(B, T, I).astype(np.float32))
+    y_w, (h_w, c_w) = wrapper(x)
+    y_f, (h_f, c_f) = fused(x)
+    np.testing.assert_allclose(y_w.numpy(), y_f.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(h_w.numpy(), h_f.numpy()[0], rtol=1e-5, atol=1e-5)
+
+    cell_bw = nn.LSTMCell(I, H)
+    bi = nn.BiRNN(cell, cell_bw)
+    y_bi, _ = bi(x)
+    assert y_bi.shape == [B, T, 2 * H]
+
+
+def test_rnnbase_no_bias():
+    paddle.seed(3)
+    B, T, I, H = 2, 4, 3, 5
+    gru = nn.GRU(I, H, bias_ih_attr=False, bias_hh_attr=False)
+    assert all("bias" not in n for n in gru.state_dict())
+    th = torch.nn.GRU(I, H, bias=False, batch_first=True)
+    _copy_rnnbase_weights(gru, th)
+    x = np.random.RandomState(2).randn(B, T, I).astype(np.float32)
+    y_pd, _ = gru(paddle.to_tensor(x))
+    y_th, _ = th(torch.tensor(x))
+    np.testing.assert_allclose(y_pd.numpy(), y_th.detach().numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_wrapper_sequence_length():
+    paddle.seed(13)
+    B, T, I, H = 2, 5, 3, 4
+    cell = nn.GRUCell(I, H)
+    wrapper = nn.RNN(cell)
+    x = paddle.to_tensor(np.random.RandomState(4).randn(B, T, I).astype(np.float32))
+    seq = paddle.to_tensor(np.array([3, 5], np.int32))
+    y, h = wrapper(x, sequence_length=seq)
+    np.testing.assert_allclose(y.numpy()[0, 3:], 0.0, atol=0)
+    np.testing.assert_allclose(h.numpy()[0], y.numpy()[0, 2], rtol=1e-6, atol=1e-6)
+
+
+def test_rnn_dropout_between_layers():
+    paddle.seed(17)
+    lstm = nn.LSTM(4, 6, num_layers=2, dropout=0.5)
+    x = paddle.to_tensor(np.random.RandomState(6).randn(3, 5, 4).astype(np.float32))
+    lstm.train()
+    y1, _ = lstm(x)
+    y2, _ = lstm(x)
+    assert not np.allclose(y1.numpy(), y2.numpy())  # fresh mask each call
+    lstm.eval()
+    y3, _ = lstm(x)
+    y4, _ = lstm(x)
+    np.testing.assert_allclose(y3.numpy(), y4.numpy())
