@@ -48,7 +48,10 @@ from .framework import io_utils as _io_utils
 from .framework.io_utils import load, save
 from .framework.random_utils import get_cuda_rng_state, set_cuda_rng_state
 
-disable_static = lambda *a, **k: None  # dygraph is the default and only eager mode
-enable_static = lambda *a, **k: None
+from . import static
+from .static import disable_static, enable_static
+from . import inference
+from . import sparse
+from . import incubate
 
 __version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""paddle.incubate equivalent — fused ops, MoE models, experimental API.
+
+Parity: python/paddle/incubate/ (nn.functional fused ops,
+distributed.models.moe, asp stubs).
+"""
+
+from . import nn
+from . import distributed
+
+__all__ = ["nn", "distributed"]

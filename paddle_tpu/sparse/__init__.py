@@ -1,0 +1,369 @@
+"""paddle.sparse equivalent — COO/CSR sparse tensors and ops.
+
+Parity: paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h, the
+sparse kernels in paddle/phi/kernels/sparse/ (~22k LoC), and the python
+surface python/paddle/sparse/. TPU design: the storage formats are
+jax.experimental.sparse BCOO/BCSR (batched-COO maps directly onto TPU
+gather/scatter; XLA fuses the unary value ops), so every op here is a pure
+jax function and sparse @ dense rides ``bcoo_dot_general`` which XLA lowers
+to MXU-friendly gathers + matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "matmul", "masked_matmul", "add", "subtract", "multiply", "divide",
+    "relu", "sqrt", "sin", "tanh", "abs", "pow", "neg", "cast", "transpose",
+    "coalesce", "is_same_shape", "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor handle (parity: phi::SparseCooTensor)."""
+
+    format = "coo"
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient: bool = True):
+        self._mat = bcoo
+        self.stop_gradient = stop_gradient
+
+    # -- paddle Tensor-protocol surface --
+    @property
+    def shape(self):
+        return tuple(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    @property
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def indices(self) -> Tensor:
+        # paddle layout: [sparse_dim, nnz]; BCOO stores [nnz, sparse_dim]
+        return Tensor(self._mat.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor(self._mat.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr requires a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._mat))
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.sum_duplicates())
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._mat.todense())
+
+    def astype(self, dtype) -> "SparseCooTensor":
+        return SparseCooTensor(jsparse.BCOO((self._mat.data.astype(dtype), self._mat.indices),
+                                            shape=self._mat.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    @property
+    def T(self):
+        return transpose(self, list(range(len(self.shape)))[::-1])
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor handle (parity: phi::SparseCsrTensor)."""
+
+    format = "csr"
+
+    def __init__(self, bcsr: jsparse.BCSR, stop_gradient: bool = True):
+        self._mat = bcsr
+        self.stop_gradient = stop_gradient
+
+    @property
+    def shape(self):
+        return tuple(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    @property
+    def nnz(self):
+        return int(self._mat.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._mat.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._mat.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._mat.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+        return SparseCooTensor(self._mat.to_bcoo())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._mat.todense())
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient: bool = True) -> SparseCooTensor:
+    """Build a COO tensor from [sparse_dim, nnz] indices (paddle layout)."""
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    val = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    idx = idx.T.astype(jnp.int32)  # -> [nnz, sparse_dim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=0)))
+        shape = shape + tuple(val.shape[1:])
+    mat = jsparse.BCOO((val, idx), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(mat, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int],
+                      dtype=None, place=None, stop_gradient: bool = True) -> SparseCsrTensor:
+    crows = crows._data if isinstance(crows, Tensor) else jnp.asarray(crows)
+    cols = cols._data if isinstance(cols, Tensor) else jnp.asarray(cols)
+    val = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    mat = jsparse.BCSR((val, cols.astype(jnp.int32), crows.astype(jnp.int32)),
+                       shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(mat, stop_gradient=stop_gradient)
+
+
+def _as_bcoo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._mat
+    if isinstance(x, SparseCsrTensor):
+        return x._mat.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _rewrap(x, mat: jsparse.BCOO):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(mat))
+    return SparseCooTensor(mat)
+
+
+# ---------------------------------------------------------------- ops
+
+def matmul(x, y):
+    """sparse @ dense (or dense @ sparse) with autograd through the dense
+    operand (parity: paddle.sparse.matmul; kernels
+    phi/kernels/sparse/gpu/matmul_kernel.cu)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and isinstance(y, Tensor):
+        mat = _as_bcoo(x)
+
+        def fn(d):
+            return mat @ d
+
+        return apply_op("sparse_matmul", fn, y)
+    if isinstance(x, Tensor) and isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        mat = _as_bcoo(y)
+
+        def fn(d):
+            return (mat.T @ d.T).T
+
+        return apply_op("sparse_matmul", fn, x)
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        out = _as_bcoo(x) @ _as_bcoo(y)
+        return SparseCooTensor(out if isinstance(out, jsparse.BCOO) else jsparse.BCOO.fromdense(out))
+    raise TypeError("matmul requires at least one sparse operand")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask):
+    """dense @ dense sampled at mask's sparsity (SDDMM; parity:
+    paddle.sparse.masked_matmul)."""
+    m = _as_bcoo(mask)
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+
+    def fn(a, b):
+        # gather the needed rows/cols and contract — avoids materializing a@b
+        va = a[rows]            # [nnz, K]
+        vb = b[:, cols].T       # [nnz, K]
+        return (va * vb).sum(-1)
+
+    vals = apply_op("sparse_masked_matmul", fn, x, y)
+    return SparseCooTensor(jsparse.BCOO((vals._data, m.indices), shape=m.shape))
+
+
+def _ewise_values(name, x, f):
+    mat = _as_bcoo(x) if not isinstance(x, SparseCsrTensor) else None
+    if isinstance(x, SparseCsrTensor):
+        m = x._mat
+        return SparseCsrTensor(jsparse.BCSR((f(m.data), m.indices, m.indptr), shape=m.shape))
+    return _rewrap(x, jsparse.BCOO((f(mat.data), mat.indices), shape=mat.shape))
+
+
+def relu(x):
+    return _ewise_values("sparse_relu", x, jax.nn.relu)
+
+
+def sqrt(x):
+    return _ewise_values("sparse_sqrt", x, jnp.sqrt)
+
+
+def sin(x):
+    return _ewise_values("sparse_sin", x, jnp.sin)
+
+
+def tanh(x):
+    return _ewise_values("sparse_tanh", x, jnp.tanh)
+
+
+def abs(x):
+    return _ewise_values("sparse_abs", x, jnp.abs)
+
+
+def neg(x):
+    return _ewise_values("sparse_neg", x, jnp.negative)
+
+
+def pow(x, factor):
+    return _ewise_values("sparse_pow", x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    mat = _as_bcoo(x)
+    data = mat.data if value_dtype is None else mat.data.astype(value_dtype)
+    idx = mat.indices if index_dtype is None else mat.indices.astype(index_dtype)
+    return _rewrap(x, jsparse.BCOO((data, idx), shape=mat.shape))
+
+
+def _binary(name, x, y, f):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        a, b = _as_bcoo(x), _as_bcoo(y)
+        out = f(a.todense(), b.todense())  # union of patterns; re-sparsify
+        return _rewrap(x, jsparse.BCOO.fromdense(out))
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return Tensor(f(_as_bcoo(x).todense(), y._data if isinstance(y, Tensor) else y))
+    return Tensor(f(x._data if isinstance(x, Tensor) else x, _as_bcoo(y).todense()))
+
+
+def add(x, y):
+    return _binary("sparse_add", x, y, jnp.add)
+
+
+def subtract(x, y):
+    return _binary("sparse_subtract", x, y, jnp.subtract)
+
+
+def multiply(x, y):
+    return _binary("sparse_multiply", x, y, jnp.multiply)
+
+
+def divide(x, y):
+    return _binary("sparse_divide", x, y, jnp.divide)
+
+
+def transpose(x, perm):
+    mat = _as_bcoo(x)
+    return _rewrap(x, mat.transpose(tuple(perm)))
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+# Tensor conversions (parity: Tensor.to_sparse_coo / to_sparse_csr methods)
+def _tensor_to_sparse_coo(self: Tensor, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+    n = sparse_dim if sparse_dim is not None else len(self.shape)
+    return SparseCooTensor(jsparse.BCOO.fromdense(self._data, n_dense=len(self.shape) - n))
+
+
+def _tensor_to_sparse_csr(self: Tensor) -> SparseCsrTensor:
+    return SparseCsrTensor(jsparse.BCSR.fromdense(self._data))
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+
+# ---------------------------------------------------------------- sparse.nn
+
+class nn:
+    """paddle.sparse.nn subset (ReLU + Linear over sparse inputs)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Linear:
+        def __init__(self, in_features, out_features, bias=True):
+            from .. import nn as dense_nn
+
+            self._lin = dense_nn.Linear(in_features, out_features, bias_attr=bias if bias is not True else None)
+
+        def __call__(self, x):
+            out = matmul(x, self._lin.weight)
+            if getattr(self._lin, "bias", None) is not None:
+                out = apply_op("sparse_linear_bias", jnp.add, out, self._lin.bias)
+            return out
+
+        @property
+        def weight(self):
+            return self._lin.weight

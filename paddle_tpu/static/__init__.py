@@ -1,0 +1,40 @@
+"""paddle.static equivalent — static-graph build/run API over XLA.
+
+Parity: python/paddle/static/ (Program/Executor/data/InputSpec/
+save_inference_model) and the executor stack beneath it
+(paddle/fluid/framework/new_executor/standalone_executor.cc:37).
+"""
+
+from . import nn_static as nn
+from .graph import (
+    Executor,
+    Program,
+    Variable,
+    append_backward,
+    create_global_var,
+    create_parameter,
+    data,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    global_scope,
+    gradients,
+    in_static_mode,
+    program_guard,
+    scope_guard,
+    static_minimize,
+)
+from .input_spec import InputSpec
+from .io import load_inference_model, save_inference_model
+
+# Paddle exposes these under paddle.static as well
+CompiledProgram = Program
+
+__all__ = [
+    "InputSpec", "Program", "CompiledProgram", "Executor", "Variable", "nn",
+    "data", "program_guard", "default_main_program", "default_startup_program",
+    "enable_static", "disable_static", "in_static_mode", "gradients",
+    "append_backward", "create_parameter", "create_global_var", "global_scope",
+    "scope_guard", "save_inference_model", "load_inference_model",
+]

@@ -1,0 +1,529 @@
+"""Static-graph mode: Program / Variable / Executor.
+
+Parity: the reference's static graph stack — Program/Block/OpDesc
+(paddle/fluid/framework/ framework.proto, python/paddle/static/),
+StandaloneExecutor + PirInterpreter (paddle/fluid/framework/new_executor/
+standalone_executor.cc:37, pir_interpreter.cc:1504).
+
+TPU design: the "graph" is a record of pure jax op closures captured at
+Python build time through the same ``apply_op`` dispatch the eager mode
+uses (the record hook below is the analogue of tracing into PIR instead of
+executing). ``Executor.run`` replays the recorded nodes as one pure
+function over the feed/parameter environment and hands the whole thing to
+``jax.jit`` — so the "interpreter" is XLA itself: one compiled executable
+per (program version, fetch set, feed shapes), which is exactly the
+whole-graph fast path the reference's interpreter approximates with
+instruction scheduling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor
+from ..ops import dispatch as _dispatch
+
+__all__ = [
+    "Variable", "Program", "Executor", "program_guard", "data",
+    "default_main_program", "default_startup_program", "enable_static",
+    "disable_static", "in_static_mode", "gradients", "append_backward",
+    "create_parameter", "create_global_var", "scope_guard", "global_scope",
+]
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (parity: python/paddle/base/framework.py
+    Variable). ``_data`` holds a ShapeDtypeStruct aval, never a value."""
+
+    __slots__ = ("_prog", "_vid", "_kind", "_declared_shape")
+
+    def __init__(self, aval, prog: "Program", kind: str, name: Optional[str] = None,
+                 stop_gradient: bool = True):
+        # bypass Tensor.__init__'s jnp.asarray: set fields directly
+        self._data = aval
+        self.stop_gradient = stop_gradient
+        self._grad_data = None
+        self._grad_node = None
+        self._out_slot = 0
+        Tensor._next_id[0] += 1
+        self.name = name or f"var_{Tensor._next_id[0]}"
+        self.persistable = False
+        self._hooks = []
+        self.placements = None
+        self.process_mesh = None
+        self._prog = prog
+        self._kind = kind  # 'feed' | 'op' | 'param'
+        self._declared_shape = None  # feed vars: user shape with None dims
+        self._vid = prog._new_vid(self)
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value in static-graph mode; "
+            "fetch it through Executor.run(fetch_list=[...]).")
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self.shape}, dtype={self.dtype}, kind={self._kind})"
+
+
+class _Node:
+    __slots__ = ("op", "fn", "inputs", "out_vids", "kind", "extra")
+
+    def __init__(self, op: str, fn, inputs: List[Tuple[str, Any]], out_vids: List[int],
+                 kind: str = "op", extra=None):
+        self.op = op
+        self.fn = fn
+        self.inputs = inputs          # list of ('var', vid) | ('const', jax array)
+        self.out_vids = out_vids
+        self.kind = kind              # 'op' | 'grad' | 'assign_param'
+        self.extra = extra
+
+
+class Program:
+    """An ordered record of op nodes (parity: ProgramDesc / pir::Program)."""
+
+    def __init__(self):
+        self._nodes: List[_Node] = []
+        self._vars: Dict[int, Variable] = {}
+        self._feeds: Dict[str, int] = {}          # feed name -> vid
+        self._params: Dict[int, Parameter] = {}   # vid -> eager storage
+        self._next = [0]
+        self._version = 0
+        self._cache: Dict[tuple, Any] = {}
+        self.random_seed = 0
+
+    def _new_vid(self, var: Variable) -> int:
+        vid = self._next[0]
+        self._next[0] += 1
+        self._vars[vid] = var
+        return vid
+
+    def _invalidate(self):
+        self._version += 1
+        self._cache.clear()
+
+    # -- introspection (parity: Program.list_vars / global_block) --
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def all_parameters(self):
+        return [self._vars[vid] for vid in self._params]
+
+    def block(self, i=0):
+        return self
+
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self._nodes
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        if for_test:
+            # prune backward + optimizer-update nodes (parity: clone(for_test=True)
+            # pruning the backward block)
+            p._nodes = [n for n in self._nodes
+                        if n.kind != "grad" and n.op != "optimizer_update"]
+        else:
+            p._nodes = list(self._nodes)
+            if "_writebacks" in self.__dict__:
+                p.__dict__["_writebacks"] = list(self.__dict__["_writebacks"])
+            if "_opt_states" in self.__dict__:
+                p.__dict__["_opt_states"] = self.__dict__["_opt_states"]  # shared state
+            if "_lr_refresh" in self.__dict__:
+                p.__dict__["_lr_refresh"] = list(self.__dict__["_lr_refresh"])
+        p._vars = dict(self._vars)
+        p._feeds = dict(self._feeds)
+        p._params = dict(self._params)
+        p._next = [self._next[0]]
+        return p
+
+    def __repr__(self):
+        return f"Program(nodes={len(self._nodes)}, feeds={list(self._feeds)}, params={len(self._params)})"
+
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "main"):
+        _tls.main = Program()
+        _tls.startup = Program()
+        _tls.static = False
+    return _tls
+
+
+def default_main_program() -> Program:
+    return _state().main
+
+
+def default_startup_program() -> Program:
+    return _state().startup
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self._main = main_program
+        self._startup = startup_program or Program()
+
+    def __enter__(self):
+        s = _state()
+        self._saved = (s.main, s.startup)
+        s.main, s.startup = self._main, self._startup
+        return self
+
+    def __exit__(self, *exc):
+        s = _state()
+        s.main, s.startup = self._saved
+        return False
+
+
+def in_static_mode() -> bool:
+    return _state().static
+
+
+def enable_static(*args, **kwargs):
+    _state().static = True
+    _dispatch._static_hook = _record_hook
+
+
+def disable_static(*args, **kwargs):
+    _state().static = False
+    _dispatch._static_hook = None
+
+
+# ---------------------------------------------------------------- recording
+
+def _aval_of(x) -> jax.ShapeDtypeStruct:
+    if isinstance(x, Variable):
+        return x._data
+    d = x._data if isinstance(x, Tensor) else x
+    return jax.ShapeDtypeStruct(tuple(d.shape), d.dtype)
+
+
+def _record_hook(name: str, fn, tensors: Sequence[Tensor], nouts=None):
+    """Installed as ops.dispatch._static_hook while static mode is on.
+    Returns NotImplemented for all-concrete inputs (constant folding — the
+    op just executes eagerly, like the reference executing CPU ops at
+    build time for shape computation)."""
+    if not any(isinstance(t, Variable) for t in tensors):
+        return NotImplemented
+    prog = None
+    for t in tensors:
+        if isinstance(t, Variable):
+            prog = t._prog
+            break
+    inputs: List[Tuple[str, Any]] = []
+    avals = []
+    for t in tensors:
+        if isinstance(t, Variable):
+            if t._prog is not prog:
+                raise ValueError("cannot mix Variables from different Programs in one op")
+            inputs.append(("var", t._vid))
+        else:
+            inputs.append(("const", t._data))
+        avals.append(_aval_of(t))
+    out_aval = jax.eval_shape(fn, *avals)
+    multi = isinstance(out_aval, (tuple, list))
+    out_avals = list(out_aval) if multi else [out_aval]
+    outs = [Variable(a, prog, "op") for a in out_avals]
+    prog._nodes.append(_Node(name, fn, inputs, [o._vid for o in outs]))
+    prog._invalidate()
+    return outs if multi else outs[0]
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32", lod_level=0) -> Variable:
+    """Declare a feed input (parity: paddle.static.data)."""
+    prog = default_main_program()
+    dt = dtypes.convert_dtype(dtype)
+    declared = tuple(None if (d is None or (isinstance(d, int) and d < 0)) else int(d) for d in shape)
+    concrete = tuple(1 if d is None else d for d in declared)
+    v = Variable(jax.ShapeDtypeStruct(concrete, dt), prog, "feed", name=name)
+    v._declared_shape = declared
+    prog._feeds[name] = v._vid
+    prog._invalidate()
+    return v
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None, is_bias=False,
+                     default_initializer=None) -> Variable:
+    """Declare a trainable parameter with eager storage (parity:
+    paddle.static.create_parameter; storage plays the Scope's role)."""
+    from ..nn import initializer as init_mod
+
+    prog = default_main_program()
+    dt = dtypes.convert_dtype(dtype)
+    if default_initializer is None:
+        default_initializer = (init_mod.Constant(0.0) if is_bias
+                               else init_mod.XavierNormal())
+    storage = Parameter(default_initializer(tuple(shape), dt), trainable=True, name=name)
+    v = Variable(jax.ShapeDtypeStruct(tuple(shape), dt), prog, "param",
+                 name=storage.name, stop_gradient=False)
+    prog._params[v._vid] = storage
+    prog._invalidate()
+    return v
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False, name=None) -> Variable:
+    prog = default_main_program()
+    dt = dtypes.convert_dtype(dtype)
+    storage = Parameter(jnp.full(tuple(shape), value, dt), trainable=False, name=name)
+    v = Variable(jax.ShapeDtypeStruct(tuple(shape), dt), prog, "param", name=storage.name)
+    prog._params[v._vid] = storage
+    prog._invalidate()
+    return v
+
+
+# ---------------------------------------------------------------- replay
+
+def _replay(nodes: List[_Node], env: Dict[int, Any], skip_vids=frozenset()):
+    """Evaluate recorded nodes over env (vid -> traced array)."""
+    for node in nodes:
+        if node.kind == "grad":
+            _replay_grad(node, env)
+            continue
+        args = [env[ref] if kind == "var" else ref for kind, ref in node.inputs]
+        out = node.fn(*args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        for vid, o in zip(node.out_vids, outs):
+            if vid not in skip_vids:
+                env[vid] = o
+
+
+def _replay_grad(node: _Node, env: Dict[int, Any]):
+    """grad node: d(targets)/d(inputs) by re-running the recorded prefix
+    under jax.vjp with the input vids as free variables."""
+    prefix, target_vids, input_vids = node.extra
+    base = dict(env)
+
+    def g(*in_vals):
+        e = dict(base)
+        for vid, val in zip(input_vids, in_vals):
+            e[vid] = val
+        _replay(prefix, e, skip_vids=frozenset(input_vids))
+        return tuple(e[t] for t in target_vids)
+
+    primals = tuple(env[v] for v in input_vids)
+    outs, vjp = jax.vjp(g, *primals)
+    cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+    grads = vjp(cots)
+    for vid, gval in zip(node.out_vids, grads):
+        env[vid] = gval
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None) -> List[Variable]:
+    """Static backward (parity: paddle.static.gradients /
+    paddle.base.backward.gradients). Appends one grad meta-node whose replay
+    runs jax.vjp over the captured forward prefix."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = targets[0]._prog
+    prefix = list(prog._nodes)
+    target_vids = [t._vid for t in targets]
+    input_vids = [i._vid for i in inputs]
+    outs = [Variable(i._data, prog, "op", name=f"{i.name}@GRAD") for i in inputs]
+    prog._nodes.append(_Node("gradients", None, [("var", v) for v in input_vids],
+                             [o._vid for o in outs], kind="grad",
+                             extra=(prefix, target_vids, input_vids)))
+    prog._invalidate()
+    return outs
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None) -> List[Tuple[Variable, Variable]]:
+    """Parity: paddle.static.append_backward — returns (param, grad) pairs."""
+    prog = loss._prog
+    if parameter_list is None:
+        params = [prog._vars[vid] for vid in prog._params
+                  if not prog._vars[vid].stop_gradient]
+    else:
+        params = list(parameter_list)
+    grads = gradients([loss], params)
+    return list(zip(params, grads))
+
+
+def static_minimize(optimizer, loss: Variable):
+    """Append grad + update nodes implementing optimizer.minimize for static
+    mode, using the optimizer's functional update rule. Parameter storage
+    (and accumulator state) is updated post-run by the Executor."""
+    from ..optimizer.functional import from_eager
+
+    prog = loss._prog
+    pairs = append_backward(loss)
+    if not pairs:
+        return None, []
+    fopt = from_eager(optimizer)
+    pvars = [p for p, _ in pairs]
+    gvars = [g for _, g in pairs]
+    storages = [prog._params[p._vid] for p in pvars]
+    key = f"@opt_state_{id(optimizer)}"
+    state_store = prog.__dict__.setdefault("_opt_states", {})
+    if key not in state_store:
+        state_store[key] = fopt.init({s.name: s._data for s in storages})
+
+    # lr enters the graph as a refreshed param input so LRScheduler.step()/
+    # set_lr() between runs take effect without retracing
+    lr_storage = Parameter(jnp.asarray(optimizer.get_lr(), jnp.float32),
+                           trainable=False, name=f"@lr_{id(optimizer)}")
+    lr_var = Variable(jax.ShapeDtypeStruct((), jnp.float32), prog, "param",
+                      name=lr_storage.name)
+    prog._params[lr_var._vid] = lr_storage
+    prog.__dict__.setdefault("_lr_refresh", []).append((lr_storage, optimizer))
+
+    def upd_fn(lr, *pg_vals):
+        n = len(pvars)
+        p_vals, g_vals = pg_vals[:n], pg_vals[n:]
+        params = {s.name: v for s, v in zip(storages, p_vals)}
+        grads = {s.name: v for s, v in zip(storages, g_vals)}
+        new_params, new_state = fopt.update(grads, state_store[key], params, lr)
+        flat_state = jax.tree.leaves(new_state)
+        return tuple(new_params[s.name] for s in storages) + tuple(flat_state)
+
+    n_state = len(jax.tree.leaves(state_store[key]))
+    out_avals = ([jax.ShapeDtypeStruct(p.shape, p.dtype) for p in pvars]
+                 + [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in jax.tree.leaves(state_store[key])])
+    outs = [Variable(a, prog, "op") for a in out_avals]
+    node = _Node("optimizer_update", upd_fn,
+                 [("var", lr_var._vid)] + [("var", p._vid) for p in pvars]
+                 + [("var", g._vid) for g in gvars],
+                 [o._vid for o in outs], kind="op",
+                 extra=None)
+    prog._nodes.append(node)
+    # remember write-back plan: (param storages, their out vids, state key, state out vids)
+    prog.__dict__.setdefault("_writebacks", []).append(
+        (storages, [o._vid for o in outs[:len(pvars)]], key,
+         [o._vid for o in outs[len(pvars):]], n_state))
+    prog._invalidate()
+    return None, pairs
+
+
+# ---------------------------------------------------------------- executor
+
+class _Scope:
+    def find_var(self, name):
+        return None
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Executor:
+    """Parity: paddle.static.Executor over StandaloneExecutor
+    (standalone_executor.cc:37). run() = jit-compiled whole-program replay."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True, **kwargs):
+        from ..jit.save_load import TranslatedLayer
+
+        if isinstance(program, TranslatedLayer):
+            feed = feed or {}
+            names = [s.name for s in program.input_specs]
+            out = program(*[feed[n] for n in names])
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            fetch_names = program._meta.get("fetch_names") or [f"fetch_{i}" for i in range(len(outs))]
+            by_name = dict(zip(fetch_names, outs))
+            if fetch_list:
+                wanted = [f if isinstance(f, str) else getattr(f, "name", f) for f in fetch_list]
+                outs = [by_name[w] for w in wanted]
+            return [np.asarray(o._data) if return_numpy else o for o in outs]
+
+        prog = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_vids = tuple(v._vid for v in fetch_list)
+        if not prog._nodes and not fetch_list:
+            return []  # startup program: params were initialized eagerly
+
+        for lr_storage, opt in prog.__dict__.get("_lr_refresh", []):
+            lr_storage._data = jnp.asarray(opt.get_lr(), jnp.float32)
+
+        writebacks = prog.__dict__.get("_writebacks", [])
+        opt_states = prog.__dict__.get("_opt_states", {})
+        feed_names = tuple(sorted(prog._feeds.keys() & feed.keys()))
+        param_vids = tuple(prog._params.keys())
+        wb_param_vids = tuple(vid for wb in writebacks for vid in wb[1])
+        wb_state_vids = tuple(vid for wb in writebacks for vid in wb[3])
+
+        ckey = (prog._version, fetch_vids, feed_names)
+        runner = prog._cache.get(ckey)
+        if runner is None:
+            nodes = prog._nodes
+
+            def run_fn(feed_vals, param_vals, state_leaves):
+                env: Dict[int, Any] = {}
+                for nm, v in zip(feed_names, feed_vals):
+                    env[prog._feeds[nm]] = v
+                for vid, v in zip(param_vids, param_vals):
+                    env[vid] = v
+                # rebind optimizer state snapshots for this step
+                it = iter(state_leaves)
+                for k in sorted(opt_states.keys()):
+                    treedef = jax.tree.structure(opt_states[k])
+                    opt_states[k] = jax.tree.unflatten(
+                        treedef, [next(it) for _ in range(treedef.num_leaves)])
+                _replay(nodes, env)
+                fetches = tuple(env[v] for v in fetch_vids)
+                wb_p = tuple(env[v] for v in wb_param_vids)
+                wb_s = tuple(env[v] for v in wb_state_vids)
+                return fetches, wb_p, wb_s
+
+            runner = jax.jit(run_fn)
+            prog._cache[ckey] = runner
+
+        feed_vals = tuple(jnp.asarray(feed[nm]) for nm in feed_names)
+        param_vals = tuple(prog._params[vid]._data for vid in param_vids)
+        state_leaves = tuple(l for k in sorted(opt_states.keys())
+                             for l in jax.tree.leaves(opt_states[k]))
+        fetches, wb_p, wb_s = runner(feed_vals, param_vals, state_leaves)
+
+        # write back updated params + optimizer state (the Scope mutation step)
+        i = 0
+        for storages, out_vids, skey, svids, n_state in writebacks:
+            for s, vid in zip(storages, out_vids):
+                s._data = wb_p[i]
+                i += 1
+        j = 0
+        for storages, out_vids, skey, svids, n_state in writebacks:
+            leaves = list(wb_s[j:j + n_state])
+            j += n_state
+            treedef = jax.tree.structure(opt_states[skey])
+            opt_states[skey] = jax.tree.unflatten(treedef, leaves)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def close(self):
+        pass

@@ -1,0 +1,88 @@
+"""InputSpec — declarative input signature for to_static / jit.save.
+
+Parity: python/paddle/static/input_spec.py (reference InputSpec). TPU design:
+an InputSpec maps 1:1 onto a jax.ShapeDtypeStruct; unknown dims (None / -1)
+become jax.export symbolic dimensions so saved programs stay
+shape-polymorphic the way the reference's ProgramDesc is.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _canon_dtype(dtype) -> jnp.dtype:
+    if dtype is None:
+        return jnp.dtype("float32")
+    if isinstance(dtype, str):
+        return jnp.dtype(dtype)
+    return jnp.dtype(dtype)
+
+
+class InputSpec:
+    """Describes the shape/dtype/name of one program input."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype: Any = "float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(None if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+                           for d in shape)
+        self.dtype = _canon_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name: Optional[str] = None) -> "InputSpec":
+        return cls(tuple(tensor.shape), str(np.dtype(tensor.dtype)) if not isinstance(tensor.dtype, jnp.dtype) else str(tensor.dtype), name or getattr(tensor, "name", None))
+
+    @classmethod
+    def from_numpy(cls, ndarray: np.ndarray, name: Optional[str] = None) -> "InputSpec":
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size: Optional[int] = None) -> "InputSpec":
+        return InputSpec((batch_size,) + self.shape, str(self.dtype), self.name)
+
+    def unbatch(self) -> "InputSpec":
+        if len(self.shape) == 0:
+            raise ValueError("Cannot unbatch a 0-d InputSpec.")
+        return InputSpec(self.shape[1:], str(self.dtype), self.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def __eq__(self, other):
+        return (isinstance(other, InputSpec) and self.shape == other.shape
+                and self.dtype == other.dtype and self.name == other.name)
+
+    def __hash__(self):
+        return hash((self.shape, str(self.dtype), self.name))
+
+    def to_dict(self) -> dict:
+        return {"shape": [d for d in self.shape], "dtype": str(self.dtype), "name": self.name}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InputSpec":
+        return cls(d["shape"], d["dtype"], d.get("name"))
+
+
+def avals_from_specs(specs: Sequence[InputSpec], scope=None):
+    """InputSpecs → jax ShapeDtypeStructs; None dims → symbolic dims (one
+    shared SymbolicScope so constraints relate across inputs)."""
+    from jax import export as jexport
+
+    if scope is None:
+        scope = jexport.SymbolicScope()
+    avals = []
+    for si, s in enumerate(specs):
+        dims = []
+        for di, d in enumerate(s.shape):
+            if d is None:
+                (sym,) = jexport.symbolic_shape(f"_s{si}_{di}", scope=scope)
+                dims.append(sym)
+            else:
+                dims.append(d)
+        avals.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+    return avals

@@ -1,0 +1,79 @@
+"""Static-graph inference model save/load.
+
+Parity: python/paddle/static/io.py (save_inference_model /
+load_inference_model). The artifact format is shared with
+``paddle_tpu.jit.save`` — a serialized StableHLO program + params — so one
+predictor (paddle_tpu.inference) serves both entry points, the way the
+reference serves ``.pdmodel``/``.pdiparams`` from both jit.save and
+static save_inference_model.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+import jax
+import numpy as np
+from jax import export as jexport
+
+from ..core.tensor import Tensor
+from .graph import Executor, Program, Variable, _replay, default_main_program
+from .input_spec import InputSpec, avals_from_specs
+
+_MODEL_SUFFIX = ".pdmodel"
+_PARAMS_SUFFIX = ".pdiparams"
+_META_SUFFIX = ".pdmeta"
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
+                         fetch_vars: Sequence[Variable], executor: Executor = None,
+                         program: Program = None, **kwargs) -> None:
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    prog = program or feed_vars[0]._prog
+    nodes = list(prog._nodes)
+    feed_vids = [v._vid for v in feed_vars]
+    fetch_vids = [v._vid for v in fetch_vars]
+    param_vids = list(prog._params.keys())
+    params = {prog._params[vid].name: np.asarray(prog._params[vid]._data) for vid in param_vids}
+    name_by_vid = {vid: prog._params[vid].name for vid in param_vids}
+
+    def runner(params, buffers, *feed_vals):
+        del buffers
+        env = {}
+        for vid, val in zip(feed_vids, feed_vals):
+            env[vid] = val
+        for vid in param_vids:
+            env[vid] = params[name_by_vid[vid]]
+        _replay(nodes, env)
+        return tuple(env[v] for v in fetch_vids)
+
+    specs = []
+    for v in feed_vars:
+        declared = v._declared_shape if v._declared_shape is not None else tuple(v.shape)
+        specs.append(InputSpec(list(declared), str(v.dtype), name=v.name))
+    avals = avals_from_specs(specs)
+    param_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in params.items()}
+    exported = jexport.export(jax.jit(runner))(param_sds, {}, *avals)
+
+    with open(path_prefix + _MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + _PARAMS_SUFFIX, "wb") as f:
+        np.savez(f, **{("p:" + k): v for k, v in params.items()})
+    with open(path_prefix + _META_SUFFIX, "w") as f:
+        json.dump({"input_specs": [s.to_dict() for s in specs],
+                   "params": sorted(params.keys()), "buffers": [],
+                   "fetch_names": [v.name for v in fetch_vars],
+                   "format": "paddle_tpu.static.v1"}, f)
+
+
+def load_inference_model(path_prefix: str, executor: Executor = None, **kwargs) -> List:
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; ``program`` is a TranslatedLayer the Executor can run."""
+    from ..jit.save_load import load as jit_load
+
+    layer = jit_load(path_prefix)
+    feed_names = [s.name for s in layer.input_specs]
+    fetch_names = layer._meta.get("fetch_names", [])
+    return [layer, feed_names, fetch_names]

@@ -1,0 +1,73 @@
+"""paddle.static.nn — layer builders for static-graph mode.
+
+Parity: python/paddle/static/nn/common.py (fc, conv2d, batch_norm, ...).
+Each builder declares parameters via ``create_parameter`` and emits ops
+through the normal functional API (which the static record hook captures).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .. import nn
+from ..nn import functional as F
+from .graph import create_parameter
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None, bias_attr=None,
+       activation: Optional[str] = None, name=None):
+    """Fully-connected layer (parity: paddle.static.nn.fc)."""
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    if tuple(x.shape[num_flatten_dims:]) != (in_dim,):
+        lead = list(x.shape[:num_flatten_dims])
+        x = x.reshape([-1 if d is None else int(d) for d in lead] + [in_dim])
+    w = create_parameter([in_dim, size], str(x.dtype), name=None)
+    out = x.matmul(w)
+    if bias_attr is not False:
+        b = create_parameter([size], str(x.dtype), is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0, dilation=1,
+           groups: int = 1, param_attr=None, bias_attr=None, act: Optional[str] = None,
+           data_format: str = "NCHW", name=None):
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+    cin = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    w = create_parameter([num_filters, cin // groups, ks[0], ks[1]], str(input.dtype))
+    out = F.conv2d(input, w, None, stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, data_format=data_format)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], str(input.dtype), is_bias=True)
+        shape = [1, num_filters, 1, 1] if data_format == "NCHW" else [1, 1, 1, num_filters]
+        out = out + b.reshape(shape)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum: float = 0.9, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, data_layout: str = "NCHW",
+               is_test: bool = False, name=None):
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    from ..nn import initializer as init_mod
+
+    scale = create_parameter([c], str(input.dtype), default_initializer=init_mod.Constant(1.0))
+    bias = create_parameter([c], str(input.dtype), is_bias=True)
+    mean = create_parameter([c], str(input.dtype), default_initializer=init_mod.Constant(0.0))
+    var = create_parameter([c], str(input.dtype), default_initializer=init_mod.Constant(1.0))
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias, training=False,
+                       momentum=momentum, epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size: Sequence[int], is_sparse: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    w = create_parameter(list(size), dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)

@@ -1,0 +1,297 @@
+"""jit.save/load, paddle.static graph mode, and the inference predictor.
+
+Mirrors reference test patterns: test/legacy_test/test_jit_save_load.py,
+test/legacy_test/test_inference_model_io.py, test/book/ static training.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture(autouse=True)
+def _dynamic_mode_guard():
+    yield
+    static.disable_static()
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestJitSaveLoad:
+    def test_save_load_layer_roundtrip(self, tmp_path):
+        paddle.seed(7)
+        net = SmallNet()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(3, 8).astype("float32"))
+        ref = net(x).numpy()
+
+        prefix = str(tmp_path / "model")
+        paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32", name="x")])
+        loaded = paddle.jit.load(prefix)
+        out = loaded(x)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_loaded_layer_polymorphic_batch(self, tmp_path):
+        paddle.seed(3)
+        net = SmallNet()
+        prefix = str(tmp_path / "poly")
+        paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32", name="x")])
+        loaded = paddle.jit.load(prefix)
+        for bs in (1, 5, 11):
+            x = paddle.to_tensor(np.random.randn(bs, 8).astype("float32"))
+            np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_save_function_with_spec(self, tmp_path):
+        @paddle.jit.to_static
+        def f(x):
+            return paddle.tanh(x) * 2.0
+
+        prefix = str(tmp_path / "fn")
+        paddle.jit.save(f, prefix, input_spec=[InputSpec([None, 4], "float32", name="x")])
+        loaded = paddle.jit.load(prefix)
+        x = np.random.randn(2, 4).astype("float32")
+        np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                                   np.tanh(x) * 2.0, rtol=1e-6, atol=1e-6)
+
+    def test_set_state_dict_swaps_params(self, tmp_path):
+        paddle.seed(11)
+        net = SmallNet()
+        prefix = str(tmp_path / "swap")
+        paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32", name="x")])
+        loaded = paddle.jit.load(prefix)
+        sd = {k: paddle.zeros_like(v) for k, v in loaded.state_dict().items()}
+        loaded.set_state_dict(sd)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+        np.testing.assert_allclose(loaded(x).numpy(), np.zeros((2, 4), "float32"), atol=1e-7)
+
+
+class TestStaticGraph:
+    def test_feed_fetch_forward(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 6], "float32")
+            y = paddle.tanh(x) + 1.0
+        exe = static.Executor()
+        arr = np.random.RandomState(0).randn(4, 6).astype("float32")
+        (out,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(out, np.tanh(arr) + 1.0, rtol=1e-5, atol=1e-6)
+
+    def test_static_nn_fc_and_gradients(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 5], "float32")
+            h = static.nn.fc(x, 7, activation="relu")
+            loss = h.sum()
+            params = [p for p in main.all_parameters() if not p.stop_gradient]
+            grads = static.gradients([loss], params)
+        exe = static.Executor()
+        arr = np.abs(np.random.RandomState(1).randn(3, 5)).astype("float32")
+        outs = exe.run(main, feed={"x": arr}, fetch_list=[loss] + grads)
+        assert np.isfinite(outs[0]).all()
+        assert all(np.isfinite(g).all() for g in outs[1:])
+        assert outs[1].shape == (5, 7)
+
+    def test_static_training_converges(self):
+        """Loss-descent oracle: static minimize() must train a linear fit
+        (pattern: reference test/book regression tests)."""
+        static.enable_static()
+        rng = np.random.RandomState(0)
+        Xd = rng.randn(64, 3).astype("float32")
+        true_w = np.array([[1.5], [-2.0], [0.5]], "float32")
+        Yd = Xd @ true_w + 0.3
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            ytrue = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = ((pred - ytrue) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            (lv,) = exe.run(main, feed={"x": Xd, "y": Yd}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.05, losses[::10]
+
+    def test_save_load_inference_model(self, tmp_path):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            out = static.nn.fc(x, 2)
+        exe = static.Executor()
+        arr = np.random.RandomState(2).randn(5, 4).astype("float32")
+        (ref,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+
+        prefix = str(tmp_path / "inf")
+        static.save_inference_model(prefix, [x], [out], exe)
+        static.disable_static()
+
+        prog, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+        assert feed_names == ["x"]
+        (got,) = exe.run(prog, feed={"x": arr}, fetch_list=fetch_names)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestInferencePredictor:
+    def test_predictor_end_to_end(self, tmp_path):
+        from paddle_tpu import inference
+
+        paddle.seed(5)
+        net = SmallNet()
+        prefix = str(tmp_path / "pred")
+        paddle.jit.save(net, prefix, input_spec=[InputSpec([None, 8], "float32", name="x")])
+
+        config = inference.Config(prefix)
+        predictor = inference.create_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+        arr = np.random.RandomState(4).randn(6, 8).astype("float32")
+        h = predictor.get_input_handle("x")
+        h.copy_from_cpu(arr)
+        predictor.run()
+        out_names = predictor.get_output_names()
+        got = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, net(paddle.to_tensor(arr)).numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_config_model_dir_form(self, tmp_path):
+        from paddle_tpu import inference
+
+        net = SmallNet()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32", name="x")])
+        config = inference.Config(str(tmp_path))
+        predictor = inference.create_predictor(config)
+        arr = np.zeros((2, 8), "float32")
+        outs = predictor.run([arr])
+        assert outs[0].shape == (2, 4)
+
+
+class TestStaticRegressions:
+    def test_lr_scheduler_affects_static_training(self):
+        """lr must be read at run time, not baked at build time."""
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = ((pred - y) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        Xd = np.random.RandomState(0).randn(8, 2).astype("float32")
+        Yd = np.ones((8, 1), "float32")
+        params = main.all_parameters()
+        storages = [main._params[p._vid] for p in params if not p.stop_gradient]
+        exe.run(main, feed={"x": Xd, "y": Yd}, fetch_list=[loss])
+        before = [np.asarray(s._data).copy() for s in storages]
+        opt.set_lr(0.0)  # must freeze training
+        exe.run(main, feed={"x": Xd, "y": Yd}, fetch_list=[loss])
+        after = [np.asarray(s._data) for s in storages]
+        for b, a in zip(before, after):
+            np.testing.assert_allclose(a, b, atol=0)
+
+    def test_clone_for_test_prunes_backward(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = ((pred - y) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        test_prog = main.clone(for_test=True)
+        assert all(n.kind != "grad" and n.op != "optimizer_update" for n in test_prog.ops)
+        exe = static.Executor()
+        Xd = np.zeros((2, 3), "float32")
+        storages = [main._params[p._vid] for p in main.all_parameters()]
+        before = [np.asarray(s._data).copy() for s in storages]
+        (lv,) = exe.run(test_prog, feed={"x": Xd, "y": np.zeros((2, 1), "float32")},
+                        fetch_list=[loss])
+        after = [np.asarray(s._data) for s in storages]
+        for b, a in zip(before, after):  # eval must not move params
+            np.testing.assert_allclose(a, b, atol=0)
+
+    def test_clone_training_program_still_trains(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            y = static.data("y", [None, 1], "float32")
+            loss = ((static.nn.fc(x, 1) - y) ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        cloned = main.clone()
+        exe = static.Executor()
+        Xd = np.random.RandomState(1).randn(16, 2).astype("float32")
+        Yd = (Xd @ np.array([[1.0], [2.0]], "float32"))
+        losses = [float(exe.run(cloned, feed={"x": Xd, "y": Yd}, fetch_list=[loss])[0])
+                  for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_save_inference_model_preserves_declared_dims(self, tmp_path):
+        """Fixed dims stay fixed; None dims stay polymorphic after save."""
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, None], "float32")
+            out = paddle.tanh(x)
+        exe = static.Executor()
+        prefix = str(tmp_path / "dyn")
+        static.save_inference_model(prefix, [x], [out], exe)
+        static.disable_static()
+        prog, feeds, fetches = static.load_inference_model(prefix, exe)
+        arr = np.random.randn(4, 6).astype("float32")
+        (got,) = exe.run(prog, feed={"x": arr}, fetch_list=fetches)
+        np.testing.assert_allclose(got, np.tanh(arr), rtol=1e-5, atol=1e-6)
+
+    def test_executor_fetch_subset_on_loaded_program(self, tmp_path):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            a = x + 1.0
+            b = x * 10.0
+        exe = static.Executor()
+        prefix = str(tmp_path / "two_out")
+        static.save_inference_model(prefix, [x], [a, b], exe)
+        static.disable_static()
+        prog, feeds, fetches = static.load_inference_model(prefix, exe)
+        arr = np.ones((2, 3), "float32")
+        (only_b,) = exe.run(prog, feed={"x": arr}, fetch_list=[fetches[1]])
+        np.testing.assert_allclose(only_b, arr * 10.0)
+
+    def test_disable_static_accepts_place_arg(self):
+        paddle.disable_static(paddle.CPUPlace())  # must not raise
+
+
+class TestSparseLinearGrad:
+    def test_sparse_linear_bias_grads_flow(self):
+        from paddle_tpu import sparse
+
+        rng = np.random.RandomState(0)
+        dense = np.zeros((4, 3), "float32")
+        dense[0, 1] = 1.0
+        dense[2, 0] = 2.0
+        sp = paddle.to_tensor(dense).to_sparse_coo(2)
+        lin = sparse.nn.Linear(3, 2)
+        out = lin(sp)
+        (out * out).sum().backward()
+        assert lin.weight.grad is not None
+        assert lin._lin.bias.grad is not None
+        assert np.abs(lin._lin.bias.grad.numpy()).sum() > 0

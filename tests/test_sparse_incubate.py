@@ -1,0 +1,151 @@
+"""paddle.sparse and paddle.incubate surfaces.
+
+Reference patterns: test/legacy_test/test_sparse_utils_op.py,
+test_sparse_matmul_op.py, test_fused_rotary_position_embedding.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def _rand_coo(rng, shape=(4, 5), nnz=6):
+    dense = np.zeros(shape, "float32")
+    idx = rng.choice(shape[0] * shape[1], nnz, replace=False)
+    dense.flat[idx] = rng.randn(nnz).astype("float32")
+    return dense
+
+
+class TestSparseCreation:
+    def test_coo_roundtrip(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        st = sparse.sparse_coo_tensor(indices, values, [3, 3])
+        assert st.is_sparse_coo() and st.nnz == 3
+        dense = np.zeros((3, 3), "float32")
+        dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+        np.testing.assert_allclose(np.sort(st.values().numpy()), [1, 2, 3])
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 1]
+        values = [1.0, 2.0, 3.0, 4.0, 5.0]
+        st = sparse.sparse_csr_tensor(crows, cols, values, [3, 4])
+        assert st.is_sparse_csr() and st.nnz == 5
+        dense = np.zeros((3, 4), "float32")
+        dense[0, 1], dense[0, 3], dense[1, 2], dense[2, 0], dense[2, 1] = values
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+
+    def test_dense_to_sparse_and_back(self):
+        rng = np.random.RandomState(0)
+        dense = _rand_coo(rng)
+        t = paddle.to_tensor(dense)
+        coo = t.to_sparse_coo(2)
+        np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+        csr = t.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        coo2 = csr.to_sparse_coo()
+        np.testing.assert_allclose(coo2.to_dense().numpy(), dense)
+
+
+class TestSparseOps:
+    def test_matmul_sparse_dense_and_grad(self):
+        rng = np.random.RandomState(1)
+        dense_a = _rand_coo(rng, (4, 5), 7)
+        sp = paddle.to_tensor(dense_a).to_sparse_coo(2)
+        bd = rng.randn(5, 3).astype("float32")
+        b = paddle.to_tensor(bd, stop_gradient=False)
+        out = sparse.matmul(sp, b)
+        np.testing.assert_allclose(out.numpy(), dense_a @ bd, rtol=1e-5, atol=1e-5)
+        out.sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(), np.tile(dense_a.sum(0)[:, None], (1, 3)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(4, 6).astype("float32")
+        b = rng.randn(6, 4).astype("float32")
+        mask_dense = _rand_coo(rng, (4, 4), 5)
+        mask = paddle.to_tensor(mask_dense).to_sparse_coo(2)
+        out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        full = a @ b
+        expect = np.where(mask_dense != 0, full, 0.0)
+        np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-4, atol=1e-4)
+
+    def test_unary_and_binary(self):
+        rng = np.random.RandomState(3)
+        dense = _rand_coo(rng)
+        sp = paddle.to_tensor(dense).to_sparse_coo(2)
+        np.testing.assert_allclose(sparse.relu(sp).to_dense().numpy(), np.maximum(dense, 0))
+        np.testing.assert_allclose(sparse.tanh(sp).to_dense().numpy(), np.tanh(dense), rtol=1e-6)
+        other = paddle.to_tensor(_rand_coo(rng)).to_sparse_coo(2)
+        got = sparse.add(sp, other).to_dense().numpy()
+        np.testing.assert_allclose(got, dense + other.to_dense().numpy(), rtol=1e-6)
+
+    def test_transpose(self):
+        rng = np.random.RandomState(4)
+        dense = _rand_coo(rng, (3, 5), 4)
+        sp = paddle.to_tensor(dense).to_sparse_coo(2)
+        np.testing.assert_allclose(sparse.transpose(sp, [1, 0]).to_dense().numpy(), dense.T)
+
+
+class TestIncubateFused:
+    def test_fused_rms_norm_matches_functional(self):
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(2, 6, 8).astype("float32"))
+        w = paddle.to_tensor(rng.rand(8).astype("float32"))
+        out = IF.fused_rms_norm(x, w, epsilon=1e-6)
+        ref = paddle.nn.functional.rms_norm(x, w, epsilon=1e-6)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_fused_rope_agrees_with_manual(self):
+        rng = np.random.RandomState(6)
+        B, S, H, D = 2, 8, 3, 16
+        q = paddle.to_tensor(rng.randn(B, S, H, D).astype("float32"))
+        k = paddle.to_tensor(rng.randn(B, S, H, D).astype("float32"))
+        qo, ko, _ = IF.fused_rotary_position_embedding(q, k, None, use_neox_rotary_style=True)
+        # manual neox rope
+        inv = 1.0 / (10000.0 ** (np.arange(0, D, 2, dtype="float32") / D))
+        freqs = np.outer(np.arange(S, dtype="float32"), inv)
+        c, s = np.cos(freqs)[None, :, None, :], np.sin(freqs)[None, :, None, :]
+        qn = q.numpy()
+        q1, q2 = qn[..., : D // 2], qn[..., D // 2:]
+        expect = np.concatenate([q1 * c - q2 * s, q2 * c + q1 * s], axis=-1)
+        np.testing.assert_allclose(qo.numpy(), expect, rtol=1e-5, atol=1e-5)
+        assert tuple(ko.shape) == (B, S, H, D)
+
+    def test_swiglu(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(4, 10).astype("float32")
+        out = IF.swiglu(paddle.to_tensor(x))
+        a, b = x[:, :5], x[:, 5:]
+        sil = a / (1 + np.exp(-a)) * b
+        np.testing.assert_allclose(out.numpy(), sil, rtol=1e-5, atol=1e-5)
+
+    def test_fused_mha_and_ffn_shapes(self):
+        rng = np.random.RandomState(8)
+        B, S, E, H = 2, 5, 16, 4
+        hd = E // H
+        x = paddle.to_tensor(rng.randn(B, S, E).astype("float32") * 0.1)
+        qkvw = paddle.to_tensor(rng.randn(3, H, hd, E).astype("float32") * 0.05)
+        lw = paddle.to_tensor(rng.randn(E, E).astype("float32") * 0.05)
+        ln_s = paddle.to_tensor(np.ones(E, "float32"))
+        ln_b = paddle.to_tensor(np.zeros(E, "float32"))
+        out = IF.fused_multi_head_attention(x, qkvw, lw, pre_layer_norm=True,
+                                            pre_ln_scale=ln_s, pre_ln_bias=ln_b)
+        assert tuple(out.shape) == (B, S, E)
+        w1 = paddle.to_tensor(rng.randn(E, 32).astype("float32") * 0.05)
+        w2 = paddle.to_tensor(rng.randn(32, E).astype("float32") * 0.05)
+        out2 = IF.fused_feedforward(out, w1, w2, ln1_scale=ln_s, ln1_bias=ln_b,
+                                    pre_layer_norm=True, activation="gelu")
+        assert tuple(out2.shape) == (B, S, E)
+        assert np.isfinite(out2.numpy()).all()
+
+    def test_incubate_moe_reexport(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer, NaiveGate
+
+        assert MoELayer is not None and NaiveGate is not None
