@@ -1,4 +1,5 @@
 from .auto_cast import amp_guard, auto_cast, decorate, white_list, black_list
 from .grad_scaler import AmpScaler, GradScaler
+from . import debugging
 
 __all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler", "amp_guard"]

@@ -1,0 +1,159 @@
+"""Numerical debugging toolkit.
+
+Parity: python/paddle/amp/debugging.py — TensorCheckerConfig:173,
+check_numerics:361, enable/disable_operator_stats_collection:481,
+collect_operator_stats, compare_accuracy (amp/accuracy_compare.py) — plus
+the FLAGS_check_nan_inf per-op checker (fluid/eager/nan_inf_utils.h:38),
+which on TPU hooks the same eager dispatch every op flows through.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+from enum import Enum
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.flags import get_flags, set_flags
+from ..core.tensor import Tensor
+from ..ops import dispatch as _dispatch
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+    "compare_accuracy",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """Parity: debugging.py:173. Configures the per-op NaN/Inf checker
+    (which ops, which dtypes, abort vs log)."""
+
+    def __init__(self, enable: bool, debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list: Optional[Sequence[str]] = None,
+                 skipped_op_list: Optional[Sequence[str]] = None, debug_step=None,
+                 stack_height_limit: int = 1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = list(checked_op_list or [])
+        self.skipped_op_list = list(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+    def _level(self) -> int:
+        # 0 = abort (raise), >=1 = log-only: matches FLAGS_check_nan_inf_level
+        return 0 if self.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    if checker_config.enable:
+        set_flags({"FLAGS_check_nan_inf": True,
+                   "FLAGS_check_nan_inf_level": checker_config._level()})
+    else:
+        disable_tensor_checker()
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count NaN/Inf in a tensor; abort or report per debug_mode (parity:
+    debugging.py:361 — returns (num_nan, num_inf, num_zero) Tensors)."""
+    d = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = jnp.isnan(d).sum()
+    num_inf = jnp.isinf(d).sum()
+    num_zero = (d == 0).sum()
+    if debug_mode in (DebugMode.CHECK_NAN_INF_AND_ABORT, DebugMode.CHECK_NAN_INF):
+        n_nan, n_inf = int(num_nan), int(num_inf)
+        if n_nan or n_inf:
+            msg = (f"[check_numerics] op={op_type} var={var_name}: "
+                   f"{n_nan} NaN, {n_inf} Inf detected")
+            if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(msg)
+            print(msg)
+    return Tensor(num_nan), Tensor(num_inf), Tensor(num_zero)
+
+
+def enable_operator_stats_collection():
+    """Start counting (op, output dtype) pairs flowing through dispatch."""
+    _dispatch._op_stats = {}
+
+
+def disable_operator_stats_collection():
+    """Stop collection and print the per-dtype op table (parity: the
+    reference's low-precision op-list summary)."""
+    stats = _dispatch._op_stats
+    _dispatch._op_stats = None
+    if stats is None:
+        return None
+    table = {}
+    for (op, dt), n in sorted(stats.items()):
+        table.setdefault(op, {})[dt] = n
+    print("<------------------------------ op list ------------------------------>")
+    header = ["op", "fp32", "fp16", "bf16", "other"]
+    print("  ".join(f"{h:<28}" if h == "op" else f"{h:>8}" for h in header))
+    for op, by_dt in table.items():
+        fp32 = by_dt.get("float32", 0)
+        fp16 = by_dt.get("float16", 0)
+        bf16 = by_dt.get("bfloat16", 0)
+        other = sum(v for k, v in by_dt.items() if k not in ("float32", "float16", "bfloat16"))
+        print(f"{op:<28}  {fp32:>8}  {fp16:>8}  {bf16:>8}  {other:>8}")
+    print("<----------------------------------------------------------------------->")
+    return table
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def _dump_stats(stats: dict, path: str):
+    with open(path, "w") as f:
+        json.dump({f"{op}|{dt}": n for (op, dt), n in stats.items()}, f)
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str, output_filename: str,
+                     loss_scale: float = 1.0, dump_all_tensors: bool = False):
+    """Diff two tensor-stat dumps (parity: amp/accuracy_compare.py — the
+    fp32-vs-fp16 run differ). Dumps here are JSON files mapping
+    'name' -> [mean, max, min] produced by dump_tensor_stats below."""
+    with open(dump_path) as f:
+        a = json.load(f)
+    with open(another_dump_path) as f:
+        b = json.load(f)
+    rows = []
+    for k in sorted(set(a) & set(b)):
+        va, vb = np.asarray(a[k], "float64"), np.asarray(b[k], "float64")
+        diff = np.abs(va - vb).max()
+        rows.append({"name": k, "run1": a[k], "run2": b[k], "max_abs_diff": float(diff)})
+    with open(output_filename, "w") as f:
+        json.dump(rows, f, indent=1)
+    return rows
+
+
+def dump_tensor_stats(named_tensors, path: str):
+    """Helper: dump {name: [mean, max, min]} for compare_accuracy."""
+    out = {}
+    for name, t in named_tensors.items():
+        d = np.asarray(t._data if isinstance(t, Tensor) else t, "float64")
+        out[name] = [float(d.mean()), float(d.max()), float(d.min())]
+    with open(path, "w") as f:
+        json.dump(out, f)

@@ -59,7 +59,13 @@ def get_flags(names) -> Dict[str, Any]:
     return out
 
 
+def _strip(name: str) -> str:
+    # accept the reference's spelled form: paddle.set_flags({'FLAGS_x': v})
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
 def _get(name: str) -> Any:
+    name = _strip(name)
     flag = _REGISTRY.get(name)
     if flag is None:
         raise KeyError(f"unknown flag: {name!r}")
@@ -74,6 +80,7 @@ def _get(name: str) -> Any:
 
 def set_flags(flags: Dict[str, Any]) -> None:
     for name, value in flags.items():
+        name = _strip(name)
         flag = _REGISTRY.get(name)
         if flag is None:
             raise KeyError(f"unknown flag: {name!r}")

@@ -1,0 +1,146 @@
+"""Activation recompute (gradient checkpointing).
+
+Parity: python/paddle/distributed/fleet/recompute/recompute.py
+(RecomputeFunction:124, recompute_sequential:622) and recompute_hybrid.py.
+
+TPU design — two paths, matching the reference's eager/static split:
+  * eager tape: forward runs WITHOUT tape recording (no residuals held by
+    XLA pullbacks); a single GradNode re-runs the function with the tape on
+    during backward, replaying the saved RNG state (the reference's
+    CUDA-RNG-state stash/replay, recompute.py:190).
+  * program mode (to_static / ShardedTrainStep): ``remat(fn)`` wraps the
+    block in ``jax.checkpoint`` so XLA rematerializes it — the
+    compiler-native form of the same trade.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ...core.autograd import (Edge, GradNode, backward as _run_backward, enable_grad,
+                              is_grad_enabled, no_grad)
+from ...core.tensor import Tensor
+from ...ops.random import get_rng_state, set_rng_state
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid", "remat"]
+
+
+def recompute(function: Callable, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run ``function`` without storing intermediate activations; recompute
+    them in backward. Gradients flow to both the tensor ``args`` and any
+    parameters ``function`` closes over (via the inner tape's leaf
+    accumulation), matching RecomputeFunction semantics."""
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_inputs = [args[i] for i in tensor_idx]
+    rng_state = get_rng_state() if preserve_rng_state else None
+
+    with no_grad():
+        outs = function(*args, **kwargs)
+    single = not isinstance(outs, (tuple, list))
+    outs_list = [outs] if single else list(outs)
+    out_specs = [(tuple(o._data.shape), o._data.dtype) for o in outs_list]
+
+    def vjp_fn(cots):
+        cot_list = [cots] if len(outs_list) == 1 else list(cots)
+        # re-forward with the tape ON and the original RNG stream
+        saved_state = get_rng_state() if preserve_rng_state else None
+        if preserve_rng_state:
+            set_rng_state(rng_state)
+        try:
+            detached = []
+            for a in tensor_inputs:
+                d = Tensor(a._data, stop_gradient=a.stop_gradient)
+                detached.append(d)
+            it = iter(detached)
+            re_args = [next(it) if i in tensor_idx else args[i] for i in range(len(args))]
+            with enable_grad():
+                re_outs = function(*re_args, **kwargs)
+            re_list = [re_outs] if not isinstance(re_outs, (tuple, list)) else list(re_outs)
+            live = [(o, c) for o, c in zip(re_list, cot_list)
+                    if isinstance(o, Tensor) and not o.stop_gradient and c is not None]
+            if live:
+                _run_backward([o for o, _ in live],
+                              [Tensor(c, stop_gradient=True) for _, c in live],
+                              retain_graph=False)
+        finally:
+            if preserve_rng_state:
+                set_rng_state(saved_state)
+        grads = []
+        for d in detached:
+            grads.append(None if d.grad is None else d.grad._data)
+        return tuple(grads)
+
+    edges = []
+    for t in tensor_inputs:
+        if t.stop_gradient:
+            edges.append(Edge())
+        elif t._grad_node is not None:
+            edges.append(Edge(node=t._grad_node, slot=t._out_slot))
+        else:
+            edges.append(Edge(leaf=t))
+    node = GradNode("recompute", vjp_fn, edges, out_specs)
+
+    from ...core import dtype as dtypes
+
+    for i, o in enumerate(outs_list):
+        if dtypes.is_floating_point(o._data.dtype):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_slot = i
+    return outs_list[0] if single else tuple(outs_list)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Segment an nn.Sequential into chunks and recompute each (parity:
+    recompute_sequential, recompute.py:622). ctx supports
+    {'segments': N, 'preserve_rng_state': bool}."""
+    segments = int(ctx.get("segments", 1)) if ctx else 1
+    preserve = bool(ctx.get("preserve_rng_state", True)) if ctx else True
+    layers = list(functions)
+    if segments <= 0:
+        segments = 1
+    n = len(layers)
+    per = max(1, n // segments)
+
+    def make_chunk(chunk):
+        def run(x):
+            for l in chunk:
+                x = l(x)
+            return x
+
+        return run
+
+    x = args[0]
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+        i += per
+        x = recompute(make_chunk(chunk), x, preserve_rng_state=preserve)
+    return x
+
+
+def recompute_hybrid(ctx: dict, function: Callable, *args, **kwargs):
+    """Hybrid-parallel recompute (parity: recompute_hybrid.py). On TPU the
+    mp/sharding-aware offload options collapse into the same remat; comm
+    inside ``function`` is compiled collectives and replays deterministically."""
+    preserve = bool(ctx.get("preserve_rng_state", True)) if ctx else True
+    return recompute(function, *args, preserve_rng_state=preserve, **kwargs)
+
+
+def remat(fn: Callable, policy: str = "nothing_saveable", prevent_cse: bool = True) -> Callable:
+    """Program-mode rematerialization: jax.checkpoint with a named policy.
+    Policies map to jax.checkpoint_policies (e.g. 'dots_saveable' keeps
+    matmul outputs — the flash-attention-style tradeoff)."""
+    policies = {
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "everything_saveable": jax.checkpoint_policies.everything_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    return jax.checkpoint(fn, policy=policies[policy], prevent_cse=prevent_cse)

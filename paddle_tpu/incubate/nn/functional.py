@@ -63,18 +63,24 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         freqs = jnp.outer(t, inv)
         return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
+    pos = None
+    if position_ids is not None:
+        pos = position_ids._data if isinstance(position_ids, Tensor) else jnp.asarray(position_ids)
+
     def rope_one(x, cos_t, sin_t):
-        # x: [B, S, H, D]
+        # x: [B, S, H, D]; per-batch positions when position_ids given
+        if pos is not None:
+            c = cos_t[pos][:, :, None, :]   # [B, S, 1, half]
+            s = sin_t[pos][:, :, None, :]
+        else:
+            c = cos_t[None, :, None, :]
+            s = sin_t[None, :, None, :]
         if use_neox_rotary_style:
             half = x.shape[-1] // 2
             x1, x2 = x[..., :half], x[..., half:]
-            c = cos_t[None, :, None, :]
-            s = sin_t[None, :, None, :]
             return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
         x1 = x[..., 0::2]
         x2 = x[..., 1::2]
-        c = cos_t[None, :, None, :]
-        s = sin_t[None, :, None, :]
         o1 = x1 * c - x2 * s
         o2 = x2 * c + x1 * s
         return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
@@ -85,15 +91,15 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             outs.append(None)
             continue
         seqlen, dim = t.shape[1], t.shape[3]
+        table_len = seqlen if pos is None else int(pos.max()) + 1
         if cos is not None and sin is not None:
-            cos_t = (cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)).reshape(seqlen, -1)
-            sin_t = (sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)).reshape(seqlen, -1)
-            # tables may arrive duplicated to full dim; keep first dim//2 cols
-            cos_t = cos_t[:, : dim // 2]
-            sin_t = sin_t[:, : dim // 2]
-            ct, st = cos_t, sin_t
+            ca = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
+            sa = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
+            # tables arrive as [*, half] or duplicated to full dim; keep half
+            ct = ca.reshape(-1, ca.shape[-1])[:, : dim // 2]
+            st = sa.reshape(-1, sa.shape[-1])[:, : dim // 2]
         else:
-            ct, st = make_tables(seqlen, dim, t._data.dtype)
+            ct, st = make_tables(table_len, dim, t._data.dtype)
         outs.append(apply_op("fused_rope", lambda x, c=ct, s=st: rope_one(x, c, s), t))
     return tuple(outs)
 

@@ -40,7 +40,7 @@ def _specs_from_args(args) -> list:
         if isinstance(a, InputSpec):
             specs.append(a)
         elif isinstance(a, Tensor):
-            specs.append(InputSpec(tuple(a.shape), str(np.dtype(a.numpy().dtype))))
+            specs.append(InputSpec(tuple(a.shape), str(np.dtype(a._data.dtype))))
         elif isinstance(a, (np.ndarray, jax.Array)):
             specs.append(InputSpec(tuple(a.shape), str(a.dtype)))
         else:

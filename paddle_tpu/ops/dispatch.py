@@ -38,6 +38,11 @@ _amp_cast_hook: Optional[Callable] = None
 # into the current Program instead of executing (graph capture).
 _static_hook: Optional[Callable] = None
 
+# Set by paddle_tpu.amp.debugging while operator-stats collection is on:
+# dict[(op_name, dtype_str)] -> count (parity: FLAGS low-precision op list,
+# python/paddle/amp/debugging.py enable_operator_stats_collection).
+_op_stats: Optional[dict] = None
+
 # Op registry for introspection/testing (parity: phi/ops/yaml/ops.yaml registry role).
 OP_REGISTRY: dict = {}
 
@@ -129,6 +134,11 @@ def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[in
 
     multi = isinstance(out_data, (tuple, list))
     outs_data = list(out_data) if multi else [out_data]
+
+    if _op_stats is not None:
+        for d in outs_data:
+            k = (name, str(np.dtype(d.dtype)))
+            _op_stats[k] = _op_stats.get(k, 0) + 1
 
     if flag("check_nan_inf"):
         _check_finite(name, outs_data)

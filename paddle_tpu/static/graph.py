@@ -291,7 +291,8 @@ def create_global_var(shape, value, dtype="float32", persistable=False, name=Non
 
 # ---------------------------------------------------------------- replay
 
-def _replay(nodes: List[_Node], env: Dict[int, Any], skip_vids=frozenset()):
+def _replay(nodes: List[_Node], env: Dict[int, Any], skip_vids=frozenset(),
+            stop_grad_vids=frozenset()):
     """Evaluate recorded nodes over env (vid -> traced array)."""
     for node in nodes:
         if node.kind == "grad":
@@ -302,25 +303,29 @@ def _replay(nodes: List[_Node], env: Dict[int, Any], skip_vids=frozenset()):
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
         for vid, o in zip(node.out_vids, outs):
             if vid not in skip_vids:
-                env[vid] = o
+                env[vid] = jax.lax.stop_gradient(o) if vid in stop_grad_vids else o
 
 
 def _replay_grad(node: _Node, env: Dict[int, Any]):
     """grad node: d(targets)/d(inputs) by re-running the recorded prefix
     under jax.vjp with the input vids as free variables."""
-    prefix, target_vids, input_vids = node.extra
+    prefix, target_vids, input_vids, cot_vids, no_grad_vids = node.extra
     base = dict(env)
+    ng = frozenset(no_grad_vids)
 
     def g(*in_vals):
         e = dict(base)
         for vid, val in zip(input_vids, in_vals):
             e[vid] = val
-        _replay(prefix, e, skip_vids=frozenset(input_vids))
+        _replay(prefix, e, skip_vids=frozenset(input_vids), stop_grad_vids=ng)
         return tuple(e[t] for t in target_vids)
 
     primals = tuple(env[v] for v in input_vids)
     outs, vjp = jax.vjp(g, *primals)
-    cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+    if cot_vids:
+        cots = tuple(env[v] for v in cot_vids)
+    else:
+        cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
     grads = vjp(cots)
     for vid, gval in zip(node.out_vids, grads):
         env[vid] = gval
@@ -336,10 +341,27 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None) -> List[
     prefix = list(prog._nodes)
     target_vids = [t._vid for t in targets]
     input_vids = [i._vid for i in inputs]
+    if target_gradients is not None:
+        tgs = target_gradients if isinstance(target_gradients, (list, tuple)) else [target_gradients]
+        if len(tgs) != len(targets):
+            raise ValueError("target_gradients must match targets in length")
+        cot_vids = []
+        for tg, t in zip(tgs, targets):
+            if isinstance(tg, Variable):
+                cot_vids.append(tg._vid)
+            else:  # concrete Tensor/array cotangent: intern as a constant var
+                arr = tg._data if isinstance(tg, Tensor) else jnp.asarray(tg)
+                cv = Variable(jax.ShapeDtypeStruct(arr.shape, arr.dtype), prog, "param")
+                prog._params[cv._vid] = Parameter(arr, trainable=False, name=cv.name)
+                cot_vids.append(cv._vid)
+    else:
+        cot_vids = []
+    no_grad_vids = [v._vid for v in (no_grad_set or [])]
     outs = [Variable(i._data, prog, "op", name=f"{i.name}@GRAD") for i in inputs]
-    prog._nodes.append(_Node("gradients", None, [("var", v) for v in input_vids],
+    prog._nodes.append(_Node("gradients", None,
+                             [("var", v) for v in input_vids + cot_vids],
                              [o._vid for o in outs], kind="grad",
-                             extra=(prefix, target_vids, input_vids)))
+                             extra=(prefix, target_vids, input_vids, cot_vids, no_grad_vids)))
     prog._invalidate()
     return outs
 

