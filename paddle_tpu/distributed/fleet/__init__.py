@@ -9,14 +9,8 @@ parallel training engine.
 from .base import DistributedStrategy, Fleet, fleet
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from . import mp_layers as meta_parallel
-from . import recompute as recompute_mod
 from .recompute import recompute, recompute_hybrid, recompute_sequential, remat
-
-
-class utils:
-    """fleet.utils namespace (parity: paddle.distributed.fleet.utils.recompute)."""
-
-    recompute = staticmethod(recompute)
+from . import utils
 
 init = fleet.init
 distributed_model = fleet.distributed_model

@@ -44,10 +44,14 @@ def recompute(function: Callable, *args, use_reentrant: bool = True,
         outs = function(*args, **kwargs)
     single = not isinstance(outs, (tuple, list))
     outs_list = [outs] if single else list(outs)
-    out_specs = [(tuple(o._data.shape), o._data.dtype) for o in outs_list]
+    # mixed tensor/non-tensor outputs (e.g. (hidden, cache=None)) are allowed;
+    # only Tensor slots join the grad node
+    t_out_idx = [i for i, o in enumerate(outs_list) if isinstance(o, Tensor)]
+    t_outs = [outs_list[i] for i in t_out_idx]
+    out_specs = [(tuple(o._data.shape), o._data.dtype) for o in t_outs]
 
     def vjp_fn(cots):
-        cot_list = [cots] if len(outs_list) == 1 else list(cots)
+        cot_list = [cots] if len(t_outs) == 1 else list(cots)
         # re-forward with the tape ON and the original RNG stream
         saved_state = get_rng_state() if preserve_rng_state else None
         if preserve_rng_state:
@@ -62,7 +66,8 @@ def recompute(function: Callable, *args, use_reentrant: bool = True,
             with enable_grad():
                 re_outs = function(*re_args, **kwargs)
             re_list = [re_outs] if not isinstance(re_outs, (tuple, list)) else list(re_outs)
-            live = [(o, c) for o, c in zip(re_list, cot_list)
+            re_tensors = [re_list[i] for i in t_out_idx]
+            live = [(o, c) for o, c in zip(re_tensors, cot_list)
                     if isinstance(o, Tensor) and not o.stop_gradient and c is not None]
             if live:
                 _run_backward([o for o, _ in live],
@@ -88,11 +93,11 @@ def recompute(function: Callable, *args, use_reentrant: bool = True,
 
     from ...core import dtype as dtypes
 
-    for i, o in enumerate(outs_list):
+    for slot, o in enumerate(t_outs):
         if dtypes.is_floating_point(o._data.dtype):
             o.stop_gradient = False
             o._grad_node = node
-            o._out_slot = i
+            o._out_slot = slot
     return outs_list[0] if single else tuple(outs_list)
 
 
@@ -117,11 +122,25 @@ def recompute_sequential(ctx: dict, functions, *args, **kwargs):
         return run
 
     x = args[0]
+    rest, kw = args[1:], kwargs
     i = 0
+    first = True
     while i < n:
         chunk = layers[i:i + per]
         i += per
-        x = recompute(make_chunk(chunk), x, preserve_rng_state=preserve)
+        if first and (rest or kw):
+            # extra args reach the first layer of the first segment only
+            # (matching the reference's *args threading)
+            def run_first(x0, *extra, _chunk=chunk, **k):
+                h = _chunk[0](x0, *extra, **k)
+                for l in _chunk[1:]:
+                    h = l(h)
+                return h
+
+            x = recompute(run_first, x, *rest, preserve_rng_state=preserve, **kw)
+        else:
+            x = recompute(make_chunk(chunk), x, preserve_rng_state=preserve)
+        first = False
     return x
 
 

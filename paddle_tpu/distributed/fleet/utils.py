@@ -1,0 +1,6 @@
+"""fleet.utils submodule (parity: python/paddle/distributed/fleet/utils/ —
+recompute re-export plus hybrid-parallel helper surface)."""
+
+from .recompute import recompute, recompute_hybrid, recompute_sequential
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]

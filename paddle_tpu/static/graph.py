@@ -323,7 +323,8 @@ def _replay_grad(node: _Node, env: Dict[int, Any]):
     primals = tuple(env[v] for v in input_vids)
     outs, vjp = jax.vjp(g, *primals)
     if cot_vids:
-        cots = tuple(env[v] for v in cot_vids)
+        cots = tuple(jnp.ones(o.shape, o.dtype) if v is None else env[v]
+                     for v, o in zip(cot_vids, outs))
     else:
         cots = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
     grads = vjp(cots)
@@ -347,7 +348,9 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None) -> List[
             raise ValueError("target_gradients must match targets in length")
         cot_vids = []
         for tg, t in zip(tgs, targets):
-            if isinstance(tg, Variable):
+            if tg is None:  # None -> default ones cotangent for that target
+                cot_vids.append(None)
+            elif isinstance(tg, Variable):
                 cot_vids.append(tg._vid)
             else:  # concrete Tensor/array cotangent: intern as a constant var
                 arr = tg._data if isinstance(tg, Tensor) else jnp.asarray(tg)
@@ -359,7 +362,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None) -> List[
     no_grad_vids = [v._vid for v in (no_grad_set or [])]
     outs = [Variable(i._data, prog, "op", name=f"{i.name}@GRAD") for i in inputs]
     prog._nodes.append(_Node("gradients", None,
-                             [("var", v) for v in input_vids + cot_vids],
+                             [("var", v) for v in input_vids + [c for c in cot_vids if c is not None]],
                              [o._vid for o in outs], kind="grad",
                              extra=(prefix, target_vids, input_vids, cot_vids, no_grad_vids)))
     prog._invalidate()

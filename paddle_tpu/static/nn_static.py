@@ -69,7 +69,11 @@ def batch_norm(input, act=None, momentum: float = 0.9, epsilon: float = 1e-5,
     bias = create_parameter([c], str(input.dtype), is_bias=True)
     mean = create_global_var([c], 0.0, str(input.dtype))
     var = create_global_var([c], 1.0, str(input.dtype))
-    bshape = [1, c, 1, 1] if data_layout == "NCHW" else [1] * (len(input.shape) - 1) + [c]
+    ndim = len(input.shape)
+    if data_layout == "NCHW":
+        bshape = [1, c] + [1] * (ndim - 2)
+    else:
+        bshape = [1] * (ndim - 1) + [c]
     inv = (var.reshape(bshape) + epsilon).rsqrt()
     out = (input - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
     if act:

@@ -1,0 +1,150 @@
+"""InceptionV3 (parity: python/paddle/vision/models/inceptionv3.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride, padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = _ConvBNAct(cin, 64, 1)
+        self.branch5x5 = nn.Sequential(_ConvBNAct(cin, 48, 1), _ConvBNAct(48, 64, 5, padding=2))
+        self.branch3x3dbl = nn.Sequential(_ConvBNAct(cin, 64, 1), _ConvBNAct(64, 96, 3, padding=1),
+                                          _ConvBNAct(96, 96, 3, padding=1))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.branch_pool = _ConvBNAct(cin, pool_features, 1)
+
+    def forward(self, x):
+        return concat([self.branch1x1(x), self.branch5x5(x), self.branch3x3dbl(x),
+                       self.branch_pool(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = _ConvBNAct(cin, 384, 3, stride=2)
+        self.branch3x3dbl = nn.Sequential(_ConvBNAct(cin, 64, 1), _ConvBNAct(64, 96, 3, padding=1),
+                                          _ConvBNAct(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.branch3x3(x), self.branch3x3dbl(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.branch1x1 = _ConvBNAct(cin, 192, 1)
+        self.branch7x7 = nn.Sequential(
+            _ConvBNAct(cin, c7, 1),
+            _ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNAct(c7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.branch7x7dbl = nn.Sequential(
+            _ConvBNAct(cin, c7, 1),
+            _ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNAct(c7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.branch_pool = _ConvBNAct(cin, 192, 1)
+
+    def forward(self, x):
+        return concat([self.branch1x1(x), self.branch7x7(x), self.branch7x7dbl(x),
+                       self.branch_pool(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = nn.Sequential(_ConvBNAct(cin, 192, 1), _ConvBNAct(192, 320, 3, stride=2))
+        self.branch7x7x3 = nn.Sequential(
+            _ConvBNAct(cin, 192, 1),
+            _ConvBNAct(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBNAct(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBNAct(192, 192, 3, stride=2),
+        )
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.branch3x3(x), self.branch7x7x3(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch1x1 = _ConvBNAct(cin, 320, 1)
+        self.branch3x3_1 = _ConvBNAct(cin, 384, 1)
+        self.branch3x3_2a = _ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = _ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = nn.Sequential(_ConvBNAct(cin, 448, 1),
+                                            _ConvBNAct(448, 384, 3, padding=1))
+        self.branch3x3dbl_2a = _ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_2b = _ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.branch_pool = _ConvBNAct(cin, 192, 1)
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = concat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], axis=1)
+        bd = self.branch3x3dbl_1(x)
+        bd = concat([self.branch3x3dbl_2a(bd), self.branch3x3dbl_2b(bd)], axis=1)
+        return concat([self.branch1x1(x), b3, bd, self.branch_pool(self.pool(x))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 32, 3, stride=2),
+            _ConvBNAct(32, 32, 3),
+            _ConvBNAct(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBNAct(64, 80, 1),
+            _ConvBNAct(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160), _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return InceptionV3(**kwargs)

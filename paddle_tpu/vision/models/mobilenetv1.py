@@ -1,0 +1,69 @@
+"""MobileNetV1 (parity: python/paddle/vision/models/mobilenetv1.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import flatten
+
+
+class _ConvBNRelu(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, cin, cmid, cout, stride, scale):
+        super().__init__()
+        cin, cmid, cout = int(cin * scale), int(cmid * scale), int(cout * scale)
+        self.dw = _ConvBNRelu(cin, cmid, 3, stride=stride, padding=1, groups=cmid)
+        self.pw = _ConvBNRelu(cmid, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = _ConvBNRelu(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1), (128, 128, 256, 2),
+            (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 1024, 2), (1024, 1024, 1024, 1),
+        ]
+        self.blocks = nn.Sequential(*[
+            _DepthwiseSeparable(cin, cmid, cout, stride, scale)
+            for cin, cmid, cout, stride in cfg
+        ])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return MobileNetV1(scale=scale, **kwargs)

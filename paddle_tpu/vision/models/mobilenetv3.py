@@ -1,0 +1,139 @@
+"""MobileNetV3 small/large (parity: python/paddle/vision/models/mobilenetv3.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channels // reduction)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(channels, mid, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(mid, channels, 1)
+        self.hsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsigmoid(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, groups=1, act=None):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=(kernel - 1) // 2, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = act() if act is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, cin, cmid, cout, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if cmid != cin:
+            layers.append(_ConvBNAct(cin, cmid, 1, act=act))
+        layers.append(_ConvBNAct(cmid, cmid, kernel, stride=stride, groups=cmid, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(cmid))
+        layers.append(_ConvBNAct(cmid, cout, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, act, stride); act: 'RE' relu / 'HS' hardswish
+_LARGE = [
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2), (3, 72, 24, False, "RE", 1),
+    (5, 72, 40, True, "RE", 2), (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1), (5, 960, 160, True, "HS", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2), (3, 88, 24, False, "RE", 1),
+    (5, 96, 40, True, "HS", 2), (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1), (5, 288, 96, True, "HS", 2),
+    (5, 576, 96, True, "HS", 1), (5, 576, 96, True, "HS", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        cin = _make_divisible(16 * scale)
+        self.conv1 = _ConvBNAct(3, cin, 3, stride=2, act=nn.Hardswish)
+        blocks = []
+        for kernel, exp, cout, use_se, act_name, stride in config:
+            act = nn.ReLU if act_name == "RE" else nn.Hardswish
+            cmid = _make_divisible(exp * scale)
+            cout = _make_divisible(cout * scale)
+            blocks.append(_InvertedResidual(cin, cmid, cout, kernel, stride, use_se, act))
+            cin = cout
+        self.blocks = nn.Sequential(*blocks)
+        clast = _make_divisible(config[-1][1] * scale)
+        self.conv2 = _ConvBNAct(cin, clast, 1, act=nn.Hardswish)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(clast, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.conv2(self.blocks(self.conv1(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, last_channel=1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, last_channel=1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return MobileNetV3Small(scale=scale, **kwargs)

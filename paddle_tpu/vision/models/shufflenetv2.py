@@ -1,0 +1,148 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten, reshape, transpose
+
+
+def channel_shuffle(x, groups: int):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _act_layer(name):
+    return {"relu": nn.ReLU, "swish": nn.Swish}[name]
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, groups=1, act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=(kernel - 1) // 2, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = _act_layer(act)() if act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _InvertedResidual(nn.Layer):
+    """Stride-1 unit: split channels, transform one branch, shuffle."""
+
+    def __init__(self, channels, act="relu"):
+        super().__init__()
+        c = channels // 2
+        self.branch = nn.Sequential(
+            _ConvBNAct(c, c, 1, act=act),
+            _ConvBNAct(c, c, 3, groups=c, act=None),
+            _ConvBNAct(c, c, 1, act=act),
+        )
+
+    def forward(self, x):
+        c = x.shape[1] // 2
+        x1 = x[:, :c]
+        x2 = x[:, c:]
+        out = concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class _InvertedResidualDS(nn.Layer):
+    """Stride-2 unit: both branches downsample; channels double."""
+
+    def __init__(self, cin, cout, act="relu"):
+        super().__init__()
+        c = cout // 2
+        self.branch1 = nn.Sequential(
+            _ConvBNAct(cin, cin, 3, stride=2, groups=cin, act=None),
+            _ConvBNAct(cin, c, 1, act=act),
+        )
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(cin, c, 1, act=act),
+            _ConvBNAct(c, c, 3, stride=2, groups=c, act=None),
+            _ConvBNAct(c, c, 1, act=act),
+        )
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+_STAGE_REPEATS = [4, 8, 4]
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        outs = _STAGE_OUT[scale]
+
+        self.conv1 = _ConvBNAct(3, outs[0], 3, stride=2, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        cin = outs[0]
+        for i, reps in enumerate(_STAGE_REPEATS):
+            cout = outs[i + 1]
+            stages.append(_InvertedResidualDS(cin, cout, act=act))
+            for _ in range(reps - 1):
+                stages.append(_InvertedResidual(cout, act=act))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(cin, outs[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, act="swish", **kwargs)

@@ -1,0 +1,78 @@
+"""SqueezeNet (parity: python/paddle/vision/models/squeezenet.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, expand1x1, expand3x3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.expand1x1 = nn.Conv2D(squeeze, expand1x1, 1)
+        self.expand3x3 = nn.Conv2D(squeeze, expand3x3, 3, padding=1)
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1x1(s)), self.relu(self.expand3x3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.version = version
+
+        if version == "1.0":
+            self.conv1 = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64), _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            ]
+            self._pool_after = {2, 6}  # 1.0 layout: pool after 3rd and 7th fire
+        elif version == "1.1":
+            self.conv1 = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            fires = [
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            ]
+            self._pool_after = {1, 3}
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version}")
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2)
+        self.fires = nn.LayerList(fires)
+        self.dropout = nn.Dropout(0.5)
+        self.final_conv = nn.Conv2D(512, num_classes if num_classes > 0 else 1000, 1)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.conv1(x)))
+        for i, fire in enumerate(self.fires):
+            x = fire(x)
+            if i in self._pool_after:
+                x = self.maxpool(x)
+        if self.num_classes > 0:
+            x = self.relu(self.final_conv(self.dropout(x)))
+        if self.with_pool:
+            x = self.pool(x)
+            x = flatten(x, 1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access; load weights via set_state_dict")
+    return SqueezeNet("1.1", **kwargs)

@@ -336,3 +336,32 @@ class TestStaticNnBuilders:
         wv = np.full((2, 3), 2.0, "float32")
         (got,) = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[gx])
         np.testing.assert_allclose(got, 2 * xv * wv, rtol=1e-6)  # vjp with w cotangent
+
+
+class TestStaticMoreRegressions:
+    def test_batch_norm_2d_input(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 6], "float32")
+            out = static.nn.batch_norm(x)
+        exe = static.Executor()
+        arr = np.random.RandomState(0).randn(3, 6).astype("float32")
+        (got,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+        assert got.shape == (3, 6)
+        np.testing.assert_allclose(got, arr / np.sqrt(1 + 1e-5), rtol=1e-5, atol=1e-5)
+
+    def test_gradients_none_target_gradient_mixes_defaults(self):
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            w = static.data("w", [2, 2], "float32")
+            y1 = x * 2.0
+            y2 = x * x
+            (gx,) = static.gradients([y1, y2], [x], target_gradients=[w, None])
+        exe = static.Executor()
+        xv = np.arange(4, dtype="float32").reshape(2, 2)
+        wv = np.full((2, 2), 3.0, "float32")
+        (got,) = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[gx])
+        np.testing.assert_allclose(got, 2.0 * wv + 2 * xv, rtol=1e-6)

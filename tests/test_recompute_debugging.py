@@ -156,3 +156,35 @@ class TestDebugging:
         debugging.dump_tensor_stats({"x": paddle.to_tensor(np.full(3, 1.5, "float32"))}, p2)
         rows = debugging.compare_accuracy(p1, p2, str(tmp_path / "out.json"))
         assert rows[0]["max_abs_diff"] == pytest.approx(0.5)
+
+
+class TestRecomputeEdgeCases:
+    def test_mixed_tensor_nontensor_outputs(self):
+        lin = nn.Linear(4, 4)
+
+        def block(x):
+            return lin(x), None
+
+        x = paddle.to_tensor(np.ones((2, 4), "float32"), stop_gradient=False)
+        out, cache = recompute(block, x)
+        assert cache is None
+        out.sum().backward()
+        assert x.grad is not None and lin.weight.grad is not None
+
+    def test_sequential_extra_kwargs_reach_first_layer(self):
+        seen = {}
+
+        class Probe(nn.Layer):
+            def forward(self, x, scale=1.0):
+                seen["scale"] = scale
+                return x * scale
+
+        layers = [Probe(), nn.Linear(4, 4)]
+        x = paddle.to_tensor(np.ones((2, 4), "float32"), stop_gradient=False)
+        recompute_sequential({"segments": 1}, layers, x, scale=3.0)
+        assert seen["scale"] == 3.0
+
+    def test_fleet_utils_submodule_import(self):
+        from paddle_tpu.distributed.fleet.utils import recompute as r2
+
+        assert r2 is recompute

@@ -1,0 +1,79 @@
+"""Vision model zoo smoke + shape tests (parity: test/legacy_test/
+test_vision_models.py — each model builds and produces [N, num_classes]).
+
+Small inputs + num_classes=10 keep XLA:CPU compile time bounded; each
+model also runs one backward to catch graph-breaking layers.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _check(model, size=64, n=1, num_classes=10, backward=False):
+    x = paddle.to_tensor(np.random.RandomState(0).randn(n, 3, size, size).astype("float32"),
+                         stop_gradient=False)
+    out = model(x)
+    assert tuple(out.shape) == (n, num_classes)
+    assert np.isfinite(out.numpy()).all()
+    if backward:
+        out.sum().backward()
+        g = next(iter(model.parameters())).grad
+        assert g is not None
+
+
+class TestVisionZoo:
+    def test_mobilenet_v1(self):
+        _check(models.mobilenet_v1(scale=0.25, num_classes=10), backward=True)
+
+    def test_mobilenet_v3_small(self):
+        _check(models.mobilenet_v3_small(scale=0.5, num_classes=10))
+
+    def test_mobilenet_v3_large(self):
+        _check(models.mobilenet_v3_large(scale=0.5, num_classes=10))
+
+    def test_shufflenet_v2(self):
+        _check(models.shufflenet_v2_x0_25(num_classes=10), backward=True)
+
+    def test_squeezenet(self):
+        _check(models.squeezenet1_1(num_classes=10))
+
+    def test_densenet(self):
+        _check(models.densenet121(num_classes=10))
+
+    def test_inception_v3(self):
+        # inception needs >=75px input
+        _check(models.inception_v3(num_classes=10), size=96)
+
+    def test_resnext_and_wide(self):
+        _check(models.resnext50_32x4d(num_classes=10))
+        _check(models.wide_resnet50_2(num_classes=10))
+
+    def test_channel_shuffle_roundtrip(self):
+        from paddle_tpu.vision.models.shufflenetv2 import channel_shuffle
+
+        x = paddle.to_tensor(np.arange(2 * 8 * 2 * 2, dtype="float32").reshape(2, 8, 2, 2))
+        y = channel_shuffle(channel_shuffle(x, 2), 4)
+        # shuffle with g then c//g is the inverse permutation
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    def test_with_pool_false_and_no_classifier(self):
+        m = models.mobilenet_v1(scale=0.25, num_classes=-1, with_pool=False)
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+        out = m(x)
+        assert len(out.shape) == 4  # feature map, no pooling/fc
+
+
+class TestReviewRegressions:
+    def test_squeezenet_1_0_layout(self):
+        m = models.squeezenet1_0(num_classes=10)
+        _check(m, size=96)
+
+    def test_shufflenet_swish_uses_swish(self):
+        m = models.shufflenet_v2_swish(num_classes=10)
+        from paddle_tpu import nn as _nn
+
+        acts = [l for l in m.sublayers() if isinstance(l, _nn.Swish)]
+        assert acts, "swish variant must contain Swish activations"
