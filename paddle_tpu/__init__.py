@@ -53,5 +53,6 @@ from .static import disable_static, enable_static
 from . import inference
 from . import sparse
 from . import incubate
+from . import quantization
 
 __version__ = "0.1.0"

@@ -43,3 +43,6 @@ from .parallel import DataParallel
 from . import fleet
 from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
+from . import auto_tuner
+from . import elastic
+from .fleet.recompute import recompute

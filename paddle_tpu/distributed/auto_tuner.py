@@ -1,0 +1,167 @@
+"""Auto-tuner: search over hybrid-parallel configurations.
+
+Parity: python/paddle/distributed/auto_tuner/ — tuner.py:21 AutoTuner,
+prune.py (divisibility/memory pruning rules), search.py (grid +
+priority ordering), recorder. TPU design: candidates are mesh layouts
+(dp × mp × pp × sharding over chips); the memory model follows the
+standard transformer accounting (params/grads/opt-states sharded by
+dp-sharding and mp, activations by mp and micro-batch) and the cost
+model prefers MXU-friendly layouts: mp bounded by ICI domain, dp outermost.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["AutoTuner", "Candidate", "default_candidates", "prune_by_memory", "estimate_memory_gb"]
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+@dataclass
+class Candidate:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sharding_stage: int = 1
+    micro_batch_size: int = 1
+    use_recompute: bool = False
+    estimated_memory_gb: float = 0.0
+    estimated_score: float = 0.0
+    metric: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    @property
+    def degree_product(self) -> int:
+        return self.dp_degree * self.mp_degree * self.pp_degree * self.sharding_degree
+
+
+def estimate_memory_gb(cand: Candidate, model_cfg: Dict[str, Any]) -> float:
+    """Per-chip HBM estimate (GB) for a transformer LM in bf16 + fp32
+    master/opt-state (parity: auto_tuner memory model, prune.py)."""
+    h = model_cfg.get("hidden_size", 4096)
+    L = model_cfg.get("num_layers", 32)
+    V = model_cfg.get("vocab_size", 32000)
+    S = model_cfg.get("seq_length", 2048)
+    params = 12 * L * h * h + V * h  # dense transformer approximation
+    params_per = params / (cand.mp_degree * cand.pp_degree)
+    # bf16 weights + grads; fp32 master + 2 adam moments sharded by dp-sharding
+    shard = cand.sharding_degree if cand.sharding_stage >= 1 else 1
+    weight_bytes = params_per * 2
+    grad_bytes = params_per * 2 / (shard if cand.sharding_stage >= 2 else 1)
+    opt_bytes = params_per * 12 / shard
+    if cand.sharding_stage >= 3:
+        weight_bytes /= shard
+    # activations per micro-batch (bf16), halved by recompute
+    act = cand.micro_batch_size * S * h * L / cand.pp_degree / cand.mp_degree * 16 * 2
+    if cand.use_recompute:
+        act *= 0.3
+    return (weight_bytes + grad_bytes + opt_bytes + act) / (1 << 30)
+
+
+def _score(cand: Candidate, model_cfg: Dict[str, Any]) -> float:
+    """Heuristic throughput score: prefer less model-split (mp/pp comm),
+    bigger micro-batches (MXU util), recompute only if needed."""
+    score = 100.0
+    score -= 8.0 * (cand.mp_degree - 1) ** 0.5     # per-layer collectives
+    score -= 4.0 * (cand.pp_degree - 1) ** 0.5     # bubble
+    score -= 1.0 * (cand.sharding_degree - 1) ** 0.25
+    score += 2.0 * min(cand.micro_batch_size, 16) ** 0.5
+    if cand.use_recompute:
+        score -= 10.0  # ~30% recompute overhead
+    return score
+
+
+def prune_by_memory(cands: List[Candidate], model_cfg: Dict[str, Any],
+                    hbm_gb: float) -> List[Candidate]:
+    out = []
+    for c in cands:
+        c.estimated_memory_gb = estimate_memory_gb(c, model_cfg)
+        if c.estimated_memory_gb <= hbm_gb:
+            out.append(c)
+    return out
+
+
+def default_candidates(world_size: int, tuner_cfg: Dict[str, Any]) -> List[Candidate]:
+    def axis(name, default):
+        v = tuner_cfg.get(name, default)
+        return _divisors(world_size) if v in ("auto", None) else ([v] if isinstance(v, int) else list(v))
+
+    dp_list = axis("dp_degree", "auto")
+    mp_list = axis("mp_degree", "auto")
+    pp_list = axis("pp_degree", [1])
+    sh_list = axis("sharding_degree", [1])
+    stages = tuner_cfg.get("sharding_stage", [1])
+    stages = [stages] if isinstance(stages, int) else list(stages)
+    mbs_list = tuner_cfg.get("micro_batch_size", [1, 2, 4, 8])
+    mbs_list = [mbs_list] if isinstance(mbs_list, int) else list(mbs_list)
+    rc_list = tuner_cfg.get("use_recompute", [False, True])
+    rc_list = [rc_list] if isinstance(rc_list, bool) else list(rc_list)
+
+    heads = tuner_cfg.get("num_attention_heads", 0)
+    layers = tuner_cfg.get("num_layers", 0)
+    gbs = tuner_cfg.get("global_batch_size", 0)
+
+    cands = []
+    for dp, mp, pp, sh, st, mbs, rc in itertools.product(
+            dp_list, mp_list, pp_list, sh_list, stages, mbs_list, rc_list):
+        c = Candidate(dp, mp, pp, sh, st, mbs, rc)
+        if c.degree_product != world_size:
+            continue
+        if heads and heads % mp != 0:
+            continue
+        if layers and layers % pp != 0:
+            continue
+        if gbs and gbs % (dp * sh * mbs) != 0:
+            continue
+        cands.append(c)
+    return cands
+
+
+class AutoTuner:
+    """Parity: auto_tuner/tuner.py AutoTuner — candidate generation,
+    pruning, priority ordering, run recording, best() lookup."""
+
+    def __init__(self, tuner_cfg: Dict[str, Any]):
+        self.cfg = dict(tuner_cfg)
+        self.world_size = int(tuner_cfg.get("world_size", 8))
+        self.model_cfg = tuner_cfg.get("model_cfg", {})
+        self.hbm_gb = float(tuner_cfg.get("hbm_gb", 95.0))  # v5p default
+        cands = default_candidates(self.world_size, self.cfg)
+        cands = prune_by_memory(cands, self.model_cfg, self.hbm_gb)
+        for c in cands:
+            c.estimated_score = _score(c, self.model_cfg)
+        self._cands = sorted(cands, key=lambda c: -c.estimated_score)
+        self._cur = -1
+        self.history: List[Candidate] = []
+
+    @property
+    def candidates(self) -> List[Candidate]:
+        return list(self._cands)
+
+    def search_once(self) -> Optional[Candidate]:
+        """Next most-promising untried candidate (parity: tuner.search_once)."""
+        self._cur += 1
+        if self._cur >= len(self._cands):
+            return None
+        return self._cands[self._cur]
+
+    def record(self, cand: Candidate, metric: float):
+        cand.metric = metric
+        self.history.append(cand)
+
+    def best(self) -> Optional[Candidate]:
+        done = [c for c in self.history if c.metric is not None]
+        return max(done, key=lambda c: c.metric) if done else None
+
+    def save_history(self, path: str):
+        with open(path, "w") as f:
+            json.dump([c.to_dict() for c in self.history], f, indent=1)

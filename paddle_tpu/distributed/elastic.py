@@ -1,0 +1,183 @@
+"""Elastic training membership manager.
+
+Parity: python/paddle/distributed/fleet/elastic/manager.py:125
+(ElasticManager — etcd leases, heartbeats, watch callbacks, scale in/out)
+and launch/controllers/collective.py:262 CollectiveElasticController.
+
+TPU design: membership rides the framework TCPStore (csrc/tcp_store.cc)
+instead of etcd — each pod heartbeats a key with a TTL stamp; the manager
+thread watches the key set, detects join/leave, and invokes the restart
+callback so the launcher can relaunch trainers with re-synced ranks
+(exactly the reference's kill-and-relaunch flow, no partial recovery).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .store import TCPStore
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Watches pod membership; triggers restart on join/leave within
+    [min_nodes, max_nodes] (scale bounds, parity: --elastic np=min:max)."""
+
+    def __init__(self, store: TCPStore, pod_id: str, np_min: int = 1,
+                 np_max: Optional[int] = None, heartbeat_interval: float = 0.5,
+                 ttl: float = 2.0, prefix: str = "/elastic/nodes"):
+        self.store = store
+        self.pod_id = pod_id
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self.prefix = prefix
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._watch_cbs: List[Callable[[List[str]], None]] = []
+        self._known: List[str] = []
+        self._seen: Dict[str, tuple] = {}  # pod -> (last seq, local monotonic time)
+        self.need_restart = False
+
+    # -- membership --
+    # Liveness uses per-pod monotonically increasing sequence numbers, not
+    # wall-clock stamps: each reader tracks when it last saw a pod's counter
+    # advance on its OWN clock, so cross-host clock skew cannot mark a
+    # heartbeating pod dead.
+    def _hb_key(self) -> str:
+        return f"{self.prefix}/{self.pod_id}@seq"
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.store.add(self._hb_key(), 1)
+            self._stop.wait(self.interval)
+
+    def _try_get(self, key: str) -> Optional[bytes]:
+        try:
+            return self.store.get(key, timeout=0.05)
+        except TimeoutError:
+            return None
+
+    def alive_nodes(self) -> List[str]:
+        now = time.monotonic()
+        alive = []
+        for pod in self._registry():
+            raw = self._try_get(f"{self.prefix}/{pod}@seq")
+            if raw is None:
+                continue
+            try:
+                seq = int(raw)
+            except ValueError:
+                continue
+            if seq <= 0:  # deregistered
+                continue
+            last = self._seen.get(pod)
+            if last is None or last[0] != seq:
+                self._seen[pod] = (seq, now)
+                alive.append(pod)
+            elif now - last[1] <= self.ttl:
+                alive.append(pod)
+        return sorted(alive)
+
+    def _registry(self) -> List[str]:
+        raw = self._try_get(f"{self.prefix}/@registry")
+        return raw.decode().split(",") if raw else []
+
+    def _register(self):
+        with _RegistryLock(self.store, self.prefix):
+            pods = set(self._registry())
+            pods.add(self.pod_id)
+            self.store.set(f"{self.prefix}/@registry", ",".join(sorted(pods)))
+
+    def deregister(self):
+        with _RegistryLock(self.store, self.prefix):
+            pods = set(self._registry())
+            pods.discard(self.pod_id)
+            self.store.set(f"{self.prefix}/@registry", ",".join(sorted(pods)))
+        self.store.set(self._hb_key(), "-1")
+
+    # -- watching --
+    def watch(self, callback: Callable[[List[str]], None]):
+        self._watch_cbs.append(callback)
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            alive = self.alive_nodes()
+            if alive != self._known:
+                prev = self._known
+                self._known = alive
+                if prev:  # skip the initial population event
+                    self.need_restart = True
+                    for cb in self._watch_cbs:
+                        try:
+                            cb(alive)
+                        except Exception as e:  # a bad callback must not kill the watcher
+                            print(f"[elastic] watch callback error: {e!r}")
+            self._stop.wait(self.interval)
+
+    def start(self):
+        self._register()
+        for fn in (self._heartbeat_loop, self._watch_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        # wait for own heartbeat to land
+        while self.pod_id not in self.alive_nodes():
+            time.sleep(0.02)
+        self._known = self.alive_nodes()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- status decision (parity: ElasticManager.exit/ wait logic) --
+    def decide(self) -> str:
+        n = len(self.alive_nodes())
+        if n < self.np_min:
+            return ElasticStatus.HOLD
+        if self.need_restart:
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def reset(self):
+        self.need_restart = False
+        self._known = self.alive_nodes()
+
+
+class _RegistryLock:
+    """Store-side spinlock via add() parity counter, with breaker: if the
+    holder dies mid-critical-section (the crash elastic exists to survive),
+    waiters force-reset the counter after ``ttl`` seconds of spinning."""
+
+    def __init__(self, store: TCPStore, prefix: str, ttl: float = 2.0):
+        self.store = store
+        self.key = f"{prefix}/@lock"
+        self.ttl = ttl
+
+    def __enter__(self):
+        start = time.monotonic()
+        while True:
+            if self.store.add(self.key, 1) == 1:
+                return self
+            self.store.add(self.key, -1)
+            if time.monotonic() - start > self.ttl:
+                self.store.set(self.key, "0")  # break a dead holder's lock
+                start = time.monotonic()
+            time.sleep(0.005)
+
+    def __exit__(self, *exc):
+        self.store.add(self.key, -1)
+        return False

@@ -286,6 +286,10 @@ class TCPStore:
                 port = py_server.port
         self.port = port
 
+        # one socket per thread: the native client is a plain blocking
+        # socket, so concurrent threads (watchdogs, heartbeats, rendezvous
+        # waits) each get their own connection instead of sharing one
+        self._tls = threading.local()
         if lib is not None:
             self._client = lib.pts_client_new(host.encode(), port, int(timeout * 1000))
             self._client_native = self._client is not None and self._client != 0
@@ -294,15 +298,33 @@ class TCPStore:
         else:
             self._client = _PyClient(host, port, timeout)
             self._client_native = False
+        self._all_native_clients: List = []
+        self._clients_lock = threading.Lock()
+        if self._client_native:
+            self._tls.client = self._client
+            self._all_native_clients.append(self._client)
 
     @property
     def is_native(self) -> bool:
         return self._client_native
 
+    def _nc(self):
+        """Per-thread native client connection."""
+        c = getattr(self._tls, "client", None)
+        if c is None:
+            c = self._lib.pts_client_new(self.host.encode(), self.port,
+                                         int(self.timeout * 1000))
+            if c is None or c == 0:
+                raise RuntimeError("TCPStore: failed to open native client connection")
+            self._tls.client = c
+            with self._clients_lock:
+                self._all_native_clients.append(c)
+        return c
+
     def set(self, key: str, value: Union[bytes, str, int]) -> None:
         data = _to_bytes(value)
         if self._client_native:
-            if self._lib.pts_set(self._client, key.encode(), data, len(data)) != 0:
+            if self._lib.pts_set(self._nc(), key.encode(), data, len(data)) != 0:
                 raise RuntimeError(f"TCPStore set({key}) failed")
         else:
             self._client.set(key, data)
@@ -313,7 +335,7 @@ class TCPStore:
         if self._client_native:
             out = ctypes.c_void_p()
             outlen = ctypes.c_int()
-            rc = self._lib.pts_get(self._client, key.encode(), t_ms,
+            rc = self._lib.pts_get(self._nc(), key.encode(), t_ms,
                                    ctypes.byref(out), ctypes.byref(outlen))
             if rc != 0:
                 raise TimeoutError(f"TCPStore get({key}) timed out after {t_ms}ms")
@@ -328,7 +350,7 @@ class TCPStore:
 
     def add(self, key: str, amount: int) -> int:
         if self._client_native:
-            rc = self._lib.pts_add(self._client, key.encode(), amount)
+            rc = self._lib.pts_add(self._nc(), key.encode(), amount)
             if rc == -(2**63):
                 raise RuntimeError(f"TCPStore add({key}) failed")
             return rc
@@ -340,7 +362,7 @@ class TCPStore:
         t_ms = int((timeout if timeout is not None else self.timeout) * 1000)
         for k in keys:
             if self._client_native:
-                if self._lib.pts_wait(self._client, k.encode(), t_ms) != 0:
+                if self._lib.pts_wait(self._nc(), k.encode(), t_ms) != 0:
                     raise TimeoutError(f"TCPStore wait({k}) timed out")
             else:
                 if not self._client.wait_key(k, t_ms):
@@ -348,17 +370,17 @@ class TCPStore:
 
     def check(self, key: str) -> bool:
         if self._client_native:
-            return self._lib.pts_check(self._client, key.encode()) == 1
+            return self._lib.pts_check(self._nc(), key.encode()) == 1
         return self._client.check(key)
 
     def delete_key(self, key: str) -> bool:
         if self._client_native:
-            return self._lib.pts_delete_key(self._client, key.encode()) == 1
+            return self._lib.pts_delete_key(self._nc(), key.encode()) == 1
         return self._client.delete_key(key)
 
     def num_keys(self) -> int:
         if self._client_native:
-            return int(self._lib.pts_num_keys(self._client))
+            return int(self._lib.pts_num_keys(self._nc()))
         return self._client.num_keys()
 
     def barrier(self, prefix: str = "barrier", timeout: Optional[float] = None) -> None:
@@ -373,7 +395,10 @@ class TCPStore:
     def close(self) -> None:
         if self._client is not None:
             if self._client_native:
-                self._lib.pts_client_free(self._client)
+                with self._clients_lock:
+                    for c in self._all_native_clients:
+                        self._lib.pts_client_free(c)
+                    self._all_native_clients.clear()
             else:
                 self._client.close()
             self._client = None

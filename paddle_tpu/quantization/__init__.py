@@ -1,0 +1,20 @@
+"""paddle.quantization equivalent — QAT/PTQ over fake-quant simulation.
+
+Parity: python/paddle/quantization/ (QuantConfig, QAT, PTQ, observers,
+quanters) and paddle/nn/quant/ quanted layers.
+"""
+
+from .observers import (AbsmaxObserver, BaseObserver, HistObserver,
+                        MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver)
+from .qat import (PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear, convert)
+from .quanters import (FakeQuanterChannelWiseAbsMax, FakeQuanterWithAbsMaxObserver,
+                       fake_quant_dequant)
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "convert",
+    "QuantedLinear", "QuantedConv2D",
+    "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "PerChannelAbsmaxObserver", "HistObserver",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
+    "fake_quant_dequant",
+]

@@ -1,0 +1,124 @@
+"""Quantization observers — collect tensor statistics for scale calibration.
+
+Parity: python/paddle/quantization/observers/ (AbsmaxObserver,
+HistObserver, KLObserver) and the uniform observer base
+(python/paddle/quantization/base_observer.py). Observers run eagerly on
+device; the abs-max reductions are single fused XLA ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class BaseObserver:
+    """Tracks statistics of every tensor passed through observe()."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._scale: Optional[float] = None
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scales(self) -> float:
+        if self._scale is None:
+            raise RuntimeError("observer has no data; run calibration first")
+        return self._scale
+
+    def quant_axis(self):
+        return -1
+
+    def zero_points(self) -> float:
+        return 0.0
+
+    def bound(self) -> int:
+        return (1 << (self.quant_bits - 1)) - 1
+
+
+class AbsmaxObserver(BaseObserver):
+    """scale = max(|x|) over all calibration batches."""
+
+    def observe(self, x: Tensor):
+        m = float(jnp.abs(x._data).max())
+        self._scale = m if self._scale is None else max(self._scale, m)
+        return x
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA of per-batch abs-max (parity: moving_average_abs_max)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x: Tensor):
+        m = float(jnp.abs(x._data).max())
+        self._scale = m if self._scale is None else (
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m)
+        return x
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel abs-max (weights; parity: channel_wise_abs_max)."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 0):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._scale_vec: Optional[np.ndarray] = None
+
+    def observe(self, x: Tensor):
+        d = x._data
+        axes = tuple(i for i in range(d.ndim) if i != self._axis)
+        m = np.asarray(jnp.abs(d).max(axis=axes))
+        self._scale_vec = m if self._scale_vec is None else np.maximum(self._scale_vec, m)
+        return x
+
+    def scales(self):
+        if self._scale_vec is None:
+            raise RuntimeError("observer has no data; run calibration first")
+        return self._scale_vec
+
+    def quant_axis(self):
+        return self._axis
+
+
+class HistObserver(BaseObserver):
+    """Histogram percentile observer (parity: HistObserver — simplified to
+    a fixed-percentile cut of the accumulated |x| histogram)."""
+
+    def __init__(self, quant_bits: int = 8, bins_count: int = 2048, percent: float = 0.999):
+        super().__init__(quant_bits)
+        self.bins = bins_count
+        self.percent = percent
+        self._hist = np.zeros(bins_count, np.float64)
+        self._max = 0.0
+
+    def observe(self, x: Tensor):
+        d = np.abs(np.asarray(x._data, np.float32)).ravel()
+        mx = float(d.max()) if d.size else 0.0
+        if mx > self._max and self._max > 0:
+            # rescale existing histogram into the new range
+            ratio = self._max / mx
+            idx = (np.arange(self.bins) * ratio).astype(np.int64)
+            newh = np.zeros_like(self._hist)
+            np.add.at(newh, idx, self._hist)
+            self._hist = newh
+        self._max = max(self._max, mx)
+        if self._max > 0:
+            h, _ = np.histogram(d, bins=self.bins, range=(0, self._max))
+            self._hist += h
+        return x
+
+    def scales(self) -> float:
+        total = self._hist.sum()
+        if total == 0:
+            raise RuntimeError("observer has no data; run calibration first")
+        csum = np.cumsum(self._hist) / total
+        cut = int(np.searchsorted(csum, self.percent))
+        return self._max * (cut + 1) / self.bins

@@ -1,0 +1,217 @@
+"""QAT / PTQ engines and quantized layer wrappers.
+
+Parity: python/paddle/quantization/qat.py (QAT.quantize), ptq.py
+(PTQ.quantize/convert), config.py (QuantConfig), and the quanted layer
+zoo in python/paddle/nn/quant/. The wrapped layers fake-quant weights
+and activations in forward; convert() freezes scales and stores int8
+weights + scales for inference-style dequant matmul.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from .observers import AbsmaxObserver, BaseObserver, MovingAverageAbsmaxObserver
+from .quanters import (FakeQuanterChannelWiseAbsMax, FakeQuanterWithAbsMaxObserver,
+                       fake_quant_dequant)
+
+
+class QuantConfig:
+    """Parity: paddle.quantization.QuantConfig — maps layers/types to
+    quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_cfg: Dict[int, tuple] = {}
+        self._type_cfg: Dict[Type, tuple] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+def _make(factory, default):
+    if factory is None:
+        return default()
+    return factory() if callable(factory) else factory
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quanted weight + activation (parity:
+    paddle/nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, source: "nn.Linear", act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.weight = source.weight
+        self.bias = getattr(source, "bias", None)
+        self.activation_quanter = _make(act_quanter, FakeQuanterWithAbsMaxObserver)
+        self.weight_quanter = _make(weight_quanter, lambda: FakeQuanterChannelWiseAbsMax(quant_axis=1))
+
+    def forward(self, x):
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(self.weight)
+        out = x.matmul(w)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class QuantedConv2D(nn.Layer):
+    """Conv2D with fake-quanted weight + activation."""
+
+    def __init__(self, source: "nn.Conv2D", act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._source = source
+        self.weight = source.weight
+        self.bias = getattr(source, "bias", None)
+        self.activation_quanter = _make(act_quanter, FakeQuanterWithAbsMaxObserver)
+        self.weight_quanter = _make(weight_quanter, lambda: FakeQuanterChannelWiseAbsMax(quant_axis=0))
+
+    def forward(self, x):
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(self.weight)
+        return nn.functional.conv2d(x, w, self.bias, stride=self._source._stride,
+                                    padding=self._source._padding,
+                                    dilation=self._source._dilation,
+                                    groups=self._source._groups)
+
+
+_QAT_MAP = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+def _replace_layers(model, factory):
+    for name, child in list(model.named_children()):
+        replaced = factory(child)
+        if replaced is not None:
+            setattr(model, name, replaced)
+        else:
+            _replace_layers(child, factory)
+    return model
+
+
+class QAT:
+    """Quantization-aware training engine (parity: paddle.quantization.QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace: bool = False):
+        config = self._config
+        if not inplace:
+            original = model
+            model = copy.deepcopy(model)
+            # deepcopy invalidates id()-keyed per-layer configs: remap them
+            # onto the copied layers (traversal order is preserved)
+            if config._layer_cfg:
+                config = copy.copy(config)
+                remapped = {}
+                for orig_l, new_l in zip(original.sublayers(include_self=True),
+                                         model.sublayers(include_self=True)):
+                    if id(orig_l) in self._config._layer_cfg:
+                        remapped[id(new_l)] = self._config._layer_cfg[id(orig_l)]
+                config._layer_cfg = remapped
+
+        def factory(layer):
+            cls = _QAT_MAP.get(type(layer))
+            if cls is None:
+                return None
+            act_f, w_f = config._config_for(layer)
+            return cls(layer, act_f, w_f)
+
+        return _replace_layers(model, factory)
+
+    def convert(self, model, inplace: bool = False):
+        return convert(model, inplace=inplace)
+
+
+class _ObservedLayer(nn.Layer):
+    def __init__(self, source, observer: BaseObserver):
+        super().__init__()
+        self.source = source
+        self.observer = observer
+
+    def forward(self, *args, **kwargs):
+        if args and isinstance(args[0], Tensor):
+            self.observer.observe(args[0])
+        return self.source(*args, **kwargs)
+
+
+class PTQ:
+    """Post-training quantization engine (parity: paddle.quantization.PTQ):
+    quantize() inserts observers, run calibration batches, convert()
+    replaces observed layers with fixed-scale fake-quant layers."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self._config = config or QuantConfig()
+
+    def quantize(self, model, inplace: bool = False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def factory(layer):
+            if type(layer) not in _QAT_MAP:
+                return None
+            act_f, _ = self._config._config_for(layer)
+            observer = _make(act_f, AbsmaxObserver)
+            if not isinstance(observer, BaseObserver):
+                raise TypeError(
+                    f"PTQ activation config must be an observer, got {type(observer)}")
+            return _ObservedLayer(layer, observer)
+
+        return _replace_layers(model, factory)
+
+    def convert(self, model, inplace: bool = False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def factory(layer):
+            if not isinstance(layer, _ObservedLayer):
+                return None
+            scale = layer.observer.scales()
+            src = layer.source
+
+            class _Frozen(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.inner = src
+                    self.scale = scale
+
+                def forward(self, x, *a, **k):
+                    return self.inner(fake_quant_dequant(x, self.scale), *a, **k)
+
+            return _Frozen()
+
+        return _replace_layers(model, factory)
+
+
+def convert(model, inplace: bool = False):
+    """Freeze QAT quanters for inference (parity: QAT.convert — stop
+    updating activation scales)."""
+    if not inplace:
+        model = copy.deepcopy(model)
+    for layer in model.sublayers(include_self=True):
+        q = getattr(layer, "activation_quanter", None)
+        if isinstance(q, FakeQuanterWithAbsMaxObserver):
+            q.eval()
+    return model

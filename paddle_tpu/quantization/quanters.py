@@ -1,0 +1,90 @@
+"""Fake quanters — quant-dequant simulation with straight-through gradients.
+
+Parity: python/paddle/quantization/quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver) and the fake_quantize_dequantize kernels
+(paddle/phi/kernels/fake_quantize_*). TPU design: one jax function
+round(clip(x/s))·s dispatched through the tape; the STE gradient comes
+from a jax.custom_vjp so backward is identity inside the clip range —
+XLA fuses the whole quant-dequant into the surrounding computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+from .observers import MovingAverageAbsmaxObserver
+
+
+@jax.custom_vjp
+def _fake_quant_ste(x, scale, bound):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bound), -bound, bound)
+    return q * s / bound
+
+
+def _fq_fwd(x, scale, bound):
+    out = _fake_quant_ste(x, scale, bound)
+    return out, (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    s = jnp.maximum(scale, 1e-9)
+    mask = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * mask, None, None
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant_dequant(x: Tensor, scale, quant_bits: int = 8, quant_axis: int = -1) -> Tensor:
+    """Quant-dequant a tensor given scale(s); STE backward."""
+    bound = float((1 << (quant_bits - 1)) - 1)
+    s_arr = jnp.asarray(scale, jnp.float32)
+    if s_arr.ndim == 1 and quant_axis >= 0:
+        shape = [1] * len(x.shape)
+        shape[quant_axis] = -1
+        s_arr = s_arr.reshape(shape)
+
+    def fn(x):
+        return _fake_quant_ste(x, s_arr.astype(x.dtype), jnp.asarray(bound, x.dtype))
+
+    return apply_op("fake_quantize_dequantize", fn, x)
+
+
+class FakeQuanterWithAbsMaxObserver:
+    """Activation quanter: EMA abs-max scale updated each forward during
+    training; fixed at convert time (parity: FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8):
+        self._observer = MovingAverageAbsmaxObserver(quant_bits, moving_rate)
+        self.quant_bits = quant_bits
+        self.training = True
+
+    def __call__(self, x: Tensor) -> Tensor:
+        if self.training:
+            self._observer.observe(x)
+        return fake_quant_dequant(x, self._observer.scales(), self.quant_bits)
+
+    def scales(self):
+        return self._observer.scales()
+
+    def eval(self):
+        self.training = False
+
+
+class FakeQuanterChannelWiseAbsMax:
+    """Weight quanter: per-channel abs-max computed from the live weight."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 0):
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+
+    def __call__(self, w: Tensor) -> Tensor:
+        d = w._data
+        axes = tuple(i for i in range(d.ndim) if i != self.quant_axis)
+        scale = jnp.abs(d).max(axis=axes)
+        return fake_quant_dequant(w, scale, self.quant_bits, self.quant_axis)

@@ -1,0 +1,142 @@
+"""Quantization: observers, fake-quant STE, QAT, PTQ.
+
+Reference patterns: test/quantization/test_quant_aware*.py,
+test_ptq.py — oracle is output-closeness to the fp model plus trainability
+through the fake-quant (STE) path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import quantization as Q
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestObservers:
+    def test_absmax(self):
+        ob = Q.AbsmaxObserver()
+        ob.observe(paddle.to_tensor(np.array([1.0, -3.0], "float32")))
+        ob.observe(paddle.to_tensor(np.array([2.0], "float32")))
+        assert ob.scales() == pytest.approx(3.0)
+
+    def test_moving_average(self):
+        ob = Q.MovingAverageAbsmaxObserver(moving_rate=0.5)
+        ob.observe(paddle.to_tensor(np.array([4.0], "float32")))
+        ob.observe(paddle.to_tensor(np.array([2.0], "float32")))
+        assert ob.scales() == pytest.approx(3.0)  # 0.5*4 + 0.5*2
+
+    def test_per_channel(self):
+        ob = Q.PerChannelAbsmaxObserver(quant_axis=1)
+        w = np.array([[1.0, -5.0], [3.0, 2.0]], "float32")
+        ob.observe(paddle.to_tensor(w))
+        np.testing.assert_allclose(ob.scales(), [3.0, 5.0])
+
+    def test_hist_percentile(self):
+        ob = Q.HistObserver(percent=1.0)
+        ob.observe(paddle.to_tensor(np.linspace(0, 10, 1000).astype("float32")))
+        assert ob.scales() == pytest.approx(10.0, rel=0.01)
+
+
+class TestFakeQuant:
+    def test_quant_dequant_error_bounded(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(64).astype("float32")
+        scale = float(np.abs(x).max())
+        out = Q.fake_quant_dequant(paddle.to_tensor(x), scale, quant_bits=8)
+        step = scale / 127
+        np.testing.assert_allclose(out.numpy(), x, atol=step / 2 + 1e-7)
+
+    def test_ste_gradient(self):
+        x = paddle.to_tensor(np.array([0.5, 2.0, -0.3], "float32"), stop_gradient=False)
+        out = Q.fake_quant_dequant(x, 1.0, quant_bits=8)
+        out.sum().backward()
+        # inside |x|<=scale grad=1; outside clipped -> 0
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+class TestQAT:
+    def test_quantize_replaces_layers(self):
+        paddle.seed(0)
+        model = Net()
+        q_model = Q.QAT(Q.QuantConfig()).quantize(model)
+        kinds = [type(l).__name__ for l in q_model.sublayers()]
+        assert kinds.count("QuantedLinear") == 2
+
+    def test_qat_output_close_and_trainable(self):
+        paddle.seed(1)
+        model = Net()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8).astype("float32"))
+        ref = model(x).numpy()
+        q_model = Q.QAT(Q.QuantConfig()).quantize(model)
+        out = q_model(x)
+        # int8 fake-quant should stay within a few quant steps of fp32
+        assert np.abs(out.numpy() - ref).max() < 0.2
+        loss = (out * out).mean()
+        loss.backward()
+        grads = [p.grad for p in q_model.parameters() if not p.stop_gradient]
+        assert any(g is not None and np.abs(g.numpy()).sum() > 0 for g in grads)
+
+    def test_convert_freezes_activation_scales(self):
+        paddle.seed(2)
+        q_model = Q.QAT(Q.QuantConfig()).quantize(Net())
+        x = paddle.to_tensor(np.random.RandomState(1).randn(4, 8).astype("float32"))
+        q_model(x)  # populate scales
+        frozen = Q.convert(q_model)
+        for l in frozen.sublayers():
+            q = getattr(l, "activation_quanter", None)
+            if q is not None:
+                assert not q.training
+
+
+class TestPTQ:
+    def test_ptq_calibrate_convert(self):
+        paddle.seed(3)
+        model = Net()
+        ptq = Q.PTQ()
+        observed = ptq.quantize(model)
+        rng = np.random.RandomState(2)
+        for _ in range(4):
+            observed(paddle.to_tensor(rng.randn(8, 8).astype("float32")))
+        converted = ptq.convert(observed)
+        x = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+        ref = model(x).numpy()
+        got = converted(x).numpy()
+        assert np.abs(got - ref).max() < 0.25
+
+
+class TestConfigRegressions:
+    def test_per_layer_config_survives_deepcopy(self):
+        paddle.seed(5)
+        model = Net()
+        marker = []
+
+        class MarkerQuanter(Q.FakeQuanterWithAbsMaxObserver):
+            def __init__(self):
+                super().__init__()
+                marker.append(self)
+
+        cfg = Q.QuantConfig()
+        cfg.add_layer_config(model.fc1, activation=MarkerQuanter)
+        q_model = Q.QAT(cfg).quantize(model)  # not inplace: deepcopied
+        assert isinstance(q_model.fc1.activation_quanter, MarkerQuanter)
+        assert not isinstance(q_model.fc2.activation_quanter, MarkerQuanter)
+
+    def test_ptq_uses_configured_observer(self):
+        paddle.seed(6)
+        model = Net()
+        cfg = Q.QuantConfig(activation=lambda: Q.HistObserver(percent=1.0))
+        ptq = Q.PTQ(cfg)
+        observed = ptq.quantize(model)
+        layers = [l for l in observed.sublayers() if hasattr(l, "observer")]
+        assert layers and all(isinstance(l.observer, Q.HistObserver) for l in layers)
