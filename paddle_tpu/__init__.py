@@ -54,5 +54,7 @@ from . import inference
 from . import sparse
 from . import incubate
 from . import quantization
+from . import audio
+from . import text
 
 __version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""paddle.audio equivalent — features, functional, IO backends.
+
+Parity: python/paddle/audio/ (features/layers.py, functional/, backends/).
+"""
+
+from . import backends, features, functional
+from .backends import info, load, save
+from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram
+
+__all__ = ["features", "functional", "backends", "load", "save", "info",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

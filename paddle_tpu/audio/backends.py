@@ -1,0 +1,72 @@
+"""Audio IO (parity: python/paddle/audio/backends/ — wave_backend.load/save).
+
+Pure-stdlib WAV codec (the reference's default backend is also a
+soundfile/wave wrapper); covers PCM16/PCM8/float32 mono+stereo.
+"""
+
+from __future__ import annotations
+
+import wave
+from typing import Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["load", "save", "info"]
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True) -> Tuple[Tensor, int]:
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n_channels = w.getnchannels()
+        sampwidth = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    if sampwidth == 2:
+        data = np.frombuffer(raw, "<i2").astype(np.float32)
+        if normalize:
+            data /= 32768.0
+    elif sampwidth == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0)
+        if normalize:
+            data /= 128.0
+    elif sampwidth == 4:
+        data = np.frombuffer(raw, "<i4").astype(np.float32)
+        if normalize:
+            data /= 2147483648.0
+    else:
+        raise ValueError(f"unsupported sample width {sampwidth}")
+    data = data.reshape(-1, n_channels)
+    if channels_first:
+        data = data.T
+    return Tensor(data), sr
+
+
+def save(filepath: str, src: Tensor, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16) -> None:
+    data = np.asarray(src._data if isinstance(src, Tensor) else src, np.float32)
+    if data.ndim == 1:
+        data = data[None, :] if channels_first else data[:, None]
+    if channels_first:
+        data = data.T  # -> [frames, channels]
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm16 = (pcm * 32767.0).astype("<i2")
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1])
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm16.tobytes())
+
+
+def info(filepath: str):
+    with wave.open(filepath, "rb") as w:
+        class _Info:
+            sample_rate = w.getframerate()
+            num_channels = w.getnchannels()
+            num_frames = w.getnframes()
+            bits_per_sample = w.getsampwidth() * 8
+
+        return _Info()

@@ -1,0 +1,124 @@
+"""Audio feature layers (parity: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+
+STFT = strided framing + window + rfft, expressed as one jax function per
+layer so XLA fuses the pipeline; the mel projection is a matmul on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops.dispatch import apply_op
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length: int, hop_length: int, center: bool, pad_mode: str):
+    # x: [..., T] -> [..., n_frames, frame_length]
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(frame_length // 2, frame_length // 2)]
+        x = jnp.pad(x, pad, mode="reflect" if pad_mode == "reflect" else "constant")
+    T = x.shape[-1]
+    n_frames = 1 + (T - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length + jnp.arange(frame_length)[None, :])
+    return x[..., idx]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.register_buffer("window", Tensor(w), persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        n_fft, hop, center, pad_mode, power = (self.n_fft, self.hop_length,
+                                               self.center, self.pad_mode, self.power)
+        win = self.window._data
+
+        def fn(x, win):
+            frames = _frame(x, n_fft, hop, center, pad_mode)
+            spec = jnp.fft.rfft(frames * win, n=n_fft, axis=-1)
+            mag = jnp.abs(spec)
+            out = mag if power == 1.0 else mag ** power
+            return jnp.swapaxes(out, -1, -2)  # [..., freq, time]
+
+        return apply_op("spectrogram", fn, x, self.window)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 2048, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window, power,
+                                       center, pad_mode, dtype)
+        fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
+        self.register_buffer("fbank_matrix", Tensor(fbank), persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self.spectrogram(x)
+
+        def fn(spec, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, spec)
+
+        return apply_op("mel_projection", fn, spec, self.fbank_matrix)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, **mel_kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self.mel(x)
+        ref, amin, top_db = self.ref_value, self.amin, self.top_db
+
+        def fn(m):
+            import math as _m
+
+            log_spec = 10.0 * jnp.log10(jnp.maximum(m, amin))
+            log_spec = log_spec - 10.0 * _m.log10(max(ref, amin))
+            if top_db is not None:
+                log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+            return log_spec
+
+        return apply_op("power_to_db", fn, mel)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, norm: str = "ortho", **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, **mel_kwargs)
+        n_mels = mel_kwargs.get("n_mels", 64)
+        self.register_buffer("dct_matrix", Tensor(AF.create_dct(n_mfcc, n_mels, norm)),
+                             persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        logmel = self.log_mel(x)
+
+        def fn(lm, dct):
+            return jnp.einsum("mk,...mt->...kt", dct, lm)
+
+        return apply_op("mfcc_dct", fn, logmel, self.dct_matrix)

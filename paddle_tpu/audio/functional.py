@@ -1,0 +1,126 @@
+"""Audio functional ops (parity: python/paddle/audio/functional/ —
+window functions, mel scale conversion, fbank matrix, dct matrix).
+
+All pure jnp; the STFT inside Spectrogram is framing + rfft, which XLA
+maps onto batched matmuls/FFT on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "get_window", "power_to_db"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, jnp.ndarray))
+    f = freq._data if isinstance(freq, Tensor) else jnp.asarray(freq, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        # Slaney scale
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mels)
+    if scalar:
+        return float(out)
+    return Tensor(out) if isinstance(freq, Tensor) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, jnp.ndarray))
+    m = mel._data if isinstance(mel, Tensor) else jnp.asarray(mel, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    if scalar:
+        return float(out)
+    return Tensor(out) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min: float = 0.0,
+                         f_max: Optional[float] = None, htk: bool = False,
+                         norm: str = "slaney"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2: n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """[n_mels, n_mfcc] DCT-II matrix (parity: audio/functional/create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2))
+        dct = dct * math.sqrt(1.0 / (2.0 * n_mels))
+    return dct
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    n = win_length
+    denom = n if fftbins else n - 1
+    t = jnp.arange(n, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        return 0.5 - 0.5 * jnp.cos(2 * math.pi * t / denom)
+    if window in ("hamming",):
+        return 0.54 - 0.46 * jnp.cos(2 * math.pi * t / denom)
+    if window in ("blackman",):
+        return (0.42 - 0.5 * jnp.cos(2 * math.pi * t / denom)
+                + 0.08 * jnp.cos(4 * math.pi * t / denom))
+    if window in ("rectangular", "boxcar", "ones"):
+        return jnp.ones(n, jnp.float32)
+    raise ValueError(f"unsupported window {window!r}")
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    d = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(d, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec

@@ -45,4 +45,5 @@ from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
 from . import auto_tuner
 from . import elastic
+from . import rpc
 from .fleet.recompute import recompute

@@ -99,12 +99,15 @@ def default_candidates(world_size: int, tuner_cfg: Dict[str, Any]) -> List[Candi
     mp_list = axis("mp_degree", "auto")
     pp_list = axis("pp_degree", [1])
     sh_list = axis("sharding_degree", [1])
-    stages = tuner_cfg.get("sharding_stage", [1])
-    stages = [stages] if isinstance(stages, int) else list(stages)
-    mbs_list = tuner_cfg.get("micro_batch_size", [1, 2, 4, 8])
-    mbs_list = [mbs_list] if isinstance(mbs_list, int) else list(mbs_list)
-    rc_list = tuner_cfg.get("use_recompute", [False, True])
-    rc_list = [rc_list] if isinstance(rc_list, bool) else list(rc_list)
+    def listify(name, default):
+        v = tuner_cfg.get(name, default)
+        if v in ("auto", None):
+            return default
+        return [v] if isinstance(v, (int, bool)) else list(v)
+
+    stages = listify("sharding_stage", [1, 2, 3])
+    mbs_list = listify("micro_batch_size", [1, 2, 4, 8])
+    rc_list = listify("use_recompute", [False, True])
 
     heads = tuner_cfg.get("num_attention_heads", 0)
     layers = tuner_cfg.get("num_layers", 0)

@@ -114,7 +114,9 @@ class ElasticManager:
 
     def _watch_loop(self):
         while not self._stop.is_set():
-            alive = self.alive_nodes()
+            # membership is capped at np_max: pods beyond capacity are held
+            # out and do not perturb the running job (reference scale bound)
+            alive = self.alive_nodes()[: self.np_max]
             if alive != self._known:
                 prev = self._known
                 self._known = alive
@@ -136,7 +138,7 @@ class ElasticManager:
         # wait for own heartbeat to land
         while self.pod_id not in self.alive_nodes():
             time.sleep(0.02)
-        self._known = self.alive_nodes()
+        self._known = self.alive_nodes()[: self.np_max]
 
     def stop(self):
         self._stop.set()
@@ -154,7 +156,7 @@ class ElasticManager:
 
     def reset(self):
         self.need_restart = False
-        self._known = self.alive_nodes()
+        self._known = self.alive_nodes()[: self.np_max]
 
 
 class _RegistryLock:

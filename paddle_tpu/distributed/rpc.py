@@ -1,0 +1,153 @@
+"""paddle.distributed.rpc equivalent — TCPStore-bootstrapped RPC.
+
+Parity: python/paddle/distributed/rpc/rpc.py (init_rpc, rpc_sync,
+rpc_async, get_worker_info, shutdown) over
+paddle/fluid/distributed/rpc/ (the reference's brpc agent). Here each
+worker hosts a socket server thread; worker endpoints rendezvous through
+the TCPStore; payloads are pickled (fn, args, kwargs) executed on the
+callee — same single-master bootstrap flow as the reference.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "shutdown", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {"inited": False}
+
+
+def _recv_exact(conn, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc: peer closed")
+        buf += chunk
+    return buf
+
+
+def _serve_loop(server: socket.socket, pool: ThreadPoolExecutor):
+    while _state.get("inited"):
+        try:
+            conn, _ = server.accept()
+        except OSError:
+            return
+        pool.submit(_handle, conn)
+
+
+def _handle(conn: socket.socket):
+    try:
+        with conn:
+            (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+            fn, args, kwargs = pickle.loads(_recv_exact(conn, n))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # marshal the exception back to caller
+                result = (False, e)
+            payload = pickle.dumps(result)
+            conn.sendall(struct.pack("<Q", len(payload)) + payload)
+    except (ConnectionError, OSError):
+        pass
+
+
+def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC agent and rendezvous with peers."""
+    import os
+
+    rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size if world_size is not None else int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    store = TCPStore(host, int(port), is_master=(rank == 0), world_size=world_size)
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(64)
+    my_port = server.getsockname()[1]
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else socket.gethostbyname(socket.gethostname())
+
+    store.set(f"/rpc/{rank}", f"{name},{my_ip},{my_port}")
+    workers: Dict[str, WorkerInfo] = {}
+    for r in range(world_size):
+        wname, ip, p = store.get(f"/rpc/{r}").decode().split(",")
+        workers[wname] = WorkerInfo(wname, r, ip, int(p))
+
+    pool = ThreadPoolExecutor(max_workers=16)
+    _state.update({"inited": True, "store": store, "server": server, "pool": pool,
+                   "name": name, "rank": rank, "world_size": world_size,
+                   "workers": workers})
+    t = threading.Thread(target=_serve_loop, args=(server, pool), daemon=True)
+    t.start()
+    _state["server_thread"] = t
+    store.barrier("rpc_init")
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _state["workers"][_state["name"]]
+
+
+def _invoke(to: str, fn: Callable, args, kwargs, timeout: float):
+    info = _state["workers"][to]
+    payload = pickle.dumps((fn, args or (), kwargs or {}))
+    with socket.create_connection((info.ip, info.port), timeout=timeout or None) as conn:
+        conn.sendall(struct.pack("<Q", len(payload)) + payload)
+        (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+        ok, result = pickle.loads(_recv_exact(conn, n))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn: Callable, args=None, kwargs=None, timeout: float = 500.0):
+    """Blocking remote call (parity: rpc.rpc_sync)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn: Callable, args=None, kwargs=None, timeout: float = 500.0) -> Future:
+    """Returns a Future with .wait() alias (parity: rpc.rpc_async)."""
+    fut = _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
+    if not hasattr(Future, "wait"):
+        Future.wait = lambda self, timeout=None: self.result(timeout)  # type: ignore[attr-defined]
+    return fut
+
+
+def shutdown() -> None:
+    if not _state.get("inited"):
+        return
+    store = _state["store"]
+    store.barrier("rpc_shutdown")
+    _state["inited"] = False
+    try:
+        _state["server"].close()
+    except OSError:
+        pass
+    _state["pool"].shutdown(wait=False)

@@ -298,18 +298,22 @@ class TCPStore:
         else:
             self._client = _PyClient(host, port, timeout)
             self._client_native = False
-        self._all_native_clients: List = []
+        self._closed = False
+        self._native_by_thread: Dict[int, object] = {}  # thread ident -> client
         self._clients_lock = threading.Lock()
         if self._client_native:
             self._tls.client = self._client
-            self._all_native_clients.append(self._client)
+            self._native_by_thread[threading.get_ident()] = self._client
 
     @property
     def is_native(self) -> bool:
         return self._client_native
 
     def _nc(self):
-        """Per-thread native client connection."""
+        """Per-thread native client connection (dead threads' connections
+        are reclaimed lazily here)."""
+        if self._closed:
+            raise RuntimeError("TCPStore is closed")
         c = getattr(self._tls, "client", None)
         if c is None:
             c = self._lib.pts_client_new(self.host.encode(), self.port,
@@ -318,7 +322,10 @@ class TCPStore:
                 raise RuntimeError("TCPStore: failed to open native client connection")
             self._tls.client = c
             with self._clients_lock:
-                self._all_native_clients.append(c)
+                self._native_by_thread[threading.get_ident()] = c
+                live = {t.ident for t in threading.enumerate()}
+                for ident in [i for i in self._native_by_thread if i not in live]:
+                    self._lib.pts_client_free(self._native_by_thread.pop(ident))
         return c
 
     def set(self, key: str, value: Union[bytes, str, int]) -> None:
@@ -393,12 +400,15 @@ class TCPStore:
         self.wait([f"{prefix}/done/{epoch}"], timeout)
 
     def close(self) -> None:
+        """Free all client connections and stop a hosted server. Callers must
+        stop threads that use this store first (e.g. ElasticManager.stop())."""
+        self._closed = True
         if self._client is not None:
             if self._client_native:
                 with self._clients_lock:
-                    for c in self._all_native_clients:
+                    for c in self._native_by_thread.values():
                         self._lib.pts_client_free(c)
-                    self._all_native_clients.clear()
+                    self._native_by_thread.clear()
             else:
                 self._client.close()
             self._client = None

@@ -78,23 +78,27 @@ class QuantedLinear(nn.Layer):
 
 
 class QuantedConv2D(nn.Layer):
-    """Conv2D with fake-quanted weight + activation."""
+    """Conv2D with fake-quanted weight + activation. Copies the conv
+    hyperparameters rather than retaining the source layer, so the fp32
+    conv does not linger in the layer tree (double-quantization hazard)."""
 
     def __init__(self, source: "nn.Conv2D", act_quanter=None, weight_quanter=None):
         super().__init__()
-        self._source = source
         self.weight = source.weight
         self.bias = getattr(source, "bias", None)
+        self._stride = source._stride
+        self._padding = source._padding
+        self._dilation = source._dilation
+        self._groups = source._groups
         self.activation_quanter = _make(act_quanter, FakeQuanterWithAbsMaxObserver)
         self.weight_quanter = _make(weight_quanter, lambda: FakeQuanterChannelWiseAbsMax(quant_axis=0))
 
     def forward(self, x):
         x = self.activation_quanter(x)
         w = self.weight_quanter(self.weight)
-        return nn.functional.conv2d(x, w, self.bias, stride=self._source._stride,
-                                    padding=self._source._padding,
-                                    dilation=self._source._dilation,
-                                    groups=self._source._groups)
+        return nn.functional.conv2d(x, w, self.bias, stride=self._stride,
+                                    padding=self._padding, dilation=self._dilation,
+                                    groups=self._groups)
 
 
 _QAT_MAP = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
@@ -184,18 +188,30 @@ class PTQ:
     def convert(self, model, inplace: bool = False):
         if not inplace:
             model = copy.deepcopy(model)
+        config = self._config
 
         def factory(layer):
             if not isinstance(layer, _ObservedLayer):
                 return None
             scale = layer.observer.scales()
             src = layer.source
+            # quantize weights too (per-channel abs-max, or the configured
+            # weight quanter) and record the scales for export
+            _, w_f = config._config_for(src)
+            axis = 1 if isinstance(src, nn.Linear) else 0
+            wq = _make(w_f, lambda: FakeQuanterChannelWiseAbsMax(quant_axis=axis))
+            w = src.weight
+            d = w._data
+            axes = tuple(i for i in range(d.ndim) if i != getattr(wq, "quant_axis", axis))
+            weight_scales = jnp.abs(d).max(axis=axes)
+            w._data = wq(w)._data
 
             class _Frozen(nn.Layer):
                 def __init__(self):
                     super().__init__()
                     self.inner = src
                     self.scale = scale
+                    self.weight_scales = weight_scales
 
                 def forward(self, x, *a, **k):
                     return self.inner(fake_quant_dequant(x, self.scale), *a, **k)

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..nn.layer import Layer
 from ..ops.dispatch import apply_op
 from .observers import MovingAverageAbsmaxObserver
 
@@ -55,25 +56,23 @@ def fake_quant_dequant(x: Tensor, scale, quant_bits: int = 8, quant_axis: int = 
     return apply_op("fake_quantize_dequantize", fn, x)
 
 
-class FakeQuanterWithAbsMaxObserver:
+class FakeQuanterWithAbsMaxObserver(Layer):
     """Activation quanter: EMA abs-max scale updated each forward during
-    training; fixed at convert time (parity: FakeQuanterWithAbsMaxObserver)."""
+    training; fixed in eval mode (parity: FakeQuanterWithAbsMaxObserver).
+    A Layer, so model.train()/eval() propagates to it like the reference."""
 
     def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8):
+        super().__init__()
         self._observer = MovingAverageAbsmaxObserver(quant_bits, moving_rate)
         self.quant_bits = quant_bits
-        self.training = True
 
-    def __call__(self, x: Tensor) -> Tensor:
+    def forward(self, x: Tensor) -> Tensor:
         if self.training:
             self._observer.observe(x)
         return fake_quant_dequant(x, self._observer.scales(), self.quant_bits)
 
     def scales(self):
         return self._observer.scales()
-
-    def eval(self):
-        self.training = False
 
 
 class FakeQuanterChannelWiseAbsMax:

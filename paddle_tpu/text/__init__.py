@@ -1,0 +1,11 @@
+"""paddle.text equivalent — text datasets + sequence decoding.
+
+Parity: python/paddle/text/ (datasets/{imdb,imikolov,uci_housing,...}.py,
+viterbi_decode.py). Zero-egress environment: dataset classes parse local
+files in the reference formats via ``data_file=`` instead of downloading.
+"""
+
+from .datasets import Imdb, Imikolov, UCIHousing
+from .viterbi import ViterbiDecoder, viterbi_decode
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "ViterbiDecoder", "viterbi_decode"]

@@ -1,0 +1,124 @@
+"""Text datasets (parity: python/paddle/text/datasets/ — Imdb, Imikolov,
+UCIHousing). Zero-egress: each class reads a local ``data_file`` in the
+reference's on-disk format instead of downloading."""
+
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class UCIHousing(Dataset):
+    """Boston-housing regression table: whitespace-separated rows of 14
+    floats (13 features + target), normalized like the reference
+    (uci_housing.py feature scaling)."""
+
+    def __init__(self, data_file: str, mode: str = "train"):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"UCIHousing needs a local copy of the housing data at {data_file} "
+                "(no network access; place the UCI housing.data file there)")
+        raw = np.loadtxt(data_file, dtype=np.float32)
+        if raw.ndim == 1:
+            raw = raw.reshape(-1, 14)
+        feats, target = raw[:, :13], raw[:, 13:]
+        mins, maxs = feats.min(0), feats.max(0)
+        span = np.where(maxs > mins, maxs - mins, 1.0)
+        feats = (feats - feats.mean(0)) / span
+        split = int(len(feats) * 0.8)
+        if mode == "train":
+            self.data = np.concatenate([feats[:split], target[:split]], axis=1)
+        else:
+            self.data = np.concatenate([feats[split:], target[split:]], axis=1)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:13], row[13:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset from the reference's aclImdb tarball layout
+    (imdb.py: tar members aclImdb/{train,test}/{pos,neg}/*.txt)."""
+
+    def __init__(self, data_file: str, mode: str = "train", cutoff: int = 150):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"Imdb needs the aclImdb tarball at {data_file} (no network access)")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs: List[List[str]] = []
+        labels: List[int] = []
+        freq: dict = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode("utf-8", "ignore").lower()
+                words = re.sub(r"[^a-z0-9\s]", "", text).split()
+                docs.append(words)
+                labels.append(0 if m.group(1) == "neg" else 1)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        kept = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in d], np.int64)
+                     for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (imikolov.py): one sentence per line; yields
+    n-gram windows over <s> ... </e> wrapped sentences."""
+
+    def __init__(self, data_file: str, data_type: str = "NGRAM", window_size: int = 5,
+                 mode: str = "train", min_word_freq: int = 50):
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"Imikolov needs a local corpus file at {data_file} (no network access)")
+        with open(data_file, encoding="utf-8") as f:
+            lines = [l.strip().split() for l in f if l.strip()]
+        freq: dict = {}
+        for words in lines:
+            for w in words:
+                freq[w] = freq.get(w, 0) + 1
+        kept = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                if c >= min_word_freq]
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        for tok in ("<s>", "<e>", "<unk>"):
+            if tok not in self.word_idx:
+                self.word_idx[tok] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for words in lines:
+            ids = ([self.word_idx["<s>"]]
+                   + [self.word_idx.get(w, unk) for w in words]
+                   + [self.word_idx["<e>"]])
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(np.array(ids[i:i + window_size], np.int64))
+            else:  # SEQ
+                self.data.append((np.array(ids[:-1], np.int64), np.array(ids[1:], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,85 @@
+"""Viterbi decoding for linear-chain CRF outputs.
+
+Parity: python/paddle/text/viterbi_decode.py (ViterbiDecoder,
+viterbi_decode — kernel phi/kernels/cpu/viterbi_decode_kernel.cc).
+
+TPU design: the max-sum recursion is a lax.scan over time with batched
+[B, N, N] score broadcasting — one fused compiled loop instead of the
+reference's per-step kernel; backtracking is a second scan over the
+argmax history.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops.dispatch import apply_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(potentials, trans, lengths, include_bos_eos_tag: bool):
+    # potentials: [B, T, N]; trans: [N, N]; lengths: [B]
+    B, T, N = potentials.shape
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 = BOS, N-1 = EOS
+        alpha0 = potentials[:, 0] + trans[N - 2][None, :]
+    else:
+        alpha0 = potentials[:, 0]
+
+    def step(carry, t):
+        alpha, _ = carry
+        emit = potentials[:, t]                     # [B, N]
+        scores = alpha[:, :, None] + trans[None]    # [B, N_from, N_to]
+        best_prev = jnp.argmax(scores, axis=1)      # [B, N]
+        best_score = jnp.max(scores, axis=1) + emit
+        # positions beyond the sequence keep their alpha (masked update)
+        live = (t < lengths)[:, None]
+        new_alpha = jnp.where(live, best_score, alpha)
+        return (new_alpha, None), best_prev
+
+    (alpha, _), history = jax.lax.scan(step, (alpha0, None), jnp.arange(1, T))
+    # history: [T-1, B, N]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, N - 1][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)           # [B]
+
+    def backstep(tag, t):
+        prev = history[t]                           # [B, N]
+        new_tag = jnp.take_along_axis(prev, tag[:, None], axis=1)[:, 0]
+        live = (t + 1) < lengths
+        new_tag = jnp.where(live, new_tag, tag)
+        return new_tag, tag
+
+    first_tag, path_rev = jax.lax.scan(backstep, last_tag, jnp.arange(T - 2, -1, -1))
+    # scan outputs are the pre-update tags: [path[T-1], ..., path[1]]; the
+    # final carry is path[0]
+    path = jnp.concatenate([first_tag[None], path_rev[::-1]], axis=0)  # [T, B]
+    return scores, path.T.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Returns (scores [B], paths [B, T]) of the best tag sequences."""
+    lens = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+
+    def fn(pot, trans):
+        return _viterbi(pot, trans, lens, include_bos_eos_tag)
+
+    return apply_op("viterbi_decode", fn, potentials, transition_params)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,162 @@
+"""Custom C++ op extension builder.
+
+Parity: python/paddle/utils/cpp_extension/ (load/setup/CppExtension — the
+JIT build path of custom operators, reference
+fluid/eager/custom_operator/). TPU design: the C++ side is a plain
+C-ABI function over host buffers, compiled with g++ into a shared lib;
+the framework side wraps it with ``jax.pure_callback`` so the custom op
+participates in jit programs (XLA calls back to host for this op —
+matching the reference's host-side custom op execution), and with
+``apply_op`` so it lands on the autograd tape when a backward function
+is registered.
+
+C ABI convention (simplified PD_BUILD_OP):
+    extern "C" void <op>(const float** ins, float* out, const int64_t* shape,
+                         int ndim);
+for single-output float ops; the Python wrapper handles marshalling.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["load", "CppExtension", "CustomOpModule", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Parity: paddle.utils.cpp_extension.CppExtension(sources=...)."""
+
+    def __init__(self, sources: Sequence[str], extra_compile_args: Optional[List[str]] = None,
+                 **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+def _compile(name: str, sources: Sequence[str], extra_cxx_cflags: Sequence[str],
+             build_directory: Optional[str], verbose: bool) -> str:
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    stamp = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            stamp.update(f.read())
+    stamp.update(" ".join(extra_cxx_cflags).encode())
+    so_path = os.path.join(build_dir, f"{name}_{stamp.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *extra_cxx_cflags, *sources, "-o", so_path]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return so_path
+
+
+class CustomOpModule:
+    """Loaded extension: exposes each C symbol as a framework op."""
+
+    def __init__(self, name: str, so_path: str):
+        self.name = name
+        self.so_path = so_path
+        self._lib = ctypes.CDLL(so_path)
+        self._grads: dict = {}
+
+    def register_backward(self, op_name: str, grad_fn: Callable):
+        """grad_fn(cotangent_arrays, input_arrays) -> tuple of input grads
+        (host numpy). Registered ops become differentiable."""
+        self._grads[op_name] = grad_fn
+
+    def _call_c(self, op_name: str, arrays: List[np.ndarray], out_shape) -> np.ndarray:
+        fn = getattr(self._lib, op_name)
+        fn.restype = None
+        ins = (ctypes.POINTER(ctypes.c_float) * len(arrays))()
+        for i, a in enumerate(arrays):
+            ins[i] = a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        out = np.empty(out_shape, np.float32)
+        shape_arr = (ctypes.c_int64 * max(len(out_shape), 1))(*(out_shape or (0,)))
+        fn(ins, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           shape_arr, ctypes.c_int(len(out_shape)))
+        return out
+
+    def __getattr__(self, op_name: str):
+        if op_name.startswith("_"):
+            raise AttributeError(op_name)
+
+        def op(*tensors: Tensor, out_shape=None):
+            shape = tuple(out_shape) if out_shape is not None else tuple(tensors[0].shape)
+
+            def host(*arrays):
+                np_in = [np.ascontiguousarray(np.asarray(a, np.float32)) for a in arrays]
+                return self._call_c(op_name, np_in, shape)
+
+            def fn(*arrays):
+                return jax.pure_callback(
+                    host, jax.ShapeDtypeStruct(shape, jnp.float32), *arrays)
+
+            grad_fn = self._grads.get(op_name)
+            if grad_fn is None:
+                # no backward registered: forward works under autograd (vjp
+                # needs a rule for the callback), backward raises — matching
+                # the reference's "no grad kernel for custom op" error
+                @jax.custom_vjp
+                def nodiff_fn(*arrays):
+                    return fn(*arrays)
+
+                def _fwd(*arrays):
+                    return fn(*arrays), None
+
+                def _bwd(res, g):
+                    raise NotImplementedError(
+                        f"custom op {op_name} has no registered backward; "
+                        "call register_backward() to make it differentiable")
+
+                nodiff_fn.defvjp(_fwd, _bwd)
+                return apply_op(f"custom_{op_name}", nodiff_fn, *tensors)
+
+            @jax.custom_vjp
+            def diff_fn(*arrays):
+                return fn(*arrays)
+
+            def fwd(*arrays):
+                return fn(*arrays), arrays
+
+            def bwd(res, g):
+                in_sds = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in res)
+
+                def host_grad(g, *arrays):
+                    outs = grad_fn(np.asarray(g), [np.asarray(a) for a in arrays])
+                    return tuple(np.asarray(o, np.float32) for o in outs)
+
+                return jax.pure_callback(host_grad, in_sds, g, *res)
+
+            diff_fn.defvjp(fwd, bwd)
+            return apply_op(f"custom_{op_name}", diff_fn, *tensors)
+
+        return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None, verbose: bool = False,
+         **kwargs) -> CustomOpModule:
+    """Compile + load a custom op extension (parity:
+    paddle.utils.cpp_extension.load)."""
+    so_path = _compile(name, sources, list(extra_cxx_cflags or []), build_directory, verbose)
+    return CustomOpModule(name, so_path)

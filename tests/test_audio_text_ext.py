@@ -1,0 +1,200 @@
+"""Audio features/IO, text datasets + Viterbi, cpp_extension, rpc.
+
+Reference patterns: test/legacy_test/test_audio_functions.py,
+test_audio_logmel_feature.py, test_viterbi_decode_op.py (numpy
+brute-force oracle), test/custom_op/ (compile + run + grad), test/rpc/.
+"""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+class TestAudioFunctional:
+    def test_mel_hz_roundtrip(self):
+        from paddle_tpu.audio import functional as AF
+
+        for htk in (False, True):
+            f = np.array([0.0, 100.0, 440.0, 1000.0, 4000.0], "float32")
+            mel = AF.hz_to_mel(paddle.to_tensor(f), htk=htk)
+            back = AF.mel_to_hz(mel, htk=htk)
+            np.testing.assert_allclose(back.numpy(), f, rtol=1e-3, atol=1e-2)
+
+    def test_fbank_shape_and_coverage(self):
+        from paddle_tpu.audio import functional as AF
+
+        fb = np.asarray(AF.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter covers some bins
+
+    def test_spectrogram_matches_numpy_stft(self):
+        rng = np.random.RandomState(0)
+        wav = rng.randn(1, 4000).astype("float32")
+        n_fft, hop = 512, 160
+        layer = audio.Spectrogram(n_fft=n_fft, hop_length=hop, power=2.0, center=True)
+        out = layer(paddle.to_tensor(wav)).numpy()[0]  # [freq, time]
+        # numpy oracle
+        window = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+        padded = np.pad(wav[0], n_fft // 2, mode="reflect")
+        n_frames = 1 + (len(padded) - n_fft) // hop
+        ref = np.empty((n_fft // 2 + 1, n_frames), "float32")
+        for t in range(n_frames):
+            seg = padded[t * hop: t * hop + n_fft] * window
+            ref[:, t] = np.abs(np.fft.rfft(seg)) ** 2
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_logmel_and_mfcc_shapes(self):
+        wav = paddle.to_tensor(np.random.RandomState(1).randn(2, 8000).astype("float32"))
+        logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=64, f_min=50.0)
+        lm = logmel(wav)
+        assert tuple(lm.shape)[:2] == (2, 64)
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=64, f_min=50.0)
+        mf = mfcc(wav)
+        assert tuple(mf.shape)[:2] == (2, 13)
+        assert np.isfinite(mf.numpy()).all()
+
+    def test_wav_save_load_roundtrip(self, tmp_path):
+        sr = 16000
+        wav = np.sin(np.linspace(0, 440 * 2 * np.pi, sr)).astype("float32")[None, :] * 0.5
+        path = str(tmp_path / "t.wav")
+        audio.save(path, paddle.to_tensor(wav), sr)
+        loaded, sr2 = audio.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(loaded.numpy(), wav, atol=1e-3)
+        meta = audio.info(path)
+        assert meta.sample_rate == sr and meta.num_channels == 1
+
+
+class TestViterbi:
+    def _brute_force(self, pot, trans, length, bos_eos):
+        import itertools
+
+        N = pot.shape[-1]
+        best_score, best_path = -1e30, None
+        for path in itertools.product(range(N), repeat=length):
+            s = pot[0, path[0]]
+            if bos_eos:
+                s += trans[N - 2, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+            if bos_eos:
+                s += trans[path[-1], N - 1]
+            if s > best_score:
+                best_score, best_path = s, path
+        return best_score, list(best_path)
+
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_matches_brute_force(self, bos_eos):
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(3)
+        B, T, N = 3, 5, 4
+        pot = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lengths = np.array([T] * B, "int32")
+        scores, paths = viterbi_decode(paddle.to_tensor(pot), paddle.to_tensor(trans),
+                                       paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            ref_s, ref_p = self._brute_force(pot[b], trans, T, bos_eos)
+            assert scores.numpy()[b] == pytest.approx(ref_s, rel=1e-4)
+            assert list(paths.numpy()[b]) == ref_p
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+
+        rng = np.random.RandomState(0)
+        rows = np.hstack([rng.rand(50, 13), rng.rand(50, 1) * 50])
+        path = str(tmp_path / "housing.data")
+        np.savetxt(path, rows)
+        train = UCIHousing(path, mode="train")
+        test = UCIHousing(path, mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imikolov_ngrams(self, tmp_path):
+        from paddle_tpu.text import Imikolov
+
+        path = str(tmp_path / "corpus.txt")
+        with open(path, "w") as f:
+            f.write("the cat sat on the mat\nthe dog sat on the rug\n")
+        ds = Imikolov(path, data_type="NGRAM", window_size=3, min_word_freq=1)
+        assert len(ds) > 0
+        assert all(len(item) == 3 for item in ds)
+
+    def test_imdb_tarball(self, tmp_path):
+        import io
+        import tarfile
+
+        from paddle_tpu.text import Imdb
+
+        tar_path = str(tmp_path / "aclImdb.tar.gz")
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for i, (split, lab, text) in enumerate([
+                    ("train", "pos", b"great movie loved it"),
+                    ("train", "neg", b"terrible movie hated it"),
+                    ("train", "pos", b"great fun"),
+            ]):
+                data = text
+                ti = tarfile.TarInfo(f"aclImdb/{split}/{lab}/{i}.txt")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        ds = Imdb(tar_path, mode="train", cutoff=1)
+        assert len(ds) == 3
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+
+
+class TestCppExtension:
+    def test_compile_load_run_and_grad(self, tmp_path):
+        from paddle_tpu.utils.cpp_extension import load
+
+        src = tmp_path / "myops.cc"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            extern "C" void square_op(const float** ins, float* out,
+                                      const int64_t* shape, int ndim) {
+                int64_t n = 1;
+                for (int i = 0; i < ndim; ++i) n *= shape[i];
+                const float* x = ins[0];
+                for (int64_t i = 0; i < n; ++i) out[i] = x[i] * x[i];
+            }
+        """))
+        mod = load("myops", [str(src)], build_directory=str(tmp_path / "build"))
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"), stop_gradient=False)
+        out = mod.square_op(x)
+        np.testing.assert_allclose(out.numpy(), [1.0, 4.0, 9.0])
+
+        mod.register_backward("square_op", lambda g, ins: (2.0 * ins[0] * g,))
+        out2 = mod.square_op(x)
+        out2.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+class TestRpc:
+    def test_single_worker_sync_async(self):
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc("worker0", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:0")
+        try:
+            info = rpc.get_worker_info("worker0")
+            assert info.rank == 0
+            assert rpc.get_current_worker_info().name == "worker0"
+            out = rpc.rpc_sync("worker0", max, args=((3, 1, 2),))
+            assert out == 3
+            fut = rpc.rpc_async("worker0", pow, args=(2, 10))
+            assert fut.result(timeout=10) == 1024
+            with pytest.raises(ZeroDivisionError):
+                rpc.rpc_sync("worker0", divmod, args=(1, 0))
+        finally:
+            rpc.shutdown()

@@ -136,18 +136,18 @@ class TestElasticRegressions:
 
     def test_watch_callback_exception_does_not_kill_watcher(self):
         store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
-        m1 = ElasticManager(store, "a", np_min=1, heartbeat_interval=0.05, ttl=0.4)
+        m1 = ElasticManager(store, "a", np_min=1, np_max=4, heartbeat_interval=0.05, ttl=0.4)
         good_events = []
         m1.watch(lambda alive: (_ for _ in ()).throw(KeyError("boom")))
         m1.watch(lambda alive: good_events.append(list(alive)))
         m1.start()
-        m2 = ElasticManager(store, "b", np_min=1, heartbeat_interval=0.05, ttl=0.4)
+        m2 = ElasticManager(store, "b", np_min=1, np_max=4, heartbeat_interval=0.05, ttl=0.4)
         m2.start()
         deadline = time.time() + 3
         while not good_events and time.time() < deadline:
             time.sleep(0.05)
         assert good_events  # second callback still ran after the first raised
-        m3 = ElasticManager(store, "c", np_min=1, heartbeat_interval=0.05, ttl=0.4)
+        m3 = ElasticManager(store, "c", np_min=1, np_max=4, heartbeat_interval=0.05, ttl=0.4)
         m3.start()
         deadline = time.time() + 3
         while (not good_events or "c" not in good_events[-1]) and time.time() < deadline:
@@ -155,3 +155,15 @@ class TestElasticRegressions:
         assert "c" in good_events[-1]  # watcher survived the exception
         for m in (m1, m2, m3):
             m.stop()
+
+    def test_np_max_caps_membership(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        m1 = ElasticManager(store, "p0", np_min=1, np_max=1,
+                            heartbeat_interval=0.05, ttl=0.4)
+        m1.start()
+        m2 = ElasticManager(store, "p1", np_min=1, np_max=1,
+                            heartbeat_interval=0.05, ttl=0.4)
+        m2.start()
+        time.sleep(0.5)  # p1 joins but capacity is 1: no restart for m1
+        assert m1.decide() == ElasticStatus.COMPLETED
+        m1.stop(); m2.stop()

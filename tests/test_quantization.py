@@ -140,3 +140,55 @@ class TestConfigRegressions:
         observed = ptq.quantize(model)
         layers = [l for l in observed.sublayers() if hasattr(l, "observer")]
         assert layers and all(isinstance(l.observer, Q.HistObserver) for l in layers)
+
+
+class TestReviewRegressions2:
+    def test_model_eval_freezes_quanter(self):
+        paddle.seed(7)
+        q_model = Q.QAT(Q.QuantConfig()).quantize(Net())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+        q_model(x)
+        q_model.eval()  # Layer.eval must reach the quanters now
+        s_before = q_model.fc1.activation_quanter.scales()
+        q_model(paddle.to_tensor(np.random.RandomState(1).randn(4, 8).astype("float32") * 100))
+        assert q_model.fc1.activation_quanter.scales() == s_before
+        q_model.train()
+        q_model(paddle.to_tensor(np.random.RandomState(1).randn(4, 8).astype("float32") * 100))
+        assert q_model.fc1.activation_quanter.scales() != s_before
+
+    def test_quanted_conv_has_no_inner_fp32_conv(self):
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        q = Q.QAT(Q.QuantConfig()).quantize(ConvNet())
+        assert not any(type(l) is nn.Conv2D for l in q.sublayers())
+        x = paddle.to_tensor(np.random.RandomState(2).randn(1, 3, 8, 8).astype("float32"))
+        assert tuple(q(x).shape) == (1, 4, 8, 8)
+
+    def test_ptq_convert_quantizes_weights(self):
+        paddle.seed(8)
+        model = Net()
+        w_before = model.fc1.weight.numpy().copy()
+        ptq = Q.PTQ()
+        observed = ptq.quantize(model)
+        observed(paddle.to_tensor(np.random.RandomState(3).randn(4, 8).astype("float32")))
+        converted = ptq.convert(observed)
+        frozen = [l for l in converted.sublayers() if hasattr(l, "weight_scales")]
+        assert len(frozen) == 2
+        wq = frozen[0].inner.weight.numpy()
+        assert not np.allclose(wq, w_before)           # weights actually quantized
+        assert np.abs(wq - w_before).max() < 0.05      # but close (int8 grid)
+
+    def test_autotuner_auto_micro_batch(self):
+        from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+        tuner = AutoTuner({"world_size": 4, "dp_degree": "auto", "mp_degree": "auto",
+                           "micro_batch_size": "auto", "sharding_stage": "auto",
+                           "model_cfg": {"hidden_size": 256, "num_layers": 2,
+                                         "vocab_size": 1000, "seq_length": 128}})
+        assert tuner.candidates
