@@ -56,5 +56,6 @@ from . import incubate
 from . import quantization
 from . import audio
 from . import text
+from . import signal
 
 __version__ = "0.1.0"

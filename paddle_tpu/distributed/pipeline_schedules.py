@@ -1,0 +1,280 @@
+"""Pipeline micro-batch schedule generation (Plan/Job layer).
+
+Parity: the reference's static pipeline passes that build per-rank Job
+lists for the StandaloneExecutor Plan —
+python/paddle/distributed/passes/pipeline_scheduler_pass/pipeline_fthenb.py:35,
+pipeline_1f1b.py:39,170 (_create_job_list), pipeline_vpp.py:41
+(interleaved virtual-pipeline), pipeline_zero_bubble.py:38,62,151
+(backward split into dX ("backward_b") and dW ("backward_w") jobs that
+fill bubbles; reference splits matmul_grad at :43).
+
+TPU design: on-chip the whole pipeline compiles into one XLA program
+(pipeline.py gpipe_spmd), so these job lists serve the host-driven path —
+DCN-spanning pipelines and the multi-computation scheduler — exactly the
+Plan/Job role in the reference. ``simulate()`` validates executability
+(every job's data dependencies precede it under a global clock).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Job", "Plan", "create_fthenb_jobs", "create_1f1b_jobs",
+           "create_vpp_jobs", "create_zero_bubble_jobs", "simulate"]
+
+FORWARD = "forward"
+BACKWARD = "backward"
+BACKWARD_B = "backward_b"   # dX only (zero-bubble)
+BACKWARD_W = "backward_w"   # dW only (zero-bubble)
+OPT = "optimizer"
+
+
+@dataclass(frozen=True)
+class Job:
+    type: str
+    stage_id: int
+    micro_batch_id: int
+    chunk_id: int = 0  # virtual-pipeline chunk on this rank
+
+    def __repr__(self):
+        c = f".c{self.chunk_id}" if self.chunk_id else ""
+        return f"{self.type[0].upper()}{self.micro_batch_id}@s{self.stage_id}{c}"
+
+
+@dataclass
+class Plan:
+    """Per-rank ordered job lists (reference: core.Plan of core.Jobs)."""
+
+    jobs_per_rank: List[List[Job]]
+    n_micro: int
+    n_stages: int
+    n_chunks: int = 1
+
+    def rank_jobs(self, rank: int) -> List[Job]:
+        return self.jobs_per_rank[rank]
+
+
+def create_fthenb_jobs(n_micro: int, n_stages: int) -> Plan:
+    """All forwards, then all backwards (+1 optimizer) per rank."""
+    plans = []
+    for rank in range(n_stages):
+        jobs = [Job(FORWARD, rank, m) for m in range(n_micro)]
+        jobs += [Job(BACKWARD, rank, m) for m in range(n_micro)]
+        jobs.append(Job(OPT, rank, -1))
+        plans.append(jobs)
+    return Plan(plans, n_micro, n_stages)
+
+
+def create_1f1b_jobs(n_micro: int, n_stages: int) -> Plan:
+    """Warmup forwards, steady 1F1B interleave, cooldown backwards
+    (reference pipeline_1f1b.py:170 _create_job_list)."""
+    plans = []
+    for rank in range(n_stages):
+        warmup = min(n_stages - rank - 1, n_micro)
+        steady = n_micro - warmup
+        jobs = [Job(FORWARD, rank, m) for m in range(warmup)]
+        f = warmup
+        b = 0
+        for _ in range(steady):
+            jobs.append(Job(FORWARD, rank, f))
+            f += 1
+            jobs.append(Job(BACKWARD, rank, b))
+            b += 1
+        while b < n_micro:
+            jobs.append(Job(BACKWARD, rank, b))
+            b += 1
+        jobs.append(Job(OPT, rank, -1))
+        plans.append(jobs)
+    return Plan(plans, n_micro, n_stages)
+
+
+def create_vpp_jobs(n_micro: int, n_stages: int, n_chunks: int) -> Plan:
+    """Interleaved virtual pipeline (reference pipeline_vpp.py; Megatron
+    interleaved 1F1B): each rank holds ``n_chunks`` model chunks; virtual
+    stage of (rank, chunk) = chunk * n_stages + rank. Forward order visits
+    chunks in groups of ``n_stages`` micro-batches."""
+    assert n_micro % n_stages == 0, "VPP requires micro-batches divisible by stages"
+    plans = []
+    for rank in range(n_stages):
+        fwd_seq: List[Job] = []
+        # forward order: for each chunk round, n_stages micro-batches per chunk
+        for round_start in range(0, n_micro, n_stages):
+            for chunk in range(n_chunks):
+                for m in range(round_start, round_start + n_stages):
+                    fwd_seq.append(Job(FORWARD, rank, m, chunk))
+        bwd_seq = []
+        for round_start in range(0, n_micro, n_stages):
+            for chunk in range(n_chunks - 1, -1, -1):
+                for m in range(round_start, round_start + n_stages):
+                    bwd_seq.append(Job(BACKWARD, rank, m, chunk))
+        # warmup length per Megatron interleaved schedule
+        warmup = min((n_stages - rank - 1) * 2 + (n_chunks - 1) * n_stages,
+                     n_micro * n_chunks)
+        jobs = list(fwd_seq[:warmup])
+        f, b = warmup, 0
+        n_total = n_micro * n_chunks
+        while f < n_total:
+            jobs.append(fwd_seq[f]); f += 1
+            jobs.append(bwd_seq[b]); b += 1
+        while b < n_total:
+            jobs.append(bwd_seq[b]); b += 1
+        jobs.append(Job(OPT, rank, -1))
+        plans.append(jobs)
+    return Plan(plans, n_micro, n_stages, n_chunks)
+
+
+_COST = {FORWARD: 1, BACKWARD: 2, BACKWARD_B: 1, BACKWARD_W: 1, OPT: 0}
+
+
+def create_zero_bubble_jobs(n_micro: int, n_stages: int) -> Plan:
+    """ZB-H1 schedule (reference pipeline_zero_bubble.py): backward is split
+    into B (activation grad, dX — on the critical path) and W (weight grad,
+    dW — fills bubbles). The static per-rank order is built by greedy
+    event-driven list scheduling with priority B > F > W and the 1F1B
+    activation-memory cap, which is exactly the ZB-H1 recipe: dX is never
+    delayed, dW soaks up what would otherwise be idle time."""
+    t_rank = [0] * n_stages
+    done: Dict[Tuple, int] = {}
+    next_f = [0] * n_stages
+    next_b = [0] * n_stages
+    next_w = [0] * n_stages
+    in_flight = [0] * n_stages
+    cap = [min(n_stages - r, n_micro) for r in range(n_stages)]
+    plans: List[List[Job]] = [[] for _ in range(n_stages)]
+    remaining = n_stages * 3 * n_micro
+
+    def f_ready_at(r):
+        if next_f[r] >= n_micro or in_flight[r] >= cap[r]:
+            return None
+        if r == 0:
+            return 0
+        return done.get((FORWARD, r - 1, next_f[r]))
+
+    def b_ready_at(r):
+        if next_b[r] >= n_micro or next_b[r] >= next_f[r]:
+            return None
+        m = next_b[r]
+        t = done.get((FORWARD, n_stages - 1, m))
+        if t is None:
+            return None
+        if r < n_stages - 1:
+            tb = done.get((BACKWARD_B, r + 1, m))
+            if tb is None:
+                return None
+            t = max(t, tb)
+        return t
+
+    while remaining:
+        # pick the rank that can start a job the soonest (ties: lower rank)
+        best = None
+        for r in range(n_stages):
+            cands = []
+            tb = b_ready_at(r)
+            if tb is not None:
+                cands.append((max(t_rank[r], tb), 0, BACKWARD_B))
+            tf = f_ready_at(r)
+            if tf is not None:
+                cands.append((max(t_rank[r], tf), 1, FORWARD))
+            if next_w[r] < next_b[r]:
+                cands.append((t_rank[r], 2, BACKWARD_W))
+            if not cands:
+                continue
+            cands.sort()
+            start, prio, typ = cands[0]
+            if best is None or (start, r) < (best[0], best[1]):
+                best = (start, r, typ)
+        if best is None:
+            raise RuntimeError("zero-bubble scheduler wedged (internal bug)")
+        start, r, typ = best
+        if typ == FORWARD:
+            m = next_f[r]; next_f[r] += 1; in_flight[r] += 1
+        elif typ == BACKWARD_B:
+            m = next_b[r]; next_b[r] += 1; in_flight[r] -= 1
+        else:
+            m = next_w[r]; next_w[r] += 1
+        t_rank[r] = start + _COST[typ]
+        done[(typ, r, m)] = t_rank[r]
+        plans[r].append(Job(typ, r, m))
+        remaining -= 1
+
+    for r in range(n_stages):
+        plans[r].append(Job(OPT, r, -1))
+    return Plan(plans, n_micro, n_stages)
+
+
+def simulate(plan: Plan) -> Dict[str, object]:
+    """Discrete-event executability check: each rank runs its jobs in order;
+    a job waits until its dependencies are done. Costs reflect the split:
+    a full backward = 2 units = one dX (backward_b) + one dW (backward_w).
+
+    Deps: F(s,m,c) needs F(prev virtual stage, m); B(s,m,c) needs F(last
+    virtual stage, m) and B(next virtual stage, m); W(s,m) needs B(s,m);
+    OPT needs all W (or B) on that rank. Returns per-rank finish times and
+    bubble counts; raises on deadlock."""
+    n_stages, n_chunks = plan.n_stages, plan.n_chunks
+    total_v = n_stages * n_chunks
+
+    def vstage(rank, chunk):
+        return chunk * n_stages + rank
+
+    done: Dict[Tuple, int] = {}   # (type, vstage, micro) -> finish time
+    ptr = [0] * n_stages
+    t_rank = [0] * n_stages
+    bubbles = [0] * n_stages
+    total_jobs = sum(len(j) for j in plan.jobs_per_rank)
+    executed = 0
+
+    while executed < total_jobs:
+        progressed = False
+        for rank in range(n_stages):
+            if ptr[rank] >= len(plan.jobs_per_rank[rank]):
+                continue
+            job = plan.jobs_per_rank[rank][ptr[rank]]
+            vs = vstage(rank, job.chunk_id)
+            ready_at = 0
+            if job.type == FORWARD:
+                if vs > 0:
+                    key = (FORWARD, vs - 1, job.micro_batch_id)
+                    if key not in done:
+                        continue
+                    ready_at = done[key]
+            elif job.type in (BACKWARD, BACKWARD_B):
+                key_f = (FORWARD, total_v - 1, job.micro_batch_id)
+                if key_f not in done:
+                    continue
+                ready_at = done[key_f]
+                if vs < total_v - 1:
+                    key_b = (BACKWARD, vs + 1, job.micro_batch_id)
+                    key_b2 = (BACKWARD_B, vs + 1, job.micro_batch_id)
+                    if key_b in done:
+                        ready_at = max(ready_at, done[key_b])
+                    elif key_b2 in done:
+                        ready_at = max(ready_at, done[key_b2])
+                    else:
+                        continue
+            elif job.type == BACKWARD_W:
+                key = (BACKWARD_B, vs, job.micro_batch_id)
+                if key not in done:
+                    continue
+                ready_at = done[key]
+            elif job.type == OPT:
+                need = BACKWARD_W if any(j.type == BACKWARD_W
+                                         for j in plan.jobs_per_rank[rank]) else BACKWARD
+                keys = [(need, vstage(rank, c), m)
+                        for c in range(n_chunks) for m in range(plan.n_micro)]
+                if not all(k in done for k in keys):
+                    continue
+                ready_at = max(done[k] for k in keys)
+            start = max(t_rank[rank], ready_at)
+            bubbles[rank] += start - t_rank[rank]
+            t_rank[rank] = start + _COST[job.type]
+            done[(job.type, vs, job.micro_batch_id)] = t_rank[rank]
+            ptr[rank] += 1
+            executed += 1
+            progressed = True
+        if not progressed:
+            stuck = [(r, plan.jobs_per_rank[r][ptr[r]]) for r in range(n_stages)
+                     if ptr[r] < len(plan.jobs_per_rank[r])]
+            raise RuntimeError(f"pipeline schedule deadlock at {stuck}")
+    return {"finish": max(t_rank), "per_rank_finish": t_rank, "bubbles": bubbles}

@@ -1,0 +1,104 @@
+"""paddle.signal equivalent — stft / istft.
+
+Parity: python/paddle/signal.py (stft:xxx, istft — frame/overlap_add over
+fft ops, reference kernels phi/kernels/cpu/stft_kernel.cc). TPU design:
+framing is a strided gather, overlap-add is a scatter-add, both fused by
+XLA around the batched FFT.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .audio.functional import get_window as _get_window
+from .core.tensor import Tensor
+from .ops.dispatch import apply_op
+
+__all__ = ["stft", "istft"]
+
+
+def _prepare_window(n_fft: int, hop_length: Optional[int], win_length: Optional[int],
+                    window):
+    """Shared stft/istft window setup: defaults, string names (via
+    audio.functional.get_window), Tensor unwrap, center-pad to n_fft."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is None:
+        win = jnp.ones(wl, jnp.float32)
+    elif isinstance(window, str):
+        win = _get_window(window, wl)
+    else:
+        win = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    if win.shape[0] != wl:
+        raise ValueError(f"window length {win.shape[0]} != win_length {wl}")
+    if wl < n_fft:
+        lpad = (n_fft - wl) // 2
+        win = jnp.pad(win, (lpad, n_fft - wl - lpad))
+    return hop, win
+
+
+def stft(x: Tensor, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window: Optional[Tensor] = None,
+         center: bool = True, pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None) -> Tensor:
+    """[..., T] -> complex [..., n_fft//2+1 (or n_fft), n_frames]."""
+    hop, win = _prepare_window(n_fft, hop_length, win_length, window)
+
+    def fn(x, win):
+        h = x
+        if center:
+            pad = [(0, 0)] * (h.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            h = jnp.pad(h, pad, mode="reflect" if pad_mode == "reflect" else "constant")
+        T = h.shape[-1]
+        n_frames = 1 + (T - n_fft) // hop
+        idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+        frames = h[..., idx] * win
+        if onesided:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+
+    return apply_op("stft", fn, x, Tensor(win))
+
+
+def istft(x: Tensor, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window: Optional[Tensor] = None,
+          center: bool = True, normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False, name=None) -> Tensor:
+    """Inverse STFT with window-square overlap-add normalization."""
+    if return_complex and onesided:
+        raise ValueError("return_complex=True requires onesided=False (reference behavior)")
+    hop, win = _prepare_window(n_fft, hop_length, win_length, window)
+
+    def fn(spec, win):
+        s = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        T = n_fft + hop * (n_frames - 1)
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (T,), frames.dtype)
+        wsum = jnp.zeros(T, jnp.float32)
+        idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+        out = out.at[..., idx].add(frames)
+        wsum = wsum.at[idx.reshape(-1)].add(jnp.tile(win * win, n_frames))
+        out = out / jnp.where(wsum > 1e-11, wsum, 1.0)
+        if center:
+            out = out[..., n_fft // 2: T - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op("istft", fn, x, Tensor(win))

@@ -1,0 +1,181 @@
+"""vision.ops (nms/roi_align/roi_pool/box ops) and paddle.signal stft/istft.
+
+Oracles: brute-force numpy NMS, torchvision-style roi checks on constant
+maps, and istft(stft(x)) == x reconstruction (reference test patterns:
+test/legacy_test/test_ops_nms.py, test_roi_align_op.py, test_stft_op.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _nms_numpy(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = ((boxes[order[1:], 2] - boxes[order[1:], 0])
+              * (boxes[order[1:], 3] - boxes[order[1:], 1]))
+        iou = inter / (a1 + a2 - inter)
+        order = order[1:][iou <= thresh]
+    return keep
+
+
+class TestNms:
+    def test_matches_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(40, 2) * 10
+        wh = rng.rand(40, 2) * 4 + 0.5
+        boxes = np.hstack([xy, xy + wh]).astype("float32")
+        scores = rng.rand(40).astype("float32")
+        got = V.nms(paddle.to_tensor(boxes), 0.4, paddle.to_tensor(scores)).numpy()
+        ref = _nms_numpy(boxes, scores, 0.4)
+        assert list(got) == ref
+
+    def test_categories_respected(self):
+        boxes = np.array([[0, 0, 2, 2], [0, 0, 2, 2.01]], "float32")  # near-identical
+        scores = np.array([0.9, 0.8], "float32")
+        cats = np.array([0, 1], "int32")
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    paddle.to_tensor(cats), categories=[0, 1])
+        assert len(got.numpy()) == 2  # different categories: both survive
+
+    def test_box_iou_and_area(self):
+        a = paddle.to_tensor(np.array([[0, 0, 2, 2]], "float32"))
+        b = paddle.to_tensor(np.array([[1, 1, 3, 3], [4, 4, 5, 5]], "float32"))
+        iou = V.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou, [[1 / 7, 0.0]], rtol=1e-6)
+        np.testing.assert_allclose(V.box_area(b).numpy(), [4.0, 1.0])
+
+
+class TestRoi:
+    def test_roi_align_constant_map(self):
+        # constant feature map -> every pooled value equals the constant
+        x = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, "float32"))
+        boxes = paddle.to_tensor(np.array([[2, 2, 10, 10], [0, 0, 15, 15]], "float32"))
+        out = V.roi_align(x, boxes, paddle.to_tensor(np.array([2], "int32")), 4)
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-5)
+
+    def test_roi_align_gradient_ramp(self):
+        # feature = x coordinate; pooled values should increase along width
+        H = W = 16
+        ramp = np.tile(np.arange(W, dtype="float32"), (H, 1))
+        x = paddle.to_tensor(ramp[None, None])
+        boxes = paddle.to_tensor(np.array([[0, 0, 15, 15]], "float32"))
+        out = V.roi_align(x, boxes, paddle.to_tensor(np.array([1], "int32")), 4)[0, 0].numpy()
+        assert np.all(np.diff(out, axis=1) > 0)
+        assert np.allclose(np.diff(out, axis=0), 0, atol=1e-5)
+
+    def test_roi_pool_max_semantics(self):
+        x_np = np.zeros((1, 1, 8, 8), "float32")
+        x_np[0, 0, 3, 3] = 5.0
+        x = paddle.to_tensor(x_np)
+        boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], "float32"))
+        out = V.roi_pool(x, boxes, paddle.to_tensor(np.array([1], "int32")), 2).numpy()
+        assert out.max() == 5.0
+        assert out.shape == (1, 1, 2, 2)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(1)
+        priors = np.abs(rng.rand(10, 4)).astype("float32")
+        priors[:, 2:] = priors[:, :2] + rng.rand(10, 2).astype("float32") + 0.5
+        targets = priors + rng.rand(10, 4).astype("float32") * 0.1
+        var = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+        enc = V.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(targets),
+                          "encode_center_size")
+        dec = V.box_coder(paddle.to_tensor(priors), var, enc, "decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), targets, rtol=1e-4, atol=1e-4)
+
+
+class TestSignal:
+    def test_stft_matches_numpy(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(3, 2000).astype("float32")
+        n_fft, hop = 256, 100
+        win = (0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)).astype("float32")
+        out = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop,
+                                 window=paddle.to_tensor(win), center=True).numpy()
+        padded = np.pad(x, [(0, 0), (n_fft // 2, n_fft // 2)], mode="reflect")
+        n_frames = 1 + (padded.shape[1] - n_fft) // hop
+        ref = np.stack([
+            np.stack([np.fft.rfft(padded[b, t * hop: t * hop + n_fft] * win)
+                      for t in range(n_frames)], axis=1)
+            for b in range(3)])
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_istft_reconstruction(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 1600).astype("float32")
+        n_fft, hop = 256, 64
+        win = (0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop,
+                                  window=paddle.to_tensor(win))
+        rec = paddle.signal.istft(spec, n_fft, hop, window=paddle.to_tensor(win),
+                                  length=1600).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_normalized_and_twosided(self):
+        x = paddle.to_tensor(np.random.RandomState(4).randn(1, 512).astype("float32"))
+        one = paddle.signal.stft(x, 128, 64, normalized=True)
+        two = paddle.signal.stft(x, 128, 64, onesided=False)
+        assert one.shape[1] == 65
+        assert two.shape[1] == 128
+
+
+class TestReviewRegressions:
+    def test_box_coder_3d_decode_axis(self):
+        rng = np.random.RandomState(5)
+        M, N = 6, 3
+        priors = np.abs(rng.rand(M, 4)).astype("float32")
+        priors[:, 2:] = priors[:, :2] + 0.5
+        var = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+        deltas = (rng.rand(N, M, 4).astype("float32") - 0.5) * 0.2
+        out = V.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(deltas),
+                          "decode_center_size", axis=1)
+        assert tuple(out.shape) == (N, M, 4)
+        # row n must equal the 2-D decode of deltas[n]
+        ref0 = V.box_coder(paddle.to_tensor(priors), var, paddle.to_tensor(deltas[0]),
+                           "decode_center_size").numpy()
+        np.testing.assert_allclose(out.numpy()[0], ref0, rtol=1e-5, atol=1e-6)
+
+    def test_roi_align_adaptive_sampling_large_roi(self):
+        # ramp map: adaptive sampling must track the bin centers closely
+        H = W = 32
+        ramp = np.tile(np.arange(W, dtype="float32"), (H, 1))
+        x = paddle.to_tensor(ramp[None, None])
+        boxes = paddle.to_tensor(np.array([[0, 0, 31, 31]], "float32"))
+        out = V.roi_align(x, boxes, paddle.to_tensor(np.array([1], "int32")),
+                          4, sampling_ratio=-1)[0, 0].numpy()
+        # bin centers along x: roi width 31 over 4 bins -> centers at
+        # (b + 0.5)/4 * 31 - 0.5 (aligned)
+        centers = (np.arange(4) + 0.5) / 4 * 31 - 0.5
+        np.testing.assert_allclose(out[0], centers, atol=0.5)
+
+    def test_istft_return_complex_onesided_raises(self):
+        spec = paddle.signal.stft(
+            paddle.to_tensor(np.random.randn(1, 512).astype("float32")), 128, 64)
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            paddle.signal.istft(spec, 128, 64, return_complex=True)
+
+    def test_stft_accepts_string_window(self):
+        x = paddle.to_tensor(np.random.RandomState(6).randn(1, 512).astype("float32"))
+        out = paddle.signal.stft(x, 128, 64, window="hann")
+        assert out.shape[1] == 65
