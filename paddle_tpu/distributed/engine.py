@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..core.autograd import no_grad
 from ..core.tensor import Parameter, Tensor
 from ..optimizer import functional as fopt
 from ..optimizer.lr import LRScheduler
@@ -120,11 +121,16 @@ class ShardedTrainStep:
 
         def forward_loss(params, buffers, inputs, labels):
             def run(params):
-                outs = functional_call(model, {**{k: v for k, v in params.items()},
-                                               **{k: v for k, v in buffers.items()}},
-                                       *[Tensor(x) for x in inputs])
-                outs_t = outs if isinstance(outs, (list, tuple)) else (outs,)
-                loss = loss_fn(*outs_t, *[Tensor(y) for y in labels])
+                # no_grad: the outer jax.value_and_grad owns differentiation;
+                # letting the eager tape also record would make every op's
+                # jax.vjp part of the traced graph — wasted work, and JVP
+                # through Pallas kernels (flash attention) is unsupported
+                with no_grad():
+                    outs = functional_call(model, {**{k: v for k, v in params.items()},
+                                                   **{k: v for k, v in buffers.items()}},
+                                           *[Tensor(x) for x in inputs])
+                    outs_t = outs if isinstance(outs, (list, tuple)) else (outs,)
+                    loss = loss_fn(*outs_t, *[Tensor(y) for y in labels])
                 return loss._data if isinstance(loss, Tensor) else loss
 
             if self._remat:

@@ -1,0 +1,176 @@
+"""Collective hang watchdog.
+
+Parity: paddle/phi/core/distributed/comm_task_manager.h:37
+(CommTaskManager background thread), nccl_comm_task.h:34 (per-collective
+CommTask with IsTimeout/AbortComm), FLAGS_enable_async_trace dump.
+
+TPU design: XLA collectives are compiled, so the hang modes are (a) a
+host-side rendezvous/barrier that never completes (peer died before
+launch) and (b) a dispatched device computation that never resolves
+(ICI/DCN stall — surfaced by PJRT as a never-ready buffer). CommTask here
+wraps both: `watch()` registers a task with a deadline; a background
+manager thread detects expiry, records a diagnosis (matching the
+reference's comm-state dump), and invokes the abort callback — by default
+raising in the waiting thread via the returned task handle.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager", "watch_async"]
+
+
+@dataclass
+class CommTask:
+    """One in-flight communication operation (parity: NCCLCommTask)."""
+
+    name: str
+    group_ranks: tuple
+    started_at: float
+    timeout: float
+    seq: int
+    done: bool = False
+    timed_out: bool = False
+    error: Optional[str] = None
+    _event: threading.Event = field(default_factory=threading.Event, repr=False)
+
+    def is_timeout(self, now: Optional[float] = None) -> bool:
+        if self.done:
+            return False
+        return (now or time.monotonic()) - self.started_at > self.timeout
+
+    def mark_done(self):
+        self.done = True
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until completion or watchdog abort; raises on timeout.
+        Completion wins over a racing timeout mark (a collective that
+        finished at the deadline must not abort training)."""
+        ok = self._event.wait(timeout)
+        if self.timed_out and not self.done:
+            raise TimeoutError(
+                f"collective '{self.name}' (ranks {self.group_ranks}, seq {self.seq}) "
+                f"exceeded {self.timeout}s — {self.error or 'hang detected'}")
+        return ok
+
+
+class CommTaskManager:
+    """Background watchdog over registered CommTasks (parity:
+    CommTaskManager's loop checking IsTimeout + comm-state dump)."""
+
+    def __init__(self, poll_interval: float = 0.2, default_timeout: float = 1800.0):
+        self.poll_interval = poll_interval
+        self.default_timeout = default_timeout
+        self._tasks: List[CommTask] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._abort_hooks: List[Callable[[CommTask], None]] = []
+        self.timeout_history: List[CommTask] = []
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def on_abort(self, hook: Callable[[CommTask], None]):
+        self._abort_hooks.append(hook)
+
+    def register(self, name: str, group_ranks=(), timeout: Optional[float] = None) -> CommTask:
+        with self._lock:
+            self._seq += 1
+            task = CommTask(name=name, group_ranks=tuple(group_ranks),
+                            started_at=time.monotonic(),
+                            timeout=timeout or self.default_timeout, seq=self._seq)
+            self._tasks.append(task)
+        self.start()
+        return task
+
+    def _dump_state(self, task: CommTask) -> str:
+        """Comm-state dump for hang diagnosis (parity: async trace dump)."""
+        with self._lock:
+            pending = [t for t in self._tasks if not t.done]
+        lines = [f"hang diagnosis for '{task.name}' seq={task.seq}:",
+                 f"  pending collectives: {[(t.name, t.seq) for t in pending]}",
+                 f"  stacks of live threads:"]
+        for tid, frame in sys_frames():
+            lines.append(f"  -- thread {tid} --")
+            lines.extend("    " + l for l in traceback.format_stack(frame)[-4:])
+        return "\n".join(lines)
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            now = time.monotonic()
+            with self._lock:
+                expired = [t for t in self._tasks if t.is_timeout(now)]
+                self._tasks = [t for t in self._tasks if not t.done and not t.is_timeout(now)]
+            for t in expired:
+                with self._lock:
+                    if t.done:  # completed between snapshot and mark
+                        continue
+                    t.timed_out = True
+                t.error = self._dump_state(t)
+                self.timeout_history.append(t)
+                for hook in self._abort_hooks:
+                    try:
+                        hook(t)
+                    except Exception:
+                        pass
+                t._event.set()  # release waiters with the timeout flag set
+
+
+def sys_frames():
+    import sys
+
+    return list(sys._current_frames().items())
+
+
+_manager: Optional[CommTaskManager] = None
+_mgr_lock = threading.Lock()
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _manager
+    with _mgr_lock:
+        if _manager is None:
+            _manager = CommTaskManager()
+        return _manager
+
+
+def watch_async(name: str, fn: Callable, *args, timeout: Optional[float] = None,
+                group_ranks=(), **kwargs):
+    """Run a blocking communication call under watchdog supervision: executes
+    ``fn`` in a worker thread, returns its result, raises TimeoutError (with
+    the comm-state dump) if it exceeds the deadline."""
+    mgr = get_comm_task_manager()
+    task = mgr.register(name, group_ranks, timeout)
+    result: Dict[str, object] = {}
+
+    def runner():
+        try:
+            result["value"] = fn(*args, **kwargs)
+        except Exception as e:
+            result["exc"] = e
+        finally:
+            task.mark_done()
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    task.wait()
+    if "exc" in result:
+        raise result["exc"]
+    return result.get("value")

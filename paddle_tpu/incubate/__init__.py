@@ -4,7 +4,8 @@ Parity: python/paddle/incubate/ (nn.functional fused ops,
 distributed.models.moe, asp stubs).
 """
 
+from . import asp
 from . import nn
 from . import distributed
 
-__all__ = ["nn", "distributed"]
+__all__ = ["asp", "nn", "distributed"]

@@ -1,0 +1,162 @@
+"""Automatic SParsity (ASP) — n:m structured sparsity workflow.
+
+Parity: python/paddle/incubate/asp/ (prune_model, decorate,
+calculate_density, check_sparsity, set/reset_excluded_layers; mask algos
+utils.py get_mask_1d/get_mask_2d_best). TPU note: n:m masks keep the
+matmul shapes static — XLA treats masked weights as dense bf16, so ASP
+here is a training-workflow feature (mask maintenance across optimizer
+steps) exactly like the reference's pre-Ampere CPU path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["calculate_density", "check_sparsity", "get_mask_1d", "get_mask_2d_best",
+           "prune_model", "decorate", "set_excluded_layers", "reset_excluded_layers"]
+
+_excluded: Dict[int, List[str]] = {}
+# id(param) -> (weakref to param, mask): the weakref guards against both
+# leak-forever growth and id() reuse applying a dead model's mask
+_masks: Dict[int, tuple] = {}
+
+
+def _set_mask(p, mask: np.ndarray):
+    import weakref
+
+    _masks[id(p)] = (weakref.ref(p), mask)
+
+
+def _get_mask(p) -> Optional[np.ndarray]:
+    entry = _masks.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:  # param died and id was reused
+        del _masks[id(p)]
+        return None
+    return mask
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-|w| entries of every m-length group along the last
+    dim (parity: asp/utils.py get_mask_1d)."""
+    shape = mat.shape
+    flat = np.abs(mat.reshape(-1, m))
+    order = np.argsort(-flat, axis=1)
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(shape)
+
+
+_VALID_2D_PATTERNS: Dict[tuple, np.ndarray] = {}
+
+
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m×m 0/1 blocks with every row AND column summing to n (parity:
+    asp/utils.py compute_valid_2d_patterns). 90 patterns for 2:4."""
+    key = (n, m)
+    if key not in _VALID_2D_PATTERNS:
+        import itertools
+
+        rows = [np.array([1 if i in c else 0 for i in range(m)])
+                for c in itertools.combinations(range(m), n)]
+        pats = [np.stack(combo) for combo in itertools.product(rows, repeat=m)
+                if (np.stack(combo).sum(0) == n).all()]
+        _VALID_2D_PATTERNS[key] = np.stack(pats).astype(bool)
+    return _VALID_2D_PATTERNS[key]
+
+
+def get_mask_2d_best(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Exhaustive n:m mask over m×m blocks satisfying n:m along BOTH dims,
+    maximizing retained |w| (parity: asp/utils.py get_mask_2d_best)."""
+    if mat.ndim < 2 or mat.shape[-1] % m or mat.shape[-2] % m:
+        raise ValueError(f"get_mask_2d_best needs trailing dims divisible by {m}")
+    pats = _valid_2d_patterns(n, m)           # [P, m, m]
+    lead = mat.shape[:-2]
+    R, C = mat.shape[-2], mat.shape[-1]
+    a = np.abs(mat.reshape(-1, R // m, m, C // m, m).transpose(0, 1, 3, 2, 4))
+    blocks = a.reshape(-1, m, m)              # [B, m, m]
+    scores = np.einsum("bij,pij->bp", blocks, pats)
+    best = pats[np.argmax(scores, axis=1)]    # [B, m, m]
+    mask = best.reshape(-1, R // m, C // m, m, m).transpose(0, 1, 3, 2, 4)
+    return mask.reshape(mat.shape)
+
+
+def check_sparsity(mat, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    if arr.size % m:
+        return False
+    groups = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def set_excluded_layers(model, layer_names: List[str]):
+    _excluded[id(model)] = list(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _excluded.clear()
+    else:
+        _excluded.pop(id(model), None)
+
+
+def _prunable(model, m: int = 4):
+    excluded = set(_excluded.get(id(model), []))
+    for name, layer in model.named_sublayers():
+        if name in excluded:
+            continue
+        if isinstance(layer, (nn.Linear, nn.Conv2D)) and hasattr(layer, "weight"):
+            w = layer.weight
+            if int(w.shape[-1]) % m == 0:  # per-row n:m groups must not span rows
+                yield name, layer
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Compute and apply n:m masks to all prunable weights; masks are
+    remembered so `decorate`d optimizers re-apply them after each step."""
+    algo = {"mask_1d": get_mask_1d, "mask_2d_best": get_mask_2d_best,
+            "mask_2d_greedy": get_mask_2d_best}[mask_algo]
+    pruned = {}
+    for name, layer in _prunable(model, m):
+        w = layer.weight
+        arr = np.asarray(w._data, np.float32)
+        if algo is not get_mask_1d and (arr.ndim < 2 or arr.shape[-2] % m):
+            mask = get_mask_1d(arr, n, m)  # 2-D pattern needs both dims divisible
+        else:
+            mask = algo(arr, n, m)
+        w._data = (jnp.asarray(arr * mask)).astype(w._data.dtype)
+        if with_mask:
+            _set_mask(w, mask)
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so masked weights stay pruned after updates
+    (parity: OptimizerWithSparsityGuarantee — mask re-applied post-step)."""
+    inner_step = optimizer.step
+
+    def step_with_masks(*args, **kwargs):
+        out = inner_step(*args, **kwargs)
+        for p in optimizer._parameter_list:
+            mask = _get_mask(p)
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask, p._data.dtype)
+        return out
+
+    optimizer.step = step_with_masks
+    return optimizer
