@@ -1,0 +1,155 @@
+// Dependency-graph job scheduler with a worker thread pool.
+//
+// Parity: the reference's async instruction executor —
+// paddle/fluid/framework/new_executor/pir_interpreter.cc:1508
+// (MultiThreadRunImpl over new_executor/workqueue/) and the fleet_executor
+// Carrier/Interceptor graph (paddle/fluid/distributed/fleet_executor/).
+//
+// TPU role: orders host-side jobs (micro-batch stage launches, H2D feeds,
+// checkpoint writes) respecting a dependency DAG. Each job invokes a
+// caller-provided C callback (Python via ctypes CFUNCTYPE — callbacks that
+// dispatch XLA executables release the GIL inside jax, so pool workers
+// overlap device work with host scheduling).
+//
+// C ABI (ctypes-friendly, no C++ types across the boundary):
+//   jsched_new(n_workers)                        -> handle
+//   jsched_add_job(h, user_tag)                  -> job id (>=0)
+//   jsched_add_dep(h, before_id, after_id)       -> 0/-1
+//   jsched_run(h, cb, ctx)                       -> 0 ok, -1 error/cycle,
+//        cb: void(*)(long job_id, long user_tag, void* ctx) called from
+//        worker threads; jobs whose deps all completed run concurrently.
+//   jsched_reset(h)  (keep graph, clear completion state for re-run)
+//   jsched_free(h)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Job {
+  int64_t tag;
+  std::vector<int> deps;      // jobs this one waits for
+  std::vector<int> dependents;
+  int pending = 0;            // guarded by Scheduler::mu
+};
+
+struct Scheduler {
+  int n_workers;
+  std::vector<Job*> jobs;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::queue<int> ready;
+  int remaining = 0;          // guarded by mu
+  int running = 0;            // guarded by mu
+  bool failed = false;        // guarded by mu; set on cycle detection
+
+  explicit Scheduler(int workers) : n_workers(workers < 1 ? 1 : workers) {}
+  ~Scheduler() {
+    for (auto* j : jobs) delete j;
+  }
+};
+
+using Callback = void (*)(int64_t, int64_t, void*);
+
+void worker_loop(Scheduler* s, Callback cb, void* ctx) {
+  for (;;) {
+    int id;
+    {
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait(lk, [&] {
+        return !s->ready.empty() || s->remaining == 0 || s->failed ||
+               (s->running == 0 && s->ready.empty());
+      });
+      if (s->failed || s->remaining == 0) {
+        s->cv.notify_all();
+        return;
+      }
+      if (s->ready.empty()) {
+        // nothing runnable, nothing running, jobs remain: dependency cycle
+        s->failed = true;
+        s->cv.notify_all();
+        return;
+      }
+      id = s->ready.front();
+      s->ready.pop();
+      s->running++;
+    }
+    cb(id, s->jobs[id]->tag, ctx);
+    bool finished;
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->running--;
+      s->remaining--;
+      for (int d : s->jobs[id]->dependents) {
+        if (--s->jobs[d]->pending == 0) s->ready.push(d);
+      }
+      finished = (s->remaining == 0);
+      s->cv.notify_all();
+    }
+    if (finished) return;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* jsched_new(int n_workers) { return new Scheduler(n_workers); }
+
+void jsched_free(void* h) { delete static_cast<Scheduler*>(h); }
+
+int64_t jsched_add_job(void* h, int64_t tag) {
+  auto* s = static_cast<Scheduler*>(h);
+  auto* j = new Job();
+  j->tag = tag;
+  s->jobs.push_back(j);
+  return static_cast<int64_t>(s->jobs.size()) - 1;
+}
+
+int jsched_add_dep(void* h, int64_t before, int64_t after) {
+  auto* s = static_cast<Scheduler*>(h);
+  if (before < 0 || after < 0 || before >= (int64_t)s->jobs.size() ||
+      after >= (int64_t)s->jobs.size() || before == after)
+    return -1;
+  s->jobs[before]->dependents.push_back(static_cast<int>(after));
+  s->jobs[after]->deps.push_back(static_cast<int>(before));
+  return 0;
+}
+
+int jsched_run(void* h, Callback cb, void* ctx) {
+  auto* s = static_cast<Scheduler*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    while (!s->ready.empty()) s->ready.pop();
+    s->failed = false;
+    s->running = 0;
+    s->remaining = static_cast<int>(s->jobs.size());
+    for (size_t i = 0; i < s->jobs.size(); ++i) {
+      s->jobs[i]->pending = static_cast<int>(s->jobs[i]->deps.size());
+      if (s->jobs[i]->deps.empty()) s->ready.push(static_cast<int>(i));
+    }
+    if (s->jobs.empty()) return 0;
+    if (s->ready.empty()) return -1;  // no roots: cycle
+  }
+  std::vector<std::thread> threads;
+  int n = s->n_workers;
+  for (int i = 0; i < n; ++i) threads.emplace_back(worker_loop, s, cb, ctx);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->cv.notify_all();
+  }
+  for (auto& t : threads) t.join();
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->remaining == 0 ? 0 : -1;  // nonzero remaining: cycle/deadlock
+}
+
+int jsched_n_jobs(void* h) {
+  return static_cast<int>(static_cast<Scheduler*>(h)->jobs.size());
+}
+
+}  // extern "C"

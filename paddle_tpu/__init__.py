@@ -57,5 +57,6 @@ from . import quantization
 from . import audio
 from . import text
 from . import signal
+from . import onnx
 
 __version__ = "0.1.0"

@@ -1,0 +1,192 @@
+"""Native dependency-graph job executor.
+
+Parity: the async-workqueue instruction execution of PirInterpreter
+(paddle/fluid/framework/new_executor/pir_interpreter.cc:1508
+MultiThreadRunImpl + new_executor/workqueue/) and the fleet_executor
+Carrier (paddle/fluid/distributed/fleet_executor/fleet_executor.h:36).
+
+The C++ pool (csrc/job_scheduler.cc) orders jobs by their dependency DAG;
+Python callbacks that dispatch compiled XLA executables release the GIL
+inside jax, so host scheduling overlaps device work. A pure-Python
+fallback keeps the API working without the native build.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+
+from .native import JSCHED_CALLBACK, get_native
+
+__all__ = ["JobGraphExecutor", "execute_plan"]
+
+
+class JobGraphExecutor:
+    """Build a DAG of callables; run() executes them respecting deps with
+    ``n_workers`` concurrent workers (native pool when available)."""
+
+    def __init__(self, n_workers: int = 4, use_native: Optional[bool] = None):
+        self.n_workers = max(1, n_workers)
+        self._jobs: List[Callable[[], None]] = []
+        self._deps: List[Tuple[int, int]] = []  # (before, after)
+        lib = get_native() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("native job scheduler requested but csrc build unavailable")
+        self._lib = lib
+
+    def add_job(self, fn: Callable[[], None]) -> int:
+        self._jobs.append(fn)
+        return len(self._jobs) - 1
+
+    def add_dep(self, before: int, after: int) -> None:
+        nj = len(self._jobs)
+        if not (0 <= before < nj and 0 <= after < nj) or before == after:
+            raise ValueError(f"invalid dependency {before}->{after}")
+        self._deps.append((before, after))
+
+    # -- execution --
+    def run(self) -> None:
+        if self._lib is not None:
+            self._run_native()
+        else:
+            self._run_python()
+
+    def _run_native(self):
+        h = self._lib.jsched_new(self.n_workers)
+        try:
+            for i in range(len(self._jobs)):
+                self._lib.jsched_add_job(h, i)
+            for before, after in self._deps:
+                if self._lib.jsched_add_dep(h, before, after) != 0:
+                    raise ValueError(f"bad dependency {before}->{after}")
+            errors: List[BaseException] = []
+
+            @JSCHED_CALLBACK
+            def cb(job_id, tag, ctx):
+                if errors:  # a prior job failed: skip side effects downstream
+                    return
+                try:
+                    self._jobs[job_id]()
+                except BaseException as e:  # keep the pool alive; re-raise after
+                    errors.append(e)
+
+            rc = self._lib.jsched_run(h, cb, None)
+            if errors:
+                raise errors[0]
+            if rc != 0:
+                raise RuntimeError("job graph has a dependency cycle")
+        finally:
+            self._lib.jsched_free(h)
+
+    def _run_python(self):
+        n = len(self._jobs)
+        pending = [0] * n
+        dependents: List[List[int]] = [[] for _ in range(n)]
+        for before, after in self._deps:
+            pending[after] += 1
+            dependents[before].append(after)
+        from collections import deque
+
+        ready = deque(i for i in range(n) if pending[i] == 0)
+        done = [0]
+        active = [0]
+        lock = threading.Lock()
+        errors: List[BaseException] = []
+        finished = threading.Event()
+        if n == 0:
+            return
+
+        def worker():
+            while not finished.is_set():
+                # claim-or-diagnose atomically (mirrors the C++ pool's
+                # pop + running++ under one mutex; avoids a spurious
+                # cycle report while a peer holds an unclaimed job)
+                with lock:
+                    if ready:
+                        i = ready.popleft()
+                        active[0] += 1
+                    elif active[0] == 0 and done[0] < n:
+                        finished.set()  # true deadlock: nothing runnable or running
+                        return
+                    else:
+                        i = None
+                if i is None:
+                    time.sleep(0.002)
+                    continue
+                try:
+                    self._jobs[i]()
+                except BaseException as e:
+                    with lock:
+                        errors.append(e)
+                    finished.set()
+                    return
+                with lock:
+                    active[0] -= 1
+                    done[0] += 1
+                    for d in dependents[i]:
+                        pending[d] -= 1
+                        if pending[d] == 0:
+                            ready.append(d)
+                    if done[0] == n:
+                        finished.set()
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(self.n_workers)]
+        for t in threads:
+            t.start()
+        finished.wait(timeout=600)
+        if errors:
+            raise errors[0]
+        if done[0] != n:
+            raise RuntimeError("job graph has a dependency cycle (or worker timeout)")
+
+
+def execute_plan(plan, handlers: Dict[str, Callable], n_workers: int = 4,
+                 use_native: Optional[bool] = None) -> None:
+    """Execute a pipeline Plan (distributed.pipeline_schedules.Plan) over
+    callables per job type: handlers[type](stage_id, micro_batch_id,
+    chunk_id). Builds the cross-rank dependency DAG (same rules the
+    schedule simulator validates) and runs it on the worker pool — the
+    host-driven Plan/Job execution path."""
+    from ..distributed.pipeline_schedules import (BACKWARD, BACKWARD_B, BACKWARD_W,
+                                                  FORWARD, OPT)
+
+    ex = JobGraphExecutor(n_workers=n_workers, use_native=use_native)
+    n_stages, n_chunks = plan.n_stages, plan.n_chunks
+    total_v = n_stages * n_chunks
+
+    def vstage(rank, chunk):
+        return chunk * n_stages + rank
+
+    ids: Dict[Tuple, int] = {}
+    for rank in range(n_stages):
+        prev = None
+        for job in plan.rank_jobs(rank):
+            fn = handlers.get(job.type)
+            if fn is None:
+                continue
+            jid = ex.add_job(lambda f=fn, j=job: f(j.stage_id, j.micro_batch_id, j.chunk_id))
+            ids[(job.type, vstage(rank, job.chunk_id), job.micro_batch_id)] = jid
+            if prev is not None:
+                ex.add_dep(prev, jid)  # per-rank program order
+            prev = jid
+    # cross-rank data deps
+    for (typ, vs, m), jid in ids.items():
+        if typ == FORWARD and vs > 0:
+            dep = ids.get((FORWARD, vs - 1, m))
+            if dep is not None:
+                ex.add_dep(dep, jid)
+        elif typ in (BACKWARD, BACKWARD_B):
+            dep = ids.get((FORWARD, total_v - 1, m))
+            if dep is not None:
+                ex.add_dep(dep, jid)
+            if vs < total_v - 1:
+                for t in (BACKWARD, BACKWARD_B):
+                    dep = ids.get((t, vs + 1, m))
+                    if dep is not None:
+                        ex.add_dep(dep, jid)
+        elif typ == BACKWARD_W:
+            dep = ids.get((BACKWARD_B, vs, m))
+            if dep is not None:
+                ex.add_dep(dep, jid)
+    ex.run()

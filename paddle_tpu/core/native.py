@@ -23,6 +23,9 @@ _tried = False
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
 _SO = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
 
+# callback signature for the native job scheduler: (job_id, user_tag, ctx)
+JSCHED_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p)
+
 
 def _stale() -> bool:
     if not os.path.exists(_SO):
@@ -96,6 +99,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pth_tracer_dropped.restype = c.c_uint64
     lib.pth_tracer_drain.restype = c.c_uint64
     lib.pth_tracer_drain.argtypes = [c.c_void_p, c.c_uint64]
+    # job scheduler (csrc/job_scheduler.cc)
+    lib.jsched_new.restype = c.c_void_p
+    lib.jsched_new.argtypes = [c.c_int]
+    lib.jsched_free.argtypes = [c.c_void_p]
+    lib.jsched_add_job.restype = c.c_int64
+    lib.jsched_add_job.argtypes = [c.c_void_p, c.c_int64]
+    lib.jsched_add_dep.restype = c.c_int
+    lib.jsched_add_dep.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+    lib.jsched_run.restype = c.c_int
+    lib.jsched_run.argtypes = [c.c_void_p, JSCHED_CALLBACK, c.c_void_p]
+    lib.jsched_n_jobs.restype = c.c_int
+    lib.jsched_n_jobs.argtypes = [c.c_void_p]
 
 
 def get_native():

@@ -96,7 +96,9 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None, group=None
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be 'os'|'os_g'|'p_g_os', got {level!r}")
     mesh = _dp_mesh(group)
-    n = int(np.prod(mesh.shape))
+    # shard over the mesh's FIRST axis only; divisibility must be checked
+    # against that axis's size, not the total device count
+    n = int(mesh.shape[0])
     if n <= 1:
         return model, optimizer, scaler
 

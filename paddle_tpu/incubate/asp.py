@@ -19,7 +19,8 @@ from .. import nn
 from ..core.tensor import Tensor
 
 __all__ = ["calculate_density", "check_sparsity", "get_mask_1d", "get_mask_2d_best",
-           "prune_model", "decorate", "set_excluded_layers", "reset_excluded_layers"]
+           "get_mask_2d_greedy", "prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers"]
 
 _excluded: Dict[int, List[str]] = {}
 # id(param) -> (weakref to param, mask): the weakref guards against both
@@ -78,11 +79,43 @@ def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
     return _VALID_2D_PATTERNS[key]
 
 
+def get_mask_2d_greedy(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy n:m mask over m×m blocks: repeatedly keep the largest |w|
+    whose row and column quotas (< n) are both open (parity: asp/utils.py
+    get_mask_2d_greedy — near-linear, works for any m)."""
+    if mat.ndim < 2 or mat.shape[-1] % m or mat.shape[-2] % m:
+        raise ValueError(f"get_mask_2d_greedy needs trailing dims divisible by {m}")
+    lead = mat.shape[:-2]
+    R, C = mat.shape[-2], mat.shape[-1]
+    a = np.abs(mat.reshape(-1, R // m, m, C // m, m).transpose(0, 1, 3, 2, 4)).reshape(-1, m, m)
+    masks = np.zeros_like(a, dtype=bool)
+    for b in range(a.shape[0]):
+        order = np.argsort(-a[b].ravel())
+        rows = np.zeros(m, np.int64)
+        cols = np.zeros(m, np.int64)
+        taken = 0
+        for idx in order:
+            r, c = divmod(int(idx), m)
+            if rows[r] < n and cols[c] < n:
+                masks[b, r, c] = True
+                rows[r] += 1
+                cols[c] += 1
+                taken += 1
+                if taken == n * m:
+                    break
+    mask = masks.reshape(-1, R // m, C // m, m, m).transpose(0, 1, 3, 2, 4)
+    return mask.reshape(mat.shape)
+
+
 def get_mask_2d_best(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
     """Exhaustive n:m mask over m×m blocks satisfying n:m along BOTH dims,
-    maximizing retained |w| (parity: asp/utils.py get_mask_2d_best)."""
+    maximizing retained |w| (parity: asp/utils.py get_mask_2d_best).
+    Pattern enumeration is C(m,n)^m, so only small groups are exact; larger
+    m falls back to the greedy variant."""
     if mat.ndim < 2 or mat.shape[-1] % m or mat.shape[-2] % m:
         raise ValueError(f"get_mask_2d_best needs trailing dims divisible by {m}")
+    if m > 4:
+        return get_mask_2d_greedy(mat, n, m)
     pats = _valid_2d_patterns(n, m)           # [P, m, m]
     lead = mat.shape[:-2]
     R, C = mat.shape[-2], mat.shape[-1]
@@ -129,7 +162,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
     """Compute and apply n:m masks to all prunable weights; masks are
     remembered so `decorate`d optimizers re-apply them after each step."""
     algo = {"mask_1d": get_mask_1d, "mask_2d_best": get_mask_2d_best,
-            "mask_2d_greedy": get_mask_2d_best}[mask_algo]
+            "mask_2d_greedy": get_mask_2d_greedy}[mask_algo]
     pruned = {}
     for name, layer in _prunable(model, m):
         w = layer.weight

@@ -1,0 +1,35 @@
+"""paddle.onnx equivalent — model export entry point.
+
+Parity: python/paddle/onnx/export.py (paddle.onnx.export, which delegates
+to paddle2onnx). Export writes the framework's portable program artifact
+(serialized StableHLO via jit.save — an open interchange format consumable
+by ONNX-MLIR/IREE toolchains). Callers that require true .onnx protobuf
+output pass ``require_onnx=True`` and get an explicit NotImplementedError
+until a StableHLO->ONNX translation lands.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 9, **configs) -> str:
+    """Export ``layer`` for external serving. Writes the StableHLO program
+    artifact at ``path`` (+ .pdmodel/.pdiparams/.pdmeta) and returns the
+    written prefix; raises if true ONNX protobuf output is requested but
+    unavailable."""
+    from .jit.save_load import save as jit_save
+
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit_save(layer, prefix, input_spec=input_spec)
+    if configs.get("require_onnx"):
+        # only an explicit request for protobuf output errors; the default
+        # contract is the portable StableHLO artifact
+        raise NotImplementedError(
+            "StableHLO->ONNX graph translation is not implemented; consume the "
+            f"serialized program at {prefix}.pdmodel instead")
+    return prefix

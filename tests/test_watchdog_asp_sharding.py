@@ -212,3 +212,28 @@ class TestReviewRegressions:
         t.timed_out = True
         t.mark_done()
         assert t.wait(timeout=1)  # must NOT raise
+
+    def test_mask_2d_greedy_large_m_fast(self):
+        rng = np.random.RandomState(9)
+        w = rng.randn(16, 16).astype("float32")
+        t0 = time.time()
+        mask = asp.get_mask_2d_greedy(w, 4, 8)
+        assert time.time() - t0 < 5
+        assert asp.check_sparsity(w * mask, 4, 8)
+        assert asp.check_sparsity((w * mask).T.copy(), 4, 8)
+
+    def test_group_sharded_multi_axis_mesh_uses_dp_size(self):
+        import jax
+        from paddle_tpu.distributed import group_sharded_parallel
+        from paddle_tpu.distributed.mesh import ProcessMesh
+
+        n = len(jax.devices())
+        if n < 4:
+            pytest.skip("needs >=4 devices")
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 2 * (n // 2)))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        mesh = ProcessMesh(np.arange(n).reshape(2, n // 2), ["dp", "mp"])
+        model, opt, _ = group_sharded_parallel(model, opt, "p_g_os", group=mesh)
+        # divisibility checked against dp size (2), so (16, x) weight shards
+        assert any("dp" in str(p._data.sharding.spec) for p in model.parameters())
