@@ -134,11 +134,15 @@ class JobGraphExecutor:
         threads = [threading.Thread(target=worker, daemon=True) for _ in range(self.n_workers)]
         for t in threads:
             t.start()
-        finished.wait(timeout=600)
+        # no overall timeout: cycle detection and error propagation both set
+        # `finished`, and jobs may legitimately run for hours
+        finished.wait()
+        for t in threads:
+            t.join(timeout=5)
         if errors:
             raise errors[0]
         if done[0] != n:
-            raise RuntimeError("job graph has a dependency cycle (or worker timeout)")
+            raise RuntimeError("job graph has a dependency cycle")
 
 
 def execute_plan(plan, handlers: Dict[str, Callable], n_workers: int = 4,

@@ -408,8 +408,39 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format, count_include_
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
-               data_format="NCHW", name=None) -> Tensor:
+               data_format="NCHW", name=None):
     x = ensure_tensor(x)
+    if return_mask:
+        ks = _pair(kernel_size)
+        st = ks if stride is None else _pair(stride)
+        pd = _pair(padding) if not isinstance(padding, int) else (padding, padding)
+
+        def _f(a):
+            if data_format != "NCHW":
+                a = jnp.transpose(a, (0, 3, 1, 2))
+            N, C, H, W = a.shape
+            ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                         constant_values=-jnp.inf)
+            oh = (H + 2 * pd[0] - ks[0]) // st[0] + 1
+            ow = (W + 2 * pd[1] - ks[1]) // st[1] + 1
+            iy = (jnp.arange(oh)[:, None] * st[0] + jnp.arange(ks[0])[None, :])  # [oh,kh]
+            ix = (jnp.arange(ow)[:, None] * st[1] + jnp.arange(ks[1])[None, :])  # [ow,kw]
+            win = ap[:, :, iy[:, None, :, None], ix[None, :, None, :]]  # [N,C,oh,ow,kh,kw]
+            win = win.reshape(N, C, oh, ow, ks[0] * ks[1])
+            arg = jnp.argmax(win, axis=-1)
+            pooled = jnp.take_along_axis(win, arg[..., None], axis=-1)[..., 0]
+            # flat index into the UNPADDED input (reference mask semantics)
+            dy = arg // ks[1]
+            dx = arg % ks[1]
+            yy = iy[:, 0][None, None, :, None] + dy - pd[0]
+            xx = ix[:, 0][None, None, None, :] + dx - pd[1]
+            mask = (yy * W + xx).astype(jnp.int32)
+            if data_format != "NCHW":
+                pooled = jnp.transpose(pooled, (0, 2, 3, 1))
+                mask = jnp.transpose(mask, (0, 2, 3, 1))
+            return pooled, mask
+
+        return apply_op("max_pool2d_with_mask", _f, x)
     f = _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format)
     return apply_op("max_pool2d", f, x)
 
@@ -1034,3 +1065,7 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None) 
 
 def linear_with_quant(*args, **kwargs):
     raise NotImplementedError("quantized linear lands with the quantization subsystem")
+
+
+# extended functional surface (vision sampling, CTC, pooling variants, loss zoo)
+from .functional_extra import *  # noqa: F401,F403,E402

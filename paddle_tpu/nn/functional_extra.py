@@ -1,0 +1,466 @@
+"""Functional op surface, continued: sampling grids, CTC, pooling variants,
+loss zoo completion.
+
+Parity targets: python/paddle/nn/functional/vision.py (grid_sample,
+affine_grid, pixel_unshuffle, channel_shuffle), loss.py (ctc_loss,
+huber/dice/triplet/poisson_nll/soft_margin/multi_label losses), common.py
+(fold, sequence_mask, class_center_sample), pooling.py (max_unpool2d,
+lp_pool2d), input.py (embedding_bag). All pure jax; CTC's recursion is a
+lax.scan (one compiled loop on TPU rather than the reference's
+warp-level CUDA kernel phi/kernels/gpu/ctc_align_kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+# shared helpers from the main functional module (defined before its tail
+# import of this file, so no cycle)
+from .functional import _pair, _reduce_loss as _reduce
+
+__all__ = [
+    "grid_sample", "affine_grid", "pixel_unshuffle", "channel_shuffle",
+    "pairwise_distance", "fold", "sequence_mask", "embedding_bag",
+    "max_unpool2d", "lp_pool2d", "ctc_loss",
+    "huber_loss", "dice_loss", "square_error_cost", "poisson_nll_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "triplet_margin_loss",
+    "feature_alpha_dropout", "class_center_sample",
+]
+
+
+# ---------------------------------------------------------------- vision
+
+def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
+                align_corners: bool = True, name=None) -> Tensor:
+    """Sample x [N,C,H,W] at normalized grid [N,Ho,Wo,2] coords in [-1,1]
+    (parity: F.grid_sample; kernel phi/kernels/gpu/grid_sample_kernel)."""
+
+    def fn(x, grid):
+        N, C, H, W = x.shape
+        gx = grid[..., 0]
+        gy = grid[..., 1]
+        if align_corners:
+            fx = (gx + 1) * 0.5 * (W - 1)
+            fy = (gy + 1) * 0.5 * (H - 1)
+        else:
+            fx = ((gx + 1) * W - 1) * 0.5
+            fy = ((gy + 1) * H - 1) * 0.5
+
+        def sample(img, yy, xx):
+            # img [C,H,W]; yy/xx [Ho,Wo] float pixel coords
+            if padding_mode == "border":
+                yyc = jnp.clip(yy, 0, H - 1)
+                xxc = jnp.clip(xx, 0, W - 1)
+                inb = jnp.ones_like(yy, bool)
+            elif padding_mode == "reflection":
+                # triangle wave that is identity on [0, span] and mirrors
+                # outside: span - |mod(y, 2*span) - span|
+                span_y = float(H - 1) if align_corners else float(H)
+                span_x = float(W - 1) if align_corners else float(W)
+                off2 = 0.0 if align_corners else 0.5
+                yyc = span_y - jnp.abs(jnp.mod(yy + off2, 2 * span_y) - span_y) - off2
+                xxc = span_x - jnp.abs(jnp.mod(xx + off2, 2 * span_x) - span_x) - off2
+                yyc = jnp.clip(yyc, 0, H - 1)
+                xxc = jnp.clip(xxc, 0, W - 1)
+                inb = jnp.ones_like(yy, bool)
+            else:  # zeros
+                inb = (yy >= -1) & (yy <= H) & (xx >= -1) & (xx <= W)
+                yyc = jnp.clip(yy, -1, H)
+                xxc = jnp.clip(xx, -1, W)
+
+            if mode == "nearest":
+                # zeros mode bounds-checks the ROUNDED index (torch/reference
+                # convention), not the float coordinate
+                yr = jnp.round(yy if padding_mode == "zeros" else yyc)
+                xr = jnp.round(xx if padding_mode == "zeros" else xxc)
+                yi = jnp.clip(yr, 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(xr, 0, W - 1).astype(jnp.int32)
+                out = img[:, yi, xi]
+                if padding_mode == "zeros":
+                    ok = (yr >= 0) & (yr <= H - 1) & (xr >= 0) & (xr <= W - 1)
+                    out = jnp.where(ok[None], out, 0.0)
+                return out
+            y0 = jnp.floor(yyc)
+            x0 = jnp.floor(xxc)
+            wy = yyc - y0
+            wx = xxc - x0
+
+            def tap(yi, xi):
+                val = img[:, jnp.clip(yi, 0, H - 1).astype(jnp.int32),
+                          jnp.clip(xi, 0, W - 1).astype(jnp.int32)]
+                if padding_mode == "zeros":
+                    ok = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+                    val = jnp.where(ok[None], val, 0.0)
+                return val
+
+            return (tap(y0, x0) * (1 - wy)[None] * (1 - wx)[None]
+                    + tap(y0, x0 + 1) * (1 - wy)[None] * wx[None]
+                    + tap(y0 + 1, x0) * wy[None] * (1 - wx)[None]
+                    + tap(y0 + 1, x0 + 1) * wy[None] * wx[None])
+
+        return jax.vmap(sample)(x, fy, fx)
+
+    return apply_op("grid_sample", fn, ensure_tensor(x), ensure_tensor(grid))
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True, name=None) -> Tensor:
+    """2-D affine sampling grid from theta [N,2,3] (parity: F.affine_grid)."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in np.asarray(out_shape._data)]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def fn(theta):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, H)
+            xs = jnp.linspace(-1, 1, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)        # [H,W,3]
+        out = jnp.einsum("nij,hwj->nhwi", theta, base)                # [N,H,W,2]
+        return out
+
+    return apply_op("affine_grid", fn, ensure_tensor(theta))
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW", name=None) -> Tensor:
+    r = downscale_factor
+
+    def fn(x):
+        if data_format == "NCHW":
+            N, C, H, W = x.shape
+            x = x.reshape(N, C, H // r, r, W // r, r)
+            return x.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = x.shape
+        x = x.reshape(N, H // r, r, W // r, r, C)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(N, H // r, W // r, C * r * r)
+
+    return apply_op("pixel_unshuffle", fn, ensure_tensor(x))
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW", name=None) -> Tensor:
+    def fn(x):
+        if data_format == "NCHW":
+            N, C, H, W = x.shape
+            return x.reshape(N, groups, C // groups, H, W).transpose(0, 2, 1, 3, 4).reshape(N, C, H, W)
+        N, H, W, C = x.shape
+        return x.reshape(N, H, W, groups, C // groups).transpose(0, 1, 2, 4, 3).reshape(N, H, W, C)
+
+    return apply_op("channel_shuffle", fn, ensure_tensor(x))
+
+
+# ---------------------------------------------------------------- common
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6, keepdim: bool = False,
+                      name=None) -> Tensor:
+    def fn(x, y):
+        d = x - y + epsilon
+        out = jnp.power(jnp.power(jnp.abs(d), p).sum(-1), 1.0 / p)
+        return out[..., None] if keepdim else out
+
+    return apply_op("pairwise_distance", fn, ensure_tensor(x), ensure_tensor(y))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Tensor:
+    """col2im — inverse of unfold (parity: F.fold). x: [N, C*kh*kw, L]."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    out_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def fn(x):
+        N = x.shape[0]
+        C = x.shape[1] // (kh * kw)
+        cols = x.reshape(N, C, kh, kw, out_h, out_w)
+        out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh
+                xs = j * dw
+                out = out.at[:, :, ys: ys + sh * out_h: sh, xs: xs + sw * out_w: sw].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph: ph + oh, pw: pw + ow]
+
+    return apply_op("fold", fn, ensure_tensor(x))
+
+
+def sequence_mask(x, maxlen: Optional[int] = None, dtype="int64", name=None) -> Tensor:
+    def fn(lengths):
+        m = maxlen if maxlen is not None else int(lengths.max())
+        return (jnp.arange(m)[None, :] < lengths[..., None]).astype(dtype)
+
+    t = ensure_tensor(x)
+    if maxlen is None:
+        m = int(np.asarray(t._data).max())
+        return apply_op("sequence_mask",
+                        lambda l: (jnp.arange(m)[None, :] < l[..., None]).astype(dtype), t)
+    return apply_op("sequence_mask", fn, t)
+
+
+def embedding_bag(input, weight, offsets=None, mode: str = "mean", name=None) -> Tensor:
+    """Bag-pooled embedding lookup (parity: incubate embedding_bag). 2-D
+    ``input`` [B, L] pools each row; 1-D input uses ``offsets``."""
+
+    def pool(e, axis):
+        if mode == "sum":
+            return e.sum(axis)
+        if mode == "mean":
+            return e.mean(axis)
+        if mode == "max":
+            return e.max(axis)
+        raise ValueError(f"unknown mode {mode}")
+
+    if offsets is None:
+        def fn(ids, w):
+            return pool(w[ids], 1)
+
+        return apply_op("embedding_bag", fn, ensure_tensor(input), ensure_tensor(weight))
+
+    offs = np.asarray(offsets._data if isinstance(offsets, Tensor) else offsets)
+    n = int(np.asarray(input._data if isinstance(input, Tensor) else input).shape[0])
+    bounds = list(offs) + [n]
+
+    def fn(ids, w):
+        e = w[ids]
+        outs = [pool(e[int(bounds[i]): int(bounds[i + 1])], 0)
+                for i in range(len(bounds) - 1)]
+        return jnp.stack(outs)
+
+    return apply_op("embedding_bag", fn, ensure_tensor(input), ensure_tensor(weight))
+
+
+# ---------------------------------------------------------------- pooling
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format: str = "NCHW", name=None) -> Tensor:
+    """Scatter pooled values back to their argmax positions (parity:
+    F.max_unpool2d; indices from max_pool2d(..., return_mask=True))."""
+    ks = _pair(kernel_size)
+    st = ks if stride is None else _pair(stride)
+
+    def fn(x, idx):
+        N, C, H, W = x.shape
+        if output_size is not None:
+            oh, ow = output_size[-2:] if len(output_size) > 2 else output_size
+        else:
+            oh = (H - 1) * st[0] + ks[0] - 2 * (padding if isinstance(padding, int) else padding[0])
+            ow = (W - 1) * st[1] + ks[1] - 2 * (padding if isinstance(padding, int) else padding[1])
+        flat = jnp.zeros((N, C, oh * ow), x.dtype)
+        flat = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+                       idx.reshape(N, C, -1)].set(x.reshape(N, C, -1))
+        return flat.reshape(N, C, oh, ow)
+
+    return apply_op("max_unpool2d", fn, ensure_tensor(x), ensure_tensor(indices))
+
+
+def lp_pool2d(x, norm_type: float, kernel_size, stride=None, padding=0, ceil_mode: bool = False,
+              data_format: str = "NCHW", name=None) -> Tensor:
+    ks = _pair(kernel_size)
+    st = ks if stride is None else _pair(stride)
+
+    def fn(x):
+        p = jnp.power(jnp.abs(x), norm_type)
+        s = jax.lax.reduce_window(p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + st, "VALID")
+        return jnp.power(s, 1.0 / norm_type)
+
+    return apply_op("lp_pool2d", fn, ensure_tensor(x))
+
+
+# ---------------------------------------------------------------- losses
+
+def huber_loss(input, label, delta: float = 1.0, reduction: str = "mean", name=None) -> Tensor:
+    def fn(x, y):
+        d = x - y
+        a = jnp.abs(d)
+        v = jnp.where(a <= delta, 0.5 * d * d, delta * (a - 0.5 * delta))
+        return _reduce(v, reduction)
+
+    return apply_op("huber_loss", fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def square_error_cost(input, label) -> Tensor:
+    def fn(x, y):
+        return (x - y) ** 2
+
+    return apply_op("square_error_cost", fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None) -> Tensor:
+    """input [N,...,C] probabilities, label [N,...,1] int (parity: F.dice_loss)."""
+
+    def fn(x, y):
+        C = x.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0], C, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = (x * oh).sum(red)
+        union = x.sum(red) + oh.sum(red)
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+    return apply_op("dice_loss", fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def poisson_nll_loss(input, label, log_input: bool = True, full: bool = False,
+                     epsilon: float = 1e-8, reduction: str = "mean", name=None) -> Tensor:
+    def fn(x, y):
+        if log_input:
+            v = jnp.exp(x) - y * x
+        else:
+            v = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            v = v + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(v, reduction)
+
+    return apply_op("poisson_nll_loss", fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None) -> Tensor:
+    def fn(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return apply_op("soft_margin_loss", fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction: str = "mean",
+                                 name=None) -> Tensor:
+    def fn(x, y, *w):
+        v = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            v = v * w[0]
+        return _reduce(v.mean(-1), reduction)
+
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply_op("multi_label_soft_margin_loss", fn, *args)
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0, p: float = 2.0,
+                        epsilon: float = 1e-6, swap: bool = False, reduction: str = "mean",
+                        name=None) -> Tensor:
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.power(jnp.abs(u - v + epsilon), p).sum(-1), 1.0 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op("triplet_margin_loss", fn, ensure_tensor(input),
+                    ensure_tensor(positive), ensure_tensor(negative))
+
+
+def feature_alpha_dropout(x, p: float = 0.5, training: bool = True, name=None) -> Tensor:
+    """Channel-wise alpha dropout (parity: F.feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else ensure_tensor(x)
+    from ..ops.random import split_key
+
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = split_key()
+
+    def fn(x):
+        shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        a = (1.0 / jnp.sqrt((alpha_p ** 2 * p + 1) * (1 - p))).astype(x.dtype)
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, x, alpha_p) + b
+
+    return apply_op("feature_alpha_dropout", fn, ensure_tensor(x))
+
+
+def class_center_sample(label, num_classes: int, num_samples: int, group=None):
+    """Sample class centers covering all positives (parity:
+    F.class_center_sample for margin-softmax training). Deterministic
+    remainder fill keeps it jit-friendly."""
+
+    def fn(label):
+        pos = jnp.zeros((num_classes,), bool).at[label].set(True)
+        order = jnp.argsort(~pos)          # positives first, stable
+        sampled = order[:num_samples]
+        # map each label to its index within sampled (positives are inside)
+        inv = jnp.full((num_classes,), -1, jnp.int32)
+        inv = inv.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+        return inv[label].astype(jnp.int64), sampled.astype(jnp.int64)
+
+    return apply_op("class_center_sample", fn, ensure_tensor(label))
+
+
+# ---------------------------------------------------------------- CTC
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False, name=None) -> Tensor:
+    """Connectionist temporal classification loss.
+
+    log_probs: [T, B, C] (logits accepted — log_softmax applied), labels
+    [B, S] int, lengths [B]. Forward (alpha) recursion in log space via
+    lax.scan (parity: F.ctc_loss, warpctc kernels)."""
+
+    in_lens = jnp.asarray(input_lengths._data if isinstance(input_lengths, Tensor) else input_lengths)
+    lab_lens = jnp.asarray(label_lengths._data if isinstance(label_lengths, Tensor) else label_lengths)
+
+    def fn(lp, labels):
+        T, B, C = lp.shape
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        S = labels.shape[1]
+        L = 2 * S + 1
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, L), blank, labels.dtype)
+        ext = ext.at[:, 1::2].set(labels)
+        ext_valid = jnp.arange(L)[None, :] < (2 * lab_lens[:, None] + 1)
+
+        NEG = -1e30
+        # alpha_0
+        a0 = jnp.full((B, L), NEG)
+        a0 = a0.at[:, 0].set(lp[0, :, blank])
+        a0 = a0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+        # positions beyond 2*lab_len: keep NEG
+        a0 = jnp.where(ext_valid, a0, NEG)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def lse(*xs):
+            stacked = jnp.stack(xs)
+            m = stacked.max(0)
+            return jnp.where(m <= NEG / 2, NEG, m + jnp.log(jnp.exp(stacked - m).sum(0)))
+
+        def step(alpha, t):
+            shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            shift2 = jnp.where(same_as_prev2, NEG, shift2)  # no skip over same label
+            new = lse(alpha, shift1, shift2)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)
+            new = new + emit
+            new = jnp.where(ext_valid, new, NEG)
+            live = (t < in_lens)[:, None]
+            return jnp.where(live, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, a0, jnp.arange(1, T))
+        # final: alpha at positions 2*lab_len and 2*lab_len - 1
+        idx_last = (2 * lab_lens).astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+        # zero-length labels have only the all-blank path (no a_prev term)
+        a_prev = jnp.where(lab_lens > 0, a_prev, NEG)
+        ll = lse(a_last, a_prev)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_lens.astype(loss.dtype)
+        return _reduce(loss, reduction)
+
+    return apply_op("ctc_loss", fn, ensure_tensor(log_probs), ensure_tensor(labels))

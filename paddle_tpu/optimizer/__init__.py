@@ -1,7 +1,9 @@
 from . import lr
 from .optimizer import (
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp,
+    ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, Momentum,
+    NAdam, Optimizer, RAdam, RMSProp, Rprop,
 )
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
-           "Adamax", "RMSProp", "Lamb", "lr"]
+           "Adamax", "RMSProp", "Lamb", "LBFGS", "Rprop", "ASGD", "NAdam", "RAdam",
+           "Lars", "lr"]

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.autograd import no_grad
 from ..core.tensor import Parameter, Tensor
@@ -431,3 +432,283 @@ class Lamb(Optimizer):
                                      jnp.asarray(wd, jnp.float32))
         self._set_acc("moment1", p, m)
         self._set_acc("moment2", p, v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _rprop_update(param, grad, prev_grad, step_size, lr_min, lr_max, eta_n, eta_p):
+    sign = jnp.sign(grad * prev_grad)
+    new_step = jnp.clip(jnp.where(sign > 0, step_size * eta_p,
+                                  jnp.where(sign < 0, step_size * eta_n, step_size)),
+                        lr_min, lr_max)
+    g_eff = jnp.where(sign < 0, 0.0, grad)
+    new_param = param - jnp.sign(g_eff).astype(param.dtype) * new_step.astype(param.dtype)
+    new_prev = jnp.where(sign < 0, 0.0, grad)
+    return new_param, new_prev, new_step
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (parity: python/paddle/optimizer/rprop.py)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_n, self._eta_p = etas
+
+    def _update_param(self, p, g):
+        g = g.astype(jnp.float32)
+        prev = self._acc("prev_grad", p, lambda x: jnp.zeros(x.shape, jnp.float32))
+        step = self._acc("step_size", p,
+                         lambda x: jnp.full(x.shape, float(self.get_lr()), jnp.float32))
+        p._data, prev, step = _rprop_update(p._data, g, prev, step,
+                                            jnp.float32(self._lr_min), jnp.float32(self._lr_max),
+                                            jnp.float32(self._eta_n), jnp.float32(self._eta_p))
+        self._set_acc("prev_grad", p, prev)
+        self._set_acc("step_size", p, step)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _asgd_update(param, grad, avg, lr, t0_passed, n_avg):
+    new_param = param - lr.astype(param.dtype) * grad.astype(param.dtype)
+    new_avg = jnp.where(t0_passed, avg + (new_param.astype(jnp.float32) - avg) / n_avg, 
+                        new_param.astype(jnp.float32))
+    return new_param, new_avg
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (parity: python/paddle/optimizer/asgd.py)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._t0 = batch_num
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g.astype(jnp.float32))
+        # copy: donation would otherwise see param and avg as one buffer
+        avg = self._acc("averaged_param", p, lambda x: jnp.array(x, jnp.float32, copy=True))
+        n_avg = max(self._step_count - self._t0, 1)
+        p._data, avg = _asgd_update(p._data, g, avg, jnp.asarray(self.get_lr(), jnp.float32),
+                                    jnp.asarray(self._step_count > self._t0),
+                                    jnp.asarray(float(n_avg), jnp.float32))
+        self._set_acc("averaged_param", p, avg)
+
+    def averaged_parameters(self):
+        return {p.name: Tensor(self._accumulators["averaged_param"][id(p)])
+                for p in self._parameter_list if id(p) in self._accumulators.get("averaged_param", {})}
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _nadam_update(param, grad, m, v, lr, beta1, beta2, eps, t, mu_prod, psi):
+    g = grad.astype(jnp.float32)
+    mu_t = beta1 * (1 - 0.5 * 0.96 ** (t * psi))
+    mu_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+    new_mu_prod = mu_prod * mu_t
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    m_hat = mu_t1 * m / (1 - new_mu_prod * mu_t1) + (1 - mu_t) * g / (1 - new_mu_prod)
+    v_hat = v / (1 - beta2 ** t)
+    upd = lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    return param - upd.astype(param.dtype), m, v, new_mu_prod
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (parity: python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._momentum_decay = momentum_decay
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g.astype(jnp.float32))
+        m = self._acc("momentum_decay_pow", p, lambda x: jnp.ones((), jnp.float32))
+        m1 = self._acc("moment1", p, lambda x: jnp.zeros(x.shape, jnp.float32))
+        m2 = self._acc("moment2", p, lambda x: jnp.zeros(x.shape, jnp.float32))
+        p._data, m1, m2, mu_prod = _nadam_update(
+            p._data, g, m1, m2, jnp.asarray(self.get_lr(), jnp.float32),
+            jnp.float32(self._beta1), jnp.float32(self._beta2), jnp.float32(self._epsilon),
+            jnp.asarray(float(self._step_count), jnp.float32), m,
+            jnp.float32(self._momentum_decay))
+        self._set_acc("moment1", p, m1)
+        self._set_acc("moment2", p, m2)
+        self._set_acc("momentum_decay_pow", p, mu_prod)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _radam_update(param, grad, m, v, lr, beta1, beta2, eps, t):
+    g = grad.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    m_hat = m / (1 - beta1 ** t)
+    rho_inf = 2.0 / (1 - beta2) - 1
+    rho_t = rho_inf - 2 * t * beta2 ** t / (1 - beta2 ** t)
+    r = jnp.sqrt((rho_t - 4) * (rho_t - 2) * rho_inf /
+                 jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8))
+    v_hat = jnp.sqrt(v / (1 - beta2 ** t))
+    upd = jnp.where(rho_t > 5.0, lr * r * m_hat / (v_hat + eps), lr * m_hat)
+    return param - upd.astype(param.dtype), m, v
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (parity: python/paddle/optimizer/radam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g):
+        g = self._apply_decay(p, g.astype(jnp.float32))
+        m1 = self._acc("moment1", p, lambda x: jnp.zeros(x.shape, jnp.float32))
+        m2 = self._acc("moment2", p, lambda x: jnp.zeros(x.shape, jnp.float32))
+        p._data, m1, m2 = _radam_update(
+            p._data, g, m1, m2, jnp.asarray(self.get_lr(), jnp.float32),
+            jnp.float32(self._beta1), jnp.float32(self._beta2), jnp.float32(self._epsilon),
+            jnp.asarray(float(self._step_count), jnp.float32))
+        self._set_acc("moment1", p, m1)
+        self._set_acc("moment2", p, m2)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _lars_update(param, grad, vel, lr, mu, lars_coeff, wd, eps):
+    g = grad.astype(jnp.float32)
+    pf = param.astype(jnp.float32)
+    p_norm = jnp.linalg.norm(pf)
+    g_norm = jnp.linalg.norm(g)
+    local_lr = jnp.where((p_norm > 0) & (g_norm > 0),
+                         lars_coeff * p_norm / (g_norm + wd * p_norm + eps), 1.0)
+    v = mu * vel + lr * local_lr * (g + wd * pf)
+    return (pf - v).astype(param.dtype), v
+
+
+class Lars(Optimizer):
+    """LARS momentum (parity: fluid lars_momentum op /
+    paddle.incubate LarsMomentumOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _update_param(self, p, g):
+        wd = 0.0 if any(e in p.name for e in self._exclude) else self._lars_wd
+        v = self._acc("velocity", p, lambda x: jnp.zeros(x.shape, jnp.float32))
+        p._data, v = _lars_update(p._data, g, v, jnp.asarray(self.get_lr(), jnp.float32),
+                                  jnp.float32(self._momentum), jnp.float32(self._lars_coeff),
+                                  jnp.float32(wd), jnp.float32(self._epsilon))
+        self._set_acc("velocity", p, v)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-based step (parity:
+    python/paddle/optimizer/lbfgs.py — full-batch two-loop recursion with
+    strong-Wolfe or fixed-step line search)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s: list = []
+        self._y: list = []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flatten(self, tensors):
+        return jnp.concatenate([jnp.ravel(t.astype(jnp.float32)) for t in tensors])
+
+    def _unflatten_to_params(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._data = flat[off: off + n].reshape(p.shape).astype(p._data.dtype)
+            off += n
+
+    def _eval(self, closure):
+        """Evaluate closure; return (loss, flat params, flat grads) with
+        weight decay and grad clip applied (reference parity)."""
+        loss = closure()
+        params = self._parameter_list
+        pg = [(p, p.grad) for p in params]
+        if self._grad_clip is not None:
+            pg = self._grad_clip([(p, g) for p, g in pg if g is not None])
+            grads_by_id = {id(p): g for p, g in pg}
+            pg = [(p, grads_by_id.get(id(p))) for p in params]
+        flat = self._flatten([p._data for p in params])
+        grad = self._flatten([
+            self._apply_decay(p, (g._data if g is not None else jnp.zeros(p.shape)).astype(jnp.float32))
+            for p, g in pg])
+        return loss, flat, grad
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss, flat, grad = self._eval(closure)
+        first_loss = loss
+        for _ in range(self._max_iter):
+            if float(jnp.abs(grad).max()) <= self._tol_grad:
+                break
+            if self._prev_flat is not None:
+                s = flat - self._prev_flat
+                y = grad - self._prev_grad
+                if float(s @ y) > 1e-10:
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self._history:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            # two-loop recursion
+            q = grad
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / (s @ y)
+                a = rho * (s @ q)
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._s:
+                s, y = self._s[-1], self._y[-1]
+                q = q * (s @ y) / jnp.maximum(y @ y, 1e-10)
+            for a, rho, s, y in reversed(alphas):
+                b = rho * (y @ q)
+                q = q + (a - b) * s
+            direction = -q
+            lr = float(self.get_lr())
+            if self._line_search == "strong_wolfe":
+                lr = self._backtrack(closure, float(loss.numpy()), flat, grad, direction, lr)
+            self._prev_flat = flat
+            self._prev_grad = grad
+            delta = lr * direction
+            self._unflatten_to_params(flat + delta)
+            if float(jnp.abs(delta).max()) <= self._tol_change:
+                break
+            new_loss, flat, grad = self._eval(closure)
+            if abs(float(new_loss.numpy()) - float(loss.numpy())) <= self._tol_change:
+                loss = new_loss
+                break
+            loss = new_loss
+        self._step_count += 1
+        return first_loss
+
+    def _backtrack(self, closure, base, flat, grad, direction, lr, c1=1e-4, shrink=0.5, iters=10):
+        """Armijo backtracking; reuses the already-computed base loss."""
+        slope = float(grad @ direction)
+        for _ in range(iters):
+            self._unflatten_to_params(flat + lr * direction)
+            trial = float(closure().numpy())
+            if trial <= base + c1 * lr * slope:
+                break
+            lr *= shrink
+        self._unflatten_to_params(flat)
+        return lr

@@ -1,0 +1,232 @@
+"""Extended functional ops — torch CPU as numerical oracle where the
+reference semantics are intricate (grid_sample, ctc_loss, fold), numpy
+closed forms elsewhere. (Reference pattern: OpTest supplies a python
+reference per op; torch is the stand-in reference implementation here.)
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+torch = pytest.importorskip("torch")
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("padding_mode", ["zeros", "border"])
+    @pytest.mark.parametrize("align_corners", [True, False])
+    def test_matches_torch(self, mode, padding_mode, align_corners):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        grid = (rng.rand(2, 5, 6, 2).astype("float32") * 2.4 - 1.2)
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid), mode=mode,
+                            padding_mode=padding_mode, align_corners=align_corners).numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode, padding_mode=padding_mode,
+            align_corners=align_corners).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_affine_grid_matches_torch(self):
+        theta = np.array([[[1.0, 0.2, 0.1], [0.0, 0.8, -0.3]]], "float32")
+        got = F.affine_grid(paddle.to_tensor(theta), [1, 3, 6, 5], align_corners=True).numpy()
+        ref = torch.nn.functional.affine_grid(torch.tensor(theta), (1, 3, 6, 5),
+                                              align_corners=True).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestCtcLoss:
+    def test_matches_torch(self):
+        rng = np.random.RandomState(1)
+        T, B, C, S = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype("float32")
+        labels = rng.randint(1, C, (B, S)).astype("int32")
+        in_lens = np.array([12, 10, 8], "int32")
+        lab_lens = np.array([4, 3, 2], "int32")
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                         blank=0, reduction="none").numpy()
+        lp = torch.tensor(logits).log_softmax(-1)
+        ref = torch.nn.functional.ctc_loss(lp, torch.tensor(labels.astype("int64")),
+                                           torch.tensor(in_lens.astype("int64")),
+                                           torch.tensor(lab_lens.astype("int64")),
+                                           blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(2)
+        logits = paddle.to_tensor(rng.randn(6, 2, 5).astype("float32"), stop_gradient=False)
+        loss = F.ctc_loss(logits, paddle.to_tensor(np.array([[1, 2], [3, 1]], "int32")),
+                          paddle.to_tensor(np.array([6, 6], "int32")),
+                          paddle.to_tensor(np.array([2, 2], "int32")))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+        assert np.abs(logits.grad.numpy()).sum() > 0
+
+
+class TestFoldUnpool:
+    def test_fold_inverts_unfold_on_nonoverlapping(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        cols = F.unfold(paddle.to_tensor(x), 2, strides=2)
+        back = F.fold(cols, (8, 8), 2, strides=2).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_fold_matches_torch_overlapping(self):
+        rng = np.random.RandomState(4)
+        cols = rng.randn(1, 3 * 3 * 3, 36).astype("float32")
+        got = F.fold(paddle.to_tensor(cols), (8, 8), 3, strides=1, paddings=0).numpy()
+        ref = torch.nn.functional.fold(torch.tensor(cols), (8, 8), 3).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_max_unpool2d_matches_torch(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 2, 8, 8).astype("float32")
+        pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, return_mask=True)
+        tp, ti = torch.nn.functional.max_pool2d(torch.tensor(x), 2, stride=2,
+                                                return_indices=True)
+        np.testing.assert_allclose(pooled.numpy(), tp.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+        unpooled = F.max_unpool2d(pooled, idx, 2, stride=2).numpy()
+        ref = torch.nn.functional.max_unpool2d(tp, ti, 2, stride=2).numpy()
+        np.testing.assert_allclose(unpooled, ref, rtol=1e-6)
+
+    def test_lp_pool2d(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        got = F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, stride=2).numpy()
+        ref = torch.nn.functional.lp_pool2d(torch.tensor(x), 2.0, 2, stride=2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestLosses:
+    def test_huber_matches_torch(self):
+        rng = np.random.RandomState(6)
+        a, b = rng.randn(10).astype("float32"), rng.randn(10).astype("float32")
+        got = F.huber_loss(paddle.to_tensor(a), paddle.to_tensor(b), delta=0.7).numpy()
+        ref = torch.nn.functional.huber_loss(torch.tensor(a), torch.tensor(b),
+                                             delta=0.7).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_triplet_and_soft_margin_match_torch(self):
+        rng = np.random.RandomState(7)
+        a = rng.randn(4, 8).astype("float32")
+        p = rng.randn(4, 8).astype("float32")
+        n = rng.randn(4, 8).astype("float32")
+        got = F.triplet_margin_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                                    paddle.to_tensor(n), margin=0.5).numpy()
+        ref = torch.nn.functional.triplet_margin_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.5).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+        x = rng.randn(6).astype("float32")
+        y = np.sign(rng.randn(6)).astype("float32")
+        got2 = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        ref2 = torch.nn.functional.soft_margin_loss(torch.tensor(x), torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got2, ref2, rtol=1e-5)
+
+    def test_poisson_nll_matches_torch(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(10).astype("float32")
+        y = rng.poisson(3, 10).astype("float32")
+        got = F.poisson_nll_loss(paddle.to_tensor(x), paddle.to_tensor(y), full=True).numpy()
+        ref = torch.nn.functional.poisson_nll_loss(torch.tensor(x), torch.tensor(y),
+                                                   full=True).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_dice_and_square_error(self):
+        probs = np.array([[[0.8, 0.2], [0.3, 0.7]]], "float32")  # [1, 2, C=2]
+        label = np.array([[[0], [1]]], "int64")
+        loss = F.dice_loss(paddle.to_tensor(probs), paddle.to_tensor(label)).numpy()
+        assert 0 <= float(loss) < 1
+        se = F.square_error_cost(paddle.to_tensor(np.array([1.0, 2.0], "float32")),
+                                 paddle.to_tensor(np.array([1.5, 1.0], "float32"))).numpy()
+        np.testing.assert_allclose(se, [0.25, 1.0])
+
+
+class TestMisc:
+    def test_pixel_unshuffle_inverts_shuffle(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(1, 8, 4, 4).astype("float32")
+        shuffled = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        back = F.pixel_unshuffle(shuffled, 2).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_channel_shuffle_matches_torch(self):
+        x = np.arange(2 * 8 * 2 * 2, dtype="float32").reshape(2, 8, 2, 2)
+        got = F.channel_shuffle(paddle.to_tensor(x), 4).numpy()
+        ref = torch.nn.functional.channel_shuffle(torch.tensor(x), 4).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_sequence_mask(self):
+        got = F.sequence_mask(paddle.to_tensor(np.array([1, 3, 2], "int32")), maxlen=4).numpy()
+        np.testing.assert_array_equal(got, [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+
+    def test_embedding_bag_modes(self):
+        w = np.arange(12, dtype="float32").reshape(6, 2)
+        ids = np.array([[0, 1], [2, 3]], "int64")
+        got = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w), mode="mean").numpy()
+        np.testing.assert_allclose(got, [[1.0, 2.0], [5.0, 6.0]])
+        got_sum = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w), mode="sum").numpy()
+        np.testing.assert_allclose(got_sum, [[2.0, 4.0], [10.0, 12.0]])
+
+    def test_pairwise_distance_matches_torch(self):
+        rng = np.random.RandomState(10)
+        a, b = rng.randn(4, 6).astype("float32"), rng.randn(4, 6).astype("float32")
+        got = F.pairwise_distance(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        ref = torch.nn.functional.pairwise_distance(torch.tensor(a), torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_class_center_sample_covers_positives(self):
+        labels = np.array([3, 7, 7, 1], "int64")
+        remapped, sampled = F.class_center_sample(paddle.to_tensor(labels), 10, 5)
+        sampled = sampled.numpy()
+        assert {1, 3, 7} <= set(sampled.tolist())
+        assert len(sampled) == 5
+        # remapped labels index into sampled correctly
+        for orig, rm in zip(labels, remapped.numpy()):
+            assert sampled[rm] == orig
+
+
+class TestReviewRegressions:
+    def test_grid_sample_reflection_matches_torch(self):
+        rng = np.random.RandomState(11)
+        x = rng.randn(1, 2, 8, 8).astype("float32")
+        grid = (rng.rand(1, 4, 4, 2).astype("float32") * 3.0 - 1.5)
+        for align in (True,):
+            got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                                padding_mode="reflection", align_corners=align).numpy()
+            ref = torch.nn.functional.grid_sample(
+                torch.tensor(x), torch.tensor(grid), padding_mode="reflection",
+                align_corners=align).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_zero_length_label(self):
+        rng = np.random.RandomState(12)
+        logits = rng.randn(8, 2, 5).astype("float32")
+        labels = rng.randint(1, 5, (2, 3)).astype("int32")
+        in_lens = np.array([8, 8], "int32")
+        lab_lens = np.array([3, 0], "int32")
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                         reduction="none").numpy()
+        lp = torch.tensor(logits).log_softmax(-1)
+        ref = torch.nn.functional.ctc_loss(lp, torch.tensor(labels.astype("int64")),
+                                           torch.tensor(in_lens.astype("int64")),
+                                           torch.tensor(lab_lens.astype("int64")),
+                                           reduction="none").numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_nadam_momentum_decay_changes_trajectory(self):
+        import paddle_tpu as paddle
+
+        def run(md):
+            p = paddle.Parameter(np.asarray([1.0], np.float32))
+            opt = paddle.optimizer.NAdam(learning_rate=0.1, momentum_decay=md,
+                                         parameters=[p])
+            for _ in range(5):
+                p.grad = paddle.to_tensor(np.asarray([0.5], np.float32))
+                opt.step()
+            return float(p.numpy()[0])
+
+        assert run(0.004) != run(0.4)

@@ -164,3 +164,83 @@ def test_bf16_param_fp32_state():
     assert str(p.dtype) == "bfloat16"
     m = opt._accumulators["moment1"][id(p)]
     assert str(m.dtype) == "float32"
+
+
+class TestNewOptimizers:
+    """Rprop/ASGD/NAdam/RAdam/Lars/LBFGS: descent oracle on a quadratic
+    (pattern: reference per-optimizer op tests + convergence checks)."""
+
+    def _quadratic_steps(self, opt_factory, steps=30, closure_based=False):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        opt = opt_factory(lin.parameters())
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(32, 4).astype("float32"))
+        yt = paddle.to_tensor((rng.randn(32, 1) * 0.1 + 1.0).astype("float32"))
+        losses = []
+
+        def closure():
+            opt.clear_grad()
+            loss = ((lin(X) - yt) ** 2).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(steps):
+            if closure_based:
+                loss = opt.step(closure)
+            else:
+                loss = closure()
+                opt.step()
+            losses.append(float(loss.numpy()))
+        return losses
+
+    def test_rprop_descends(self):
+        import paddle_tpu as paddle
+
+        losses = self._quadratic_steps(
+            lambda ps: paddle.optimizer.Rprop(learning_rate=0.01, parameters=ps))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_asgd_descends_and_averages(self):
+        import paddle_tpu as paddle
+
+        losses = self._quadratic_steps(
+            lambda ps: paddle.optimizer.ASGD(learning_rate=0.05, batch_num=5, parameters=ps))
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_nadam_descends(self):
+        import paddle_tpu as paddle
+
+        losses = self._quadratic_steps(
+            lambda ps: paddle.optimizer.NAdam(learning_rate=0.05, parameters=ps))
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_radam_descends(self):
+        import paddle_tpu as paddle
+
+        losses = self._quadratic_steps(
+            lambda ps: paddle.optimizer.RAdam(learning_rate=0.05, parameters=ps))
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_lars_descends(self):
+        import paddle_tpu as paddle
+
+        # LARS's trust ratio (coeff * |p|/|g|) makes steps tiny on toy
+        # problems; assert steady descent rather than a large drop
+        losses = self._quadratic_steps(
+            lambda ps: paddle.optimizer.Lars(learning_rate=0.1, parameters=ps))
+        assert losses[-1] < losses[0] * 0.95
+
+    def test_lbfgs_converges_fast(self):
+        import paddle_tpu as paddle
+
+        losses = self._quadratic_steps(
+            lambda ps: paddle.optimizer.LBFGS(learning_rate=0.5, history_size=10,
+                                              line_search_fn="strong_wolfe", parameters=ps),
+            steps=15, closure_based=True)
+        assert losses[-1] < losses[0] * 0.05  # quadratic: LBFGS should crush it
