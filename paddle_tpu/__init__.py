@@ -58,5 +58,6 @@ from . import audio
 from . import text
 from . import signal
 from . import onnx
+from . import regularizer
 
 __version__ = "0.1.0"

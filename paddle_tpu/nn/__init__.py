@@ -20,6 +20,12 @@ from .layers_loss import (
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss,
     MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
 )
+from .layers_extra import (
+    CTCLoss, HuberLoss, TripletMarginLoss, PoissonNLLLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, PairwiseDistance, Fold, Unfold, MaxUnPool2D,
+    ChannelShuffle, PixelUnshuffle, UpsamplingBilinear2D, UpsamplingNearest2D,
+    AlphaDropout, FeatureAlphaDropout, GridSample,
+)
 from .layers_transformer import (
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,

@@ -419,10 +419,17 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
             if data_format != "NCHW":
                 a = jnp.transpose(a, (0, 3, 1, 2))
             N, C, H, W = a.shape
-            ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+            extra = [0, 0]
+            if ceil_mode:  # extend right/bottom so the last partial window counts
+                for i, dim in enumerate((H, W)):
+                    rem = (dim + 2 * pd[i] - ks[i]) % st[i]
+                    if rem:
+                        extra[i] = st[i] - rem
+            ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0] + extra[0]),
+                             (pd[1], pd[1] + extra[1])),
                          constant_values=-jnp.inf)
-            oh = (H + 2 * pd[0] - ks[0]) // st[0] + 1
-            ow = (W + 2 * pd[1] - ks[1]) // st[1] + 1
+            oh = (H + 2 * pd[0] + extra[0] - ks[0]) // st[0] + 1
+            ow = (W + 2 * pd[1] + extra[1] - ks[1]) // st[1] + 1
             iy = (jnp.arange(oh)[:, None] * st[0] + jnp.arange(ks[0])[None, :])  # [oh,kh]
             ix = (jnp.arange(ow)[:, None] * st[1] + jnp.arange(ks[1])[None, :])  # [ow,kw]
             win = ap[:, :, iy[:, None, :, None], ix[None, :, None, :]]  # [N,C,oh,ow,kh,kw]

@@ -53,11 +53,11 @@ def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
             fy = ((gy + 1) * H - 1) * 0.5
 
         def sample(img, yy, xx):
-            # img [C,H,W]; yy/xx [Ho,Wo] float pixel coords
+            # img [C,H,W]; yy/xx [Ho,Wo] float pixel coords; zeros-mode
+            # bounds handling happens per-tap below
             if padding_mode == "border":
                 yyc = jnp.clip(yy, 0, H - 1)
                 xxc = jnp.clip(xx, 0, W - 1)
-                inb = jnp.ones_like(yy, bool)
             elif padding_mode == "reflection":
                 # triangle wave that is identity on [0, span] and mirrors
                 # outside: span - |mod(y, 2*span) - span|
@@ -68,9 +68,7 @@ def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
                 xxc = span_x - jnp.abs(jnp.mod(xx + off2, 2 * span_x) - span_x) - off2
                 yyc = jnp.clip(yyc, 0, H - 1)
                 xxc = jnp.clip(xxc, 0, W - 1)
-                inb = jnp.ones_like(yy, bool)
             else:  # zeros
-                inb = (yy >= -1) & (yy <= H) & (xx >= -1) & (xx <= W)
                 yyc = jnp.clip(yy, -1, H)
                 xxc = jnp.clip(xx, -1, W)
 
@@ -249,6 +247,9 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, output_size=No
     st = ks if stride is None else _pair(stride)
 
     def fn(x, idx):
+        if data_format == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+            idx = jnp.transpose(idx, (0, 3, 1, 2))
         N, C, H, W = x.shape
         if output_size is not None:
             oh, ow = output_size[-2:] if len(output_size) > 2 else output_size
@@ -258,7 +259,10 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, output_size=No
         flat = jnp.zeros((N, C, oh * ow), x.dtype)
         flat = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
                        idx.reshape(N, C, -1)].set(x.reshape(N, C, -1))
-        return flat.reshape(N, C, oh, ow)
+        out = flat.reshape(N, C, oh, ow)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
 
     return apply_op("max_unpool2d", fn, ensure_tensor(x), ensure_tensor(indices))
 
@@ -267,11 +271,31 @@ def lp_pool2d(x, norm_type: float, kernel_size, stride=None, padding=0, ceil_mod
               data_format: str = "NCHW", name=None) -> Tensor:
     ks = _pair(kernel_size)
     st = ks if stride is None else _pair(stride)
+    pd = _pair(padding)
 
     def fn(x):
-        p = jnp.power(jnp.abs(x), norm_type)
-        s = jax.lax.reduce_window(p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + st, "VALID")
-        return jnp.power(s, 1.0 / norm_type)
+        if data_format == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        H, W = x.shape[2], x.shape[3]
+        extra = [0, 0]
+        if ceil_mode:  # extend the right/bottom edge so the last partial window counts
+            for i, dim in enumerate((H, W)):
+                rem = (dim + 2 * pd[i] - ks[i]) % st[i]
+                if rem:
+                    extra[i] = st[i] - rem
+        pads = ((0, 0), (0, 0), (pd[0], pd[0] + extra[0]), (pd[1], pd[1] + extra[1]))
+        p = jnp.power(jnp.abs(jnp.pad(x, pads)), norm_type)
+        s = jax.lax.reduce_window(p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + tuple(st), "VALID")
+        # reference lp_pool = avg_pool(x^p)·(kh·kw) ^(1/p): partial (ceil-mode)
+        # windows scale by kk/count of in-bounds elements
+        ones = jnp.pad(jnp.ones((1, 1) + (H + 2 * pd[0], W + 2 * pd[1]), p.dtype),
+                       ((0, 0), (0, 0), (0, extra[0]), (0, extra[1])))
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + tuple(st), "VALID")
+        s = s * (ks[0] * ks[1]) / jnp.maximum(cnt, 1.0)
+        out = jnp.power(s, 1.0 / norm_type)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
 
     return apply_op("lp_pool2d", fn, ensure_tensor(x))
 
@@ -461,6 +485,9 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
         loss = -ll
         if norm_by_times:
             loss = loss / in_lens.astype(loss.dtype)
+        if reduction == "mean":
+            # reference CTC mean: per-sample loss normalized by label length
+            return (loss / jnp.maximum(lab_lens, 1).astype(loss.dtype)).mean()
         return _reduce(loss, reduction)
 
     return apply_op("ctc_loss", fn, ensure_tensor(log_probs), ensure_tensor(labels))

@@ -1,0 +1,190 @@
+"""Layer wrappers over the extended functional surface.
+
+Parity: python/paddle/nn/layer/loss.py (CTCLoss, HuberLoss/SmoothL1Loss,
+TripletMarginLoss, PoissonNLLLoss, SoftMarginLoss,
+MultiLabelSoftMarginLoss), distance.py (PairwiseDistance), common.py
+(Fold, Unfold, Upsampling*), pooling.py (MaxUnPool2D), vision.py
+(ChannelShuffle, PixelUnshuffle). Thin stateless wrappers — all compute
+lives in nn.functional.
+"""
+
+from __future__ import annotations
+
+from . import functional as F
+from .layer import Layer
+
+__all__ = [
+    "CTCLoss", "HuberLoss", "TripletMarginLoss", "PoissonNLLLoss", "SoftMarginLoss",
+    "MultiLabelSoftMarginLoss", "PairwiseDistance", "Fold", "Unfold", "MaxUnPool2D",
+    "ChannelShuffle", "PixelUnshuffle", "UpsamplingBilinear2D", "UpsamplingNearest2D",
+    "AlphaDropout", "FeatureAlphaDropout", "GridSample",
+]
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0, name=None):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, delta=self.delta, reduction=self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin: float = 1.0, p: float = 2.0, epsilon: float = 1e-6,
+                 swap: bool = False, reduction: str = "mean", name=None):
+        super().__init__()
+        self.margin, self.p, self.epsilon, self.swap, self.reduction = margin, p, epsilon, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative, margin=self.margin,
+                                     p=self.p, epsilon=self.epsilon, swap=self.swap,
+                                     reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input: bool = True, full: bool = False, epsilon: float = 1e-8,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self.log_input, self.full, self.epsilon, self.reduction = log_input, full, epsilon, reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, log_input=self.log_input, full=self.full,
+                                  epsilon=self.epsilon, reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction: str = "mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, reduction=self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, weight=self.weight,
+                                              reduction=self.reduction)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6, keepdim: bool = False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon, keepdim=self.keepdim)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format: str = "NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, stride=self.stride,
+                              padding=self.padding, output_size=self.output_size,
+                              data_format=self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups: int, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, data_format=self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self.factor, self.data_format = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.factor, data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self.size, self.scale_factor, self.data_format = size, scale_factor, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True, data_format=self.data_format)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self.size, self.scale_factor, self.data_format = size, scale_factor, data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale_factor,
+                             mode="nearest", data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
+class GridSample(Layer):
+    def __init__(self, mode: str = "bilinear", padding_mode: str = "zeros",
+                 align_corners: bool = True, name=None):
+        super().__init__()
+        self.mode, self.padding_mode, self.align_corners = mode, padding_mode, align_corners
+
+    def forward(self, x, grid):
+        return F.grid_sample(x, grid, mode=self.mode, padding_mode=self.padding_mode,
+                             align_corners=self.align_corners)

@@ -33,12 +33,15 @@ class Optimizer:
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
+        self._decay_obj = None
         if weight_decay is None:
             self._weight_decay = 0.0
         elif isinstance(weight_decay, (int, float)):
             self._weight_decay = float(weight_decay)
-        else:  # L2Decay-like object
+        else:  # L1Decay/L2Decay-like object: keep it so grad_term applies
             self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+            if hasattr(weight_decay, "grad_term"):
+                self._decay_obj = weight_decay
         self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
         self._step_count = 0
 
@@ -138,7 +141,10 @@ class Optimizer:
         return None, None
 
     def _apply_decay(self, p, g):
-        """Coupled L2 (SGD/Momentum/Adam semantics of `weight_decay` regularizer)."""
+        """Coupled decay (SGD/Momentum/Adam semantics of `weight_decay`):
+        a regularizer object supplies its own gradient term (L1 -> sign)."""
+        if self._decay_obj is not None:
+            return g + self._decay_obj.grad_term(p._data).astype(g.dtype)
         if self._weight_decay:
             return g + self._weight_decay * p._data.astype(g.dtype)
         return g
@@ -494,7 +500,8 @@ class ASGD(Optimizer):
         self._set_acc("averaged_param", p, avg)
 
     def averaged_parameters(self):
-        return {p.name: Tensor(self._accumulators["averaged_param"][id(p)])
+        # copy: the update kernel donates the accumulator buffer next step
+        return {p.name: Tensor(jnp.copy(self._accumulators["averaged_param"][id(p)]))
                 for p in self._parameter_list if id(p) in self._accumulators.get("averaged_param", {})}
 
 

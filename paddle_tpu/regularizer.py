@@ -1,0 +1,38 @@
+"""paddle.regularizer equivalent — L1Decay / L2Decay.
+
+Parity: python/paddle/regularizer.py. The optimizer base consumes the
+``_coeff`` attribute for coupled decay (optimizer.py _apply_decay); L1
+applies through the same hook as a sign-gradient penalty.
+"""
+
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|w|); gradient contribution coeff * sign(w)."""
+
+    def grad_term(self, param_data):
+        import jax.numpy as jnp
+
+        return self._coeff * jnp.sign(param_data)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(w^2); gradient contribution coeff * w."""
+
+    def grad_term(self, param_data):
+        return self._coeff * param_data

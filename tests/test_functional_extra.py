@@ -230,3 +230,59 @@ class TestReviewRegressions:
             return float(p.numpy()[0])
 
         assert run(0.004) != run(0.4)
+
+    def test_ctc_mean_divides_by_label_len(self):
+        rng = np.random.RandomState(13)
+        logits = rng.randn(10, 2, 5).astype("float32")
+        labels = rng.randint(1, 5, (2, 4)).astype("int32")
+        in_lens = np.array([10, 10], "int32")
+        lab_lens = np.array([4, 2], "int32")
+        got = float(F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                               paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                               reduction="mean").numpy())
+        lp = torch.tensor(logits).log_softmax(-1)
+        ref = float(torch.nn.functional.ctc_loss(lp, torch.tensor(labels.astype("int64")),
+                                                 torch.tensor(in_lens.astype("int64")),
+                                                 torch.tensor(lab_lens.astype("int64")),
+                                                 reduction="mean"))
+        assert got == pytest.approx(ref, rel=1e-4)
+
+    def test_l1_decay_applies_sign_gradient(self):
+        import paddle_tpu as paddle
+
+        p = paddle.Parameter(np.asarray([2.0, -3.0], np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                   weight_decay=paddle.regularizer.L1Decay(0.1))
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        opt.step()
+        # g + 0.1*sign(w): update = -1.0 * [0.1, -0.1]
+        np.testing.assert_allclose(p.numpy(), [1.9, -2.9], rtol=1e-6)
+
+    def test_asgd_averaged_parameters_survive_step(self):
+        import paddle_tpu as paddle
+
+        p = paddle.Parameter(np.asarray([1.0], np.float32))
+        p.name = "w"
+        opt = paddle.optimizer.ASGD(learning_rate=0.1, batch_num=0, parameters=[p])
+        p.grad = paddle.to_tensor(np.asarray([1.0], np.float32))
+        opt.step()
+        avg = opt.averaged_parameters()
+        p.grad = paddle.to_tensor(np.asarray([1.0], np.float32))
+        opt.step()
+        assert np.isfinite(avg["w"].numpy()).all()  # must not be a deleted buffer
+
+    def test_max_pool2d_ceil_mode_with_mask(self):
+        x = np.arange(25, dtype="float32").reshape(1, 1, 5, 5)
+        pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, ceil_mode=True,
+                                   return_mask=True)
+        tp, ti = torch.nn.functional.max_pool2d(torch.tensor(x), 2, stride=2,
+                                                ceil_mode=True, return_indices=True)
+        np.testing.assert_allclose(pooled.numpy(), tp.numpy())
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+
+    def test_lp_pool2d_ceil_and_padding(self):
+        x = np.random.RandomState(14).rand(1, 1, 5, 5).astype("float32")
+        got = F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, stride=2, ceil_mode=True).numpy()
+        ref = torch.nn.functional.lp_pool2d(torch.tensor(x), 2.0, 2, stride=2,
+                                            ceil_mode=True).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
