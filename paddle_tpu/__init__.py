@@ -59,5 +59,6 @@ from . import text
 from . import signal
 from . import onnx
 from . import regularizer
+from . import generation
 
 __version__ = "0.1.0"

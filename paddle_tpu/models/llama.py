@@ -76,8 +76,14 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos_tab, sin_tab, position_offset
 
     def _rope(x, cos, sin):
         s = x.shape[1]
-        c = cos[position_offset:position_offset + s][None, :, None, :]
-        si = sin[position_offset:position_offset + s][None, :, None, :]
+        if isinstance(position_offset, int):
+            c = cos[position_offset:position_offset + s]
+            si = sin[position_offset:position_offset + s]
+        else:  # traced offset (jitted decode step)
+            c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, 0)
+            si = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, 0)
+        c = c[None, :, None, :]
+        si = si[None, :, None, :]
         x1, x2 = jnp.split(x, 2, axis=-1)
         out = jnp.concatenate([
             x1 * c - x2 * si,
@@ -110,7 +116,28 @@ class LlamaAttention(nn.Layer):
         v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
         q, k = apply_rotary_pos_emb(q, k, cos_tab, sin_tab, position_offset)
 
-        if kv_cache is not None:
+        static_cache = isinstance(kv_cache, dict)
+        if static_cache:
+            # pre-allocated [b, max_len, h, d] buffers updated in place at
+            # position_offset (jit-friendly decode path; the reference's
+            # cache_kv semantics with TPU-native dynamic_update_slice)
+            def upd(buf, new):
+                return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype),
+                                                    (0, position_offset, 0, 0))
+
+            ck = apply_op("kv_cache_update", upd, kv_cache["k"], k)
+            cv = apply_op("kv_cache_update", upd, kv_cache["v"], v)
+            new_cache = {"k": ck, "v": cv}
+            k, v = ck, cv
+            # attention may only see positions <= position_offset + s - 1
+            max_len = int(ck.shape[1])
+            if attn_mask is None:
+                kpos = jnp.arange(max_len)
+                limit = position_offset + s  # python or traced scalar
+                qpos = position_offset + jnp.arange(s)
+                m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < limit)
+                attn_mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+        elif kv_cache is not None:
             pk, pv = kv_cache
             from ..ops.manipulation import concat
 
@@ -159,15 +186,24 @@ class LlamaDecoderLayer(nn.Layer):
         self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, hidden_states, cos_tab, sin_tab, attn_mask=None):
+    def forward(self, hidden_states, cos_tab, sin_tab, attn_mask=None, kv_cache=None,
+                position_offset=0):
         residual = hidden_states
         hidden_states = self.input_layernorm(hidden_states)
-        hidden_states = self.self_attn(hidden_states, cos_tab, sin_tab, attn_mask)
+        new_cache = None
+        if kv_cache is not None:
+            hidden_states, new_cache = self.self_attn(hidden_states, cos_tab, sin_tab,
+                                                      attn_mask, kv_cache, position_offset)
+        else:
+            hidden_states = self.self_attn(hidden_states, cos_tab, sin_tab, attn_mask)
         hidden_states = residual + hidden_states
         residual = hidden_states
         hidden_states = self.post_attention_layernorm(hidden_states)
         hidden_states = self.mlp(hidden_states)
-        return residual + hidden_states
+        out = residual + hidden_states
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 class LlamaModel(nn.Layer):
@@ -182,9 +218,15 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", Tensor(cos_tab), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin_tab), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
         h = self.embed_tokens(input_ids)
         cos_tab, sin_tab = self.rope_cos._data, self.rope_sin._data
+        if kv_caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, kv_caches):
+                h, nc = layer(h, cos_tab, sin_tab, attn_mask, cache, position_offset)
+                new_caches.append(nc)
+            return self.norm(h), new_caches
         for layer in self.layers:
             h = layer(h, cos_tab, sin_tab, attn_mask)
         return self.norm(h)
@@ -200,13 +242,25 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
 
-    def forward(self, input_ids, attn_mask=None):
-        h = self.llama(input_ids, attn_mask)
+    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
+        if kv_caches is not None:
+            h, new_caches = self.llama(input_ids, attn_mask, kv_caches, position_offset)
+        else:
+            h = self.llama(input_ids, attn_mask)
         if self.lm_head is None:
             from ..ops.math import matmul
 
-            return matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
-        return self.lm_head(h)
+            logits = matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if kv_caches is not None:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        from ..generation import generate
+
+        return generate(self, input_ids, max_new_tokens=max_new_tokens, **kwargs)
 
 
 def llama_pretrain_loss(logits: Tensor, labels: Tensor) -> Tensor:

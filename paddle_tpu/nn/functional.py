@@ -407,9 +407,38 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format, count_include_
     return _f
 
 
+def _ceil_pool_extra(dim: int, k: int, s: int, p: int):
+    """Right/bottom extension for ceil_mode pooling with the reference's
+    window-drop rule: a window starting entirely in the padding is dropped
+    ((o-1)*s must be < dim + p)."""
+    o = (dim + 2 * p - k + s - 1) // s + 1
+    if (o - 1) * s >= dim + p:
+        o -= 1
+    return max(0, (o - 1) * s + k - (dim + 2 * p)), o
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
                data_format="NCHW", name=None):
     x = ensure_tensor(x)
+    if ceil_mode and not return_mask:
+        # extend right/bottom with -inf so the last partial window counts,
+        # then reuse the plain VALID-pool path
+        ks = _pair(kernel_size)
+        st = ks if stride is None else _pair(stride)
+        pd = _pair(padding) if not isinstance(padding, int) else (padding, padding)
+        hw_axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        shape = x.shape
+        eh, _ = _ceil_pool_extra(int(shape[hw_axes[0]]), ks[0], st[0], pd[0])
+        ew, _ = _ceil_pool_extra(int(shape[hw_axes[1]]), ks[1], st[1], pd[1])
+        if eh or ew:
+            pads = [(0, 0)] * 4
+            pads[hw_axes[0]] = (0, eh)
+            pads[hw_axes[1]] = (0, ew)
+
+            def _pad(a):
+                return jnp.pad(a, pads, constant_values=-jnp.inf)
+
+            x = apply_op("ceil_pad", _pad, x)
     if return_mask:
         ks = _pair(kernel_size)
         st = ks if stride is None else _pair(stride)
@@ -421,10 +450,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
             N, C, H, W = a.shape
             extra = [0, 0]
             if ceil_mode:  # extend right/bottom so the last partial window counts
-                for i, dim in enumerate((H, W)):
-                    rem = (dim + 2 * pd[i] - ks[i]) % st[i]
-                    if rem:
-                        extra[i] = st[i] - rem
+                extra[0], _ = _ceil_pool_extra(H, ks[0], st[0], pd[0])
+                extra[1], _ = _ceil_pool_extra(W, ks[1], st[1], pd[1])
             ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0] + extra[0]),
                              (pd[1], pd[1] + extra[1])),
                          constant_values=-jnp.inf)

@@ -279,10 +279,10 @@ def lp_pool2d(x, norm_type: float, kernel_size, stride=None, padding=0, ceil_mod
         H, W = x.shape[2], x.shape[3]
         extra = [0, 0]
         if ceil_mode:  # extend the right/bottom edge so the last partial window counts
-            for i, dim in enumerate((H, W)):
-                rem = (dim + 2 * pd[i] - ks[i]) % st[i]
-                if rem:
-                    extra[i] = st[i] - rem
+            from .functional import _ceil_pool_extra
+
+            extra[0], _ = _ceil_pool_extra(H, ks[0], st[0], pd[0])
+            extra[1], _ = _ceil_pool_extra(W, ks[1], st[1], pd[1])
         pads = ((0, 0), (0, 0), (pd[0], pd[0] + extra[0]), (pd[1], pd[1] + extra[1]))
         p = jnp.power(jnp.abs(jnp.pad(x, pads)), norm_type)
         s = jax.lax.reduce_window(p, 0.0, jax.lax.add, (1, 1) + ks, (1, 1) + tuple(st), "VALID")

@@ -286,3 +286,21 @@ class TestReviewRegressions:
         ref = torch.nn.functional.lp_pool2d(torch.tensor(x), 2.0, 2, stride=2,
                                             ceil_mode=True).numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_pool_ceil_mode_padding_window_drop(self):
+        x = np.arange(25, dtype="float32").reshape(1, 1, 5, 5)
+        # return_mask branch
+        pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, padding=1,
+                                   ceil_mode=True, return_mask=True)
+        tp, ti = torch.nn.functional.max_pool2d(torch.tensor(x), 2, stride=2, padding=1,
+                                                ceil_mode=True, return_indices=True)
+        np.testing.assert_allclose(pooled.numpy(), tp.numpy())
+        # plain branch must honor ceil_mode too
+        plain = F.max_pool2d(paddle.to_tensor(x), 2, stride=2, ceil_mode=True).numpy()
+        tref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, stride=2,
+                                              ceil_mode=True).numpy()
+        np.testing.assert_allclose(plain, tref)
+        # lp_pool with padding would need count_include semantics; shape check
+        lp = F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, stride=2, padding=1,
+                         ceil_mode=True).numpy()
+        assert lp.shape == (1, 1, 3, 3)
