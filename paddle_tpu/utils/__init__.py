@@ -1,1 +1,35 @@
 from . import functional
+
+
+def run_check():
+    """Sanity-check the installation (parity: paddle.utils.run_check) —
+    runs a tiny train step on the default device and, when several devices
+    are visible, a data-parallel step over all of them."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from .. import nn
+
+    print(f"Running verify on backend={jax.default_backend()}, "
+          f"devices={len(jax.devices())} ...")
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"), stop_gradient=False)
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    opt.step()
+    assert np.isfinite(float(loss.numpy()))
+    n = len(jax.devices())
+    if n > 1:
+        import paddle_tpu.distributed as dist
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = dist.ProcessMesh(np.arange(n), ["dp"])
+        arr = jax.device_put(np.ones((n * 2, 4), "float32"),
+                             NamedSharding(mesh.jax_mesh, PartitionSpec("dp")))
+        out = (arr @ np.ones((4, 1), "float32")).sum()
+        assert np.isfinite(float(out))
+        print(f"paddle_tpu works on {n} devices.")
+    print("paddle_tpu is installed successfully!")
