@@ -46,6 +46,7 @@ from .checkpoint import load_state_dict, save_state_dict
 from . import auto_tuner
 from . import elastic
 from . import rpc
+from . import ps
 from . import sharding
 from . import watchdog
 from .fleet.recompute import recompute

@@ -1,0 +1,160 @@
+"""Parameter-server training (dense tables).
+
+Parity: the reference's PS stack (paddle/fluid/distributed/ps/ brpc
+services, python/paddle/distributed/ps/the_one_ps.py) — scoped per
+SURVEY §7.2 step 9 to an API-compatible core: dense tables with
+pull/push(+grad apply) served over the framework RPC layer, worker-side
+sync/async modes. The heter/GPU-graph PS of the reference (~80k LoC,
+CTR-specific) is out of scope for the TPU north star; sparse-table pulls
+raise with a pointer to embedding_bag-based alternatives.
+
+Server state lives host-side (numpy) — the PS role is IO/communication,
+not accelerator compute, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["DenseTable", "PsServer", "PsClient", "init_server", "init_worker",
+           "shutdown"]
+
+
+class DenseTable:
+    """One dense parameter table with a server-side optimizer (SGD/adagrad
+    accumulators, parity: the reference's dense table + optimizer combo)."""
+
+    def __init__(self, name: str, shape, lr: float = 0.01, optimizer: str = "sgd"):
+        self.name = name
+        self.value = np.zeros(shape, np.float32)
+        self.lr = lr
+        self.optimizer = optimizer
+        self._g2 = np.zeros(shape, np.float32) if optimizer == "adagrad" else None
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push_grad(self, grad: np.ndarray):
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._g2 += grad * grad
+                self.value -= self.lr * grad / (np.sqrt(self._g2) + 1e-8)
+            else:
+                self.value -= self.lr * grad
+
+    def assign(self, value: np.ndarray):
+        with self._lock:
+            self.value = np.array(value, np.float32, copy=True)
+
+
+class PsServer:
+    """Hosts tables; methods are invoked remotely via rpc (the brpc service
+    surface of the reference, minus protobuf). RPC requests run on a thread
+    pool, so instance/table creation is lock-guarded."""
+
+    _instance: Optional["PsServer"] = None
+    _cls_lock = threading.Lock()
+
+    def __init__(self):
+        self.tables: Dict[str, DenseTable] = {}
+        self._tables_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "PsServer":
+        with cls._cls_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._cls_lock:
+            cls._instance = None
+
+    # --- remote entry points (run on the server process) ---
+    @staticmethod
+    def create_table(name: str, shape, lr: float = 0.01, optimizer: str = "sgd"):
+        srv = PsServer.instance()
+        with srv._tables_lock:
+            existing = srv.tables.get(name)
+            if existing is not None:
+                if (existing.value.shape != tuple(shape) or existing.lr != lr
+                        or existing.optimizer != optimizer):
+                    raise ValueError(
+                        f"table {name!r} already exists with shape "
+                        f"{existing.value.shape}, lr={existing.lr}, "
+                        f"optimizer={existing.optimizer!r}; requested "
+                        f"{tuple(shape)}, lr={lr}, {optimizer!r}")
+                return True
+            srv.tables[name] = DenseTable(name, shape, lr, optimizer)
+        return True
+
+    @staticmethod
+    def pull_dense(name: str) -> np.ndarray:
+        return PsServer.instance().tables[name].pull()
+
+    @staticmethod
+    def push_dense_grad(name: str, grad: np.ndarray):
+        PsServer.instance().tables[name].push_grad(grad)
+        return True
+
+    @staticmethod
+    def assign_dense(name: str, value: np.ndarray):
+        PsServer.instance().tables[name].assign(value)
+        return True
+
+    @staticmethod
+    def pull_sparse(*args, **kwargs):
+        raise NotImplementedError(
+            "sparse PS tables are out of scope on TPU; use embedding_bag / "
+            "sharded embeddings over the mesh instead")
+
+
+class PsClient:
+    """Worker-side handle (parity: the_one_ps worker API)."""
+
+    def __init__(self, server_name: str = "ps_server"):
+        self.server = server_name
+
+    def create_table(self, name: str, shape, lr: float = 0.01, optimizer: str = "sgd"):
+        return rpc.rpc_sync(self.server, PsServer.create_table,
+                            args=(name, tuple(shape), lr, optimizer))
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return rpc.rpc_sync(self.server, PsServer.pull_dense, args=(name,))
+
+    def push_dense_grad(self, name: str, grad, block: bool = True):
+        g = np.asarray(grad, np.float32)
+        if block:
+            return rpc.rpc_sync(self.server, PsServer.push_dense_grad, args=(name, g))
+        return rpc.rpc_async(self.server, PsServer.push_dense_grad, args=(name, g))
+
+    def assign_dense(self, name: str, value):
+        return rpc.rpc_sync(self.server, PsServer.assign_dense,
+                            args=(name, np.asarray(value, np.float32)))
+
+
+def init_server(name: str = "ps_server", rank: Optional[int] = None,
+                world_size: Optional[int] = None, master_endpoint: Optional[str] = None):
+    """Start this process as a PS server (joins the rpc world under `name`)."""
+    rpc.init_rpc(name, rank=rank, world_size=world_size, master_endpoint=master_endpoint)
+    return PsServer.instance()
+
+
+def init_worker(name: str, rank: Optional[int] = None, world_size: Optional[int] = None,
+                master_endpoint: Optional[str] = None,
+                server_name: str = "ps_server") -> PsClient:
+    rpc.init_rpc(name, rank=rank, world_size=world_size, master_endpoint=master_endpoint)
+    return PsClient(server_name)
+
+
+def shutdown():
+    rpc.shutdown()
+    PsServer.reset()  # next init_server starts with fresh tables

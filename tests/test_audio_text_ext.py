@@ -198,3 +198,62 @@ class TestRpc:
                 rpc.rpc_sync("worker0", divmod, args=(1, 0))
         finally:
             rpc.shutdown()
+
+
+class TestParameterServer:
+    def test_dense_table_pull_push_train(self):
+        """Single-process PS: server + worker share the rpc world; a linear
+        regression trains through pull/push (reference oracle: PS training
+        decreases loss like local SGD)."""
+        from paddle_tpu.distributed import ps
+
+        server = ps.init_server("ps_server", rank=0, world_size=1,
+                                master_endpoint="127.0.0.1:0")
+        try:
+            client = ps.PsClient("ps_server")
+            client.create_table("w", (3, 1), lr=0.1)
+            rng = np.random.RandomState(0)
+            X = rng.randn(32, 3).astype("float32")
+            y = X @ np.array([[1.0], [2.0], [-1.0]], "float32")
+            losses = []
+            for _ in range(40):
+                w = client.pull_dense("w")
+                pred = X @ w
+                losses.append(float(((pred - y) ** 2).mean()))
+                grad = 2 * X.T @ (pred - y) / len(X)
+                client.push_dense_grad("w", grad)
+            assert losses[-1] < losses[0] * 0.05
+            # assign + adagrad table
+            client.create_table("b", (2,), lr=0.5, optimizer="adagrad")
+            client.assign_dense("b", np.array([1.0, -1.0], "float32"))
+            np.testing.assert_allclose(client.pull_dense("b"), [1.0, -1.0])
+            client.push_dense_grad("b", np.array([1.0, 1.0], "float32"))
+            assert client.pull_dense("b")[0] < 1.0
+        finally:
+            ps.shutdown()
+
+    def test_sparse_raises_with_guidance(self):
+        from paddle_tpu.distributed.ps import PsServer
+
+        with pytest.raises(NotImplementedError, match="embedding"):
+            PsServer.pull_sparse("t", [1, 2])
+
+    def test_shutdown_resets_tables_and_spec_mismatch_raises(self):
+        from paddle_tpu.distributed import ps
+
+        ps.init_server("ps_server", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+        try:
+            client = ps.PsClient("ps_server")
+            client.create_table("w", (3, 1), lr=0.1)
+            with pytest.raises(ValueError, match="already exists"):
+                client.create_table("w", (5, 2), lr=0.1)
+        finally:
+            ps.shutdown()
+        # fresh world: same table name with a new shape must work
+        ps.init_server("ps_server", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+        try:
+            client = ps.PsClient("ps_server")
+            client.create_table("w", (5, 2), lr=0.1)
+            assert client.pull_dense("w").shape == (5, 2)
+        finally:
+            ps.shutdown()
