@@ -50,8 +50,13 @@ class DenseTable:
                 self.value -= self.lr * grad
 
     def assign(self, value: np.ndarray):
+        value = np.asarray(value, np.float32)
         with self._lock:
-            self.value = np.array(value, np.float32, copy=True)
+            if value.shape != self.value.shape:
+                raise ValueError(
+                    f"assign to table {self.name!r}: shape {value.shape} != "
+                    f"declared {self.value.shape}")
+            self.value = np.array(value, copy=True)
 
 
 class PsServer:

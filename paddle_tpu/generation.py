@@ -24,7 +24,14 @@ from .core.autograd import no_grad
 from .core.tensor import Tensor
 from .utils.functional import functional_call
 
-__all__ = ["GenerationConfig", "generate"]
+__all__ = ["GenerationConfig", "generate", "generate_uncached"]
+
+
+def _mask_after_eos(gen, eos_id):
+    """Replace everything after the first EOS with EOS (post-hoc, static)."""
+    is_eos = gen == eos_id
+    seen = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos.astype(jnp.int32)
+    return jnp.where(seen > 0, eos_id, gen)
 
 
 @dataclass
@@ -55,6 +62,37 @@ def _select_token(logits, cfg: GenerationConfig, key):
         cutoff = jnp.min(jnp.where(inside, sorted_logits, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate_uncached(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
+                      temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                      eos_token_id: Optional[int] = None, seed: int = 0) -> Tensor:
+    """Fallback decode for models without KV-cache plumbing (GPT/BERT
+    style): re-runs the full forward per token. Correct but O(n^2) — the
+    cached path in ``generate`` is the serving path."""
+    cfg = GenerationConfig(max_new_tokens, do_sample, temperature, top_k, top_p,
+                           eos_token_id, seed)
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    S = ids.shape[1]
+    max_pos = getattr(model.config, "max_position_embeddings", None)
+    if max_pos is not None and S + cfg.max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({cfg.max_new_tokens}) exceeds "
+            f"max_position_embeddings ({max_pos})")
+    if cfg.max_new_tokens <= 0:
+        return Tensor(ids)
+    key = jax.random.PRNGKey(cfg.seed)
+    with no_grad():
+        for _ in range(cfg.max_new_tokens):
+            logits = model(Tensor(ids))
+            key, sub = jax.random.split(key)
+            nxt = _select_token(logits._data[:, -1], cfg, sub)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    if cfg.eos_token_id is not None:
+        gen = _mask_after_eos(ids[:, S:], cfg.eos_token_id)
+        ids = jnp.concatenate([ids[:, :S], gen], axis=1)
+    return Tensor(ids)
 
 
 def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
@@ -135,8 +173,5 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     gen = jnp.stack(out, axis=1)  # [B, N]
 
     if cfg.eos_token_id is not None:
-        # mask everything after the first EOS with EOS (post-hoc, static)
-        is_eos = gen == cfg.eos_token_id
-        seen = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos.astype(jnp.int32)
-        gen = jnp.where(seen > 0, cfg.eos_token_id, gen)
+        gen = _mask_after_eos(gen, cfg.eos_token_id)
     return Tensor(jnp.concatenate([ids, gen], axis=1))

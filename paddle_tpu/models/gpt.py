@@ -96,3 +96,8 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, attn_mask=None):
         return self.lm_head(self.gpt(input_ids, attn_mask))
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        from ..generation import generate_uncached
+
+        return generate_uncached(self, input_ids, max_new_tokens=max_new_tokens, **kwargs)

@@ -82,3 +82,23 @@ class TestGeneration:
         n = len(store)
         model.generate(ids, max_new_tokens=3)
         assert len(store) == n  # same shapes/config: reused, not re-built
+
+
+class TestUncachedGeneration:
+    def test_gpt_generate_greedy(self):
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(1)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=32)
+        model = GPTForCausalLM(cfg)
+        ids = np.random.RandomState(2).randint(0, 64, (2, 5)).astype("int32")
+        out = model.generate(paddle.to_tensor(ids), max_new_tokens=4).numpy()
+        assert out.shape == (2, 9)
+        # greedy reference via repeated full forward
+        cur = ids
+        for _ in range(4):
+            logits = model(paddle.to_tensor(cur)).numpy()
+            cur = np.concatenate([cur, logits[:, -1].argmax(-1).astype("int32")[:, None]], 1)
+        np.testing.assert_array_equal(out, cur)
