@@ -1,0 +1,87 @@
+"""OpTest-pattern checks (output + numeric-vs-analytic grads) for the
+extended functional surface — the reference's check_output/check_grad
+oracle applied to grid_sample, fold, losses, pooling, signal, sparse ops.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from optest import check_grad, check_output
+from paddle_tpu.nn import functional as F
+
+
+class TestExtendedOpGrads:
+    def test_grid_sample_grads(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 6, 6).astype("float32")
+        grid = (rng.rand(1, 3, 3, 2).astype("float32") * 1.6 - 0.8)
+        check_grad(lambda a, g: F.grid_sample(a, g), [x, grid], grad_inputs=[0])
+
+    def test_fold_grads(self):
+        rng = np.random.RandomState(1)
+        cols = rng.randn(1, 2 * 2 * 2, 9).astype("float32")
+        check_grad(lambda c: F.fold(c, (6, 6), 2, strides=2), [cols])
+
+    def test_huber_and_triplet_grads(self):
+        rng = np.random.RandomState(2)
+        a, b = rng.randn(8).astype("float32"), rng.randn(8).astype("float32")
+        check_grad(lambda x, y: F.huber_loss(x, y, delta=0.5), [a, b], grad_inputs=[0])
+        p, n = rng.randn(4, 6).astype("float32"), rng.randn(4, 6).astype("float32")
+        anchor = rng.randn(4, 6).astype("float32")
+        check_grad(lambda q, r, s: F.triplet_margin_loss(q, r, s), [anchor, p, n],
+                   grad_inputs=[0])
+
+    def test_lp_pool_grads(self):
+        rng = np.random.RandomState(3)
+        x = np.abs(rng.randn(1, 1, 6, 6)).astype("float32") + 0.1
+        check_grad(lambda a: F.lp_pool2d(a, 2.0, 2, stride=2), [x])
+
+    def test_stft_grads_match_jax(self):
+        """|STFT| finite differences are too noisy at f32; the oracle here is
+        jax.grad of the same composite (tape must agree exactly)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 256).astype("float32")
+        t = paddle.to_tensor(x, stop_gradient=False)
+        paddle.signal.stft(t, 64, 32).abs().sum().backward()
+
+        def f(a):
+            return paddle.signal.stft(Tensor(a), 64, 32).abs().sum()._data
+
+        ref = np.asarray(jax.grad(f)(jnp.asarray(x)))
+        np.testing.assert_allclose(t.grad.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_pixel_unshuffle_output_and_grads(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+
+        def np_ref(a):
+            n, c, h, w = a.shape
+            r = 2
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            return out.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * 4, h // r, w // r)
+
+        check_output(lambda t: F.pixel_unshuffle(t, 2), np_ref, [x])
+        check_grad(lambda t: F.pixel_unshuffle(t, 2), [x])
+
+    def test_embedding_bag_grads(self):
+        rng = np.random.RandomState(6)
+        w = rng.randn(10, 4).astype("float32")
+        ids = np.array([[0, 3], [7, 2]], "int64")
+        check_grad(lambda weight: F.embedding_bag(paddle.to_tensor(ids), weight,
+                                                  mode="mean"), [w])
+
+    def test_sparse_matmul_grads(self):
+        from paddle_tpu import sparse
+
+        rng = np.random.RandomState(7)
+        dense_a = np.zeros((4, 5), "float32")
+        dense_a[rng.rand(4, 5) > 0.6] = 1.5
+        sp = paddle.to_tensor(dense_a).to_sparse_coo(2)
+        b = rng.randn(5, 3).astype("float32")
+        check_grad(lambda y: sparse.matmul(sp, y), [b])
