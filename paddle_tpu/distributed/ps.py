@@ -3,10 +3,10 @@
 Parity: the reference's PS stack (paddle/fluid/distributed/ps/ brpc
 services, python/paddle/distributed/ps/the_one_ps.py) — scoped per
 SURVEY §7.2 step 9 to an API-compatible core: dense tables with
-pull/push(+grad apply) served over the framework RPC layer, worker-side
-sync/async modes. The heter/GPU-graph PS of the reference (~80k LoC,
-CTR-specific) is out of scope for the TPU north star; sparse-table pulls
-raise with a pointer to embedding_bag-based alternatives.
+pull/push(+grad apply) and sparse id->embedding tables with lazy row
+creation, served over the framework RPC layer. The heter/GPU-graph PS of
+the reference (~80k LoC, CTR-specific accelerator caching) is out of
+scope for the TPU north star.
 
 Server state lives host-side (numpy) — the PS role is IO/communication,
 not accelerator compute, exactly as in the reference.
@@ -21,8 +21,8 @@ import numpy as np
 
 from . import rpc
 
-__all__ = ["DenseTable", "PsServer", "PsClient", "init_server", "init_worker",
-           "shutdown"]
+__all__ = ["DenseTable", "SparseTable", "PsServer", "PsClient", "init_server",
+           "init_worker", "shutdown"]
 
 
 class DenseTable:
@@ -57,6 +57,50 @@ class DenseTable:
                     f"assign to table {self.name!r}: shape {value.shape} != "
                     f"declared {self.value.shape}")
             self.value = np.array(value, copy=True)
+
+
+class SparseTable:
+    """Sparse (id -> embedding row) table with lazy row creation and a
+    per-row server optimizer (parity: the reference's sparse/embedding
+    tables for CTR workloads — downpour SGD/adagrad rows)."""
+
+    def __init__(self, name: str, emb_dim: int, lr: float = 0.01,
+                 optimizer: str = "sgd", init_std: float = 0.01):
+        self.name = name
+        self.emb_dim = emb_dim
+        self.lr = lr
+        self.optimizer = optimizer
+        self.init_std = init_std
+        self.rows: Dict[int, np.ndarray] = {}
+        self._g2: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(hash(name) % (2 ** 31))
+        self._lock = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:
+            r = (self._rng.randn(self.emb_dim) * self.init_std).astype(np.float32)
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids.ravel()]).reshape(
+                ids.shape + (self.emb_dim,))
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray):
+        flat_ids = ids.ravel()
+        flat_g = grads.reshape(-1, self.emb_dim)
+        with self._lock:
+            for i, g in zip(flat_ids, flat_g):
+                i = int(i)
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    g2 = self._g2.setdefault(i, np.zeros(self.emb_dim, np.float32))
+                    g2 += g * g
+                    row -= self.lr * g / (np.sqrt(g2) + 1e-8)
+                else:
+                    row -= self.lr * g
 
 
 class PsServer:
@@ -116,10 +160,27 @@ class PsServer:
         return True
 
     @staticmethod
-    def pull_sparse(*args, **kwargs):
-        raise NotImplementedError(
-            "sparse PS tables are out of scope on TPU; use embedding_bag / "
-            "sharded embeddings over the mesh instead")
+    def create_sparse_table(name: str, emb_dim: int, lr: float = 0.01,
+                            optimizer: str = "sgd", init_std: float = 0.01):
+        srv = PsServer.instance()
+        with srv._tables_lock:
+            existing = srv.tables.get(name)
+            if existing is not None:
+                if not isinstance(existing, SparseTable) or existing.emb_dim != emb_dim:
+                    raise ValueError(f"table {name!r} exists with a different spec")
+                return True
+            srv.tables[name] = SparseTable(name, emb_dim, lr, optimizer, init_std)
+        return True
+
+    @staticmethod
+    def pull_sparse(name: str, ids) -> np.ndarray:
+        return PsServer.instance().tables[name].pull(np.asarray(ids, np.int64))
+
+    @staticmethod
+    def push_sparse_grad(name: str, ids, grads):
+        PsServer.instance().tables[name].push_grad(np.asarray(ids, np.int64),
+                                                   np.asarray(grads, np.float32))
+        return True
 
 
 class PsClient:
@@ -144,6 +205,20 @@ class PsClient:
     def assign_dense(self, name: str, value):
         return rpc.rpc_sync(self.server, PsServer.assign_dense,
                             args=(name, np.asarray(value, np.float32)))
+
+    def create_sparse_table(self, name: str, emb_dim: int, lr: float = 0.01,
+                            optimizer: str = "sgd"):
+        return rpc.rpc_sync(self.server, PsServer.create_sparse_table,
+                            args=(name, emb_dim, lr, optimizer))
+
+    def pull_sparse(self, name: str, ids) -> np.ndarray:
+        return rpc.rpc_sync(self.server, PsServer.pull_sparse,
+                            args=(name, np.asarray(ids, np.int64)))
+
+    def push_sparse_grad(self, name: str, ids, grads):
+        return rpc.rpc_sync(self.server, PsServer.push_sparse_grad,
+                            args=(name, np.asarray(ids, np.int64),
+                                  np.asarray(grads, np.float32)))
 
 
 def init_server(name: str = "ps_server", rank: Optional[int] = None,

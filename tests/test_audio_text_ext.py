@@ -232,11 +232,25 @@ class TestParameterServer:
         finally:
             ps.shutdown()
 
-    def test_sparse_raises_with_guidance(self):
-        from paddle_tpu.distributed.ps import PsServer
+    def test_sparse_table_lazy_rows_and_training(self):
+        from paddle_tpu.distributed import ps
 
-        with pytest.raises(NotImplementedError, match="embedding"):
-            PsServer.pull_sparse("t", [1, 2])
+        ps.init_server("ps_server", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+        try:
+            client = ps.PsClient("ps_server")
+            client.create_sparse_table("emb", emb_dim=4, lr=0.5)
+            ids = np.array([3, 99, 3], "int64")
+            rows = client.pull_sparse("emb", ids)
+            assert rows.shape == (3, 4)
+            np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+            grads = np.ones((3, 4), "float32")
+            client.push_sparse_grad("emb", ids, grads)
+            rows2 = client.pull_sparse("emb", ids)
+            # id 3 got two gradient rows applied, id 99 one
+            np.testing.assert_allclose(rows[0] - rows2[0], 2 * 0.5 * np.ones(4), atol=1e-6)
+            np.testing.assert_allclose(rows[1] - rows2[1], 0.5 * np.ones(4), atol=1e-6)
+        finally:
+            ps.shutdown()
 
     def test_shutdown_resets_tables_and_spec_mismatch_raises(self):
         from paddle_tpu.distributed import ps
