@@ -73,7 +73,9 @@ class SparseTable:
         self.init_std = init_std
         self.rows: Dict[int, np.ndarray] = {}
         self._g2: Dict[int, np.ndarray] = {}
-        self._rng = np.random.RandomState(hash(name) % (2 ** 31))
+        import zlib
+
+        self._rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
         self._lock = threading.Lock()
 
     def _row(self, i: int) -> np.ndarray:
@@ -85,6 +87,8 @@ class SparseTable:
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         with self._lock:
+            if ids.size == 0:
+                return np.zeros(ids.shape + (self.emb_dim,), np.float32)
             return np.stack([self._row(int(i)) for i in ids.ravel()]).reshape(
                 ids.shape + (self.emb_dim,))
 
@@ -134,12 +138,14 @@ class PsServer:
         with srv._tables_lock:
             existing = srv.tables.get(name)
             if existing is not None:
-                if (existing.value.shape != tuple(shape) or existing.lr != lr
+                if (not isinstance(existing, DenseTable)
+                        or existing.value.shape != tuple(shape) or existing.lr != lr
                         or existing.optimizer != optimizer):
+                    desc = (f"shape {existing.value.shape}" if isinstance(existing, DenseTable)
+                            else "a sparse table")
                     raise ValueError(
-                        f"table {name!r} already exists with shape "
-                        f"{existing.value.shape}, lr={existing.lr}, "
-                        f"optimizer={existing.optimizer!r}; requested "
+                        f"table {name!r} already exists as {desc}, lr={existing.lr}, "
+                        f"optimizer={existing.optimizer!r}; requested dense "
                         f"{tuple(shape)}, lr={lr}, {optimizer!r}")
                 return True
             srv.tables[name] = DenseTable(name, shape, lr, optimizer)
@@ -166,7 +172,9 @@ class PsServer:
         with srv._tables_lock:
             existing = srv.tables.get(name)
             if existing is not None:
-                if not isinstance(existing, SparseTable) or existing.emb_dim != emb_dim:
+                if (not isinstance(existing, SparseTable) or existing.emb_dim != emb_dim
+                        or existing.lr != lr or existing.optimizer != optimizer
+                        or existing.init_std != init_std):
                     raise ValueError(f"table {name!r} exists with a different spec")
                 return True
             srv.tables[name] = SparseTable(name, emb_dim, lr, optimizer, init_std)

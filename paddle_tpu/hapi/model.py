@@ -247,13 +247,23 @@ class Model:
 
     # -- persistence (reference save:1196 / load) --------------------------
     def save(self, path: str, training: bool = True):
+        """training=True: checkpoint (params + optimizer state);
+        training=False: export an inference program via jit.save (reference:
+        hapi Model.save -> paddle.jit.save when training=False)."""
         dirname = os.path.dirname(path)
         if dirname:
             os.makedirs(dirname, exist_ok=True)
         import paddle_tpu as paddle
 
+        if not training:
+            if not self._input_spec:
+                raise ValueError(
+                    "Model.save(training=False) needs input specs: construct "
+                    "Model(net, inputs=[InputSpec(...)]) to export an inference model")
+            paddle.jit.save(self.network, path, input_spec=_to_list(self._input_spec))
+            return
         paddle.save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             state = getattr(self._optimizer, "state_dict", lambda: {})()
             paddle.save(state, path + ".pdopt")
 

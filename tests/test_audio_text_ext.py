@@ -271,3 +271,19 @@ class TestParameterServer:
             assert client.pull_dense("w").shape == (5, 2)
         finally:
             ps.shutdown()
+
+    def test_sparse_table_empty_pull_and_spec_guards(self):
+        from paddle_tpu.distributed import ps
+
+        ps.init_server("ps_server", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+        try:
+            client = ps.PsClient("ps_server")
+            client.create_sparse_table("e", emb_dim=4, lr=0.5)
+            empty = client.pull_sparse("e", np.array([], "int64"))
+            assert empty.shape == (0, 4)
+            with pytest.raises(ValueError, match="different spec"):
+                client.create_sparse_table("e", emb_dim=4, lr=0.01)
+            with pytest.raises(ValueError):
+                client.create_table("e", (4,))  # name held by a sparse table
+        finally:
+            ps.shutdown()

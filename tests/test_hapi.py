@@ -153,3 +153,22 @@ def test_network_returning_loss_directly():
     for _ in range(10):
         l1 = model.train_batch([x])
     assert l1[0] < l0[0]
+
+
+class TestModelInferenceExport:
+    def test_save_training_false_exports_program(self, tmp_path):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(6, 4), nn.ReLU(), nn.Linear(4, 2))
+        model = Model(net, inputs=[InputSpec([None, 6], "float32", name="x")])
+        prefix = str(tmp_path / "infer")
+        model.save(prefix, training=False)
+        loaded = paddle.jit.load(prefix)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(3, 6).astype("float32"))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5, atol=1e-6)
