@@ -203,5 +203,38 @@ def _patch_tensor():
 
     Tensor.cast_ = cast_
 
+    # remaining reference Tensor-method surface
+    import numpy as _np
+
+    Tensor.numel = lambda self: self.size
+    Tensor.dim = lambda self: self.ndim
+    Tensor.ndimension = Tensor.dim
+    Tensor.element_size = lambda self: _np.dtype(self._data.dtype).itemsize
+    # reference API form: methods, not properties (paddle Tensor.real())
+    Tensor.real = math.real
+    Tensor.imag = math.imag
+    def _mT(self):
+        if len(self._data.shape) < 2:
+            raise ValueError("Tensor.mT/H require at least 2 dimensions")
+        return manipulation.swapaxes(self, -1, -2)
+
+    Tensor.mT = property(_mT)
+    Tensor.H = property(lambda self: math.conj(_mT(self)))
+    Tensor.unbind = lambda self, axis=0: manipulation.unstack(self, axis)
+    Tensor.cuda = lambda self, *a, **k: self  # device movement is a no-op handle copy
+    Tensor.value = lambda self: self
+    Tensor.get_tensor = lambda self: self
+    for nm, op in (("exp_", math.exp), ("sqrt_", math.sqrt), ("rsqrt_", math.rsqrt),
+                   ("floor_", math.floor), ("ceil_", math.ceil), ("round_", math.round),
+                   ("reciprocal_", math.reciprocal), ("tanh_", math.tanh)):
+        setattr(Tensor, nm, _make_inplace_unary(op))
+
+
+def _make_inplace_unary(op):
+    def f(self, name=None):
+        return self._replace_(op(self))
+
+    return f
+
 
 _patch_tensor()

@@ -194,3 +194,33 @@ class TestCreation:
         assert r.min() >= 0 and r.max() < 10
         p = paddle.randperm(16).numpy()
         assert sorted(p.tolist()) == list(range(16))
+
+
+class TestTensorMethodSurface:
+    def test_inspection_and_views(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        assert t.numel() == 6
+        assert t.dim() == 2 == t.ndimension()
+        assert t.element_size() == 4
+        np.testing.assert_allclose(t.mT.numpy(), t.numpy().T)
+        assert len(t.unbind(1)) == 3
+        assert t.cuda() is t and t.value() is t and t.get_tensor() is t
+
+    def test_complex_parts_and_inplace_unary(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        c = paddle.to_tensor(np.array([[2 + 3j]], "complex64"))
+        np.testing.assert_allclose(c.real().numpy(), [[2.0]])  # paddle method form
+        np.testing.assert_allclose(c.imag().numpy(), [[3.0]])
+        np.testing.assert_allclose(c.H.numpy(), [[2 - 3j]])
+        x = paddle.to_tensor(np.array([9.0], "float32"))
+        assert x.sqrt_() is x
+        np.testing.assert_allclose(x.numpy(), [3.0])
+        x.exp_()
+        np.testing.assert_allclose(x.numpy(), [np.exp(3.0)], rtol=1e-6)
