@@ -97,9 +97,15 @@ class GradNode:
     ``vjp_fn``: cotangents-of-outputs -> cotangents-of-inputs (XLA traced).
     ``edges[i]`` describes where input-cotangent ``i`` flows.
     ``out_specs``: (shape, dtype) per output slot for zero-filling.
+    ``fwd_fn``/``fwd_inputs``/``diff_idx``: re-derivation info for
+    create_graph=True (double backward): the pure forward over the
+    differentiable inputs, the input Tensors, and their positions — the
+    backward pass is re-expressed as taped ops so grad-of-grad sees the
+    residual dependence (reference: generated GradNode ops being tracked).
     """
 
-    __slots__ = ("name", "vjp_fn", "edges", "out_specs", "hooks", "released")
+    __slots__ = ("name", "vjp_fn", "edges", "out_specs", "hooks", "released",
+                 "fwd_fn", "fwd_inputs", "fwd_datas", "diff_idx", "multi")
 
     def __init__(self, name: str, vjp_fn: Callable, edges: List[Edge], out_specs: List[Tuple[tuple, Any]]):
         self.name = name
@@ -108,6 +114,11 @@ class GradNode:
         self.out_specs = out_specs
         self.hooks: List[Callable] = []
         self.released = False
+        self.fwd_fn = None
+        self.fwd_inputs = None
+        self.fwd_datas = None
+        self.diff_idx = None
+        self.multi = False
 
     def __repr__(self):
         return f"<GradNode {self.name} n_in={len(self.edges)} n_out={len(self.out_specs)}>"
@@ -194,7 +205,12 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
         for hook in node.hooks:
             in_cots = hook(in_cots)
         if not retain_graph:
+            # drop BOTH the stored pullback and the re-derivation snapshots,
+            # or the graph's activations stay pinned after backward
             node.vjp_fn = None
+            node.fwd_fn = None
+            node.fwd_inputs = None
+            node.fwd_datas = None
             node.released = True
         for e, g in zip(node.edges, in_cots):
             if e.leaf is not None:
@@ -202,6 +218,124 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
                     e.leaf._accumulate_grad(g)
             elif e.node is not None:
                 if g is not None and not _is_float0(g):
+                    seed(e.node, e.slot, g)
+                indeg[id(e.node)] -= 1
+                if indeg[id(e.node)] == 0:
+                    ready.append(e.node)
+
+
+def _backward_create_graph(roots, grad_tensors, capture: dict):
+    """Taped backward: cotangents flow as Tensors and each node's vjp is
+    re-derived with ``apply_op`` over (inputs, cotangents), so the computed
+    gradients carry their own grad nodes (double backward; parity:
+    RunBackward with create_graph — backward ops are themselves tracked).
+
+    NOTE: shares the traversal shape with backward() above but the per-node
+    kernel differs fundamentally (Tensor cotangents + taped re-derivation
+    vs raw arrays + stored pullback); changes to seeding/ordering semantics
+    must be mirrored in both."""
+    from .tensor import Tensor
+    from ..ops.dispatch import apply_op
+
+    pending: dict = {}
+    nodes: dict = {}
+    indeg: dict = {}
+
+    def seed(node: GradNode, slot: int, g: "Tensor"):
+        buf = pending.setdefault(id(node), [None] * len(node.out_specs))
+        buf[slot] = g if buf[slot] is None else buf[slot] + g
+
+    root_nodes: List[GradNode] = []
+    for t, g in zip(roots, grad_tensors):
+        node = t._grad_node
+        if node is None:
+            continue
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError("grad can be implicitly created only for scalar outputs")
+            gt = Tensor(jnp.ones(t._data.shape, t._data.dtype), stop_gradient=True)
+        else:
+            gt = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g, t._data.dtype), stop_gradient=True)
+        seed(node, t._out_slot, gt)
+        root_nodes.append(node)
+
+    stack = list(root_nodes)
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes:
+            continue
+        nodes[id(node)] = node
+        indeg.setdefault(id(node), 0)
+        for e in node.edges:
+            if e.node is not None:
+                indeg[id(e.node)] = indeg.get(id(e.node), 0) + 1
+                stack.append(e.node)
+
+    ready = deque(nodes[nid] for nid in set(map(id, root_nodes)) if indeg[nid] == 0)
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        if node.fwd_fn is None:
+            raise NotImplementedError(
+                f"create_graph=True through node {node.name} is unsupported "
+                "(no re-derivation info — e.g. PyLayer/recompute nodes)")
+        cots = pending.pop(id(node), [None] * len(node.out_specs))
+        cot_ts = [c if c is not None else Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+                  for c, (shape, dtype) in zip(cots, node.out_specs)]
+        n_in = len(node.fwd_inputs)
+        fwd_fn, multi, out_specs = node.fwd_fn, node.multi, node.out_specs
+
+        def revjp(*args, _fwd=fwd_fn, _n=n_in, _multi=multi, _specs=out_specs):
+            xs, cs = args[:_n], args[_n:]
+            _, vjp = jax.vjp(_fwd, *xs)
+            cs = list(cs)
+            fixed = []
+            ci = 0
+            for shape, dtype in _specs:
+                import numpy as _np
+
+                if _np.issubdtype(_np.dtype(dtype), _np.floating) or _np.issubdtype(
+                        _np.dtype(dtype), _np.complexfloating):
+                    fixed.append(cs[ci])
+                else:
+                    fixed.append(_np.zeros(shape, jax.dtypes.float0))
+                ci += 1
+            out = fixed[0] if not _multi else tuple(fixed)
+            res = vjp(out)
+            # singleton tuples break the engine's single-output convention
+            return res[0] if len(res) == 1 else res
+
+        # run over the record-time snapshots: later in-place mutation of the
+        # inputs must not change the re-derived vjp (swap data in, restore)
+        saved_data = [t._data for t in node.fwd_inputs]
+        for t, d in zip(node.fwd_inputs, node.fwd_datas):
+            t._data = d
+        try:
+            diff_cots = apply_op(f"grad_{node.name}", revjp, *node.fwd_inputs, *cot_ts)
+        finally:
+            for t, d in zip(node.fwd_inputs, saved_data):
+                t._data = d
+        diff_cots = diff_cots if isinstance(diff_cots, (tuple, list)) else [diff_cots]
+        # scatter diff-input cotangents back to the full edge list
+        full = [None] * len(node.edges)
+        for i, g in zip(node.diff_idx, diff_cots):
+            full[i] = g
+        for e, g in zip(node.edges, full):
+            if e.leaf is not None:
+                if g is not None:
+                    # leaf hooks (e.g. DP allreduce) must still fire; they
+                    # receive the live (graph-carrying) grad Tensor here
+                    for hook in e.leaf._hooks:
+                        out = hook(g)
+                        if out is not None:
+                            g = out
+                    key = id(e.leaf)
+                    capture[key] = g if capture.get(key) is None else capture[key] + g
+            elif e.node is not None:
+                if g is not None:
                     seed(e.node, e.slot, g)
                 indeg[id(e.node)] -= 1
                 if indeg[id(e.node)] == 0:
@@ -218,21 +352,34 @@ def grad(
 ):
     """``paddle.grad`` equivalent: partial-graph gradient computation.
 
-    Parity: paddle/fluid/eager/backward.cc:103 GeneralGrad (non-higher-order
-    subset; ``create_graph`` raises for now — program-mode AD covers
-    higher-order via jax.grad composition).
+    Parity: paddle/fluid/eager/backward.cc:103 GeneralGrad; with
+    ``create_graph=True`` the backward pass is re-derived through the tape
+    so returned grads are differentiable (double backward).
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; use "
-            "paddle_tpu.jit.to_static + jax.grad composition for higher-order AD"
-        )
     outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph
+
+    if create_graph:
+        roots = [t for t in outputs if isinstance(t, Tensor)]
+        gts = list(grad_outputs) if grad_outputs is not None else [None] * len(roots)
+        capture: dict = {}
+        _backward_create_graph(roots, gts, capture)
+        results = []
+        for inp in inputs:
+            g = capture.get(id(inp))
+            if g is None:
+                if allow_unused:
+                    results.append(None)
+                else:
+                    results.append(Tensor(jnp.zeros(inp._data.shape, inp._data.dtype),
+                                          stop_gradient=True))
+            else:
+                results.append(g)
+        return results
 
     # Save/clear existing leaf grads of inputs, run backward, collect, restore.
     saved = [inp._grad_data for inp in inputs]

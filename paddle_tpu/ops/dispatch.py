@@ -169,6 +169,14 @@ def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[in
         return vjp_fn(cots)
 
     node = GradNode(name, vjp_with_zero_fill, edges, out_specs)
+    # re-derivation info for create_graph (double backward); fwd_datas
+    # snapshots the input arrays so later in-place mutation of the input
+    # Tensors cannot corrupt the re-derived vjp
+    node.fwd_fn = wrapped
+    node.fwd_inputs = [tensors[i] for i in diff_idx]
+    node.fwd_datas = diff_datas
+    node.diff_idx = diff_idx
+    node.multi = multi
 
     outs = []
     for i, d in enumerate(outs_data):
