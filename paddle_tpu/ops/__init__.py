@@ -21,8 +21,9 @@ from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .math_extra import *  # noqa: F401,F403
 
-from . import creation, random, math, manipulation, logic, search
+from . import creation, random, math, manipulation, logic, math_extra, search
 
 
 def _norm_index(idx):
@@ -202,6 +203,13 @@ def _patch_tensor():
         return self
 
     Tensor.cast_ = cast_
+
+    # long-tail ops as Tensor methods (paddle method-call parity)
+    for nm in ("bincount", "take", "quantile", "nanquantile", "nanmedian", "signbit",
+               "sinc", "sgn", "isneginf", "isposinf", "isreal", "frexp", "unflatten",
+               "masked_scatter", "renorm", "cov", "corrcoef", "vander", "trapezoid",
+               "cumulative_trapezoid", "cdist"):
+        setattr(Tensor, nm, getattr(math_extra, nm))
 
     # remaining reference Tensor-method surface
     import numpy as _np

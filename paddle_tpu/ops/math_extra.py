@@ -1,0 +1,312 @@
+"""Long-tail math/manipulation ops.
+
+Parity: the remaining python/paddle/tensor/{math,manipulation,linalg,
+stat}.py surface — bincount, vander, trapezoid, cdist, quantile family,
+stacking/splitting aliases, cov/corrcoef, take, renorm, polar/sgn/sinc,
+masked_scatter. All pure jnp through the standard dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+__all__ = [
+    "bincount", "vander", "trapezoid", "cumulative_trapezoid", "cdist", "renorm",
+    "frexp", "signbit", "take", "masked_scatter", "column_stack", "row_stack",
+    "hstack", "vstack", "dstack", "hsplit", "vsplit", "dsplit", "unflatten",
+    "block_diag", "cartesian_prod", "corrcoef", "cov", "nanmedian", "quantile",
+    "nanquantile", "bitwise_invert", "polar", "sgn", "sinc", "isneginf",
+    "isposinf", "isreal", "combinations",
+]
+
+
+def bincount(x, weights=None, minlength: int = 0, name=None) -> Tensor:
+    xt = ensure_tensor(x)
+    d = xt._data
+    if isinstance(d, jax.Array) and not isinstance(d, jax.core.Tracer):
+        n = int(max(int(np.asarray(d).max(initial=-1)) + 1, minlength))
+    elif minlength > 0:  # traced/static values: the static length must be given
+        n = minlength
+    else:
+        raise NotImplementedError(
+            "bincount under tracing/static capture needs minlength (the output "
+            "length is data-dependent)")
+
+    if weights is None:
+        return apply_op("bincount", lambda a: jnp.bincount(a, length=n), xt)
+    return apply_op("bincount", lambda a, w: jnp.bincount(a, weights=w, length=n),
+                    xt, ensure_tensor(weights))
+
+
+def vander(x, n: Optional[int] = None, increasing: bool = False, name=None) -> Tensor:
+    xt = ensure_tensor(x)
+    cols = n if n is not None else int(xt.shape[0])
+    return apply_op("vander", lambda a: jnp.vander(a, N=cols, increasing=increasing), xt)
+
+
+def trapezoid(y, x=None, dx=None, axis: int = -1, name=None) -> Tensor:
+    yt = ensure_tensor(y)
+    if x is not None:
+        return apply_op("trapezoid", lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                        yt, ensure_tensor(x))
+    step = 1.0 if dx is None else dx
+    return apply_op("trapezoid", lambda yy: jnp.trapezoid(yy, dx=step, axis=axis), yt)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis: int = -1, name=None) -> Tensor:
+    yt = ensure_tensor(y)
+
+    def fn(yy, *rest):
+        yy_m = jnp.moveaxis(yy, axis, -1)
+        if rest:
+            xx = jnp.moveaxis(rest[0], axis, -1) if rest[0].ndim == yy.ndim else rest[0]
+            d = jnp.diff(xx, axis=-1)
+        else:
+            d = jnp.full(yy_m.shape[-1] - 1, 1.0 if dx is None else dx, yy.dtype)
+        avg = (yy_m[..., 1:] + yy_m[..., :-1]) * 0.5
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    if x is not None:
+        return apply_op("cumulative_trapezoid", fn, yt, ensure_tensor(x))
+    return apply_op("cumulative_trapezoid", fn, yt)
+
+
+def cdist(x, y, p: float = 2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None) -> Tensor:
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            d2 = (d * d).sum(-1)
+            # zero-distance pairs (self-distance) must not NaN the gradient
+            safe = jnp.where(d2 > 0, d2, 1.0)
+            return jnp.where(d2 > 0, jnp.sqrt(safe), 0.0)
+        return jnp.power(jnp.power(jnp.abs(d), p).sum(-1), 1.0 / p)
+
+    return apply_op("cdist", fn, ensure_tensor(x), ensure_tensor(y))
+
+
+def renorm(x, p: float, axis: int, max_norm: float, name=None) -> Tensor:
+    def fn(a):
+        am = jnp.moveaxis(a, axis, 0)
+        flat = am.reshape(am.shape[0], -1)
+        norms = jnp.power(jnp.power(jnp.abs(flat), p).sum(-1), 1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return jnp.moveaxis(am * scale.reshape((-1,) + (1,) * (am.ndim - 1)), 0, axis)
+
+    return apply_op("renorm", fn, ensure_tensor(x))
+
+
+def frexp(x, name=None):
+    return apply_op("frexp", lambda a: jnp.frexp(a), ensure_tensor(x))
+
+
+def signbit(x, name=None) -> Tensor:
+    return apply_op("signbit", jnp.signbit, ensure_tensor(x))
+
+
+def take(x, index, mode: str = "raise", name=None) -> Tensor:
+    xt, it = ensure_tensor(x), ensure_tensor(index)
+    if mode == "raise":
+        # eager bounds check when values are concrete (tracers can't raise)
+        idx_val = it._data
+        if isinstance(idx_val, jax.Array) and not isinstance(idx_val, jax.core.Tracer):
+            n = int(np.prod(xt.shape)) if xt.shape else 1
+            arr = np.asarray(idx_val)
+            if arr.size and (int(arr.max()) >= n or int(arr.min()) < -n):
+                raise IndexError(f"take index out of range for {n} elements")
+
+    def fn(a, i):
+        flat = a.ravel()
+        if mode == "clip":
+            return jnp.take(flat, i, mode="clip")
+        # raise/wrap: python-style negative indexing via modulo
+        return jnp.take(flat, i % flat.shape[0], mode="clip")
+
+    return apply_op("take", fn, xt, it)
+
+
+def masked_scatter(x, mask, value, name=None) -> Tensor:
+    def fn(a, m, v):
+        flat_idx = jnp.cumsum(m.astype(jnp.int32).ravel()) - 1
+        src = v.ravel()[jnp.clip(flat_idx, 0, v.size - 1)].reshape(a.shape)
+        return jnp.where(m, src, a)
+
+    return apply_op("masked_scatter", fn, ensure_tensor(x), ensure_tensor(mask),
+                    ensure_tensor(value))
+
+
+def _nary(name, jfn, tensors):
+    ts = [ensure_tensor(t) for t in tensors]
+    return apply_op(name, lambda *a: jfn(a), *ts)
+
+
+def column_stack(x, name=None) -> Tensor:
+    return _nary("column_stack", jnp.column_stack, x)
+
+
+def hstack(x, name=None) -> Tensor:
+    return _nary("hstack", jnp.hstack, x)
+
+
+def vstack(x, name=None) -> Tensor:
+    return _nary("vstack", jnp.vstack, x)
+
+
+row_stack = vstack
+
+
+def dstack(x, name=None) -> Tensor:
+    return _nary("dstack", jnp.dstack, x)
+
+
+def _nsplit(name, jfn, x, num_or_indices):
+    xt = ensure_tensor(x)
+    spec = num_or_indices if isinstance(num_or_indices, int) else [int(i) for i in num_or_indices]
+    out = apply_op(name, lambda a: tuple(jfn(a, spec)), xt)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def hsplit(x, num_or_indices, name=None):
+    return _nsplit("hsplit", jnp.hsplit, x, num_or_indices)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _nsplit("vsplit", jnp.vsplit, x, num_or_indices)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _nsplit("dsplit", jnp.dsplit, x, num_or_indices)
+
+
+def unflatten(x, axis: int, shape: Sequence[int], name=None) -> Tensor:
+    def fn(a):
+        ax = axis % a.ndim
+        new_shape = a.shape[:ax] + tuple(shape) + a.shape[ax + 1:]
+        return a.reshape(new_shape)
+
+    return apply_op("unflatten", fn, ensure_tensor(x))
+
+
+def block_diag(inputs, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def fn(*mats):
+        mats = [m if m.ndim == 2 else m.reshape(1, -1) for m in mats]
+        R = sum(m.shape[0] for m in mats)
+        C = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((R, C), mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(m)
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply_op("block_diag", fn, *ts)
+
+
+def cartesian_prod(x, name=None) -> Tensor:
+    ts = [ensure_tensor(t) for t in x]
+
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+    out = apply_op("cartesian_prod", fn, *ts)
+    return out
+
+
+def combinations(x, r: int = 2, with_replacement: bool = False, name=None) -> Tensor:
+    import itertools
+
+    xt = ensure_tensor(x)
+    n = int(xt.shape[0])
+    comb = itertools.combinations_with_replacement if with_replacement else itertools.combinations
+    idx = np.array(list(comb(range(n), r)), np.int32).reshape(-1, r)
+
+    return apply_op("combinations", lambda a: a[jnp.asarray(idx)], xt)
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None, aweights=None,
+        name=None) -> Tensor:
+    # single implementation lives in linalg (handles fweights/aweights)
+    from ..linalg import cov as _linalg_cov
+
+    return _linalg_cov(x, rowvar=rowvar, ddof=ddof, fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar: bool = True, name=None) -> Tensor:
+    from ..linalg import corrcoef as _linalg_corrcoef
+
+    return _linalg_corrcoef(x, rowvar=rowvar)
+
+
+def quantile(x, q, axis=None, keepdim: bool = False, interpolation: str = "linear",
+             name=None) -> Tensor:
+    qa = jnp.asarray(q)
+    return apply_op("quantile", lambda a: jnp.quantile(a, qa, axis=axis, keepdims=keepdim,
+                                                       method=interpolation),
+                    ensure_tensor(x))
+
+
+def nanquantile(x, q, axis=None, keepdim: bool = False, interpolation: str = "linear",
+                name=None) -> Tensor:
+    qa = jnp.asarray(q)
+    return apply_op("nanquantile", lambda a: jnp.nanquantile(a, qa, axis=axis, keepdims=keepdim,
+                                                             method=interpolation),
+                    ensure_tensor(x))
+
+
+def nanmedian(x, axis=None, keepdim: bool = False, mode: str = "avg", name=None) -> Tensor:
+    if mode == "min":  # lower of the two middle values on even counts
+        return apply_op("nanmedian",
+                        lambda a: jnp.nanquantile(a, 0.5, axis=axis, keepdims=keepdim,
+                                                  method="lower"),
+                        ensure_tensor(x))
+    return apply_op("nanmedian", lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def bitwise_invert(x, name=None) -> Tensor:
+    return apply_op("bitwise_invert", jnp.invert, ensure_tensor(x))
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    def fn(r, t):
+        ctype = jnp.complex128 if r.dtype == jnp.float64 else jnp.complex64
+        return (r * jnp.cos(t) + 1j * r * jnp.sin(t)).astype(ctype)
+
+    return apply_op("polar", fn, ensure_tensor(abs), ensure_tensor(angle))
+
+
+def sgn(x, name=None) -> Tensor:
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-38)).astype(a.dtype)
+        return jnp.sign(a)
+
+    return apply_op("sgn", fn, ensure_tensor(x))
+
+
+def sinc(x, name=None) -> Tensor:
+    return apply_op("sinc", jnp.sinc, ensure_tensor(x))
+
+
+def isneginf(x, name=None) -> Tensor:
+    return apply_op("isneginf", jnp.isneginf, ensure_tensor(x))
+
+
+def isposinf(x, name=None) -> Tensor:
+    return apply_op("isposinf", jnp.isposinf, ensure_tensor(x))
+
+
+def isreal(x, name=None) -> Tensor:
+    return apply_op("isreal", jnp.isreal, ensure_tensor(x))

@@ -155,3 +155,135 @@ class TestMatmul:
         check_output(paddle.t, lambda v: v.T, [a(3, 4)])
         check_output(lambda x: paddle.transpose(x, [2, 0, 1]),
                      lambda v: v.transpose(2, 0, 1), [a(2, 3, 4)])
+
+
+class TestLongTailOps:
+    """math_extra surface vs numpy closed forms (OpTest pattern)."""
+
+    def test_bincount_vander_trapezoid(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = np.array([0, 1, 1, 3], "int32")
+        np.testing.assert_array_equal(paddle.bincount(paddle.to_tensor(x)).numpy(),
+                                      np.bincount(x))
+        w = np.array([1.0, 0.5, 0.5, 2.0], "float32")
+        np.testing.assert_allclose(
+            paddle.bincount(paddle.to_tensor(x), paddle.to_tensor(w)).numpy(),
+            np.bincount(x, w), rtol=1e-6)
+        v = np.array([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_allclose(paddle.vander(paddle.to_tensor(v)).numpy(),
+                                   np.vander(v), rtol=1e-6)
+        y = np.array([1.0, 2.0, 3.0], "float32")
+        assert float(paddle.trapezoid(paddle.to_tensor(y)).numpy()) == 4.0
+        ct = paddle.cumulative_trapezoid(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(ct, [1.5, 4.0])
+
+    def test_cdist_quantile_cov(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 3).astype("float32")
+        b = rng.randn(5, 3).astype("float32")
+        got = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        ref = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        x = rng.randn(100).astype("float32")
+        np.testing.assert_allclose(paddle.quantile(paddle.to_tensor(x), 0.3).numpy(),
+                                   np.quantile(x, 0.3), rtol=1e-5)
+        m = rng.randn(3, 50).astype("float32")
+        np.testing.assert_allclose(paddle.cov(paddle.to_tensor(m)).numpy(),
+                                   np.cov(m), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.corrcoef(paddle.to_tensor(m)).numpy(),
+                                   np.corrcoef(m), rtol=1e-4, atol=1e-5)
+
+    def test_stack_split_families(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        a = np.ones((2, 3), "float32")
+        b = np.zeros((2, 3), "float32")
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        assert paddle.hstack([ta, tb]).shape == [2, 6]
+        assert paddle.vstack([ta, tb]).shape == [4, 3]
+        assert paddle.dstack([ta, tb]).shape == [2, 3, 2]
+        assert paddle.column_stack([ta, tb]).shape == [2, 6]
+        parts = paddle.hsplit(paddle.to_tensor(np.ones((2, 6), "float32")), 3)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        u = paddle.unflatten(paddle.to_tensor(np.ones((2, 6), "float32")), 1, [2, 3])
+        assert u.shape == [2, 2, 3]
+
+    def test_misc_elementwise(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = np.array([-2.0, 0.0, 3.0], "float32")
+        np.testing.assert_array_equal(paddle.signbit(paddle.to_tensor(x)).numpy(),
+                                      np.signbit(x))
+        np.testing.assert_allclose(paddle.sinc(paddle.to_tensor(x)).numpy(), np.sinc(x),
+                                   rtol=1e-5, atol=1e-6)
+        inf = np.array([-np.inf, 1.0, np.inf], "float32")
+        np.testing.assert_array_equal(paddle.isneginf(paddle.to_tensor(inf)).numpy(),
+                                      [True, False, False])
+        np.testing.assert_array_equal(paddle.isposinf(paddle.to_tensor(inf)).numpy(),
+                                      [False, False, True])
+        bd = paddle.block_diag([paddle.to_tensor(np.ones((2, 2), "float32")),
+                                paddle.to_tensor(np.full((1, 3), 2.0, "float32"))])
+        assert bd.shape == [3, 5]
+        cp = paddle.cartesian_prod([paddle.to_tensor(np.array([1, 2], "int32")),
+                                    paddle.to_tensor(np.array([3, 4, 5], "int32"))])
+        assert cp.shape == [6, 2]
+        comb = paddle.combinations(paddle.to_tensor(np.array([1, 2, 3], "int32")), 2)
+        assert comb.shape == [3, 2]
+        taken = paddle.take(paddle.to_tensor(np.arange(6, dtype="int32").reshape(2, 3)),
+                            paddle.to_tensor(np.array([0, 5], "int32")))
+        np.testing.assert_array_equal(taken.numpy(), [0, 5])
+
+    def test_masked_scatter_and_renorm(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        x = np.zeros((2, 3), "float32")
+        mask = np.array([[True, False, True], [False, True, False]])
+        vals = np.array([1.0, 2.0, 3.0], "float32")
+        got = paddle.masked_scatter(paddle.to_tensor(x), paddle.to_tensor(mask),
+                                    paddle.to_tensor(vals)).numpy()
+        np.testing.assert_allclose(got, [[1, 0, 2], [0, 3, 0]])
+        w = np.array([[3.0, 4.0], [6.0, 8.0]], "float32")  # row norms 5, 10
+        rn = paddle.renorm(paddle.to_tensor(w), 2.0, 0, 5.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(rn, axis=1), [5.0, 5.0], rtol=1e-5)
+
+    def test_review_regressions(self):
+        import numpy as np
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+
+        # negative index take + OOB raise
+        t = paddle.to_tensor(np.arange(6, dtype="int32"))
+        np.testing.assert_array_equal(
+            paddle.take(t, paddle.to_tensor(np.array([-1, 0], "int32"))).numpy(), [5, 0])
+        with _pytest.raises(IndexError):
+            paddle.take(t, paddle.to_tensor(np.array([7], "int32")))
+        # cov honors fweights (delegates to linalg)
+        m = np.array([[1.0, 2.0, 3.0]], "float32")
+        got = float(paddle.cov(paddle.to_tensor(m), fweights=np.array([1, 2, 3])).numpy())
+        ref = float(np.cov(m, fweights=[1, 2, 3]))
+        assert got == _pytest.approx(ref, rel=1e-5)
+        # cdist self-distance gradient is NaN-free
+        x = paddle.to_tensor(np.random.RandomState(0).randn(3, 2).astype("float32"),
+                             stop_gradient=False)
+        paddle.cdist(x, x).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        # nanmedian min mode takes the lower middle
+        v = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], "float32"))
+        assert float(paddle.nanmedian(v, mode="min").numpy()) == 2.0
+        # method-call parity
+        assert t.take(paddle.to_tensor(np.array([1], "int32"))).numpy()[0] == 1
+        assert float(paddle.to_tensor(np.arange(4.0, dtype="float32")).quantile(0.5).numpy()) == 1.5
