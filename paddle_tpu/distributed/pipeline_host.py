@@ -1,0 +1,231 @@
+"""Host-driven pipeline execution of Plan/Job schedules.
+
+Parity: the reference's executed pipeline schedules —
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:575
+(forward_backward_pipeline, 1F1B), :1174 (interleaved VPP) and the
+zero-bubble pass python/paddle/distributed/passes/
+pipeline_scheduler_pass/pipeline_zero_bubble.py:38,62,151 (backward
+split into dX "backward_b" and dW "backward_w" jobs; the reference
+splits matmul_grad at :43).
+
+TPU design: each (virtual) stage is a separately-compiled XLA program
+pinned to its rank's device; activations/grads move between stage
+devices as explicit transfers (device_put — ICI/DCN on real slices).
+The per-rank job lists from pipeline_schedules are executed through
+core.job_executor.execute_plan, whose worker pool honours the same
+cross-rank dependency DAG the discrete-event simulator validates.
+The zero-bubble dX/dW split is real: backward_b computes only the
+activation gradient (the inter-stage critical path), backward_w
+computes the weight gradient later from saved (x, gy).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.job_executor import execute_plan
+from .pipeline_schedules import (BACKWARD, BACKWARD_B, BACKWARD_W, FORWARD,
+                                 OPT, Plan, create_1f1b_jobs,
+                                 create_fthenb_jobs, create_vpp_jobs,
+                                 create_zero_bubble_jobs)
+
+__all__ = ["HostPipelineEngine"]
+
+
+class _StageProgram:
+    """One virtual stage's compiled programs, pinned to a device.
+
+    fwd:   (params, x)      -> y
+    bwd:   (params, x, gy)  -> (gparams, gx)          [full backward]
+    bwd_b: (params, x, gy)  -> gx                     [dX only — critical path]
+    bwd_w: (params, x, gy)  -> gparams                [dW only — fills bubbles]
+    """
+
+    def __init__(self, stage_fn: Callable, params, device):
+        self.device = device
+        self.params = jax.device_put(params, device)
+        self._fn = stage_fn
+        self.fwd = jax.jit(stage_fn)
+
+        def _bwd(params, x, gy):
+            _, vjp = jax.vjp(stage_fn, params, x)
+            gp, gx = vjp(gy)
+            return gp, gx
+
+        def _bwd_b(params, x, gy):
+            _, vjp = jax.vjp(lambda xx: stage_fn(params, xx), x)
+            return vjp(gy)[0]
+
+        def _bwd_w(params, x, gy):
+            _, vjp = jax.vjp(lambda pp: stage_fn(pp, x), params)
+            return vjp(gy)[0]
+
+        self.bwd = jax.jit(_bwd)
+        self.bwd_b = jax.jit(_bwd_b)
+        self.bwd_w = jax.jit(_bwd_w)
+
+
+class HostPipelineEngine:
+    """Execute FThenB / 1F1B / VPP / zero-bubble schedules over per-stage
+    compiled programs with real inter-device activation transfer.
+
+    stage_fns/stage_params: one entry per *virtual* stage, in virtual-stage
+    order (len = n_stages * n_chunks). Virtual stage v runs on rank
+    ``v % n_stages`` (chunk ``v // n_stages``), matching create_vpp_jobs.
+
+    loss_fn(y, labels) -> scalar, computed after the last virtual stage;
+    the batch loss is the mean over micro-batch losses, so the backward
+    seed is grad(loss_fn)/n_micro — identical semantics to a full-batch
+    mean loss when micro sizes are equal.
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable], stage_params: Sequence,
+                 loss_fn: Callable, n_stages: int, n_micro: int,
+                 schedule: str = "1f1b", n_chunks: int = 1,
+                 optimizer=None, lr: float = 0.1,
+                 devices: Optional[Sequence] = None, n_workers: int = 4):
+        total_v = n_stages * n_chunks
+        assert len(stage_fns) == total_v, (
+            f"need {total_v} virtual stages, got {len(stage_fns)}")
+        self.n_stages, self.n_chunks, self.n_micro = n_stages, n_chunks, n_micro
+        self.total_v = total_v
+        self.schedule = schedule
+        self.lr = lr
+        self.n_workers = n_workers
+        if devices is None:
+            devs = jax.devices()
+            devices = [devs[r % len(devs)] for r in range(n_stages)]
+        self.devices = list(devices)
+        self.stages: List[_StageProgram] = [
+            _StageProgram(stage_fns[v], stage_params[v],
+                          self.devices[v % n_stages])
+            for v in range(total_v)
+        ]
+        if optimizer is None:
+            from ..optimizer.functional import sgd
+            optimizer = sgd()
+        self._opt = optimizer
+        self._opt_state = [optimizer.init(s.params) for s in self.stages]
+        self._loss_fn = loss_fn
+
+        def _loss_seed(y, labels):
+            l, gy = jax.value_and_grad(loss_fn)(y, labels)
+            return l, jax.tree.map(lambda g: g / n_micro, gy)
+
+        self._loss_seed = jax.jit(_loss_seed)
+
+        if schedule == "fthenb":
+            self.plan: Plan = create_fthenb_jobs(n_micro, n_stages)
+        elif schedule == "1f1b":
+            self.plan = create_1f1b_jobs(n_micro, n_stages)
+        elif schedule == "vpp":
+            self.plan = create_vpp_jobs(n_micro, n_stages, n_chunks)
+        elif schedule == "zb":
+            assert n_chunks == 1, "zero-bubble runs with one chunk per rank"
+            self.plan = create_zero_bubble_jobs(n_micro, n_stages)
+        else:
+            raise ValueError(f"unknown schedule {schedule!r}")
+
+    # -- one training batch ------------------------------------------------
+    def train_batch(self, x_micro, labels_micro):
+        """x_micro/labels_micro: [n_micro, micro_batch, ...] arrays.
+        Runs the full schedule (forwards, backwards, optimizer) and returns
+        the mean micro-batch loss as a float."""
+        S, V, M = self.n_stages, self.total_v, self.n_micro
+        x_micro = jnp.asarray(x_micro)
+        labels_micro = jnp.asarray(labels_micro)
+
+        acts: Dict[Tuple[int, int], Any] = {}      # (vs, m) -> stage input x
+        outs: Dict[int, Any] = {}                  # m -> last-stage output y
+        handoff: Dict[Tuple[int, int], Any] = {}   # (vs, m) -> incoming x
+        grad_in: Dict[Tuple[int, int], Any] = {}   # (vs, m) -> incoming gy
+        saved_w: Dict[Tuple[int, int], Any] = {}   # (vs, m) -> (x, gy) for dW
+        grad_acc: List[List[Any]] = [[] for _ in range(V)]
+        losses: Dict[int, Any] = {}
+        lock = threading.Lock()
+
+        def _vs(rank, chunk):
+            return chunk * S + rank
+
+        def fwd(rank, m, chunk):
+            vs = _vs(rank, chunk)
+            st = self.stages[vs]
+            if vs == 0:
+                x = jax.device_put(x_micro[m], st.device)
+            else:
+                x = handoff.pop((vs, m))
+            y = st.fwd(st.params, x)
+            acts[(vs, m)] = x
+            if vs == V - 1:
+                outs[m] = y
+            else:
+                nxt = self.stages[vs + 1]
+                handoff[(vs + 1, m)] = jax.device_put(y, nxt.device)
+
+        def _seed_or_recv(vs, m, device):
+            if vs == V - 1:
+                y = outs.pop(m)
+                lab = jax.device_put(labels_micro[m], device)
+                l, gy = self._loss_seed(y, lab)
+                losses[m] = l
+                return gy
+            return grad_in.pop((vs, m))
+
+        def bwd(rank, m, chunk):
+            vs = _vs(rank, chunk)
+            st = self.stages[vs]
+            gy = _seed_or_recv(vs, m, st.device)
+            x = acts.pop((vs, m))
+            gp, gx = st.bwd(st.params, x, gy)
+            with lock:
+                grad_acc[vs].append(gp)
+            if vs > 0:
+                prev = self.stages[vs - 1]
+                grad_in[(vs - 1, m)] = jax.device_put(gx, prev.device)
+
+        def bwd_b(rank, m, chunk):
+            vs = _vs(rank, chunk)
+            st = self.stages[vs]
+            gy = _seed_or_recv(vs, m, st.device)
+            x = acts.pop((vs, m))
+            gx = st.bwd_b(st.params, x, gy)
+            saved_w[(vs, m)] = (x, gy)
+            if vs > 0:
+                prev = self.stages[vs - 1]
+                grad_in[(vs - 1, m)] = jax.device_put(gx, prev.device)
+
+        def bwd_w(rank, m, chunk):
+            vs = _vs(rank, chunk)
+            st = self.stages[vs]
+            x, gy = saved_w.pop((vs, m))
+            gp = st.bwd_w(st.params, x, gy)
+            with lock:
+                grad_acc[vs].append(gp)
+
+        def opt(rank, m, chunk):
+            for c in range(self.n_chunks):
+                vs = _vs(rank, c)
+                gs = grad_acc[vs]
+                assert len(gs) == M, f"stage {vs}: {len(gs)}/{M} micro grads"
+                total = gs[0]
+                for g in gs[1:]:
+                    total = jax.tree.map(jnp.add, total, g)
+                st = self.stages[vs]
+                lr = jnp.asarray(self.lr, jnp.float32)
+                st.params, self._opt_state[vs] = self._opt.update(
+                    total, self._opt_state[vs], st.params, lr)
+                grad_acc[vs] = []
+
+        handlers = {FORWARD: fwd, BACKWARD: bwd, BACKWARD_B: bwd_b,
+                    BACKWARD_W: bwd_w, OPT: opt}
+        execute_plan(self.plan, handlers, n_workers=self.n_workers)
+        assert len(losses) == M
+        return float(sum(float(losses[m]) for m in range(M)) / M)
+
+    # -- introspection for parity tests -----------------------------------
+    def stage_parameters(self, vstage: int):
+        return self.stages[vstage].params

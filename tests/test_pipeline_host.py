@@ -1,0 +1,134 @@
+"""Executed pipeline schedules: loss parity across no-pipeline / FThenB /
+1F1B / VPP / zero-bubble.
+
+Reference oracle pattern: test/collective/fleet/hybrid_parallel_pp_layer /
+hybrid_parallel_mp_model.py — the parallel execution must produce the
+same losses as a single-process replica. Here every schedule (including
+zero-bubble's real dX/dW split) runs the same model on the same data and
+must match the plain full-batch training loop step for step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.pipeline_host import HostPipelineEngine
+
+N_VSTAGES = 4
+WIDTH = 8
+N_MICRO = 4
+MICRO_B = 2
+LR = 0.1
+STEPS = 3
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_params(seed):
+    rng = np.random.RandomState(seed)
+    return [
+        {"w": jnp.asarray(rng.randn(WIDTH, WIDTH) * 0.5, jnp.float32),
+         "b": jnp.asarray(rng.randn(WIDTH) * 0.1, jnp.float32)}
+        for _ in range(N_VSTAGES)
+    ]
+
+
+def _loss_fn(y, labels):
+    return jnp.mean((y - labels) ** 2)
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(STEPS, N_MICRO, MICRO_B, WIDTH), jnp.float32)
+    t = jnp.asarray(rng.randn(STEPS, N_MICRO, MICRO_B, WIDTH), jnp.float32)
+    return x, t
+
+
+def _baseline_losses():
+    """Plain full-batch training loop — the parity oracle."""
+    params = _make_params(0)
+    x, t = _data()
+
+    def full_loss(params, xb, tb):
+        h = xb
+        for p in params:
+            h = _stage_fn(p, h)
+        return jnp.mean((h - tb) ** 2)
+
+    @jax.jit
+    def step(params, xb, tb):
+        loss, grads = jax.value_and_grad(full_loss)(params, xb, tb)
+        new = jax.tree.map(lambda p, g: p - LR * g, params, grads)
+        return loss, new
+
+    losses = []
+    for s in range(STEPS):
+        xb = x[s].reshape(N_MICRO * MICRO_B, WIDTH)
+        tb = t[s].reshape(N_MICRO * MICRO_B, WIDTH)
+        loss, params = step(params, xb, tb)
+        losses.append(float(loss))
+    return losses, params
+
+
+BASELINE = None
+
+
+def _get_baseline():
+    global BASELINE
+    if BASELINE is None:
+        BASELINE = _baseline_losses()
+    return BASELINE
+
+
+@pytest.mark.parametrize("schedule,n_stages,n_chunks", [
+    ("fthenb", 4, 1),
+    ("1f1b", 4, 1),
+    ("vpp", 2, 2),
+    ("zb", 4, 1),
+])
+def test_schedule_loss_parity(schedule, n_stages, n_chunks):
+    ref_losses, ref_params = _get_baseline()
+    eng = HostPipelineEngine(
+        [_stage_fn] * N_VSTAGES, _make_params(0), _loss_fn,
+        n_stages=n_stages, n_micro=N_MICRO, schedule=schedule,
+        n_chunks=n_chunks, lr=LR)
+    x, t = _data()
+    got = [eng.train_batch(x[s], t[s]) for s in range(STEPS)]
+    np.testing.assert_allclose(got, ref_losses, rtol=1e-5, atol=1e-6)
+    # updated weights must match too (the optimizer consumed real dW grads)
+    for vs in range(N_VSTAGES):
+        got_p = eng.stage_parameters(vs)
+        np.testing.assert_allclose(np.asarray(got_p["w"]),
+                                   np.asarray(ref_params[vs]["w"]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_stages_on_distinct_devices():
+    """Stage programs must actually live on different devices (real
+    transfer between stages, not a single-device simulation)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    eng = HostPipelineEngine(
+        [_stage_fn] * N_VSTAGES, _make_params(0), _loss_fn,
+        n_stages=4, n_micro=N_MICRO, schedule="1f1b", lr=LR)
+    devs = {eng.stages[v].device for v in range(N_VSTAGES)}
+    assert len(devs) == 4
+    x, t = _data()
+    loss = eng.train_batch(x[0], t[0])
+    assert np.isfinite(loss)
+
+
+def test_zero_bubble_splits_backward():
+    """The ZB plan must contain real backward_b/backward_w jobs and no
+    monolithic backward."""
+    from paddle_tpu.distributed.pipeline_schedules import (
+        BACKWARD, BACKWARD_B, BACKWARD_W, create_zero_bubble_jobs)
+
+    plan = create_zero_bubble_jobs(N_MICRO, 4)
+    types = [j.type for r in range(4) for j in plan.rank_jobs(r)]
+    assert BACKWARD not in types
+    assert types.count(BACKWARD_B) == 4 * N_MICRO
+    assert types.count(BACKWARD_W) == 4 * N_MICRO
