@@ -32,7 +32,7 @@ def main():
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=768, intermediate_size=2048,
             num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=12,
-            max_position_embeddings=2048, use_flash_attention=False, dtype="bfloat16")
+            max_position_embeddings=2048, use_flash_attention=True, dtype="bfloat16")
         batch, seq, steps, warmup = 16, 1024, 20, 3
     else:  # CI smoke path
         cfg = LlamaConfig.tiny()

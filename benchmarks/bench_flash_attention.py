@@ -1,0 +1,82 @@
+"""Flash-attention microbench: Pallas kernel vs XLA dense attention.
+
+Run on a real TPU chip (`python benchmarks/bench_flash_attention.py`).
+Prints one JSON line per sequence length with fwd/bwd times for the
+Pallas flash kernel and the XLA dense reference. Throughput-style
+timing (enqueue N, sync once) — the realistic dispatch regime under jit.
+
+Reference analogue: the perf harnesses in test/legacy_test/benchmark.py;
+kernel parity: phi/kernels/gpu/flash_attn_kernel.cu / flash_attn_grad_kernel.cu.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.pallas_kernels.flash_attention import _flash
+
+
+def xla_attn(q, k, v, scale):
+    s_ = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+    n = q.shape[1]
+    mask = jnp.tril(jnp.ones((n, n), bool))
+    s_ = jnp.where(mask, s_, -1e30)
+    p = jax.nn.softmax(s_, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v)
+
+
+def bench(fn, *args, iters=10):
+    r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    d = 64
+    for s, bh in ((1024, 192), (2048, 96), (4096, 32)):
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+        do = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+        scale = 1.0 / math.sqrt(d)
+
+        flash_f = jax.jit(lambda q, k, v: _flash(q, k, v, None, True, scale, 256, 256))
+        xla_f = jax.jit(lambda q, k, v: xla_attn(q, k, v, scale))
+        flash_g = jax.jit(jax.grad(
+            lambda q, k, v: (_flash(q, k, v, None, True, scale, 256, 256) * do).sum(),
+            argnums=(0, 1, 2)))
+        xla_g = jax.jit(jax.grad(
+            lambda q, k, v: (xla_attn(q, k, v, scale) * do).sum(), argnums=(0, 1, 2)))
+
+        err = float(jnp.abs(flash_f(q, k, v).astype(jnp.float32)
+                            - xla_f(q, k, v).astype(jnp.float32)).max())
+        row = {
+            "seq": s, "bh": bh, "head_dim": d, "max_abs_err": round(err, 4),
+            "fwd_flash_ms": round(bench(flash_f, q, k, v) * 1e3, 2),
+            "fwd_xla_ms": round(bench(xla_f, q, k, v) * 1e3, 2),
+            "bwd_flash_ms": round(bench(flash_g, q, k, v) * 1e3, 2),
+            "bwd_xla_ms": round(bench(xla_g, q, k, v) * 1e3, 2),
+        }
+        row["speedup_fwd"] = round(row["fwd_xla_ms"] / row["fwd_flash_ms"], 2)
+        row["speedup_bwd"] = round(row["bwd_xla_ms"] / row["bwd_flash_ms"], 2)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
