@@ -28,6 +28,48 @@ from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op, ensure_tensor
 
 
+def _eager_multiprocess(tensor: "Tensor", group: "Optional[Group]") -> bool:
+    """True when an outside-spmd collective should execute as a cached
+    one-collective program across processes (real multi-process world and a
+    concrete — non-traced — array). Reference semantics: eager ProcessGroup
+    collectives (process_group.h:48-170). Only the world group is
+    supported eagerly; a proper subgroup raises instead of silently
+    reducing over the wrong ranks (or deadlocking non-members)."""
+    from . import eager_collectives as ec
+
+    if ec.process_world_size() <= 1 or not ec.is_concrete(tensor._data):
+        return False
+    if group is not None and group.id != 0:
+        W = ec.process_world_size()
+        if not group.ranks or sorted(group.ranks) != list(range(W)):
+            # includes rank-less named-axis groups: outside spmd their
+            # membership is undefined, so treating them as world would
+            # silently reduce over the wrong ranks
+            raise NotImplementedError(
+                "eager (outside-spmd) collectives over a proper subgroup are "
+                "not supported — run subgroup collectives inside dist.spmd "
+                "over a mesh axis, or use the world group")
+    return True
+
+
+def _eager_result(tensor: "Tensor", data) -> "Tensor":
+    """In-place update with the collective result, preserving autograd
+    leaf-ness (reference eager comm ops mutate the tensor's storage and do
+    not change requires_grad). The grad node is dropped: the result's
+    history crosses processes (not representable on the local tape), and a
+    shape-changing collective (scatter) would otherwise leave a stale
+    full-shape node that corrupts a later backward."""
+    sg = tensor.stop_gradient
+    tensor._data = data
+    tensor._grad_node = None
+    tensor._out_slot = None
+    tensor.stop_gradient = sg
+    return tensor
+
+
+_OP_NAMES = {0: "sum", 1: "max", 2: "min", 3: "prod", 4: "avg"}
+
+
 class ReduceOp:
     SUM = 0
     MAX = 1
@@ -196,6 +238,10 @@ def _reduce_fn(op):
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
     ax = _axis(group)
     if ax is None:
+        if _eager_multiprocess(tensor, group):
+            from . import eager_collectives as ec
+
+            return _eager_result(tensor, ec.eager_all_reduce(tensor._data, _OP_NAMES[op]))
         return tensor
     f = _reduce_fn(op)
 
@@ -219,6 +265,15 @@ def all_gather(tensor_list, tensor: Tensor = None, group: Optional[Group] = None
         tensor, tensor_list = tensor_list, None
     ax = _axis(group)
     if ax is None:
+        if _eager_multiprocess(tensor, group):
+            from . import eager_collectives as ec
+            from ..ops.manipulation import unstack
+
+            stacked = Tensor(ec.eager_all_gather(tensor._data))
+            if tensor_list is not None:
+                tensor_list.extend(unstack(stacked, axis=0))
+                return tensor_list
+            return stacked
         if tensor_list is not None:
             tensor_list.append(tensor.clone())
             return tensor_list
@@ -247,6 +302,14 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op=True, axis=0):
     ax = _axis(group)
     if ax is None:
+        if _eager_multiprocess(tensor, group):
+            if op != ReduceOp.SUM:
+                raise ValueError(
+                    "eager reduce_scatter supports ReduceOp.SUM only "
+                    "(XLA psum_scatter semantics); got op=%r" % (op,))
+            from . import eager_collectives as ec
+
+            return Tensor(ec.eager_reduce_scatter(tensor._data, axis))
         return tensor
     return apply_op("reduce_scatter", lambda x: jax.lax.psum_scatter(x, ax, scatter_dimension=axis, tiled=True), tensor)
 
@@ -254,6 +317,10 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
 def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
     ax = _axis(group)
     if ax is None:
+        if _eager_multiprocess(tensor, group):
+            from . import eager_collectives as ec
+
+            return _eager_result(tensor, ec.eager_broadcast(tensor._data, src))
         return tensor
 
     def _f(x):
@@ -277,6 +344,10 @@ def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group: Optional[Group]
 def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = None, sync_op=True):
     ax = _axis(group)
     if ax is None:
+        if _eager_multiprocess(tensor, group):
+            from . import eager_collectives as ec
+
+            return _eager_result(tensor, ec.eager_scatter(tensor._data, src))
         return tensor
     g = group or _WORLD
 
@@ -314,6 +385,10 @@ def alltoall_single(tensor: Tensor, output=None, in_split_sizes=None, out_split_
                     group: Optional[Group] = None, sync_op=True, split_axis=0, concat_axis=0):
     ax = _axis(group)
     if ax is None:
+        if _eager_multiprocess(tensor, group):
+            from . import eager_collectives as ec
+
+            return Tensor(ec.eager_alltoall(tensor._data, split_axis, concat_axis))
         return tensor
     return apply_op(
         "alltoall_single",

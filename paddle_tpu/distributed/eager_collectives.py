@@ -1,0 +1,148 @@
+"""Eager (outside-spmd) cross-process collectives.
+
+Parity: the reference's ProcessGroup task API executed from eager mode
+(phi/core/distributed/collective/process_group.h:48-170; NCCL/Gloo
+subclasses). TPU-native design (SURVEY §5.8): an eager collective is a
+cached ONE-COLLECTIVE compiled program over the global process mesh —
+each process contributes its local array as a shard of a stacked global
+array, PJRT executes the compiled reduction/permutation, and the process
+reads back its addressable shard. Rank = process (one participating
+device per process, the reference's process-per-rank model).
+
+These run on the Gloo-backed XLA CPU collectives in multi-process CPU
+jobs and over ICI/DCN on TPU slices — same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["process_world_size", "eager_all_reduce", "eager_broadcast",
+           "eager_all_gather", "eager_reduce_scatter", "eager_alltoall",
+           "eager_scatter", "is_concrete"]
+
+
+def process_world_size() -> int:
+    return jax.process_count()
+
+
+def is_concrete(arr) -> bool:
+    """True when ``arr`` is a committed jax.Array (not a tracer) — the only
+    case where a host-driven eager collective is possible."""
+    return isinstance(arr, jax.Array) and not isinstance(arr, jax.core.Tracer)
+
+
+@functools.lru_cache(maxsize=1)
+def _world_mesh() -> Mesh:
+    """One device per process, ordered by process index."""
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, []).append(d)
+    devs = [sorted(per_proc[p], key=lambda d: d.id)[0]
+            for p in sorted(per_proc)]
+    return Mesh(np.array(devs), ("world",))
+
+
+def _stacked_global(arr: jax.Array) -> jax.Array:
+    """Build the global [W, *shape] array where slot p is process p's
+    ``arr`` (the per-rank input of the collective)."""
+    mesh = _world_mesh()
+    W = mesh.devices.size
+    sharding = NamedSharding(mesh, P("world"))
+    local_dev = mesh.devices.flat[jax.process_index()]
+    shard = jax.device_put(arr[None], local_dev)
+    return jax.make_array_from_single_device_arrays(
+        (W,) + tuple(arr.shape), sharding, [shard])
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled(kind: str, shape, dtype, extra):
+    """Cache of one-collective compiled programs keyed by op + aval."""
+    mesh = _world_mesh()
+    W = mesh.devices.size
+    repl = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P("world"))
+
+    if kind in ("sum", "max", "min", "prod", "avg"):
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+               "prod": jnp.prod, "avg": jnp.mean}[kind]
+        return jax.jit(lambda g: red(g, axis=0), out_shardings=repl)
+    if kind == "broadcast":
+        src = extra
+        return jax.jit(lambda g: g[src], out_shardings=repl)
+    if kind == "all_gather":
+        return jax.jit(lambda g: g, out_shardings=repl)
+    if kind == "reduce_scatter":
+        axis = extra
+        # rank r's output = sum over ranks of slice r along ``axis``;
+        # out sharded on world over that axis so each process reads its slice
+        def f(g):
+            s = jnp.sum(g, axis=0)
+            return s
+        out_spec = [None] * (len(shape))
+        out_spec[axis] = "world"
+        return jax.jit(f, out_shardings=NamedSharding(mesh, P(*out_spec)))
+    if kind == "alltoall":
+        split_axis, concat_axis = extra
+
+        # g: [W_src(sharded), *shape] -> [W_dst, *shape'] where dst row r is
+        # concat over src of each source's r-th split along concat_axis
+        def f(g):
+            parts = jnp.stack(jnp.split(g, W, axis=1 + split_axis), axis=0)
+            # parts: [W_dst, W_src, *split_shape] (dst = split index)
+            return jnp.concatenate([parts[:, i] for i in range(W)],
+                                   axis=1 + concat_axis)
+
+        return jax.jit(f, out_shardings=NamedSharding(mesh, P("world")))
+    if kind == "scatter":
+        src, axis = extra
+        def f(g):
+            return g[src]
+        out_spec = [None] * len(shape)
+        out_spec[axis] = "world"
+        return jax.jit(f, out_shardings=NamedSharding(mesh, P(*out_spec)))
+    raise ValueError(kind)
+
+
+def _run(kind: str, arr: jax.Array, extra=None) -> jax.Array:
+    g = _stacked_global(arr)
+    fn = _compiled(kind, tuple(arr.shape), str(arr.dtype), extra)
+    out = fn(g)
+    if kind in ("sum", "max", "min", "prod", "avg", "broadcast", "all_gather"):
+        # fully replicated: our single addressable shard IS the result
+        return out.addressable_shards[0].data
+    # world-sharded outputs: our shard, leading collective axis dropped
+    shard = out.addressable_shards[0].data
+    return shard
+
+
+def eager_all_reduce(arr, op: str = "sum"):
+    return _run(op, arr)
+
+
+def eager_broadcast(arr, src: int = 0):
+    return _run("broadcast", arr, src)
+
+
+def eager_all_gather(arr):
+    """Returns the stacked [W, *shape] result (replicated)."""
+    return _run("all_gather", arr)
+
+
+def eager_reduce_scatter(arr, axis: int = 0):
+    return _run("reduce_scatter", arr, axis)
+
+
+def eager_scatter(arr, src: int = 0, axis: int = 0):
+    return _run("scatter", arr, (src, axis))
+
+
+def eager_alltoall(arr, split_axis: int = 0, concat_axis: int = 0):
+    out = _run("alltoall", arr, (split_axis, concat_axis))
+    return out[0] if out.shape[0] == 1 else out

@@ -19,7 +19,17 @@ from ..core.tensor import Tensor
 from .dispatch import ensure_tensor
 
 _lock = threading.Lock()
-_KEY = [jax.random.key(0)]
+# Lazily created: creating a key at import time would initialize the XLA
+# backend and break jax.distributed.initialize() in multi-process jobs
+# (init_parallel_env must run after `import paddle_tpu`, like the
+# reference's init_parallel_env after `import paddle`).
+_KEY = [None]
+
+
+def _key():
+    if _KEY[0] is None:
+        _KEY[0] = jax.random.key(0)
+    return _KEY[0]
 
 
 def seed(s: int):
@@ -31,12 +41,12 @@ def seed(s: int):
 def split_key():
     """Pop a fresh subkey from the global generator (host-side state update)."""
     with _lock:
-        _KEY[0], sub = jax.random.split(_KEY[0])
+        _KEY[0], sub = jax.random.split(_key())
     return sub
 
 
 def get_rng_state():
-    return [jax.random.key_data(_KEY[0])]
+    return [jax.random.key_data(_key())]
 
 
 def set_rng_state(state):
