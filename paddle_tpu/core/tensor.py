@@ -24,6 +24,10 @@ import numpy as np
 from . import dtype as dtypes
 from .autograd import backward as _backward_engine
 
+# Set by jit.sot_lite: intercepts Tensor concretization (item/bool/int/
+# float) so to_static can graph-break instead of erroring on traced values.
+_concretize_hook = [None]
+
 
 class Tensor:
     __slots__ = (
@@ -113,22 +117,34 @@ class Tensor:
     def numpy(self) -> np.ndarray:
         return np.asarray(self._data)
 
-    def item(self):
+    def _item(self):
+        """Concretization choke point. Under a to_static trace the SOT-lite
+        hook (jit/sot_lite.py) intercepts this: a traced value becomes a
+        compiled guard and the recorded outcome steers Python control flow
+        (the reference's graph-break mechanism, eval_frame_callback.py:54)."""
+        hook = _concretize_hook[0]
+        if hook is not None:
+            handled, v = hook(self._data)
+            if handled:
+                return v
         return self._data.item()
+
+    def item(self):
+        return self._item()
 
     def tolist(self):
         return np.asarray(self._data).tolist()
 
     def __float__(self):
-        return float(self._data.item())
+        return float(self._item())
 
     def __int__(self):
-        return int(self._data.item())
+        return int(self._item())
 
     def __bool__(self):
         if self.size != 1:
             raise ValueError("The truth value of a multi-element Tensor is ambiguous")
-        return builtins_bool(self._data.item())
+        return builtins_bool(self._item())
 
     def __array__(self, dtype=None):
         a = np.asarray(self._data)

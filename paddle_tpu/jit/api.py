@@ -56,6 +56,7 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, build_strategy=None, backend=None, donate_argnums=()):
         self._fn = fn
+        self._sot = None  # set on first graph break (SOT-lite fallback)
         functools.update_wrapper(self, fn, updated=[])
 
         def runner(*datas, **kw):
@@ -72,8 +73,27 @@ class StaticFunction:
                              is_leaf=lambda x: isinstance(x, Tensor))
         kw = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
                           is_leaf=lambda x: isinstance(x, Tensor))
-        out = self._jitted(*datas, **kw)
+        if self._sot is None:
+            try:
+                out = self._jitted(*datas, **kw)
+                return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+            except (jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError):
+                # GRAPH BREAK: data-dependent Python control flow. Fall back
+                # to SOT-lite guarded path programs (reference: SOT
+                # eval-frame fallback, opcode_executor.py graph breaks).
+                from .sot_lite import SotFunction
+
+                self._sot = SotFunction(self._fn, _wrap_in, _unwrap_out)
+        out = self._sot(*datas, **kw)
         return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+    @property
+    def sot_graph_count(self):
+        """Compiled sub-graph count after graph breaks (None = no break)."""
+        return None if self._sot is None else self._sot.graph_count
 
     @property
     def code(self):

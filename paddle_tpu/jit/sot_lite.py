@@ -1,0 +1,232 @@
+"""SOT-lite: graph-break fallback for ``to_static``.
+
+Parity: the reference's SOT (python/paddle/jit/sot/opcode_translator/
+executor/opcode_executor.py, eval_frame_callback.py:54) traces bytecode,
+emits guards over frame state, and falls back to eager at graph breaks.
+
+TPU-native design — guard-specialized path programs instead of bytecode
+simulation:
+
+1. A plain ``jax.jit`` trace is tried first (the fast path). If the
+   function concretizes a traced Tensor (``if tensor:``, ``int(t)``,
+   ``t.item()``) jax raises a concretization error = a GRAPH BREAK.
+2. On break, the call runs EAGERLY (the fallback), recording the concrete
+   outcome of every concretization — the path signature.
+3. The function is then re-traced with those outcomes REPLAYED at each
+   break, producing one compiled program per control-flow path. Each path
+   program also outputs the condition values it observed — its guards,
+   compiled into the program exactly like SOT's guard expressions.
+4. Dispatch: run the most-recently-used matching path; compare its
+   reported conditions with the path's signature. A mismatch reveals the
+   true outcome prefix (conditions are trustworthy up to and including
+   the first divergence), which selects/creates the right path program.
+
+Cache shape: {outcomes tuple -> jitted program}; discovery is one eager
+run per new path (the reference pays the same: a break triggers eager
+execution of the rest of the frame).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tensor as tensor_mod
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+_tls = threading.local()
+
+
+class _Ctx:
+    __slots__ = ("mode", "outcomes", "idx", "cond_tracers")
+
+    def __init__(self, mode: str, outcomes: Optional[List[Any]] = None):
+        self.mode = mode                      # "probe" | "replay"
+        self.outcomes = outcomes if outcomes is not None else []
+        self.idx = 0
+        self.cond_tracers: List[Any] = []
+
+
+def _hook(data):
+    """Concretization interception (installed as Tensor._concretize_hook).
+    Returns (handled, value)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return False, None
+    if ctx.mode == "probe":
+        v = data.item()                        # concrete during eager probe
+        ctx.outcomes.append(v)
+        return True, v
+    # replay (inside a jit trace): the traced condition becomes a guard
+    # output; the recorded outcome steers Python control flow
+    ctx.cond_tracers.append(jnp.asarray(data))
+    if ctx.idx >= len(ctx.outcomes):
+        raise RuntimeError(
+            "to_static graph-break replay diverged: more concretization "
+            "points than the recorded path (non-deterministic branching?)")
+    v = ctx.outcomes[ctx.idx]
+    ctx.idx += 1
+    return True, v
+
+
+def _install_hook():
+    tensor_mod._concretize_hook[0] = _hook
+
+
+class _PushCtx:
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self.prev
+        return False
+
+
+def _match_outcome(reported, recorded) -> bool:
+    """Guard comparison: exact for bools/ints, approximate for floats (a
+    fused program's float may differ from the eager probe in the last ulp)."""
+    if isinstance(recorded, bool):
+        return bool(reported) == recorded
+    if isinstance(recorded, int):
+        return int(reported) == recorded
+    rf, cf = float(reported), float(recorded)
+    if cf == 0.0:
+        return abs(rf) < 1e-6
+    return abs(rf - cf) <= 1e-5 * abs(cf)
+
+
+MAX_PATHS = 64  # value-specialized paths cap; beyond it -> permanent eager
+
+
+class SotFunction:
+    """Path-specialized compilation with compiled guards (SOT-lite)."""
+
+    def __init__(self, fn: Callable, wrap_in, unwrap_out):
+        self._fn = fn
+        self._wrap_in = wrap_in
+        self._unwrap_out = unwrap_out
+        # outcomes -> jitted program | None (None = eager-only path: its
+        # replay trace failed, e.g. an unhookable concretization like
+        # np.asarray(tracer) — the reference SOT also stays eager there)
+        self._paths: Dict[Tuple, Any] = {}
+        self._mru: Optional[Tuple] = None
+        self._eager_only = False  # set when the path cache overflows
+        _install_hook()
+
+    # -- program construction ---------------------------------------------
+    def _build_program(self, outcomes: Tuple):
+        fn, wrap_in, unwrap_out = self._fn, self._wrap_in, self._unwrap_out
+
+        def runner(*datas, **kw):
+            ctx = _Ctx("replay", list(outcomes))
+            from .api import _TraceScope
+
+            with _PushCtx(ctx), _TraceScope(), no_grad():
+                args = jax.tree.map(wrap_in, datas,
+                                    is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
+                kwargs = jax.tree.map(wrap_in, kw,
+                                      is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
+                out = fn(*args, **kwargs)
+                out_datas = jax.tree.map(unwrap_out, out,
+                                         is_leaf=lambda x: isinstance(x, Tensor))
+            return out_datas, tuple(ctx.cond_tracers)
+
+        return jax.jit(runner)
+
+    # -- discovery: eager fallback + path compile -------------------------
+    def _discover(self, datas, kw):
+        ctx = _Ctx("probe")
+        with _PushCtx(ctx), no_grad():
+            args = jax.tree.map(lambda x: Tensor(x, stop_gradient=True)
+                                if isinstance(x, jax.Array) else x, datas,
+                                is_leaf=lambda x: isinstance(x, jax.Array))
+            kwargs = jax.tree.map(lambda x: Tensor(x, stop_gradient=True)
+                                  if isinstance(x, jax.Array) else x, kw,
+                                  is_leaf=lambda x: isinstance(x, jax.Array))
+            out = self._fn(*args, **kwargs)
+            out_datas = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x,
+                                     out, is_leaf=lambda x: isinstance(x, Tensor))
+        key = tuple(ctx.outcomes)
+        if key not in self._paths:
+            if len(self._paths) >= MAX_PATHS:
+                # value-varying concretizations (e.g. float(loss) logged
+                # every step) would specialize forever: degrade to eager
+                self._eager_only = True
+            else:
+                self._paths[key] = self._build_program(key)
+        self._mru = key
+        return out_datas
+
+    def _find_path(self, prefix: Tuple, tried) -> Optional[Tuple]:
+        def matches(key):
+            return (key not in tried and len(key) >= len(prefix)
+                    and all(_match_outcome(p, k) for p, k in zip(prefix, key)))
+
+        if self._mru is not None and matches(self._mru):
+            return self._mru
+        for key in self._paths:
+            if matches(key):
+                return key
+        return None
+
+    # -- dispatch ----------------------------------------------------------
+    def __call__(self, *datas, **kw):
+        if self._eager_only:
+            return self._discover(datas, kw)
+        tried = set()
+        prefix: Tuple = ()
+        while True:
+            key = self._find_path(prefix, tried)
+            if key is None:
+                return self._discover(datas, kw)
+            program = self._paths[key]
+            if program is None:  # known eager-only path
+                return self._discover(datas, kw)
+            try:
+                out, conds = program(*datas, **kw)
+            except (jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError,
+                    RuntimeError):
+                # retrace failed (unhookable concretization, or the
+                # concretization count depends on input shape): this path
+                # program can't serve these avals — run eagerly
+                self._paths[key] = None
+                return self._discover(datas, kw)
+            conds_py = [jax.device_get(c) for c in conds]
+            mismatch = None
+            for i, (rep, rec) in enumerate(zip(conds_py, key)):
+                if not _match_outcome(rep, rec):
+                    mismatch = i
+                    break
+            if mismatch is None:
+                self._mru = key
+                return out
+            tried.add(key)
+            # conditions are valid up to and including the first divergence
+            verified = list(key[:mismatch])
+            rep = conds_py[mismatch]
+            rec = key[mismatch]
+            if isinstance(rec, bool):
+                verified.append(bool(rep))
+            elif isinstance(rec, int):
+                verified.append(int(rep))
+            else:
+                verified.append(float(rep))
+            prefix = tuple(verified)
+
+    @property
+    def graph_count(self) -> int:
+        """Number of compiled sub-graphs (path programs)."""
+        return len(self._paths)
