@@ -1,0 +1,72 @@
+"""Eager per-op dispatch latency microbench.
+
+Reference analogue: test/cpp/eager/performance_tests/benchmark_eager_cuda.cc
+(per-op dispatch overhead is the eager-mode bottleneck, SURVEY §7.3 #1).
+
+Measures ops/sec through the full dispatch stack (AMP hook, tape,
+autograd) for small tensors, where Python/tracing overhead dominates.
+Prints one JSON line. Run on CPU for stable numbers:
+  JAX_PLATFORMS=cpu python benchmarks/bench_eager_dispatch.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def rate(f, n=300):
+    f()  # warm (compile/cache)
+    f()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f()
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.random.randn(16, 16).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(16, 16).astype(np.float32))
+    w = paddle.to_tensor(np.random.randn(128, 128).astype(np.float32))
+    a = paddle.to_tensor(np.random.randn(8, 128).astype(np.float32))
+    b = paddle.to_tensor(np.zeros(128, np.float32))
+
+    results = {
+        "add_fwd_ops_per_sec": rate(lambda: x + y),
+        "matmul_fwd_ops_per_sec": rate(lambda: a.matmul(w)),
+        "mlp3_fwd_ops_per_sec": rate(lambda: paddle.nn.functional.relu(a.matmul(w) + b)),
+    }
+
+    def train_add():
+        xg = paddle.to_tensor(np.random.randn(16, 16).astype(np.float32),
+                              stop_gradient=False)
+        (xg + y).sum().backward()
+
+    def train_mlp():
+        wg = paddle.to_tensor(np.random.randn(128, 128).astype(np.float32),
+                              stop_gradient=False)
+        paddle.nn.functional.relu(a.matmul(wg) + b).sum().backward()
+
+    results["add_fwd_bwd_per_sec"] = rate(train_add, n=100)
+    results["mlp3_fwd_bwd_per_sec"] = rate(train_mlp, n=100)
+
+    import jax
+
+    print(json.dumps({
+        "metric": "eager_dispatch",
+        "backend": jax.default_backend(),
+        **{k: round(v, 1) for k, v in results.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -323,17 +323,32 @@ def count_nonzero(x, axis=None, keepdim=False, name=None) -> Tensor:
 
 
 # -- matmul family -----------------------------------------------------------
+# Stable matmul bodies per transpose combo: module-level identity lets the
+# dispatch layer cache compiled fwd/pullback programs (hot path).
+def _mm_nn(a, b):
+    return jnp.matmul(a, b)
+
+
+def _mm_tn(a, b):
+    return jnp.matmul(jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a, b)
+
+
+def _mm_nt(a, b):
+    return jnp.matmul(a, jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b)
+
+
+def _mm_tt(a, b):
+    return jnp.matmul(jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a,
+                      jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b)
+
+
+_MATMUL_FNS = {(False, False): _mm_nn, (True, False): _mm_tn,
+               (False, True): _mm_nt, (True, True): _mm_tt}
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None) -> Tensor:
     x, y = ensure_tensor(x), ensure_tensor(y)
-
-    def _f(a, b):
-        if transpose_x and a.ndim >= 2:
-            a = jnp.swapaxes(a, -1, -2)
-        if transpose_y and b.ndim >= 2:
-            b = jnp.swapaxes(b, -1, -2)
-        return jnp.matmul(a, b)
-
-    return apply_op("matmul", _f, x, y)
+    return apply_op("matmul", _MATMUL_FNS[bool(transpose_x), bool(transpose_y)], x, y)
 
 
 def mm(x, y, name=None) -> Tensor:
