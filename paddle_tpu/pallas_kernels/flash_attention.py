@@ -456,33 +456,43 @@ def flash_attn_varlen(q, k, v, cu_seqlens, causal: bool = True, sm_scale=None,
     flash_attn_unpadded, phi/kernels/gpu/flash_attn_kernel.cu varlen path).
     """
     from ..core.tensor import Tensor
+    from ..ops.dispatch import apply_op
 
     def _arr(x):
         return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
-    qa, ka, va = _arr(q), _arr(k), _arr(v)
     cu = _arr(cu_seqlens).astype(jnp.int32)
-    total = qa.shape[0]
-    # token i belongs to segment j iff cu[j] <= i < cu[j+1]
-    pos = jnp.arange(total, dtype=jnp.int32)
-    seg = jnp.searchsorted(cu[1:], pos, side="right").astype(jnp.int32)
-    # pad the packed stream to a 128 multiple (TPU lane tiling); padding
-    # gets segment id -1 so no real token attends to it, and its rows are
-    # sliced off below (their cotangents are zero in the backward)
-    pad = (-total) % 128
-    if pad and not _interpret():
-        zeros = lambda a: jnp.zeros((pad,) + a.shape[1:], a.dtype)
-        qa = jnp.concatenate([qa, zeros(qa)])
-        ka = jnp.concatenate([ka, zeros(ka)])
-        va = jnp.concatenate([va, zeros(va)])
-        seg = jnp.concatenate([seg, jnp.full((pad,), -1, jnp.int32)])
-    # in-segment causal positions: flash's causal mask is on absolute
-    # positions, which is correct for packed sequences as long as the
-    # segment mask also applies (cross-segment attention is masked out).
-    out = flash_attention(qa[None], ka[None], va[None], causal=causal,
-                          sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-                          segment_ids=seg[None])
-    out = out[0, :total]
-    if isinstance(q, Tensor):
-        return Tensor(out)
-    return out
+    is_tensor = any(isinstance(t, Tensor) for t in (q, k, v))
+    if is_tensor:  # normalize mixed Tensor/array inputs for apply_op
+        q, k, v = (t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+                   for t in (q, k, v))
+
+    def _f(qa, ka, va):
+        total = qa.shape[0]
+        # token i belongs to segment j iff cu[j] <= i < cu[j+1]
+        pos = jnp.arange(total, dtype=jnp.int32)
+        seg = jnp.searchsorted(cu[1:], pos, side="right").astype(jnp.int32)
+        # pad the packed stream to a 128 multiple (TPU lane tiling); padding
+        # gets segment id -1 so no real token attends to it, and its rows are
+        # sliced off below (their cotangents are zero in the backward)
+        pad = (-total) % 128
+        if pad and not _interpret():
+            zeros = lambda a: jnp.zeros((pad,) + a.shape[1:], a.dtype)
+            qa = jnp.concatenate([qa, zeros(qa)])
+            ka = jnp.concatenate([ka, zeros(ka)])
+            va = jnp.concatenate([va, zeros(va)])
+            seg = jnp.concatenate([seg, jnp.full((pad,), -1, jnp.int32)])
+        # in-segment causal positions: flash's causal mask is on absolute
+        # positions, which is correct for packed sequences as long as the
+        # segment mask also applies (cross-segment attention is masked out).
+        out = flash_attention(qa[None], ka[None], va[None], causal=causal,
+                              sm_scale=sm_scale, block_q=block_q,
+                              block_k=block_k, segment_ids=seg[None])
+        return out[0, :total]
+
+    if is_tensor:
+        # route through dispatch so the tape sees one grad node (parity with
+        # flash_attention above; the review-caught alternative silently
+        # detached packed-sequence training from autograd)
+        return apply_op("flash_attn_varlen", _f, q, k, v)
+    return _f(_arr(q), _arr(k), _arr(v))

@@ -139,3 +139,20 @@ def test_flash_gradients_multiblock():
             for a, b_ in zip(gf, gx):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                            atol=2e-4, rtol=1e-4)
+
+
+def test_flash_varlen_grad_flows_through_tape():
+    """flash_attn_varlen on Tensors must register on the autograd tape
+    (review regression: it used to silently detach)."""
+    from paddle_tpu.pallas_kernels.flash_attention import flash_attn_varlen
+
+    total, h, d = 64, 1, 16
+    q = paddle.to_tensor(RNG.randn(total, h, d).astype(np.float32), stop_gradient=False)
+    k = paddle.to_tensor(RNG.randn(total, h, d).astype(np.float32), stop_gradient=False)
+    v = paddle.to_tensor(RNG.randn(total, h, d).astype(np.float32), stop_gradient=False)
+    cu = np.array([0, 24, 64], np.int32)
+    out = flash_attn_varlen(q, k, v, cu, causal=True)
+    assert not out.stop_gradient
+    out.sum().backward()
+    assert q.grad is not None and float(np.abs(q.grad.numpy()).sum()) > 0
+    assert v.grad is not None and float(np.abs(v.grad.numpy()).sum()) > 0
