@@ -21,7 +21,12 @@ import time
 import jax
 import numpy as np
 
-V5E_PEAK_FLOPS = 197e12  # bf16
+
+def _v5e_peak_flops():
+    # single source of truth shared with the auto-tuner roofline model
+    from paddle_tpu.distributed.auto_tuner import _HW_DEFAULTS
+
+    return _HW_DEFAULTS["peak_tflops"] * 1e12
 
 
 def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
@@ -64,7 +69,7 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
     # PaLM-convention training FLOPs/token: 6N plus attention 12*L*s*h;
     # MFU only meaningful against the TPU peak (null on the CPU smoke path)
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size
-    mfu = (tokens_per_sec * flops_per_token / (V5E_PEAK_FLOPS * max(n_dev, 1))
+    mfu = (tokens_per_sec * flops_per_token / (_v5e_peak_flops() * max(n_dev, 1))
            if on_tpu else None)
     return {
         "tokens_per_sec_per_chip": round(tokens_per_sec / max(n_dev, 1), 2),

@@ -47,16 +47,48 @@ def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args, **kwargs
 
 def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]) -> Tensor:
     """Transition a DistTensor to new placements (parity: the reshard engine,
-    phi/core/distributed/auto_parallel/reshard/)."""
+    phi/core/distributed/auto_parallel/reshard/).
+
+    Partial source (p_to_r/p_to_s, reference p_to_r_reshard_function.cc):
+    eagerly, a Partial tensor's payload is THIS controller's partial
+    contribution. In a multi-process job the contributions are summed with
+    a cross-process all-reduce (compiled, Gloo/ICI); in a single-controller
+    program there is exactly one contribution, so the sum is the value
+    itself. Inside jit/spmd, Partial only exists transiently and psum
+    resolves it — this is the eager twin of that rule.
+
+    Replicate -> Partial (r_to_p): non-zeroth processes zero their
+    contribution so a subsequent p_to_r round-trips (reference
+    r_to_p_reshard_function.cc).
+    """
     sharding = named_sharding(mesh, placements, dist_tensor.ndim)
     data = dist_tensor._data
-    has_partial = any(isinstance(p, Partial) for p in (getattr(dist_tensor, "placements", None) or []))
-    if has_partial:
-        raise NotImplementedError(
-            "reshard from Partial placement eagerly: run the producing op inside "
-            "spmd/pjit where psum resolves partial sums (XLA semantics)"
-        )
-    if isinstance(data, jax.core.Tracer):
+    src_placements = list(getattr(dist_tensor, "placements", None) or [])
+    src_partial = [p for p in src_placements if isinstance(p, Partial)]
+    dst_partial = any(isinstance(p, Partial) for p in placements)
+    traced = isinstance(data, jax.core.Tracer)
+
+    if src_partial and not dst_partial and not traced:
+        from . import eager_collectives as ec
+
+        if ec.process_world_size() > 1:
+            # pass through verbatim; eager_all_reduce validates the op
+            data = ec.eager_all_reduce(data, src_partial[0].reduce_type)
+        # single controller: the lone contribution IS the reduction
+    elif dst_partial and not src_partial and not traced:
+        from . import eager_collectives as ec
+
+        if ec.process_world_size() > 1 and jax.process_index() != 0:
+            # non-root contribution = the reduction's identity element so
+            # p_to_r round-trips: 0 for sum, 1 for prod; max/min/avg are
+            # idempotent over replicas, so the value itself is correct
+            rt = next(p.reduce_type for p in placements if isinstance(p, Partial))
+            if rt == "sum":
+                data = jnp.zeros_like(data)
+            elif rt == "prod":
+                data = jnp.ones_like(data)
+
+    if traced:
         new_data = jax.lax.with_sharding_constraint(data, sharding)
     else:
         new_data = jax.device_put(data, sharding)

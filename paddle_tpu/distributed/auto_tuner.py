@@ -16,7 +16,8 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-__all__ = ["AutoTuner", "Candidate", "default_candidates", "prune_by_memory", "estimate_memory_gb"]
+__all__ = ["AutoTuner", "Candidate", "default_candidates", "prune_by_memory",
+           "estimate_memory_gb", "estimate_step_time_ms"]
 
 
 def _divisors(n: int) -> List[int]:
@@ -67,17 +68,73 @@ def estimate_memory_gb(cand: Candidate, model_cfg: Dict[str, Any]) -> float:
     return (weight_bytes + grad_bytes + opt_bytes + act) / (1 << 30)
 
 
-def _score(cand: Candidate, model_cfg: Dict[str, Any]) -> float:
-    """Heuristic throughput score: prefer less model-split (mp/pp comm),
-    bigger micro-batches (MXU util), recompute only if needed."""
-    score = 100.0
-    score -= 8.0 * (cand.mp_degree - 1) ** 0.5     # per-layer collectives
-    score -= 4.0 * (cand.pp_degree - 1) ** 0.5     # bubble
-    score -= 1.0 * (cand.sharding_degree - 1) ** 0.25
-    score += 2.0 * min(cand.micro_batch_size, 16) ** 0.5
+# v5e-class chip defaults for the roofline cost model
+_HW_DEFAULTS = {
+    "peak_tflops": 197.0,       # bf16
+    "ici_gbps": 180.0,          # per-link ICI bandwidth (bytes/s * 1e-9)
+    "base_mfu": 0.5,            # achievable compute efficiency
+}
+
+
+def estimate_step_time_ms(cand: Candidate, model_cfg: Dict[str, Any],
+                          hw: Optional[Dict[str, float]] = None) -> float:
+    """Roofline step-time estimate (ms): sharded compute on the MXU +
+    exposed collective time over ICI + pipeline bubble + recompute.
+
+    Parity role: auto_parallel/static/cost/ (comp/comm cost models feeding
+    the tuner); TPU form: compute = 6*N*tokens / (peak*mfu) per chip,
+    mp comm = per-layer activation all-reduces, dp comm = gradient
+    all-reduce (partially overlapped), pp = (pp-1)/m bubble fraction.
+    """
+    h = model_cfg.get("hidden_size", 4096)
+    L = model_cfg.get("num_layers", 32)
+    V = model_cfg.get("vocab_size", 32000)
+    S = model_cfg.get("seq_length", 2048)
+    gbs = model_cfg.get("global_batch_size", 64)
+    hw = {**_HW_DEFAULTS, **(hw or {})}
+    peak = hw["peak_tflops"] * 1e12 * hw["base_mfu"]
+    ici = hw["ici_gbps"] * 1e9
+
+    params = 12 * L * h * h + V * h
+    tokens = gbs * S
+    # compute per chip per step (fwd+bwd = 6N flops/token), dp+sharding
+    # split the batch; mp/pp split the model
+    chips = cand.degree_product
+    flops_chip = 6.0 * params * tokens / chips
     if cand.use_recompute:
-        score -= 10.0  # ~30% recompute overhead
-    return score
+        flops_chip *= 4.0 / 3.0  # one extra forward
+    t_compute = flops_chip / peak
+
+    # mp: 4 all-reduces (2 fwd + 2 bwd) of [b_local, S, h] bf16 per layer
+    t_mp = 0.0
+    if cand.mp_degree > 1:
+        b_local = max(gbs // (cand.dp_degree * cand.sharding_degree), 1)
+        ar_bytes = b_local * S * h * 2
+        ring = 2.0 * (cand.mp_degree - 1) / cand.mp_degree
+        t_mp = 4 * (L // cand.pp_degree) * ar_bytes * ring / ici
+
+    # dp/sharding gradient all-reduce (bf16), ~half overlapped with bwd
+    t_dp = 0.0
+    dpsh = cand.dp_degree * cand.sharding_degree
+    if dpsh > 1:
+        grad_bytes = 2.0 * params / (cand.mp_degree * cand.pp_degree)
+        ring = 2.0 * (dpsh - 1) / dpsh
+        t_dp = 0.5 * grad_bytes * ring / ici
+
+    t = t_compute + t_mp + t_dp
+    if cand.pp_degree > 1:
+        m = max(gbs // (cand.dp_degree * cand.sharding_degree * cand.micro_batch_size), 1)
+        t *= 1.0 + (cand.pp_degree - 1) / m  # bubble fraction
+    return t * 1e3
+
+
+def _score(cand: Candidate, model_cfg: Dict[str, Any],
+           hw: Optional[Dict[str, float]] = None) -> float:
+    """Throughput score = estimated tokens/sec (higher is better)."""
+    t_ms = estimate_step_time_ms(cand, model_cfg, hw)
+    gbs = model_cfg.get("global_batch_size", 64)
+    S = model_cfg.get("seq_length", 2048)
+    return gbs * S / max(t_ms, 1e-6) * 1e3
 
 
 def prune_by_memory(cands: List[Candidate], model_cfg: Dict[str, Any],
@@ -138,10 +195,11 @@ class AutoTuner:
         self.world_size = int(tuner_cfg.get("world_size", 8))
         self.model_cfg = tuner_cfg.get("model_cfg", {})
         self.hbm_gb = float(tuner_cfg.get("hbm_gb", 95.0))  # v5p default
+        self.hw = tuner_cfg.get("hw", None)
         cands = default_candidates(self.world_size, self.cfg)
         cands = prune_by_memory(cands, self.model_cfg, self.hbm_gb)
         for c in cands:
-            c.estimated_score = _score(c, self.model_cfg)
+            c.estimated_score = _score(c, self.model_cfg, self.hw)
         self._cands = sorted(cands, key=lambda c: -c.estimated_score)
         self._cur = -1
         self.history: List[Candidate] = []
@@ -160,6 +218,11 @@ class AutoTuner:
     def record(self, cand: Candidate, metric: float):
         cand.metric = metric
         self.history.append(cand)
+
+    def pick(self) -> Optional[Candidate]:
+        """Best candidate by the roofline cost model (no measured runs) —
+        what the dryrun/launch integration consumes."""
+        return self._cands[0] if self._cands else None
 
     def best(self) -> Optional[Candidate]:
         done = [c for c in self.history if c.metric is not None]

@@ -1,0 +1,132 @@
+"""DistModel: the semi-auto static training/eval entry over a mesh.
+
+Parity: python/paddle/distributed/auto_parallel/api.py — DistModel:2132
+and dist to_static:2715. The reference lowers a Layer + loss + optimizer
+into a parallelized static Engine program per mode (train/eval/predict);
+here each mode is one pjit-compiled program over the ProcessMesh
+(GSPMD does completion/partitioning, ShardedTrainStep provides the
+train-step program; eval/predict are jitted functional calls with the
+same param shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from .engine import ShardedTrainStep
+from .mesh import ProcessMesh
+
+__all__ = ["DistModel", "to_static"]
+
+
+class DistModel:
+    """Callable whose __call__ executes the compiled program of the current
+    mode: 'train' -> one optimizer step returning the loss; 'eval' -> loss
+    without update; 'predict' -> raw outputs."""
+
+    def __init__(self, layer, loss_fn: Optional[Callable] = None, optimizer=None,
+                 mesh: Optional[ProcessMesh] = None, dp_axis: Optional[str] = None,
+                 strategy=None, **step_kwargs):
+        self._layer = layer
+        self._loss_fn = loss_fn
+        if mesh is None:
+            # derive from sharded params, else a 1-D world mesh
+            for p in layer.parameters():
+                m = getattr(p, "process_mesh", None)
+                if m is not None:
+                    mesh = m
+                    break
+        if mesh is None:
+            mesh = ProcessMesh(np.arange(len(jax.devices())), ["dp"])
+            dp_axis = dp_axis or "dp"
+        self._mesh = mesh
+        if dp_axis is None:
+            dp_axis = "dp" if "dp" in mesh.dim_names else mesh.dim_names[0]
+        self._step = None
+        if optimizer is not None:
+            assert loss_fn is not None, "training DistModel needs a loss"
+            self._step = ShardedTrainStep(layer, loss_fn, optimizer, mesh,
+                                          dp_axis=dp_axis, **step_kwargs)
+        self._mode = "train" if self._step is not None else (
+            "eval" if loss_fn is not None else "predict")
+        self._eval_jit = None
+
+    # -- mode switches (reference DistModel.train/eval/predict) -----------
+    def train(self):
+        assert self._step is not None, "no optimizer: cannot enter train mode"
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        assert self._loss_fn is not None, "no loss: cannot enter eval mode"
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def _functional_eval(self, inputs, labels=None):
+        from ..utils.functional import functional_call
+
+        layer, loss_fn = self._layer, self._loss_fn
+
+        if self._eval_jit is None:
+            def run(params, buffers, x, lab):
+                out = functional_call(layer, {**params, **buffers}, Tensor(x))
+                if lab is None or loss_fn is None:
+                    return out._data if isinstance(out, Tensor) else out
+                l = loss_fn(out, Tensor(lab))
+                return l._data if isinstance(l, Tensor) else l
+
+            self._eval_jit = jax.jit(run, static_argnames=())
+        if self._step is not None:
+            params = {k: v for k, v in self._step.params.items()}
+            buffers = {k: v for k, v in self._step.buffers.items()}
+        else:
+            params = {k: p._data for k, p in self._layer.named_parameters_dict().items()}
+            buffers = {k: b._data for k, b in self._layer.named_buffers_dict().items()}
+        x = inputs._data if isinstance(inputs, Tensor) else inputs
+        lab = labels._data if isinstance(labels, Tensor) else labels
+        return Tensor(self._eval_jit(params, buffers, x, lab))
+
+    def __call__(self, inputs, labels=None):
+        if self._mode == "train":
+            return self._step.step(inputs, labels)
+        if self._mode == "eval":
+            return self._functional_eval(inputs, labels)
+        return self._functional_eval(inputs, None)
+
+    # -- state passthrough --------------------------------------------------
+    def state_dict(self, *a, **k):
+        if self._step is not None:
+            self._step.sync_weights_to_model()
+        return self._layer.state_dict(*a, **k)
+
+    def set_state_dict(self, sd):
+        res = self._layer.set_state_dict(sd)
+        if self._step is not None:
+            # resync the engine's live sharded params or the load is a no-op
+            self._step.sync_weights_from_model()
+        return res
+
+    @property
+    def layer(self):
+        return self._layer
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              mesh: Optional[ProcessMesh] = None, **kwargs) -> DistModel:
+    """Parity: paddle.distributed.to_static (auto_parallel/api.py:2715) —
+    wrap a (sharded) Layer into per-mode compiled mesh programs. ``loader``
+    is accepted for signature parity; data flows through __call__."""
+    return DistModel(layer, loss_fn=loss, optimizer=optimizer, mesh=mesh,
+                     strategy=strategy, **kwargs)

@@ -123,6 +123,8 @@ def _run(kind: str, arr: jax.Array, extra=None) -> jax.Array:
 
 
 def eager_all_reduce(arr, op: str = "sum"):
+    if op not in ("sum", "max", "min", "prod", "avg"):
+        raise ValueError(f"unsupported eager all_reduce op {op!r}")
     return _run(op, arr)
 
 

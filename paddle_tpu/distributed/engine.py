@@ -187,6 +187,17 @@ class ShardedTrainStep:
         for k, b in self._buffer_objs.items():
             b._data = jnp.copy(self.buffers[k])
 
+    def sync_weights_from_model(self):
+        """Push Layer weights into the engine's live (sharded) params —
+        required after set_state_dict, or loaded checkpoints would be
+        silently ignored by the compiled step. Optimizer moments are kept
+        (matching resume semantics where opt state is loaded separately)."""
+        for k, p in self._param_objs.items():
+            self.params[k] = jax.device_put(jnp.asarray(p._data),
+                                            self._param_shardings[k])
+        for k, b in self._buffer_objs.items():
+            self.buffers[k] = jax.device_put(jnp.asarray(b._data), self._replicated)
+
     def state_dict(self):
         self.sync_weights_to_model()
         return self.model.state_dict()
