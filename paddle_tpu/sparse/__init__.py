@@ -11,6 +11,7 @@ to MXU-friendly gathers + matmuls.
 
 from __future__ import annotations
 
+from builtins import slice as builtins_slice
 from typing import Optional, Sequence
 
 import jax
@@ -23,9 +24,11 @@ from ..ops.dispatch import apply_op
 
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
-    "matmul", "masked_matmul", "add", "subtract", "multiply", "divide",
-    "relu", "sqrt", "sin", "tanh", "abs", "pow", "neg", "cast", "transpose",
-    "coalesce", "is_same_shape", "nn",
+    "matmul", "masked_matmul", "mv", "addmm", "add", "subtract", "multiply",
+    "divide", "relu", "sqrt", "sin", "tan", "asin", "atan", "sinh", "asinh",
+    "atanh", "tanh", "square", "log1p", "expm1", "rad2deg", "deg2rad",
+    "isnan", "abs", "pow", "neg", "cast", "transpose", "reshape", "sum",
+    "slice", "coalesce", "is_same_shape", "mask_as", "nn",
 ]
 
 
@@ -283,6 +286,56 @@ def pow(x, factor):
     return _ewise_values("sparse_pow", x, lambda v: jnp.power(v, factor))
 
 
+def tan(x):
+    return _ewise_values("sparse_tan", x, jnp.tan)
+
+
+def asin(x):
+    return _ewise_values("sparse_asin", x, jnp.arcsin)
+
+
+def atan(x):
+    return _ewise_values("sparse_atan", x, jnp.arctan)
+
+
+def sinh(x):
+    return _ewise_values("sparse_sinh", x, jnp.sinh)
+
+
+def asinh(x):
+    return _ewise_values("sparse_asinh", x, jnp.arcsinh)
+
+
+def atanh(x):
+    return _ewise_values("sparse_atanh", x, jnp.arctanh)
+
+
+def square(x):
+    return _ewise_values("sparse_square", x, jnp.square)
+
+
+def log1p(x):
+    return _ewise_values("sparse_log1p", x, jnp.log1p)
+
+
+def expm1(x):
+    return _ewise_values("sparse_expm1", x, jnp.expm1)
+
+
+def rad2deg(x):
+    return _ewise_values("sparse_rad2deg", x, jnp.rad2deg)
+
+
+def deg2rad(x):
+    return _ewise_values("sparse_deg2rad", x, jnp.deg2rad)
+
+
+def isnan(x):
+    """Sparse bool tensor marking NaN stored values (parity:
+    paddle.sparse.isnan — zeros are never NaN so the pattern is kept)."""
+    return _ewise_values("sparse_isnan", x, jnp.isnan)
+
+
 def cast(x, index_dtype=None, value_dtype=None):
     mat = _as_bcoo(x)
     data = mat.data if value_dtype is None else mat.data.astype(value_dtype)
@@ -321,6 +374,75 @@ def transpose(x, perm):
     return _rewrap(x, mat.transpose(tuple(perm)))
 
 
+def reshape(x, shape):
+    """Parity: paddle.sparse.reshape (phi sparse reshape kernels)."""
+    mat = _as_bcoo(x)
+    try:
+        out = mat.reshape(tuple(int(s) for s in shape))
+    except Exception:  # jsparse reshape limits: dense round-trip
+        out = jsparse.BCOO.fromdense(mat.todense().reshape(tuple(int(s) for s in shape)))
+    return _rewrap(x, out)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    """Parity: paddle.sparse.sum — reduce over stored values. Full
+    reduction returns a dense scalar Tensor; axis reductions return a
+    sparse tensor of the reduced dense result (reference semantics)."""
+    mat = _as_bcoo(x)
+    if axis is None:
+        out = mat.data.sum()
+        if dtype is not None:
+            out = out.astype(dtype)
+        return Tensor(out)
+    dense = mat.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return _rewrap(x, jsparse.BCOO.fromdense(dense))
+
+
+def slice(x, axes, starts, ends):
+    """Parity: paddle.sparse.slice."""
+    mat = _as_bcoo(x)
+    dense = mat.todense()
+    idx = [builtins_slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[int(ax)] = builtins_slice(int(st), int(en))
+    return _rewrap(x, jsparse.BCOO.fromdense(dense[tuple(idx)]))
+
+
+def mask_as(x: Tensor, mask):
+    """Sample dense ``x`` at ``mask``'s sparsity pattern (parity:
+    paddle.sparse.mask_as / sparse_mask)."""
+    m = _as_bcoo(mask)
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    gathered = xd[tuple(m.indices[:, i] for i in range(m.indices.shape[1]))]
+    out = jsparse.BCOO((gathered, m.indices), shape=m.shape)
+    return _rewrap(mask, out)
+
+
+def mv(x, vec: Tensor):
+    """Sparse matrix @ dense vector (parity: paddle.sparse.mv)."""
+    mat = _as_bcoo(x)
+
+    def fn(v):
+        return mat @ v
+
+    return apply_op("sparse_mv", fn, vec)
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    """beta*input + alpha*(x @ y) with a sparse x or y (parity:
+    paddle.sparse.addmm)."""
+    prod = matmul(x, y)
+    prod_d = prod.to_dense() if not isinstance(prod, Tensor) else prod
+    inp_d = input.to_dense() if not isinstance(input, Tensor) else input
+
+    def fn(a, b):
+        return beta * a + alpha * b
+
+    return apply_op("sparse_addmm", fn, inp_d, prod_d)
+
+
 def coalesce(x: SparseCooTensor) -> SparseCooTensor:
     return x.coalesce()
 
@@ -346,11 +468,137 @@ Tensor.to_sparse_csr = _tensor_to_sparse_csr
 # ---------------------------------------------------------------- sparse.nn
 
 class nn:
-    """paddle.sparse.nn subset (ReLU + Linear over sparse inputs)."""
+    """paddle.sparse.nn (parity: python/paddle/sparse/nn — activations,
+    sparse softmax, BatchNorm over values, conv via dense lowering with
+    submanifold sampling)."""
 
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+    class ReLU6:
+        def __call__(self, x):
+            return _ewise_values("sparse_relu6", x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+    class LeakyReLU:
+        def __init__(self, negative_slope: float = 0.01):
+            self.negative_slope = negative_slope
+
+        def __call__(self, x):
+            a = self.negative_slope
+            return _ewise_values("sparse_leaky_relu", x,
+                                 lambda v: jnp.where(v >= 0, v, a * v))
+
+    class Softmax:
+        """Row-wise softmax over stored values (2-D COO/CSR; parity:
+        paddle.sparse.nn.Softmax — only nonzeros participate)."""
+
+        def __init__(self, axis: int = -1):
+            assert axis == -1, "sparse softmax supports the last axis"
+
+        def __call__(self, x):
+            mat = _as_bcoo(x)
+            rows = mat.indices[:, 0]
+            mx = jax.ops.segment_max(mat.data, rows, num_segments=mat.shape[0])
+            e = jnp.exp(mat.data - mx[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=mat.shape[0])
+            vals = e / denom[rows]
+            return _rewrap(x, jsparse.BCOO((vals, mat.indices), shape=mat.shape))
+
+    class BatchNorm:
+        """Normalize stored values per trailing channel (parity:
+        paddle.sparse.nn.BatchNorm over [N, ..., C] sparse inputs)."""
+
+        def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+            self.num_features = num_features
+            self.epsilon = epsilon
+            self.weight = jnp.ones((num_features,), jnp.float32)
+            self.bias = jnp.zeros((num_features,), jnp.float32)
+
+        def __call__(self, x):
+            mat = _as_bcoo(x)
+            v = mat.data  # [nnz, C] (n_dense=1) or [nnz] (num_features==1)
+            if v.ndim == 1:
+                if self.num_features != 1:
+                    raise ValueError(
+                        "sparse BatchNorm with per-channel features needs the "
+                        "channel axis dense (build the COO with n_dense=1); "
+                        f"got flat values but num_features={self.num_features}")
+                flat = True
+                v = v[:, None]
+            else:
+                flat = False
+            mean = v.mean(axis=0)
+            var = v.var(axis=0)
+            out = (v - mean) / jnp.sqrt(var + self.epsilon)
+            out = out * self.weight.astype(out.dtype) + self.bias.astype(out.dtype)
+            if flat:
+                out = out[:, 0]
+            return _rewrap(x, jsparse.BCOO((out, mat.indices), shape=mat.shape))
+
+    class Conv3D:
+        """Sparse 3-D conv on [N, D, H, W, C] COO inputs. TPU design: lower
+        to a dense lax.conv (XLA maps it onto the MXU) and re-sparsify —
+        functionally matches phi/kernels/sparse conv3d; the gather/scatter
+        kernel specialization is an optimization, not a semantic."""
+
+        def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                     padding=0, subm=False):
+            ks = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size,) * 3
+            self.stride = stride if isinstance(stride, (tuple, list)) else (stride,) * 3
+            self.padding = padding if isinstance(padding, (tuple, list)) else (padding,) * 3
+            self.subm = subm
+            from ..ops.random import split_key
+
+            k = split_key()
+            scale = 1.0 / float(np.sqrt(in_channels * int(np.prod(ks))))
+            self.weight = jax.random.uniform(
+                k, (*ks, in_channels, out_channels), jnp.float32, -scale, scale)
+            self.bias = jnp.zeros((out_channels,), jnp.float32)
+
+        def __call__(self, x):
+            mat = _as_bcoo(x)
+            dense = mat.todense()
+            pad = [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)]
+            out = jax.lax.conv_general_dilated(
+                dense.astype(self.weight.dtype), self.weight,
+                window_strides=tuple(self.stride),
+                padding=pad[1:4],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            out = out + self.bias
+            if self.subm:
+                # submanifold: output sparsity = input sparsity
+                idx = mat.indices
+                vals = out[tuple(idx[:, i] for i in range(idx.shape[1]))]
+                return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                                    shape=(*out.shape[:-1], out.shape[-1])))
+            return SparseCooTensor(jsparse.BCOO.fromdense(out, n_dense=1))
+
+    class SubmConv3D(Conv3D):
+        def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0):
+            super().__init__(in_channels, out_channels, kernel_size, stride,
+                             padding, subm=True)
+
+    class MaxPool3D:
+        """Sparse max pool on [N, D, H, W, C] COO inputs (dense lowering)."""
+
+        def __init__(self, kernel_size, stride=None, padding=0):
+            ks = kernel_size if isinstance(kernel_size, (tuple, list)) else (kernel_size,) * 3
+            self.ks = ks
+            st = stride if stride is not None else ks
+            self.stride = st if isinstance(st, (tuple, list)) else (st,) * 3
+            self.padding = padding if isinstance(padding, (tuple, list)) else (padding,) * 3
+
+        def __call__(self, x):
+            mat = _as_bcoo(x)
+            dense = mat.todense()
+            out = jax.lax.reduce_window(
+                dense, -jnp.inf, jax.lax.max,
+                window_dimensions=(1, *self.ks, 1),
+                window_strides=(1, *self.stride, 1),
+                padding=[(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)])
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+            return SparseCooTensor(jsparse.BCOO.fromdense(out, n_dense=1))
 
     class Linear:
         def __init__(self, in_features, out_features, bias=True):

@@ -1,0 +1,151 @@
+"""Extended sparse surface tests (COO/CSR, fp32 + bf16).
+
+Reference parity: python/paddle/sparse/{unary,binary,multiary}.py public
+function list + sparse/nn layers; oracle = dense numpy/jax results
+restricted to the sparsity pattern (pattern of test/legacy_test sparse
+OpTests)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+RNG = np.random.RandomState(0)
+
+
+def _coo(dtype=np.float32, shape=(4, 6), density=0.4):
+    dense = RNG.randn(*shape).astype(dtype)
+    dense[RNG.rand(*shape) > density] = 0
+    t = paddle.to_tensor(dense)
+    return t.to_sparse_coo(len(shape)), dense
+
+
+UNARY = [
+    ("sin", np.sin), ("tan", np.tan), ("asin", lambda v: np.arcsin(np.clip(v, -0.9, 0.9))),
+    ("atan", np.arctan), ("sinh", np.sinh), ("asinh", np.arcsinh),
+    ("atanh", lambda v: np.arctanh(np.clip(v, -0.9, 0.9))),
+    ("tanh", np.tanh), ("square", np.square), ("log1p", lambda v: np.log1p(np.abs(v))),
+    ("expm1", np.expm1), ("rad2deg", np.rad2deg), ("deg2rad", np.deg2rad),
+    ("abs", np.abs), ("neg", np.negative),
+]
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+@pytest.mark.parametrize("name,ref", UNARY, ids=[n for n, _ in UNARY])
+def test_sparse_unary(name, ref, dtype):
+    coo, dense = _coo(np.float32)
+    vals = np.asarray(coo.values().numpy())
+    if name in ("asin", "atanh"):
+        vals = np.clip(vals, -0.9, 0.9)
+    if name == "log1p":
+        vals = np.abs(vals)
+    import jax.experimental.sparse as jsp
+
+    mat = jsp.BCOO((jnp.asarray(vals, dtype), coo._mat.indices), shape=coo.shape)
+    x = sp.SparseCooTensor(mat)
+    out = getattr(sp, name)(x)
+    tol = 3e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(out.values().numpy(), np.float32),
+                               ref(np.asarray(vals, np.float32)),
+                               rtol=tol, atol=tol)
+
+
+def test_sparse_isnan():
+    coo, _ = _coo()
+    out = sp.isnan(coo)
+    assert not np.asarray(out.values().numpy()).any()
+
+
+def test_sparse_sum_full_and_axis():
+    coo, dense = _coo()
+    np.testing.assert_allclose(float(sp.sum(coo).numpy()), dense.sum(), rtol=1e-5)
+    by_row = sp.sum(coo, axis=1)
+    np.testing.assert_allclose(np.asarray(by_row.to_dense().numpy()),
+                               dense.sum(axis=1), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_reshape_slice():
+    coo, dense = _coo(shape=(4, 6))
+    r = sp.reshape(coo, (2, 12))
+    np.testing.assert_allclose(np.asarray(r.to_dense().numpy()),
+                               dense.reshape(2, 12))
+    s = sp.slice(coo, [0, 1], [1, 2], [3, 5])
+    np.testing.assert_allclose(np.asarray(s.to_dense().numpy()),
+                               dense[1:3, 2:5])
+
+
+def test_sparse_mv_addmm_mask_as():
+    coo, dense = _coo(shape=(4, 6))
+    v = RNG.randn(6).astype(np.float32)
+    out = sp.mv(coo, paddle.to_tensor(v))
+    np.testing.assert_allclose(out.numpy(), dense @ v, rtol=1e-5, atol=1e-5)
+
+    y = RNG.randn(6, 3).astype(np.float32)
+    inp = RNG.randn(4, 3).astype(np.float32)
+    got = sp.addmm(paddle.to_tensor(inp), coo, paddle.to_tensor(y),
+                   beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(got.numpy(), 0.5 * inp + 2.0 * (dense @ y),
+                               rtol=1e-5, atol=1e-5)
+
+    full = RNG.randn(4, 6).astype(np.float32)
+    masked = sp.mask_as(paddle.to_tensor(full), coo)
+    ref = np.where(dense != 0, full, 0.0)
+    np.testing.assert_allclose(np.asarray(masked.to_dense().numpy()), ref)
+
+
+def test_sparse_softmax_rowwise():
+    coo, dense = _coo(shape=(5, 7))
+    out = sp.nn.Softmax()(coo)
+    od = np.asarray(out.to_dense().numpy())
+    for r in range(5):
+        nz = dense[r] != 0
+        if nz.any():
+            e = np.exp(dense[r][nz] - dense[r][nz].max())
+            np.testing.assert_allclose(od[r][nz], e / e.sum(), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_activations():
+    coo, dense = _coo()
+    r6 = sp.nn.ReLU6()(coo)
+    np.testing.assert_allclose(np.asarray(r6.values().numpy()),
+                               np.clip(np.asarray(coo.values().numpy()), 0, 6))
+    lr = sp.nn.LeakyReLU(0.1)(coo)
+    v = np.asarray(coo.values().numpy())
+    np.testing.assert_allclose(np.asarray(lr.values().numpy()),
+                               np.where(v >= 0, v, 0.1 * v), rtol=1e-6)
+
+
+def test_sparse_batchnorm_values():
+    coo, _ = _coo(shape=(6, 8))
+    bn = sp.nn.BatchNorm(num_features=1)
+    out = bn(coo)
+    v = np.asarray(out.values().numpy())
+    np.testing.assert_allclose(v.mean(), 0.0, atol=1e-5)
+    np.testing.assert_allclose(v.std(), 1.0, atol=1e-2)
+
+
+def test_sparse_subm_conv3d_preserves_pattern():
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    pts = [(0, 1, 1, 1), (0, 2, 3, 0), (0, 3, 0, 2)]
+    for (n, d, h, w) in pts:
+        dense[n, d, h, w] = RNG.randn(2)
+    x = paddle.to_tensor(dense).to_sparse_coo(4)
+    conv = sp.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    assert out.nnz == x.nnz  # submanifold keeps the active-site set
+    assert out.shape[-1] == 3
+
+    pool = sp.nn.MaxPool3D(kernel_size=2)
+    pooled = pool(x)
+    assert tuple(pooled.shape)[:4] == (1, 2, 2, 2)
+
+
+def test_sparse_csr_ops_roundtrip():
+    coo, dense = _coo(shape=(4, 6))
+    csr = coo.to_sparse_csr()
+    out = sp.tanh(csr)
+    assert out.is_sparse_csr()
+    np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                               np.tanh(dense) * (dense != 0), rtol=1e-5, atol=1e-6)
