@@ -22,7 +22,7 @@ from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op, ensure_tensor
 # shared helpers from the main functional module (defined before its tail
 # import of this file, so no cycle)
-from .functional import _pair, _reduce_loss as _reduce
+from .functional import _pair, _reduce_loss as _reduce, log_sigmoid
 
 __all__ = [
     "grid_sample", "affine_grid", "pixel_unshuffle", "channel_shuffle",
@@ -31,6 +31,9 @@ __all__ = [
     "huber_loss", "dice_loss", "square_error_cost", "poisson_nll_loss",
     "soft_margin_loss", "multi_label_soft_margin_loss", "triplet_margin_loss",
     "feature_alpha_dropout", "class_center_sample",
+    "swiglu", "logsigmoid", "rrelu", "log_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "bilinear", "spectral_norm_value",
+    "deformable_conv",
 ]
 
 
@@ -491,3 +494,249 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
         return _reduce(loss, reduction)
 
     return apply_op("ctc_loss", fn, ensure_tensor(log_probs), ensure_tensor(labels))
+
+
+# ---------------------------------------------------------------------------
+# Long-tail functional surface (ops.yaml entries previously absent):
+# swiglu, logsigmoid (alias), rrelu, log_loss, hsigmoid_loss,
+# margin_cross_entropy, bilinear, spectral-norm normalization.
+# ---------------------------------------------------------------------------
+
+
+def swiglu(x, y=None, name=None) -> Tensor:
+    """SwiGLU activation (parity: ops.yaml swiglu; llama MLP fast path):
+    silu(x) * y; when y is None, x splits in half on the last axis."""
+    x = ensure_tensor(x)
+    if y is None:
+        def _f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply_op("swiglu", _f, x)
+    y = ensure_tensor(y)
+    return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def logsigmoid(x, name=None) -> Tensor:
+    """Alias kept for ops.yaml name parity (logsigmoid == log_sigmoid)."""
+    return log_sigmoid(x)
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = True, name=None) -> Tensor:
+    """Randomized leaky ReLU (parity: ops.yaml rrelu). Training samples the
+    negative slope uniformly per element; eval uses the mean slope."""
+    x = ensure_tensor(x)
+    if not training:
+        a = (lower + upper) / 2.0
+        return apply_op("rrelu", lambda v: jnp.where(v >= 0, v, a * v), x)
+    from ..ops.random import split_key
+
+    key = split_key()
+
+    def _f(v):
+        slopes = jax.random.uniform(key, v.shape, jnp.float32, lower, upper).astype(v.dtype)
+        return jnp.where(v >= 0, v, slopes * v)
+
+    return apply_op("rrelu", _f, x)
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None) -> Tensor:
+    """Parity: ops.yaml log_loss — negative log likelihood of a bernoulli
+    prediction: -label*log(p+eps) - (1-label)*log(1-p+eps)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def _f(p, y):
+        return -y * jnp.log(p + epsilon) - (1.0 - y) * jnp.log(1.0 - p + epsilon)
+
+    return apply_op("log_loss", _f, input, label)
+
+
+def hsigmoid_loss(input, label, num_classes: int, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse: bool = False,
+                  name=None) -> Tensor:
+    """Hierarchical sigmoid loss over a complete binary tree (parity:
+    ops.yaml hsigmoid_loss / phi hsigmoid kernels; word2vec hierarchical
+    softmax). Default tree: leaf ``l`` is node ``l + num_classes`` in a
+    1-indexed heap; internal node k's parameters are row k-1.
+
+    Custom trees pass path_table [N, L] (internal-node ids per step, -1
+    padded) and path_code [N, L] (0/1 branch taken).
+    """
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    b = ensure_tensor(bias) if bias is not None else None
+    C = int(num_classes)
+
+    if path_table is None:
+        depth = max(int(np.ceil(np.log2(max(C, 2)))), 1)
+        lab = np.asarray(label.numpy()).reshape(-1).astype(np.int64)
+        nodes = np.zeros((lab.shape[0], depth), np.int64)
+        codes = np.zeros((lab.shape[0], depth), np.float32)
+        mask = np.zeros((lab.shape[0], depth), np.float32)
+        for r, l in enumerate(lab):
+            heap = int(l) + C  # leaf id in 1-indexed heap
+            path = []
+            while heap > 1:
+                path.append((heap // 2, heap & 1))
+                heap //= 2
+            path.reverse()
+            for d, (node, code) in enumerate(path[:depth]):
+                nodes[r, d] = node - 1  # parameter row of internal node
+                codes[r, d] = float(code)
+                mask[r, d] = 1.0
+        nodes_j = jnp.asarray(nodes)
+        codes_j = jnp.asarray(codes)
+        mask_j = jnp.asarray(mask)
+    else:
+        pt = path_table._data if isinstance(path_table, Tensor) else jnp.asarray(path_table)
+        pc = path_code._data if isinstance(path_code, Tensor) else jnp.asarray(path_code)
+        mask_j = (pt >= 0).astype(jnp.float32)
+        nodes_j = jnp.maximum(pt, 0)
+        codes_j = pc.astype(jnp.float32)
+
+    def _f(x, w, *rest):
+        bb = rest[0] if rest else None
+        wn = w[nodes_j]                      # [N, L, D]
+        logits = jnp.einsum("nld,nd->nl", wn, x)
+        if bb is not None:
+            logits = logits + bb.reshape(-1)[nodes_j]
+        # code 1 -> sigmoid(logit), code 0 -> 1 - sigmoid(logit)
+        sign = 2.0 * codes_j - 1.0
+        losses = jax.nn.softplus(-sign * logits)
+        return (losses * mask_j).sum(axis=1, keepdims=True)
+
+    args = (input, weight) + ((b,) if b is not None else ())
+    return apply_op("hsigmoid_loss", _f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1: float = 1.0, margin2: float = 0.5,
+                         margin3: float = 0.0, scale: float = 64.0,
+                         return_softmax: bool = False, reduction: str = "mean",
+                         group=None, name=None):
+    """ArcFace-family margin softmax CE (parity: ops.yaml
+    margin_cross_entropy): target cos(theta) -> cos(m1*theta + m2) - m3,
+    scaled, then softmax cross-entropy."""
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+
+    def _f(cos, lab):
+        lab = lab.reshape(-1)
+        onehot = jax.nn.one_hot(lab, cos.shape[-1], dtype=cos.dtype)
+        theta = jnp.arccos(jnp.clip(cos, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -(onehot * logp).sum(-1, keepdims=True)
+        return loss, jnp.exp(logp)
+
+    loss, softmax = apply_op("margin_cross_entropy", _f, logits, label, nouts=2)
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def bilinear(x1, x2, weight, bias=None, name=None) -> Tensor:
+    """Bilinear transform x1^T W x2 (parity: ops.yaml bilinear)."""
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def _f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x1, x2, weight) + ((ensure_tensor(bias),) if bias is not None else ())
+    return apply_op("bilinear", _f, *args)
+
+
+def spectral_norm_value(weight, n_power_iterations: int = 1, eps: float = 1e-12,
+                        dim: int = 0, name=None) -> Tensor:
+    """Weight / sigma_max via power iteration (the normalization inside
+    paddle.nn.utils.spectral_norm; ops.yaml spectral_norm)."""
+    weight = ensure_tensor(weight)
+
+    def _f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        v = jnp.ones((wm.shape[1],), jnp.float32) / np.sqrt(wm.shape[1])
+
+        def body(_, v):
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            v = wm.T @ u
+            return v / jnp.maximum(jnp.linalg.norm(v), eps)
+
+        v = jax.lax.fori_loop(0, max(n_power_iterations, 1), body, v)
+        u = wm @ v
+        sigma = jnp.linalg.norm(u)
+        return w / jnp.maximum(sigma, eps)
+
+    return apply_op("spectral_norm", _f, weight)
+
+
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, deformable_groups: int = 1, groups: int = 1,
+                    im2col_step: int = 1, name=None) -> Tensor:
+    """Deformable convolution v1/v2 (parity: ops.yaml deformable_conv;
+    phi deformable_conv kernels). Implemented as per-kernel-point bilinear
+    sampling at offset-shifted taps followed by a 1x1 contraction — the
+    gather/matmul decomposition XLA maps onto the MXU.
+
+    x: [N, Cin, H, W]; offset: [N, 2*G*kh*kw, Ho, Wo];
+    weight: [Cout, Cin/groups, kh, kw]; mask (v2): [N, G*kh*kw, Ho, Wo].
+    """
+    x, offset, weight = ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)
+    m = ensure_tensor(mask) if mask is not None else None
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    assert groups == 1 and deformable_groups == 1, (
+        "deformable_conv: groups/deformable_groups > 1 not implemented")
+
+    def _f(xa, off, w, *rest):
+        mk = rest[0] if rest else None
+        N, Cin, H, W = xa.shape
+        Cout, _, kh, kw = w.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        xp = jnp.pad(xa, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        off = off.reshape(N, kh * kw, 2, Ho, Wo)
+
+        ys = jnp.arange(Ho) * sh
+        xs = jnp.arange(Wo) * sw
+        base_y, base_x = jnp.meshgrid(ys, xs, indexing="ij")  # [Ho, Wo]
+
+        cols = []
+        for k in range(kh * kw):
+            ky, kx = k // kw, k % kw
+            py = base_y[None] + ky * dh + off[:, k, 0]
+            px = base_x[None] + kx * dw + off[:, k, 1]
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+
+            def samp(yy, xx):
+                yy = jnp.clip(yy, 0, xp.shape[2] - 1).astype(jnp.int32)
+                xx = jnp.clip(xx, 0, xp.shape[3] - 1).astype(jnp.int32)
+                # gather per batch: [N, Cin, Ho, Wo]
+                return jax.vmap(lambda img, iy, ix: img[:, iy, ix])(xp, yy, xx)
+
+            inside = ((py >= 0) & (py <= xp.shape[2] - 1)
+                      & (px >= 0) & (px <= xp.shape[3] - 1)).astype(xa.dtype)
+            val = ((1 - wy) * (1 - wx))[:, None] * samp(y0, x0) \
+                + ((1 - wy) * wx)[:, None] * samp(y0, x0 + 1) \
+                + (wy * (1 - wx))[:, None] * samp(y0 + 1, x0) \
+                + (wy * wx)[:, None] * samp(y0 + 1, x0 + 1)
+            val = val * inside[:, None]
+            if mk is not None:
+                val = val * mk[:, k][:, None]
+            cols.append(val)
+        col = jnp.stack(cols, axis=2)  # [N, Cin, kh*kw, Ho, Wo]
+        return jnp.einsum("nckhw,ock->nohw", col, w.reshape(Cout, Cin, kh * kw))
+
+    args = (x, offset, weight) + ((m,) if m is not None else ())
+    return apply_op("deformable_conv", _f, *args)

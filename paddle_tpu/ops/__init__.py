@@ -22,8 +22,9 @@ from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .math_extra import *  # noqa: F401,F403
+from .long_tail import *  # noqa: F401,F403
 
-from . import creation, random, math, manipulation, logic, math_extra, search
+from . import creation, random, math, manipulation, logic, math_extra, search, long_tail
 
 
 def _norm_index(idx):

@@ -1,0 +1,505 @@
+"""Long-tail op surface: special functions, norms, diag/fill families,
+sequence decoding, segment/graph reductions, signal framing.
+
+Parity targets (phi/ops/yaml/ops.yaml entries absent from the other op
+modules): logcumsumexp, logspace, dist, diag_embed, fill_diagonal,
+fill_diagonal_tensor, complex, polygamma, gammaln, gammaincc, i0e, i1e,
+p_norm, clip_by_norm, squared_l2_norm, l1_norm, reverse, as_strided,
+reduce_as, shard_index, edit_distance, viterbi_decode, gather_tree,
+top_p_sampling, segment_pool (segment_sum/mean/max/min), send_u_recv,
+frame, overlap_add. Each lowers to a handful of XLA HLO ops through the
+standard dispatch (grads via jax.vjp).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp_special
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op, ensure_tensor
+
+__all__ = [
+    "logcumsumexp", "logspace", "dist", "diag_embed", "fill_diagonal_",
+    "fill_diagonal_tensor", "complex", "polygamma", "gammaln", "gammaincc",
+    "i0e", "i1e", "p_norm", "clip_by_norm", "squared_l2_norm", "l1_norm",
+    "reverse", "as_strided", "reduce_as", "shard_index", "edit_distance",
+    "viterbi_decode", "gather_tree", "top_p_sampling", "segment_sum",
+    "segment_mean", "segment_max", "segment_min", "send_u_recv",
+    "frame", "overlap_add",
+]
+
+
+# -------------------------------------------------------------- math/special
+
+
+def logcumsumexp(x, axis: Optional[int] = None, dtype=None, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+
+    return apply_op("logcumsumexp", _f, x)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    s = float(start if not isinstance(start, Tensor) else start.item())
+    e = float(stop if not isinstance(stop, Tensor) else stop.item())
+    b = float(base if not isinstance(base, Tensor) else base.item())
+    from ..core import dtype as dtypes
+
+    d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
+    return Tensor(jnp.logspace(s, e, int(num), base=b, dtype=d))
+
+
+def dist(x, y, p: float = 2.0, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _f(a, b):
+        d = (a - b).reshape(-1)
+        if p == float("inf"):
+            return jnp.abs(d).max()
+        if p == float("-inf"):
+            return jnp.abs(d).min()
+        if p == 0:
+            return (d != 0).sum().astype(a.dtype)
+        return (jnp.abs(d) ** p).sum() ** (1.0 / p)
+
+    return apply_op("dist", _f, x, y)
+
+
+def diag_embed(x, offset: int = 0, dim1: int = -2, dim2: int = -1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        r = i - min(offset, 0)
+        c = i + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        src = [nd - 2, nd - 1]
+        out = jnp.moveaxis(out, src, sorted((d1, d2)))
+        if d1 > d2:  # caller asked for transposed diagonal axes
+            out = jnp.swapaxes(out, d1, d2)
+        return out
+
+    return apply_op("diag_embed", _f, x)
+
+
+def fill_diagonal_(x: Tensor, value, offset: int = 0, wrap: bool = False, name=None) -> Tensor:
+    """In-place diagonal fill (parity: Tensor.fill_diagonal_). Routed
+    through dispatch + _replace_ so the tape sees the overwrite (like the
+    other in-place ops), not a silent storage mutation."""
+    assert x._data.ndim == 2, "fill_diagonal_ expects a 2-D tensor"
+
+    def _f(a):
+        n = min(a.shape[0] - max(-offset, 0), a.shape[1] - max(offset, 0))
+        i = jnp.arange(max(n, 0))
+        new = a.at[i + max(-offset, 0), i + max(offset, 0)].set(value)
+        if wrap and a.shape[0] > a.shape[1] and offset == 0:
+            m = a.shape[1]
+            for start in range(m + 1, a.shape[0], m + 1):
+                nn = min(a.shape[0] - start, m)
+                ii = jnp.arange(nn)
+                new = new.at[start + ii, ii].set(value)
+        return new
+
+    out = apply_op("fill_diagonal_", _f, x)
+    x._replace_(out)
+    return x
+
+
+def fill_diagonal_tensor(x, y, offset: int = 0, dim1: int = 0, dim2: int = 1, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _f(a, v):
+        d1, d2 = dim1 % a.ndim, dim2 % a.ndim
+        perm = [d for d in range(a.ndim) if d not in (d1, d2)] + [d1, d2]
+        moved = jnp.transpose(a, perm)
+        n = min(moved.shape[-2] - max(-offset, 0), moved.shape[-1] - max(offset, 0))
+        i = jnp.arange(n)
+        moved = moved.at[..., i + max(-offset, 0), i + max(offset, 0)].set(v)
+        inv = np.argsort(perm)
+        return jnp.transpose(moved, inv)
+
+    return apply_op("fill_diagonal_tensor", _f, x, y)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply_op("complex", jax.lax.complex, real, imag)
+
+
+def polygamma(x, n: int = 0, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("polygamma", lambda a: jsp_special.polygamma(n, a), x)
+
+
+def gammaln(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("gammaln", jsp_special.gammaln, x)
+
+
+def gammaincc(x, y, name=None) -> Tensor:
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply_op("gammaincc", jsp_special.gammaincc, x, y)
+
+
+def i0e(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("i0e", jsp_special.i0e, x)
+
+
+def i1e(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("i1e", jsp_special.i1e, x)
+
+
+# -------------------------------------------------------------- norms
+
+
+def p_norm(x, p: float = 2.0, axis: Optional[int] = None, epsilon: float = 1e-12,
+           keepdim: bool = False, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        if p == float("inf"):
+            return jnp.abs(a).max(axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.abs(a).min(axis=axis, keepdims=keepdim)
+        s = (jnp.abs(a) ** p).sum(axis=axis, keepdims=keepdim)
+        return jnp.maximum(s, epsilon) ** (1.0 / p)
+
+    return apply_op("p_norm", _f, x)
+
+
+def clip_by_norm(x, max_norm: float, name=None) -> Tensor:
+    x = ensure_tensor(x)
+
+    def _f(a):
+        n = jnp.sqrt((a.astype(jnp.float32) ** 2).sum())
+        scale = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (a * scale.astype(a.dtype))
+
+    return apply_op("clip_by_norm", _f, x)
+
+
+def squared_l2_norm(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("squared_l2_norm", lambda a: (a.astype(jnp.float32) ** 2).sum(), x)
+
+
+def l1_norm(x, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    return apply_op("l1_norm", lambda a: jnp.abs(a).sum(), x)
+
+
+# -------------------------------------------------------------- layout
+
+
+def reverse(x, axis, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("reverse", lambda a: jnp.flip(a, axes), x)
+
+
+def as_strided(x, shape: Sequence[int], stride: Sequence[int], offset: int = 0, name=None) -> Tensor:
+    """Strided view materialization (parity: ops.yaml as_strided /
+    tensor_unfold family; XLA has no aliasing views, so this gathers)."""
+    x = ensure_tensor(x)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def _f(a):
+        flat = a.reshape(-1)
+        idx = jnp.full((), int(offset), jnp.int32)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij") if shape else []
+        lin = sum((g * st for g, st in zip(grids, stride)), start=idx)
+        return flat[lin.reshape(-1)].reshape(shape)
+
+    return apply_op("as_strided", _f, x)
+
+
+def reduce_as(x, target, name=None) -> Tensor:
+    """Sum-reduce ``x`` to ``target``'s shape (parity: ops.yaml reduce_as)."""
+    x, target = ensure_tensor(x), ensure_tensor(target)
+    tshape = tuple(target.shape)
+
+    def _f(a):
+        extra = a.ndim - len(tshape)
+        if extra:
+            a = a.sum(axis=tuple(range(extra)))
+        axes = tuple(i for i, (s, t) in enumerate(zip(a.shape, tshape)) if s != t and t == 1)
+        if axes:
+            a = a.sum(axis=axes, keepdims=True)
+        return a
+
+    return apply_op("reduce_as", _f, x)
+
+
+def shard_index(x, index_num: int, nshards: int, shard_id: int,
+                ignore_value: int = -1, name=None) -> Tensor:
+    x = ensure_tensor(x)
+    per = (index_num + nshards - 1) // nshards
+
+    def _f(a):
+        shard = a // per
+        local = a % per
+        return jnp.where(shard == shard_id, local, ignore_value).astype(a.dtype)
+
+    return apply_op("shard_index", _f, x)
+
+
+# -------------------------------------------------------------- decoding
+
+
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized: bool = True, name=None):
+    """Levenshtein distance per batch row (parity: ops.yaml edit_distance).
+    Host computation (int DP, non-differentiable) like the reference's CPU
+    kernel."""
+    h = np.asarray(hyps.numpy() if isinstance(hyps, Tensor) else hyps)
+    r = np.asarray(refs.numpy() if isinstance(refs, Tensor) else refs)
+    hl = (np.asarray(hyp_lengths.numpy() if isinstance(hyp_lengths, Tensor) else hyp_lengths)
+          if hyp_lengths is not None else np.full(h.shape[0], h.shape[1]))
+    rl = (np.asarray(ref_lengths.numpy() if isinstance(ref_lengths, Tensor) else ref_lengths)
+          if ref_lengths is not None else np.full(r.shape[0], r.shape[1]))
+    out = np.zeros((h.shape[0], 1), np.float32)
+    for b in range(h.shape[0]):
+        a, bb = list(h[b][: int(hl[b])]), list(r[b][: int(rl[b])])
+        m, n = len(a), len(bb)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != bb[j - 1]))
+        d = float(dp[n])
+        out[b, 0] = d / max(n, 1) if normalized else d
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(rl.reshape(-1).astype(np.int64)))
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Viterbi best-path decoding (parity: ops.yaml viterbi_decode;
+    python/paddle/text ViterbiDecoder). potentials: [B, T, C].
+
+    include_bos_eos_tag: the last two tags of ``transition_params`` are
+    BOS/EOS — BOS's row scores the first step, EOS's column the last.
+    lengths: per-row valid step counts; steps beyond a row's length are
+    frozen (they change neither score nor path)."""
+    potentials = ensure_tensor(potentials)
+    transition_params = ensure_tensor(transition_params)
+    lens = None
+    if lengths is not None:
+        lens = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+
+    def _f(emis, trans):
+        B, T, C = emis.shape
+        L = (lens if lens is not None else jnp.full((B,), T)).astype(jnp.int32)
+        if include_bos_eos_tag:
+            bos, eos = C - 2, C - 1
+            init = emis[:, 0] + trans[bos][None, :]
+        else:
+            init = emis[:, 0]
+
+        def step(carry, te):
+            t, e_t = te
+            score = carry  # [B, C]
+            cand = score[:, :, None] + trans[None, :, :]  # [B, C_from, C_to]
+            best = cand.max(axis=1) + e_t
+            back = cand.argmax(axis=1)
+            live = (t < L)[:, None]
+            ident = jnp.broadcast_to(jnp.arange(C)[None, :], back.shape)
+            return (jnp.where(live, best, score),
+                    jnp.where(live, back, ident))
+
+        ts = jnp.arange(1, T)
+        score, backs = jax.lax.scan(step, init, (ts, jnp.moveaxis(emis[:, 1:], 1, 0)))
+        if include_bos_eos_tag:
+            score = score + trans[:, eos][None, :]
+        last = score.argmax(axis=-1)  # [B]
+
+        def backtrack(carry, back_t):
+            cur = carry
+            prev = jnp.take_along_axis(back_t, cur[:, None], axis=1)[:, 0]
+            return prev, cur
+
+        state0, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+        # reverse scan emits state(t+1) at slot t; prepend the initial state
+        path = jnp.concatenate([state0[:, None],
+                                jnp.moveaxis(path_rev, 0, 1)], axis=1)
+        return score.max(axis=-1), path.astype(jnp.int64)
+
+    scores, path = apply_op("viterbi_decode", _f, potentials, transition_params, nouts=2)
+    return scores, path
+
+
+def gather_tree(ids, parents, name=None) -> Tensor:
+    """Beam-search ancestry gather (parity: ops.yaml gather_tree).
+    ids/parents: [T, B, beam]."""
+    ids, parents = ensure_tensor(ids), ensure_tensor(parents)
+
+    def _f(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, beam] current beam indices
+            out_t = jnp.take_along_axis(idv[t], beams, axis=1)
+            nxt = jnp.take_along_axis(par[t], beams, axis=1)
+            return nxt, out_t
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2])[None, :],
+                                idv.shape[1:]).astype(idv.dtype)
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(outs, axis=0)
+
+    return apply_op("gather_tree", _f, ids, parents)
+
+
+def top_p_sampling(x, ps, threshold=None, seed: int = -1, name=None):
+    """Nucleus sampling (parity: ops.yaml top_p_sampling). x: [B, V] logits
+    or probs; ps: [B] cumulative-probability cutoffs. Returns (values, ids).
+    seed=-1 (default) draws a fresh key per call like the reference."""
+    x, ps = ensure_tensor(x), ensure_tensor(ps)
+    if seed is None or seed < 0:
+        from .random import split_key
+
+        key = split_key()
+    else:
+        key = jax.random.key(int(seed))
+
+    def _f(logits, p):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p <= p[:, None]
+        keep = keep.at[:, 0].set(True)
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / filt.sum(axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(jnp.maximum(filt, 1e-30)), axis=-1)
+        ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids.astype(jnp.int64)
+
+    return apply_op("top_p_sampling", _f, x, ps, nouts=2)
+
+
+# -------------------------------------------------------------- segment/graph
+
+
+def _segment(name, reducer, x, segment_ids):
+    x = ensure_tensor(x)
+    seg = segment_ids._data if isinstance(segment_ids, Tensor) else jnp.asarray(segment_ids)
+    nseg = int(jax.device_get(seg.max())) + 1 if seg.size else 0
+
+    def _f(a):
+        return reducer(a, seg.astype(jnp.int32), num_segments=nseg)
+
+    return apply_op(name, _f, x)
+
+
+def segment_sum(x, segment_ids, name=None) -> Tensor:
+    return _segment("segment_sum", jax.ops.segment_sum, x, segment_ids)
+
+
+def segment_mean(x, segment_ids, name=None) -> Tensor:
+    s = _segment("segment_mean_sum", jax.ops.segment_sum, x, segment_ids)
+    seg = segment_ids._data if isinstance(segment_ids, Tensor) else jnp.asarray(segment_ids)
+    counts = jnp.bincount(seg.astype(jnp.int32), length=s.shape[0])
+    counts = jnp.maximum(counts, 1).astype(s._data.dtype)
+    return apply_op("segment_mean", lambda a: a / counts.reshape((-1,) + (1,) * (a.ndim - 1)), s)
+
+
+def segment_max(x, segment_ids, name=None) -> Tensor:
+    return _segment("segment_max", jax.ops.segment_max, x, segment_ids)
+
+
+def segment_min(x, segment_ids, name=None) -> Tensor:
+    return _segment("segment_min", jax.ops.segment_min, x, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "SUM",
+                out_size=None, name=None) -> Tensor:
+    """Graph message passing: gather x at src, reduce at dst (parity:
+    ops.yaml send_u_recv; geometric message passing kernels)."""
+    x = ensure_tensor(x)
+    src = src_index._data if isinstance(src_index, Tensor) else jnp.asarray(src_index)
+    dst = dst_index._data if isinstance(dst_index, Tensor) else jnp.asarray(dst_index)
+    n_out = int(out_size) if out_size else int(x.shape[0])
+    red = {"SUM": jax.ops.segment_sum, "MEAN": jax.ops.segment_sum,
+           "MAX": jax.ops.segment_max, "MIN": jax.ops.segment_min}[reduce_op.upper()]
+
+    def _f(a):
+        msgs = a[src.astype(jnp.int32)]
+        out = red(msgs, dst.astype(jnp.int32), num_segments=n_out)
+        if reduce_op.upper() == "MEAN":
+            counts = jnp.bincount(dst.astype(jnp.int32), length=n_out)
+            out = out / jnp.maximum(counts, 1).astype(out.dtype).reshape(
+                (-1,) + (1,) * (out.ndim - 1))
+        return out
+
+    return apply_op("send_u_recv", _f, x)
+
+
+# -------------------------------------------------------------- signal
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None) -> Tensor:
+    """Slice overlapping frames (parity: ops.yaml frame; paddle.signal.frame:
+    axis=-1 -> [..., frame_length, num_frames]; axis=0 ->
+    [frame_length, num_frames, ...])."""
+    x = ensure_tensor(x)
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1 (reference contract)")
+
+    def _f(a):
+        moved = jnp.moveaxis(a, 0, -1) if axis == 0 else a
+        n = moved.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = moved[..., idx]                  # [..., n_frames, frame_length]
+        out = jnp.moveaxis(out, (-2, -1), (-1, -2))  # [..., frame_length, n_frames]
+        if axis == 0:
+            out = jnp.moveaxis(out, (-2, -1), (0, 1))  # [frame_length, n_frames, ...]
+        return out
+
+    return apply_op("frame", _f, x)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None) -> Tensor:
+    """Inverse of frame (parity: ops.yaml overlap_add): axis=-1 expects
+    [..., frame_length, num_frames]; axis=0 expects
+    [frame_length, num_frames, ...] and returns the sequence on axis 0."""
+    x = ensure_tensor(x)
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1 (reference contract)")
+
+    def _f(a):
+        moved = jnp.moveaxis(a, (0, 1), (-2, -1)) if axis == 0 else a
+        frame_length, n_frames = moved.shape[-2], moved.shape[-1]
+        n = frame_length + hop_length * (n_frames - 1)
+        out = jnp.zeros(moved.shape[:-2] + (n,), moved.dtype)
+        for f in range(n_frames):
+            out = out.at[..., f * hop_length: f * hop_length + frame_length].add(moved[..., f])
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply_op("overlap_add", _f, x)

@@ -77,3 +77,44 @@ def check_grad(op_fn: Callable, inputs: Sequence[np.ndarray], grad_inputs=None,
             num_flat[j] = (plus - minus) / (2 * eps)
         np.testing.assert_allclose(analytic[gi_pos], numeric, atol=atol, rtol=rtol,
                                    err_msg=f"grad mismatch for input {i}")
+
+
+# ---------------------------------------------------------------------------
+# Dtype sweep (parity: test/legacy_test/op_test.py dtype coverage +
+# test/white_list/op_accuracy_white_list tolerances)
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+DTYPE_TOL = {
+    "float32": (1e-5, 1e-5),
+    "float16": (1e-2, 1e-2),
+    "bfloat16": (4e-2, 4e-2),
+    "int32": (0, 0),
+    "int64": (0, 0),
+}
+
+
+def check_output_dtypes(op_fn, np_fn, inputs, dtypes=("float32", "bfloat16", "float16"),
+                        tol_override=None, kwargs=None, cast_inputs=None):
+    """Run the op across a dtype sweep with per-dtype tolerances. The
+    float32 result is the oracle for low-precision runs (reference
+    pattern: OpTest bf16/fp16 checks compare against fp32 + white-list
+    tolerances). cast_inputs: indices to cast (default: all float inputs)."""
+    kwargs = kwargs or {}
+    ref = np_fn(*inputs)
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for dt in dtypes:
+        atol, rtol = tol_override.get(dt, DTYPE_TOL[dt]) if tol_override else DTYPE_TOL[dt]
+        cast = []
+        for i, a in enumerate(inputs):
+            do = (cast_inputs is None and np.issubdtype(a.dtype, np.floating)) or \
+                 (cast_inputs is not None and i in cast_inputs)
+            cast.append(paddle.to_tensor(jnp.asarray(a, jnp.dtype(dt))) if do
+                        else paddle.to_tensor(a))
+        out = op_fn(*cast, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for o, e in zip(outs, refs):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64), np.asarray(e, np.float64),
+                atol=atol, rtol=rtol, err_msg=f"dtype {dt}")

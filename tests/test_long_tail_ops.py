@@ -1,0 +1,423 @@
+"""OpTests for the long-tail op batch (ops.yaml entries added in round 2).
+
+Oracle pattern: numpy/scipy references computed inline (reference:
+test/legacy_test per-op OpTest files); grads vs finite differences via
+the shared harness; dtype sweeps with per-dtype tolerances.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from optest import check_grad, check_output, check_output_dtypes
+
+RNG = np.random.RandomState(0)
+
+
+# ------------------------------------------------------------------ math
+
+
+def test_logcumsumexp():
+    x = RNG.randn(4, 6).astype(np.float32)
+    ref = np.log(np.cumsum(np.exp(x), axis=1))
+    check_output(lambda t: paddle.logcumsumexp(t, axis=1), lambda a: ref, [x],
+                 atol=1e-4, rtol=1e-4)
+    # fp32 finite differences of exp/log chains are good to ~5e-3
+    check_grad(lambda t: paddle.logcumsumexp(t, axis=1), [x], atol=5e-3, rtol=1e-2)
+
+
+def test_logspace():
+    out = paddle.logspace(0, 3, 4)
+    np.testing.assert_allclose(out.numpy(), [1, 10, 100, 1000], rtol=1e-5)
+
+
+@pytest.mark.parametrize("p", [0, 1, 2, float("inf")])
+def test_dist(p):
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(3, 4).astype(np.float32)
+    d = x - y
+    if p == 0:
+        ref = float((d != 0).sum())
+    elif p == float("inf"):
+        ref = float(np.abs(d).max())
+    else:
+        ref = float((np.abs(d) ** p).sum() ** (1 / p))
+    np.testing.assert_allclose(
+        float(paddle.dist(paddle.to_tensor(x), paddle.to_tensor(y), p=p)),
+        ref, rtol=1e-5)
+
+
+def test_diag_embed():
+    x = RNG.randn(2, 3).astype(np.float32)
+    out = paddle.diag_embed(paddle.to_tensor(x))
+    ref = np.zeros((2, 3, 3), np.float32)
+    for b in range(2):
+        np.fill_diagonal(ref[b], x[b])
+    np.testing.assert_allclose(out.numpy(), ref)
+    out2 = paddle.diag_embed(paddle.to_tensor(x), offset=1)
+    assert list(out2.shape) == [2, 4, 4]
+    np.testing.assert_allclose(np.asarray(out2.numpy())[0, 0, 1], x[0, 0], rtol=1e-6)
+
+
+def test_fill_diagonal_inplace_and_tensor():
+    x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    paddle.fill_diagonal_(x, 5.0)
+    np.testing.assert_allclose(np.diag(x.numpy()), 5.0)
+
+    y = RNG.randn(3).astype(np.float32)
+    out = paddle.fill_diagonal_tensor(paddle.to_tensor(np.zeros((3, 3), np.float32)),
+                                      paddle.to_tensor(y))
+    np.testing.assert_allclose(np.diag(out.numpy()), y)
+
+
+def test_complex():
+    r = RNG.randn(3).astype(np.float32)
+    i = RNG.randn(3).astype(np.float32)
+    out = paddle.complex(paddle.to_tensor(r), paddle.to_tensor(i))
+    np.testing.assert_allclose(out.numpy(), r + 1j * i)
+
+
+def test_special_functions():
+    from scipy import special as ss
+
+    x = np.abs(RNG.randn(8).astype(np.float32)) + 0.5
+    np.testing.assert_allclose(paddle.gammaln(paddle.to_tensor(x)).numpy(),
+                               ss.gammaln(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.i0e(paddle.to_tensor(x)).numpy(),
+                               ss.i0e(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.i1e(paddle.to_tensor(x)).numpy(),
+                               ss.i1e(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.polygamma(paddle.to_tensor(x), 1).numpy(),
+                               ss.polygamma(1, x), rtol=1e-3)
+    y = np.abs(RNG.randn(8).astype(np.float32)) + 0.5
+    np.testing.assert_allclose(paddle.gammaincc(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+                               ss.gammaincc(x, y), rtol=1e-4, atol=1e-5)
+
+
+def test_p_norm_and_clip_by_norm():
+    x = RNG.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(float(paddle.p_norm(paddle.to_tensor(x), p=3)),
+                               (np.abs(x) ** 3).sum() ** (1 / 3), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.p_norm(paddle.to_tensor(x), p=2, axis=1).numpy()),
+        np.linalg.norm(x, axis=1), rtol=1e-5)
+
+    big = (x * 100).astype(np.float32)
+    clipped = paddle.clip_by_norm(paddle.to_tensor(big), 1.0)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(clipped.numpy())), 1.0,
+                               rtol=1e-4)
+    small = (x * 1e-3).astype(np.float32)
+    same = paddle.clip_by_norm(paddle.to_tensor(small), 1.0)
+    np.testing.assert_allclose(same.numpy(), small, rtol=1e-6)
+
+
+def test_norm_scalars():
+    x = RNG.randn(5).astype(np.float32)
+    np.testing.assert_allclose(float(paddle.squared_l2_norm(paddle.to_tensor(x))),
+                               (x ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(float(paddle.l1_norm(paddle.to_tensor(x))),
+                               np.abs(x).sum(), rtol=1e-5)
+
+
+def test_reverse_as_strided_reduce_as_shard_index():
+    x = RNG.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(paddle.reverse(paddle.to_tensor(x), 1).numpy(),
+                               x[:, ::-1])
+    flat = np.arange(12, dtype=np.float32)
+    out = paddle.as_strided(paddle.to_tensor(flat), [3, 2], [4, 1])
+    np.testing.assert_allclose(out.numpy(), flat.reshape(3, 4)[:, :2])
+
+    big = RNG.randn(2, 3, 4).astype(np.float32)
+    tgt = np.zeros((3, 1), np.float32)
+    red = paddle.reduce_as(paddle.to_tensor(big), paddle.to_tensor(tgt))
+    np.testing.assert_allclose(red.numpy(), big.sum(axis=0).sum(axis=1, keepdims=True),
+                               rtol=1e-5)
+
+    idx = np.array([0, 5, 9, 14], np.int64)
+    out = paddle.shard_index(paddle.to_tensor(idx), 20, 2, 0)
+    np.testing.assert_allclose(out.numpy(), [0, 5, 9, -1])
+
+
+# ------------------------------------------------------------------ decoding
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0]], np.int64)
+    ref = np.array([[1, 3, 3, 4]], np.int64)
+    d, _ = paddle.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                                paddle.to_tensor(np.array([3])),
+                                paddle.to_tensor(np.array([4])), normalized=False)
+    assert float(d.numpy()[0, 0]) == 2.0  # substitute 2->3, append 4
+
+
+def test_viterbi_decode():
+    B, T, C = 2, 5, 3
+    emis = RNG.randn(B, T, C).astype(np.float32)
+    trans = RNG.randn(C, C).astype(np.float32)
+    scores, path = paddle.viterbi_decode(paddle.to_tensor(emis), paddle.to_tensor(trans),
+                                         include_bos_eos_tag=False)
+    import itertools
+
+    for b in range(B):
+        best, best_p = -1e30, None
+        for p in itertools.product(range(C), repeat=T):
+            s = emis[b, 0, p[0]] + sum(trans[p[t - 1], p[t]] + emis[b, t, p[t]]
+                                       for t in range(1, T))
+            if s > best:
+                best, best_p = s, p
+        np.testing.assert_allclose(float(scores.numpy()[b]), best, rtol=1e-5)
+        assert tuple(path.numpy()[b]) == best_p
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 5]], [[6, 1]], [[3, 9]]], np.int64)      # [T=3, B=1, beam=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    out = paddle.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+    # beam 0 at t=2: id 3, parent 0 -> t=1 beam0 id 6, its parent 1 -> t=0 beam1 id 5
+    assert list(out.numpy()[:, 0, 0]) == [5, 6, 3]
+
+
+def test_top_p_sampling():
+    logits = np.array([[0.0, 10.0, -5.0, 1.0]], np.float32)
+    ps = np.array([0.5], np.float32)
+    vals, ids = paddle.top_p_sampling(paddle.to_tensor(logits), paddle.to_tensor(ps))
+    assert int(ids.numpy()[0, 0]) == 1  # nucleus of p=0.5 is the argmax alone
+
+
+# ------------------------------------------------------------------ segments
+
+
+def test_segment_ops():
+    x = np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], np.float32)
+    seg = np.array([0, 0, 1, 1], np.int32)
+    np.testing.assert_allclose(
+        paddle.segment_sum(paddle.to_tensor(x), paddle.to_tensor(seg)).numpy(),
+        [[4, 6], [12, 14]])
+    np.testing.assert_allclose(
+        paddle.segment_mean(paddle.to_tensor(x), paddle.to_tensor(seg)).numpy(),
+        [[2, 3], [6, 7]])
+    np.testing.assert_allclose(
+        paddle.segment_max(paddle.to_tensor(x), paddle.to_tensor(seg)).numpy(),
+        [[3, 4], [7, 8]])
+    np.testing.assert_allclose(
+        paddle.segment_min(paddle.to_tensor(x), paddle.to_tensor(seg)).numpy(),
+        [[1, 2], [5, 6]])
+
+
+def test_send_u_recv():
+    x = np.array([[1.0], [2], [3]], np.float32)
+    src = np.array([0, 1, 2, 2], np.int32)
+    dst = np.array([1, 2, 0, 1], np.int32)
+    out = paddle.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                             paddle.to_tensor(dst), "SUM")
+    np.testing.assert_allclose(out.numpy(), [[3], [4], [2]])
+    mean = paddle.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                              paddle.to_tensor(dst), "MEAN")
+    np.testing.assert_allclose(mean.numpy(), [[3], [2], [2]])
+
+
+# ------------------------------------------------------------------ signal
+
+
+def test_frame_overlap_add_roundtrip():
+    x = RNG.randn(2, 16).astype(np.float32)
+    fr = paddle.frame(paddle.to_tensor(x), frame_length=4, hop_length=4)
+    assert list(fr.shape) == [2, 4, 4]
+    back = paddle.overlap_add(fr, hop_length=4)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+    fr2 = paddle.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+    ola = paddle.overlap_add(fr2, hop_length=2)
+    assert list(ola.shape) == [2, 16]
+
+
+# ------------------------------------------------------------------ nn
+
+
+def test_swiglu():
+    x = RNG.randn(3, 8).astype(np.float32)
+    y = RNG.randn(3, 8).astype(np.float32)
+
+    def silu(v):
+        return v / (1 + np.exp(-v))
+
+    np.testing.assert_allclose(
+        F.swiglu(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+        silu(x) * y, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        F.swiglu(paddle.to_tensor(np.concatenate([x, y], -1))).numpy(),
+        silu(x) * y, rtol=1e-5, atol=1e-6)
+    check_grad(lambda a, b: F.swiglu(a, b), [x, y])
+
+
+def test_rrelu():
+    x = RNG.randn(100).astype(np.float32)
+    ev = F.rrelu(paddle.to_tensor(x), 0.1, 0.3, training=False)
+    np.testing.assert_allclose(ev.numpy(), np.where(x >= 0, x, 0.2 * x), rtol=1e-6)
+    tr = np.asarray(F.rrelu(paddle.to_tensor(x), 0.1, 0.3, training=True).numpy())
+    neg = x < 0
+    slopes = tr[neg] / x[neg]
+    assert (slopes >= 0.0999).all() and (slopes <= 0.3001).all()
+    np.testing.assert_allclose(tr[~neg], x[~neg])
+
+
+def test_log_loss():
+    p = RNG.rand(4, 1).astype(np.float32) * 0.8 + 0.1
+    y = (RNG.rand(4, 1) > 0.5).astype(np.float32)
+    eps = 1e-4
+    ref = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    np.testing.assert_allclose(
+        F.log_loss(paddle.to_tensor(p), paddle.to_tensor(y)).numpy(), ref, rtol=1e-5)
+
+
+def test_hsigmoid_loss():
+    N, D, C = 4, 8, 6
+    x = RNG.randn(N, D).astype(np.float32)
+    label = RNG.randint(0, C, (N,)).astype(np.int64)
+    w = RNG.randn(C, D).astype(np.float32) * 0.1
+    out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(label), C,
+                          paddle.to_tensor(w))
+    assert list(out.shape) == [N, 1]
+    assert (np.asarray(out.numpy()) > 0).all()
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    ref = np.zeros((N, 1), np.float32)
+    for r in range(N):
+        heap = int(label[r]) + C
+        path = []
+        while heap > 1:
+            path.append((heap // 2, heap & 1))
+            heap //= 2
+        for node, code in path:
+            logit = x[r] @ w[node - 1]
+            prob = sigmoid(logit) if code else 1 - sigmoid(logit)
+            ref[r, 0] -= np.log(max(prob, 1e-12))
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_margin_cross_entropy():
+    N, C = 4, 5
+    feat = RNG.randn(N, C).astype(np.float32)
+    cos = (feat / np.linalg.norm(feat, axis=1, keepdims=True)).astype(np.float32)
+    label = RNG.randint(0, C, (N,)).astype(np.int64)
+    loss, sm = F.margin_cross_entropy(paddle.to_tensor(cos), paddle.to_tensor(label),
+                                      return_softmax=True, reduction=None)
+    plain = -np.log(np.exp(64 * cos)[np.arange(N), label]
+                    / np.exp(64 * cos).sum(1))
+    assert (np.asarray(loss.numpy()).reshape(-1) >= plain - 1e-3).all()
+    np.testing.assert_allclose(np.asarray(sm.numpy()).sum(1), 1.0, rtol=1e-5)
+
+
+def test_bilinear():
+    x1 = RNG.randn(3, 4).astype(np.float32)
+    x2 = RNG.randn(3, 5).astype(np.float32)
+    w = RNG.randn(2, 4, 5).astype(np.float32)
+    b = RNG.randn(2).astype(np.float32)
+    out = F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                     paddle.to_tensor(w), paddle.to_tensor(b))
+    ref = np.einsum("bi,oij,bj->bo", x1, w, x2) + b
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_value():
+    w = RNG.randn(6, 4).astype(np.float32)
+    out = F.spectral_norm_value(paddle.to_tensor(w), n_power_iterations=50)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.asarray(out.numpy()), w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    N, Cin, H, W, Cout, k = 1, 2, 6, 6, 3, 3
+    x = RNG.randn(N, Cin, H, W).astype(np.float32)
+    w = RNG.randn(Cout, Cin, k, k).astype(np.float32)
+    off = np.zeros((N, 2 * k * k, H - 2, W - 2), np.float32)
+    out = F.deformable_conv(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w))
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4, atol=1e-4)
+
+    off1 = np.zeros_like(off)
+    off1[:, 0::2] = 1.0  # dy = 1 for every kernel point
+    out_s = F.deformable_conv(paddle.to_tensor(x), paddle.to_tensor(off1),
+                              paddle.to_tensor(w))
+    ref_s = F.conv2d(paddle.to_tensor(np.roll(x, -1, axis=2)), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(np.asarray(out_s.numpy())[:, :, :-1],
+                               ref_s[:, :, :-1], rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------ dtype sweep
+
+
+def test_dtype_sweep_core_ops():
+    a = RNG.randn(4, 5).astype(np.float32)
+    b = RNG.randn(5, 3).astype(np.float32)
+    check_output_dtypes(lambda x, y: x.matmul(y), lambda x, y: x @ y, [a, b])
+    check_output_dtypes(lambda x: F.softmax(x, axis=-1),
+                        lambda x: np.exp(x) / np.exp(x).sum(-1, keepdims=True), [a])
+    check_output_dtypes(lambda x: paddle.logcumsumexp(x, axis=1),
+                        lambda x: np.log(np.cumsum(np.exp(x), 1)), [a])
+    c = RNG.randn(3, 8).astype(np.float32)
+    check_output_dtypes(lambda x: F.swiglu(x),
+                        lambda x: (x[:, :4] / (1 + np.exp(-x[:, :4]))) * x[:, 4:], [c])
+    ints = RNG.randint(0, 10, (6,)).astype(np.int32)
+    check_output_dtypes(lambda x: paddle.shard_index(x, 20, 2, 0),
+                        lambda x: np.where(x // 10 == 0, x % 10, -1),
+                        [ints], dtypes=("int32", "int64"), cast_inputs=[0])
+
+
+def test_viterbi_lengths_and_bos_eos():
+    """lengths freeze padded steps; BOS/EOS rows shift the decode
+    (review regressions)."""
+    B, T, C = 2, 4, 4  # last two tags = BOS, EOS
+    emis = RNG.randn(B, T, C).astype(np.float32)
+    trans = RNG.randn(C, C).astype(np.float32)
+    lens = np.array([2, 4], np.int64)
+    s_pad, p_pad = paddle.viterbi_decode(paddle.to_tensor(emis), paddle.to_tensor(trans),
+                                         paddle.to_tensor(lens), include_bos_eos_tag=False)
+    # row 0 must match decoding just its first 2 steps
+    s_short, p_short = paddle.viterbi_decode(paddle.to_tensor(emis[:1, :2]),
+                                             paddle.to_tensor(trans),
+                                             include_bos_eos_tag=False)
+    np.testing.assert_allclose(float(s_pad.numpy()[0]), float(s_short.numpy()[0]), rtol=1e-5)
+    assert list(p_pad.numpy()[0][:2]) == list(p_short.numpy()[0])
+
+    # BOS/EOS adjust first/last step scores
+    s_tag, _ = paddle.viterbi_decode(paddle.to_tensor(emis), paddle.to_tensor(trans),
+                                     include_bos_eos_tag=True)
+    s_plain, _ = paddle.viterbi_decode(paddle.to_tensor(emis), paddle.to_tensor(trans),
+                                       include_bos_eos_tag=False)
+    assert not np.allclose(np.asarray(s_tag.numpy()), np.asarray(s_plain.numpy()))
+
+
+def test_frame_overlap_add_axis0():
+    x = RNG.randn(16).astype(np.float32)
+    fr = paddle.frame(paddle.to_tensor(x), frame_length=4, hop_length=2, axis=0)
+    assert list(fr.shape) == [4, 7]  # [frame_length, num_frames]
+    np.testing.assert_allclose(np.asarray(fr.numpy())[:, 0], x[:4])
+    np.testing.assert_allclose(np.asarray(fr.numpy())[:, 1], x[2:6])
+    back = paddle.overlap_add(paddle.frame(paddle.to_tensor(x), 4, 4, axis=0),
+                              hop_length=4, axis=0)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-6)
+
+
+def test_fill_diagonal_tape_consistency():
+    w = paddle.to_tensor(np.ones((3, 3), np.float32), stop_gradient=False)
+    y = w * 2.0
+    paddle.fill_diagonal_(y, 0.0)
+    y.sum().backward()
+    g = np.asarray(w.grad.numpy())
+    # overwritten diagonal entries contribute no gradient
+    np.testing.assert_allclose(np.diag(g), 0.0)
+    np.testing.assert_allclose(g[0, 1], 2.0)
+
+
+def test_top_p_sampling_fresh_randomness():
+    logits = np.zeros((1, 50), np.float32)  # uniform nucleus
+    ps = np.array([0.99], np.float32)
+    ids = {int(paddle.top_p_sampling(paddle.to_tensor(logits),
+                                     paddle.to_tensor(ps))[1].numpy()[0, 0])
+           for _ in range(10)}
+    assert len(ids) > 1  # default seed must not be deterministic across calls
