@@ -61,4 +61,12 @@ from . import onnx
 from . import regularizer
 from . import generation
 
+# top-level aliases for reference __all__ parity
+# paddle.dtype is a TYPE in the reference (framework dtype class);
+# Tensor.dtype returns numpy dtype instances, so np.dtype is the match
+from numpy import dtype as dtype
+from .distributed.parallel import DataParallel
+from .nn.param_attr import ParamAttr
+from .jit.api import to_static as _jit_to_static  # noqa: F401 (paddle.jit.to_static path)
+
 __version__ = "0.1.0"

@@ -612,8 +612,8 @@ def hsigmoid_loss(input, label, num_classes: int, weight, bias=None,
 
 def margin_cross_entropy(logits, label, margin1: float = 1.0, margin2: float = 0.5,
                          margin3: float = 0.0, scale: float = 64.0,
-                         return_softmax: bool = False, reduction: str = "mean",
-                         group=None, name=None):
+                         group=None, return_softmax: bool = False,
+                         reduction: str = "mean", name=None):
     """ArcFace-family margin softmax CE (parity: ops.yaml
     margin_cross_entropy): target cos(theta) -> cos(m1*theta + m2) - m3,
     scaled, then softmax cross-entropy."""

@@ -23,8 +23,9 @@ from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .math_extra import *  # noqa: F401,F403
 from .long_tail import *  # noqa: F401,F403
+from .api_parity import *  # noqa: F401,F403
 
-from . import creation, random, math, manipulation, logic, math_extra, search, long_tail
+from . import api_parity, creation, random, math, manipulation, logic, math_extra, search, long_tail
 
 
 def _norm_index(idx):
@@ -247,3 +248,145 @@ def _make_inplace_unary(op):
 
 
 _patch_tensor()
+
+
+# ---------------------------------------------------------------------------
+# Module-level in-place twins (reference python/paddle/__init__.py exports
+# `op_` next to `op`). Each rebinds the tensor to the out-of-place result —
+# XLA has no aliasing mutation, so rebind IS the in-place semantic here.
+# ---------------------------------------------------------------------------
+
+import sys as _sys
+
+_THIS = _sys.modules[__name__]
+
+
+def _make_module_inplace(base_fn):
+    def f(x, *args, **kwargs):
+        out = base_fn(x, *args, **kwargs)
+        x._replace_(out if isinstance(out, Tensor) else Tensor(out))
+        return x
+
+    f.__name__ = base_fn.__name__ + "_"
+    return f
+
+
+_INPLACE_BASES = [
+    "abs", "acos", "atan", "cos", "sin", "sinh", "tan", "tanh", "erf",
+    "expm1", "log", "log2", "log10", "sqrt", "square", "floor", "ceil",
+    "round", "trunc", "frac", "neg", "lgamma", "digamma", "logit", "pow",
+    "divide", "multiply", "floor_divide", "mod", "remainder", "renorm",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "logical_and",
+    "logical_or", "logical_not", "equal", "greater_equal", "greater_than",
+    "less_equal", "less_than", "gcd", "lcm", "hypot", "ldexp", "copysign",
+    "cumsum", "cumprod", "tril", "triu", "polygamma", "gammaln",
+    "gammaincc", "gammainc", "multigammaln", "i0", "masked_fill",
+    "masked_scatter", "t", "addmm", "sinc",
+]
+
+for _nm in _INPLACE_BASES:
+    _base = getattr(_THIS, _nm, None)
+    if _base is None:
+        continue
+    _inm = _nm + "_"
+    if not hasattr(_THIS, _inm):
+        setattr(_THIS, _inm, _make_module_inplace(_base))
+    if not hasattr(Tensor, _inm):
+        setattr(Tensor, _inm, getattr(_THIS, _inm))
+
+
+def _overwrite_random(x, data):
+    """Random fills REPLACE the tensor's history: the result does not
+    depend on prior computation, so the stale grad node must go (same
+    rule as eager collectives' _eager_result)."""
+    x._data = data
+    x._grad_node = None
+    x._out_slot = None
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place bernoulli fill (reference: paddle.bernoulli_)."""
+    from .random import split_key
+
+    key = split_key()
+    return _overwrite_random(
+        x, (jax.random.uniform(key, x._data.shape) < p).astype(x._data.dtype))
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    from .random import split_key
+
+    u = jax.random.uniform(split_key(), x._data.shape, jnp.float32, 1e-6, 1 - 1e-6)
+    return _overwrite_random(x, (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(x._data.dtype))
+
+
+def geometric_(x, probs=0.5, name=None):
+    from .random import split_key
+
+    u = jax.random.uniform(split_key(), x._data.shape, jnp.float32, 1e-6, 1 - 1e-6)
+    return _overwrite_random(x, jnp.ceil(jnp.log(u) / np.log1p(-probs)).astype(x._data.dtype))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from .random import split_key
+
+    n = jax.random.normal(split_key(), x._data.shape, jnp.float32)
+    return _overwrite_random(x, jnp.exp(mean + std * n).astype(x._data.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    from .random import split_key
+
+    u = jax.random.uniform(split_key(), x._data.shape, jnp.float32, 1e-6, 1 - 1e-6)
+    return _overwrite_random(x, (-jnp.log(u) / lam).astype(x._data.dtype))
+
+
+def gaussian_(x, mean=0.0, std=1.0, name=None):
+    from .random import split_key
+
+    n = jax.random.normal(split_key(), x._data.shape, jnp.float32)
+    return _overwrite_random(x, (mean + std * n).astype(x._data.dtype))
+
+
+normal_ = gaussian_
+
+for _nm in ("bernoulli_", "cauchy_", "geometric_", "log_normal_",
+            "exponential_", "gaussian_", "normal_"):
+    if not hasattr(Tensor, _nm):
+        setattr(Tensor, _nm, getattr(_THIS, _nm))
+
+
+# final __all__ stragglers
+floor_mod_ = getattr(_THIS, "mod_", None) or getattr(_THIS, "remainder_")
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    x._replace_(out)
+    return x
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reader-composition helper (reference paddle.batch)."""
+    def wrapper():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return wrapper
+
+
+def disable_signal_handler():
+    return None
+
+
+# paddle.cast_ module-level twin (Tensor.cast_ already exists)
+def cast_(x, dtype):
+    return x.cast_(dtype)

@@ -180,6 +180,8 @@ def p_norm(x, p: float = 2.0, axis: Optional[int] = None, epsilon: float = 1e-12
             return jnp.abs(a).max(axis=axis, keepdims=keepdim)
         if p == float("-inf"):
             return jnp.abs(a).min(axis=axis, keepdims=keepdim)
+        if p == 0:
+            return (a != 0).sum(axis=axis, keepdims=keepdim).astype(a.dtype)
         s = (jnp.abs(a) ** p).sum(axis=axis, keepdims=keepdim)
         return jnp.maximum(s, epsilon) ** (1.0 / p)
 
