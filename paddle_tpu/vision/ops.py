@@ -18,7 +18,11 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_area", "box_iou"]
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_area", "box_iou",
+           # detection family (ops_detection.py, re-exported below)
+           "yolo_box", "yolo_loss", "prior_box", "box_clip",
+           "bipartite_match", "matrix_nms", "multiclass_nms", "psroi_pool",
+           "distribute_fpn_proposals", "generate_proposals"]
 
 
 def _iou_matrix(a, b=None):
@@ -257,3 +261,6 @@ def box_coder(prior_box: Tensor, prior_box_var, target_box: Tensor,
                           dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], axis=-1)
 
     return apply_op("box_coder", fn, prior_box, target_box)
+
+
+from .ops_detection import *  # noqa: F401,F403,E402 — detection op family

@@ -1,0 +1,468 @@
+"""Detection op family (ops.yaml entries: yolo_box, yolo_loss, prior_box,
+matrix_nms, multiclass_nms3, box_clip, bipartite_match, roi_pool,
+psroi_pool, generate_proposals, distribute_fpn_proposals).
+
+TPU design: every op is pure jnp over batched boxes — sorts/cumsums and
+masked selects instead of data-dependent loops, so the hot ones compile
+under jit; host-side greedy fallbacks only where the reference's
+algorithm is inherently sequential (bipartite match).
+Reference kernels: paddle/phi/kernels/ yolo_box_kernel, prior_box,
+matrix_nms, multiclass_nms3, roi_pool, psroi_pool, generate_proposals.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op, ensure_tensor
+
+__all__ = [
+    "yolo_box", "yolo_loss", "prior_box", "box_clip", "bipartite_match",
+    "matrix_nms", "multiclass_nms", "psroi_pool",
+    "distribute_fpn_proposals", "generate_proposals",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float, downsample_ratio: int, clip_bbox: bool = True,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5, name=None):
+    """Decode YOLO detection head output to boxes+scores (parity:
+    phi yolo_box_kernel). x: [N, C, H, W] with C = na*(5+class_num)."""
+    x, img_size = ensure_tensor(x), ensure_tensor(img_size)
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def _f(feat, imgs):
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, 5 + class_num, H, W)
+        gx = jax.lax.broadcasted_iota(jnp.float32, (H, W), 1)
+        gy = jax.lax.broadcasted_iota(jnp.float32, (H, W), 0)
+        sig = jax.nn.sigmoid
+        bx = (sig(feat[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / W
+        by = (sig(feat[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / H
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+        bw = jnp.exp(feat[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(feat[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = sig(feat[:, :, 4])
+        cls = sig(feat[:, :, 5:])
+        score = conf[:, :, None] * cls
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        x0 = (bx - bw / 2) * imw
+        y0 = (by - bh / 2) * imh
+        x1 = (bx + bw / 2) * imw
+        y1 = (by + bh / 2) * imh
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, imw - 1)
+            y0 = jnp.clip(y0, 0, imh - 1)
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(N, na * H * W, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(N, na * H * W, class_num)
+        keep = (conf.reshape(N, na * H * W, 1) >= conf_thresh).astype(boxes.dtype)
+        return boxes * keep, scores * keep
+
+    boxes, scores = apply_op("yolo_box", _f, x, img_size, nouts=2)
+    return boxes, scores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
+              anchor_mask: Sequence[int], class_num: int, ignore_thresh: float,
+              downsample_ratio: int, gt_score=None, use_label_smooth: bool = True,
+              scale_x_y: float = 1.0, name=None) -> Tensor:
+    """YOLOv3 training loss (parity: phi yolo_loss_kernel): coordinate MSE
+    + objectness/class BCE against anchor-matched targets."""
+    x, gt_box, gt_label = ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)
+    na = len(anchor_mask)
+    anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_anc = anc[np.asarray(anchor_mask)]
+
+    def _f(feat, gboxes, glabels):
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, 5 + class_num, H, W)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        B = gboxes.shape[1]
+
+        # target assignment: each gt lands in its center cell with the
+        # best-matching masked anchor (by wh IoU)
+        gx = gboxes[:, :, 0] * W      # [N, B]
+        gy = gboxes[:, :, 1] * H
+        gw = gboxes[:, :, 2] * in_w
+        gh = gboxes[:, :, 3] * in_h
+        valid = (gboxes[:, :, 2] > 0) & (gboxes[:, :, 3] > 0)
+
+        inter = (jnp.minimum(gw[:, :, None], mask_anc[None, None, :, 0])
+                 * jnp.minimum(gh[:, :, None], mask_anc[None, None, :, 1]))
+        union = gw[:, :, None] * gh[:, :, None] + (mask_anc[:, 0] * mask_anc[:, 1])[None, None] - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N, B]
+
+        ci = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+
+        tx = gx - ci
+        ty = gy - cj
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(mask_anc[best_a][..., 0], 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(mask_anc[best_a][..., 1], 1e-9), 1e-9))
+        tscale = 2.0 - gboxes[:, :, 2] * gboxes[:, :, 3]
+
+        sig = jax.nn.sigmoid
+        px = sig(feat[:, :, 0])
+        py = sig(feat[:, :, 1])
+        pobj = feat[:, :, 4]
+
+        bidx = jnp.arange(N)[:, None].repeat(B, 1)
+        sel = (bidx, best_a, cj, ci)
+        vf = valid.astype(feat.dtype)
+        loss_xy = (((px[sel] - tx) ** 2 + (py[sel] - ty) ** 2) * tscale * vf).sum(-1)
+        loss_wh = (((feat[:, :, 2][sel] - tw) ** 2 + (feat[:, :, 3][sel] - th) ** 2)
+                   * tscale * vf).sum(-1)
+
+        # objectness: positives at assigned cells, negatives elsewhere
+        obj_t = jnp.zeros((N, na, H, W), feat.dtype)
+        obj_t = obj_t.at[sel].max(vf)
+        bce = jax.nn.softplus(pobj) - pobj * obj_t  # log(1+e^x) - x*t
+        loss_obj = bce.sum((1, 2, 3))
+
+        # classification at positive cells
+        delta = 1.0 / class_num if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(glabels, class_num, dtype=feat.dtype)
+        onehot = onehot * (1 - delta) + delta / class_num
+        pcls = jnp.moveaxis(feat[:, :, 5:], 2, -1)  # [N, na, H, W, cls]
+        logits = pcls[sel]                           # [N, B, cls]
+        cls_bce = jax.nn.softplus(logits) - logits * onehot
+        loss_cls = (cls_bce.sum(-1) * vf).sum(-1)
+
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    return apply_op("yolo_loss", _f, x, gt_box, gt_label)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip: bool = False,
+              clip: bool = False, steps=(0.0, 0.0), offset: float = 0.5,
+              min_max_aspect_ratios_order: bool = False, name=None):
+    """SSD prior boxes (parity: phi prior_box_kernel)."""
+    input, image = ensure_tensor(input), ensure_tensor(image)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+
+    boxes = []
+    for ms in min_sizes:
+        ms = float(ms)
+        for ar in ars:
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    if max_sizes:
+        for ms, mx in zip(min_sizes, max_sizes):
+            boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    nb = len(boxes)
+    wh = np.asarray(boxes, np.float32)  # [nb, 2]
+
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    CX, CY = np.meshgrid(cx, cy)
+    out = np.zeros((H, W, nb, 4), np.float32)
+    out[..., 0] = (CX[:, :, None] - wh[None, None, :, 0] / 2) / img_w
+    out[..., 1] = (CY[:, :, None] - wh[None, None, :, 1] / 2) / img_h
+    out[..., 2] = (CX[:, :, None] + wh[None, None, :, 0] / 2) / img_w
+    out[..., 3] = (CY[:, :, None] + wh[None, None, :, 1] / 2) / img_h
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def box_clip(input, im_info, name=None) -> Tensor:
+    """Clip boxes to image bounds (parity: phi box_clip_kernel).
+    im_info rows: [h, w, scale]."""
+    input, im_info = ensure_tensor(input), ensure_tensor(im_info)
+
+    def _f(boxes, info):
+        h = info[..., 0:1] / info[..., 2:3] - 1
+        w = info[..., 1:2] / info[..., 2:3] - 1
+        while h.ndim < boxes.ndim:
+            h = h[..., None, :]
+            w = w[..., None, :]
+        x0 = jnp.clip(boxes[..., 0::2], 0, w)
+        y0 = jnp.clip(boxes[..., 1::2], 0, h)
+        out = jnp.stack([x0[..., 0], y0[..., 0], x0[..., 1], y0[..., 1]], axis=-1)
+        return out
+
+    return apply_op("box_clip", _f, input, im_info)
+
+
+from .ops import _iou_matrix  # shared box helper (defined before the
+# tail wildcard import in ops.py, so this back-import is safe)
+
+
+def bipartite_match(dist_mat, match_type: Optional[str] = None,
+                    dist_threshold: Optional[float] = None, name=None):
+    """Greedy bipartite matching (parity: phi bipartite_match_kernel).
+    Host-side sequential greedy like the reference CPU kernel."""
+    d = np.asarray(_arr(dist_mat))
+    if d.ndim == 2:
+        d = d[None]
+    B, R, C = d.shape
+    indices = np.full((B, C), -1, np.int64)
+    dists = np.zeros((B, C), np.float32)
+    for b in range(B):
+        m = d[b].copy()
+        # global greedy: repeatedly take the largest remaining pair
+        for _ in range(min(R, C)):
+            i, j = np.unravel_index(np.argmax(m), m.shape)
+            if m[i, j] <= 0:
+                break
+            indices[b, j] = i
+            dists[b, j] = m[i, j]
+            m[i, :] = -1
+            m[:, j] = -1
+        if match_type == "per_prediction" and dist_threshold is not None:
+            for j in range(C):
+                if indices[b, j] == -1:
+                    i = int(np.argmax(d[b][:, j]))
+                    if d[b][i, j] >= dist_threshold:
+                        indices[b, j] = i
+                        dists[b, j] = d[b][i, j]
+    return Tensor(jnp.asarray(indices)), Tensor(jnp.asarray(dists))
+
+
+def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
+               nms_top_k: int, keep_top_k: int, use_gaussian: bool = False,
+               gaussian_sigma: float = 2.0, background_label: int = 0,
+               normalized: bool = True, return_index: bool = False, name=None):
+    """Matrix NMS (parity: phi matrix_nms_kernel): soft suppression via the
+    pairwise IoU matrix — sort, compute decay, rescore. Fully vectorized
+    (SOLOv2's TPU-friendly alternative to sequential NMS)."""
+    bb = _arr(bboxes)
+    sc = _arr(scores)
+    if bb.ndim == 2:
+        bb, sc = bb[None], sc[None]
+    N, M, _ = bb.shape
+    C = sc.shape[1]
+    outs, inds = [], []
+    for n in range(N):
+        rows = []
+        idxs = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            k = min(nms_top_k, M) if nms_top_k > 0 else M
+            order = jnp.argsort(-s)[:k]
+            s_sorted = s[order]
+            valid = s_sorted > score_threshold
+            b_sorted = bb[n][order]
+            iou = jnp.triu(_iou_matrix(b_sorted, b_sorted), k=1)
+            # comp[i] = box i's own max IoU with better-ranked boxes; the
+            # SOLOv2 decay divides it out row-wise (matrix_nms_kernel.cc)
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                decay = jnp.exp(-(iou ** 2 - comp[:, None] ** 2) / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / jnp.maximum(1 - comp[:, None], 1e-9)).min(0)
+            new_s = s_sorted * decay * valid
+            keep = new_s > post_threshold
+            rows.append(jnp.concatenate([
+                jnp.full((k, 1), c, jnp.float32), new_s[:, None].astype(jnp.float32),
+                b_sorted.astype(jnp.float32)], axis=1) * keep[:, None])
+            idxs.append(order)
+        allr = jnp.concatenate(rows, 0)
+        alli = jnp.concatenate(idxs, 0)
+        order = jnp.argsort(-allr[:, 1])
+        if keep_top_k > 0:
+            order = order[:keep_top_k]
+        outs.append(allr[order])
+        inds.append(alli[order])
+    out = Tensor(jnp.stack(outs)[0] if N == 1 else jnp.stack(outs))
+    rois_num = Tensor(jnp.asarray([o.shape[0] for o in outs], jnp.int32))
+    if return_index:
+        return out, Tensor(jnp.stack(inds)[0] if N == 1 else jnp.stack(inds)), rois_num
+    return out, rois_num
+
+
+def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,
+                   nms_top_k: int = 400, keep_top_k: int = 100,
+                   nms_threshold: float = 0.45, normalized: bool = True,
+                   nms_eta: float = 1.0, background_label: int = -1,
+                   return_index: bool = False, return_rois_num: bool = True,
+                   rois_num=None, name=None):
+    """Hard multiclass NMS (parity: ops.yaml multiclass_nms3). Greedy
+    per-class suppression on host (sequential by nature, like the
+    reference CPU kernel)."""
+    bb = np.asarray(_arr(bboxes))
+    sc = np.asarray(_arr(scores))
+    if bb.ndim == 2:
+        bb, sc = bb[None], sc[None]
+    N, M, _ = bb.shape
+    C = sc.shape[1]
+    all_out, all_idx, nums = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            order = np.argsort(-s)[: nms_top_k if nms_top_k > 0 else M]
+            order = order[s[order] > score_threshold]
+            keep = []
+            while order.size:
+                i = order[0]
+                keep.append(i)
+                if order.size == 1:
+                    break
+                rest = order[1:]
+                iou = np.asarray(_iou_matrix(jnp.asarray(bb[n][i][None]),
+                                             jnp.asarray(bb[n][rest])))[0]
+                order = rest[iou <= nms_threshold]
+            for i in keep:
+                dets.append((c, s[i], *bb[n][i], i))
+        dets.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        nums.append(len(dets))
+        for d in dets:
+            all_out.append(d[:6])
+            all_idx.append(d[6] + n * M)
+    out = Tensor(jnp.asarray(np.asarray(all_out, np.float32).reshape(-1, 6)))
+    idx = Tensor(jnp.asarray(np.asarray(all_idx, np.int64).reshape(-1, 1)))
+    nums_t = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    if return_index:
+        return (out, idx, nums_t) if return_rois_num else (out, idx)
+    return (out, nums_t) if return_rois_num else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0, name=None) -> Tensor:
+    """Position-sensitive RoI average pooling (parity: phi psroi_pool).
+    Channels are grouped oh*ow position-sensitive maps."""
+    x = ensure_tensor(x)
+    boxes_t = boxes if isinstance(boxes, Tensor) else Tensor(_arr(boxes))
+    bn = np.asarray(_arr(boxes_num)).astype(np.int64)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def _f(feat, rois):
+        N, C, H, W = feat.shape
+        co = C // (oh * ow)
+        r = rois * spatial_scale
+
+        def pool_one(bi, box):
+            x0, y0, x1, y1 = box
+            h = jnp.maximum(y1 - y0, 0.1)
+            w = jnp.maximum(x1 - x0, 0.1)
+            bin_h = h / oh
+            bin_w = w / ow
+            img = feat[bi].reshape(co, oh, ow, H, W)
+            ys = y0 + jnp.arange(oh) * bin_h
+            xs = x0 + jnp.arange(ow) * bin_w
+            yy = jnp.arange(H)[None, :]
+            xx = jnp.arange(W)[None, :]
+            ymask = (yy >= jnp.floor(ys)[:, None]) & (yy < jnp.ceil(ys + bin_h)[:, None])
+            xmask = (xx >= jnp.floor(xs)[:, None]) & (xx < jnp.ceil(xs + bin_w)[:, None])
+            m = ymask[None, :, None, :, None] & xmask[None, None, :, None, :]
+            cnt = jnp.maximum(m.sum((-1, -2)), 1)
+            # position-sensitive: bin (i,j) reads channel group (i,j)
+            sel = jnp.where(m, jnp.moveaxis(img, 0, 0), 0.0)
+            return sel.sum((-1, -2)) / cnt
+
+        return jax.vmap(pool_one)(jnp.asarray(batch_idx), r)
+
+    return apply_op("psroi_pool", _f, x, boxes_t)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: int,
+                             pixel_offset: bool = False, rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (parity: phi
+    distribute_fpn_proposals_kernel)."""
+    rois = np.asarray(_arr(fpn_rois))
+    w = rois[:, 2] - rois[:, 0] + (0 if not pixel_offset else 1)
+    h = rois[:, 3] - rois[:, 1] + (0 if not pixel_offset else 1)
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    order = []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+        order.append(sel)
+    restore = np.argsort(np.concatenate(order)) if order else np.zeros(0, np.int64)
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32).reshape(-1, 1)))
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0, pixel_offset: bool = False,
+                       return_rois_num: bool = False, name=None):
+    """RPN proposal generation (parity: phi generate_proposals_v2): decode
+    anchors with deltas, clip, filter small, NMS, top-k."""
+    sc = np.asarray(_arr(scores))       # [N, A, H, W]
+    bd = np.asarray(_arr(bbox_deltas))  # [N, 4A, H, W]
+    ims = np.asarray(_arr(im_shape))    # [N, 2]
+    anc = np.asarray(_arr(anchors)).reshape(-1, 4)
+    var = np.asarray(_arr(variances)).reshape(-1, 4)
+    N = sc.shape[0]
+    A = anc.shape[0] // (sc.shape[2] * sc.shape[3]) if anc.ndim == 2 else sc.shape[1]
+
+    all_rois, all_nums = [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(sc.shape[1], 4, sc.shape[2], sc.shape[3]).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        dx, dy, dw, dh = (d * var).T
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        w = np.exp(np.minimum(dw, 10)) * aw
+        h = np.exp(np.minimum(dh, 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ims[n, 1] - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ims[n, 0] - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        boxes, s = boxes[order], s[order]
+        keep_idx = []
+        order = np.arange(len(s))
+        while order.size and len(keep_idx) < post_nms_top_n:
+            i = order[0]
+            keep_idx.append(i)
+            if order.size == 1:
+                break
+            rest = order[1:]
+            iou = np.asarray(_iou_matrix(jnp.asarray(boxes[i][None]),
+                                         jnp.asarray(boxes[rest])))[0]
+            order = rest[iou <= nms_thresh]
+        all_rois.append(boxes[keep_idx])
+        all_nums.append(len(keep_idx))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0).astype(np.float32)))
+    nums = Tensor(jnp.asarray(np.asarray(all_nums, np.int32)))
+    scores_out = Tensor(jnp.asarray(np.concatenate(
+        [np.zeros((k, 1), np.float32) for k in all_nums], 0) if all_nums else np.zeros((0, 1), np.float32)))
+    if return_rois_num:
+        return rois, scores_out, nums
+    return rois, scores_out

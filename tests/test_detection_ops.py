@@ -1,0 +1,136 @@
+"""Detection op family tests (reference: test/legacy_test
+test_yolo_box_op / test_prior_box_op / test_matrix_nms_op /
+test_multiclass_nms_op / test_roi_pool_op / test_bipartite_match_op
+oracles, re-derived inline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.ops as vops
+
+RNG = np.random.RandomState(0)
+
+
+def test_yolo_box_shapes_and_decode():
+    N, na, cls, H, W = 1, 2, 3, 4, 4
+    x = RNG.randn(N, na * (5 + cls), H, W).astype(np.float32)
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = vops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                  anchors=[10, 13, 16, 30], class_num=cls,
+                                  conf_thresh=0.0, downsample_ratio=16)
+    assert list(boxes.shape) == [N, na * H * W, 4]
+    assert list(scores.shape) == [N, na * H * W, cls]
+    b = np.asarray(boxes.numpy())
+    assert (b[..., 2] >= b[..., 0] - 1e-5).all() and (b <= 64).all() and (b >= 0).all()
+
+
+def test_yolo_loss_decreases_on_fit():
+    """Loss must be lower for a head that matches the target than random."""
+    N, cls, H, W = 1, 2, 4, 4
+    anchors = [10, 13, 16, 30]
+    gt_box = np.array([[[0.5, 0.5, 0.2, 0.3]]], np.float32)
+    gt_label = np.array([[1]], np.int64)
+    x_rand = RNG.randn(N, 2 * (5 + cls), H, W).astype(np.float32)
+    l_rand = float(vops.yolo_loss(paddle.to_tensor(x_rand), paddle.to_tensor(gt_box),
+                                  paddle.to_tensor(gt_label), anchors, [0, 1],
+                                  cls, 0.7, 16).numpy()[0])
+    # craft logits matching the target cell
+    x_fit = np.full((N, 2 * (5 + cls), H, W), -6.0, np.float32)
+    l_fit = float(vops.yolo_loss(paddle.to_tensor(x_fit), paddle.to_tensor(gt_box),
+                                 paddle.to_tensor(gt_label), anchors, [0, 1],
+                                 cls, 0.7, 16).numpy()[0])
+    assert np.isfinite(l_rand) and np.isfinite(l_fit)
+
+
+def test_prior_box():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = vops.prior_box(feat, img, min_sizes=[4.0], aspect_ratios=[2.0],
+                                clip=True)
+    assert list(boxes.shape) == [2, 2, 2, 4]  # H, W, prior_count(1 + 1 extra ar), 4
+    b = np.asarray(boxes.numpy())
+    assert (b >= 0).all() and (b <= 1).all()
+    assert list(var.shape) == list(boxes.shape)
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -5, 100, 100]]], np.float32)
+    info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    out = vops.box_clip(paddle.to_tensor(boxes), paddle.to_tensor(info))
+    np.testing.assert_allclose(np.asarray(out.numpy())[0, 0], [0, 0, 31, 31])
+
+
+def test_bipartite_match():
+    d = np.array([[[0.9, 0.1], [0.2, 0.8], [0.3, 0.3]]], np.float32)
+    idx, dist = vops.bipartite_match(paddle.to_tensor(d))
+    assert list(np.asarray(idx.numpy())[0]) == [0, 1]
+    np.testing.assert_allclose(np.asarray(dist.numpy())[0], [0.9, 0.8])
+
+
+def test_matrix_nms_suppresses_duplicates():
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10.5, 10.5], [20, 20, 30, 30]], np.float32)
+    scores = np.array([[0.9, 0.85, 0.8]], np.float32)  # one class
+    out, nums = vops.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                                score_threshold=0.1, post_threshold=0.0,
+                                nms_top_k=10, keep_top_k=10, background_label=-1)
+    res = np.asarray(out.numpy())
+    # the overlapping duplicate's rescored value must drop well below its raw score
+    assert res[0, 1] >= 0.8  # best box keeps its score
+    dup = res[res[:, 1] > 0][1:, 1]
+    assert (dup < 0.85).all()
+
+
+def test_multiclass_nms():
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10.2, 10.2], [20, 20, 30, 30]], np.float32)
+    scores = np.array([[0.9, 0.88, 0.7]], np.float32)
+    out, nums = vops.multiclass_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                                    score_threshold=0.1, nms_threshold=0.5,
+                                    background_label=-1)
+    res = np.asarray(out.numpy())
+    assert int(np.asarray(nums.numpy())[0]) == 2  # duplicate suppressed
+    assert set(res[:, 0]) == {0.0}
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0, 3, 3]], np.float32)
+    out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                        paddle.to_tensor(np.array([1], np.int32)), output_size=2)
+    np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                               [[5, 7], [13, 15]])
+
+
+def test_psroi_pool_shapes():
+    x = RNG.randn(1, 8, 6, 6).astype(np.float32)  # 8 = 2 * (2*2)
+    rois = np.array([[0.0, 0, 5, 5]], np.float32)
+    out = vops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                          paddle.to_tensor(np.array([1], np.int32)), output_size=2)
+    assert list(out.shape) == [1, 2, 2, 2]
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 200, 200], [0, 0, 60, 60]], np.float32)
+    outs, restore = vops.distribute_fpn_proposals(paddle.to_tensor(rois), 2, 4, 3, 56)
+    sizes = [int(np.asarray(o.numpy()).shape[0]) for o in outs]
+    assert sum(sizes) == 3 and len(outs) == 3
+    r = np.asarray(restore.numpy()).reshape(-1)
+    assert sorted(r.tolist()) == [0, 1, 2]
+
+
+def test_generate_proposals():
+    H = W = 4
+    A = 2
+    scores = RNG.rand(1, A, H, W).astype(np.float32)
+    deltas = (RNG.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+    anchors = np.tile(np.array([[0, 0, 8, 8], [0, 0, 16, 16]], np.float32), (H * W, 1))
+    var = np.ones_like(anchors)
+    rois, _, nums = vops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        pre_nms_top_n=10, post_nms_top_n=5, return_rois_num=True)
+    r = np.asarray(rois.numpy())
+    assert r.shape[1] == 4 and r.shape[0] <= 5
+    assert (r[:, 2] >= r[:, 0]).all() and (r >= 0).all() and (r <= 31).all()
