@@ -114,16 +114,19 @@ def log_sigmoid(x, name=None) -> Tensor:
     return apply_op("log_sigmoid", jax.nn.log_sigmoid, ensure_tensor(x))
 
 
+def _softmax_body(a, axis, d):
+    if d is not None:
+        a = a.astype(d)
+    return jax.nn.softmax(a, axis=axis)
+
+
 def softmax(x, axis=-1, dtype=None, name=None) -> Tensor:
+    from ..ops.dispatch import stable_closure
+
     x = ensure_tensor(x)
     d = dtypes.convert_dtype(dtype)
-
-    def _f(a):
-        if d is not None:
-            a = a.astype(d)
-        return jax.nn.softmax(a, axis=axis)
-
-    return apply_op("softmax", _f, x)
+    d = np.dtype(d) if d is not None else None
+    return apply_op("softmax", stable_closure(_softmax_body, int(axis), d), x)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None) -> Tensor:

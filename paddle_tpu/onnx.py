@@ -26,10 +26,35 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
 
     prefix = path[:-5] if path.endswith(".onnx") else path
     jit_save(layer, prefix, input_spec=input_spec)
-    if configs.get("require_onnx"):
-        # only an explicit request for protobuf output errors; the default
-        # contract is the portable StableHLO artifact
-        raise NotImplementedError(
-            "StableHLO->ONNX graph translation is not implemented; consume the "
-            f"serialized program at {prefix}.pdmodel instead")
+    if path.endswith(".onnx") or configs.get("require_onnx"):
+        # true protobuf export: trace the layer and map jax primitives to
+        # ONNX nodes (onnx_export.py); params become initializers
+        import jax.numpy as jnp
+
+        from .core.tensor import Tensor
+        from .onnx_export import export_onnx
+        from .utils.functional import functional_call
+
+        params = {k: v._data for k, v in layer.state_dict().items()}
+
+        import jax as _jax
+
+        def fwd(params, *xs):
+            out = functional_call(layer, params, *[Tensor(x) for x in xs])
+            return _jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                                 out, is_leaf=lambda t: isinstance(t, Tensor))
+
+        if input_spec is None:
+            raise ValueError("onnx export requires input_spec with shapes")
+        examples, decl_shapes = [], []
+        for spec in input_spec:
+            shape = [1 if (s is None or s == -1) else int(s) for s in spec.shape]
+            decl_shapes.append(list(spec.shape))
+            examples.append(jnp.zeros(shape, getattr(spec, "dtype", jnp.float32)))
+        model_bytes = export_onnx(fwd, examples, params=params,
+                                  input_shapes=decl_shapes)
+        onnx_path = prefix + ".onnx"
+        with open(onnx_path, "wb") as f:
+            f.write(model_bytes)
+        return onnx_path
     return prefix

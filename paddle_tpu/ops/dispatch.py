@@ -72,11 +72,41 @@ def _is_diff_dtype(dt) -> bool:
 
 
 def _stable_fn(fn) -> bool:
+    if getattr(fn, "_pt_stable", False):
+        return True
     try:
         return (getattr(fn, "__closure__", True) is None
                 and "<locals>" not in getattr(fn, "__qualname__", "<locals>"))
     except Exception:  # pragma: no cover
         return False
+
+
+from collections import OrderedDict
+
+_STABLE_CLOSURES: "OrderedDict" = OrderedDict()
+_STABLE_CLOSURES_CAP = 1024  # LRU bound: evicting a closure also releases
+# its weak-keyed jitted fwd/pullback executables (data-dependent shapes
+# would otherwise pin compiled programs forever)
+
+
+def stable_closure(fn, *attrs):
+    """Memoized attr-binding: returns THE SAME function object for the same
+    (fn, attrs), so attr-carrying ops (axis, perm, shape...) also qualify
+    for the compiled fwd/pullback caches. attrs must be hashable."""
+    key = (fn, attrs)
+    f = _STABLE_CLOSURES.get(key)
+    if f is None:
+        def f(*arrays):
+            return fn(*arrays, *attrs)
+
+        f._pt_stable = True
+        f.__name__ = getattr(fn, "__name__", "op") + str(attrs)
+        _STABLE_CLOSURES[key] = f
+        if len(_STABLE_CLOSURES) > _STABLE_CLOSURES_CAP:
+            _STABLE_CLOSURES.popitem(last=False)
+    else:
+        _STABLE_CLOSURES.move_to_end(key)
+    return f
 
 
 def _cached_fwd(fn):

@@ -31,10 +31,16 @@ def cast(x, dtype) -> Tensor:
     return ensure_tensor(x).astype(dtype)
 
 
+def _reshape_body(a, shp):
+    return jnp.reshape(a, shp)
+
+
 def reshape(x, shape, name=None) -> Tensor:
+    from .dispatch import stable_closure
+
     x = ensure_tensor(x)
-    shp = _ints(shape)
-    return apply_op("reshape", lambda a: jnp.reshape(a, shp), x)
+    shp = tuple(_ints(shape))
+    return apply_op("reshape", stable_closure(_reshape_body, shp), x)
 
 
 def reshape_(x, shape, name=None) -> Tensor:
@@ -51,10 +57,16 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
     return apply_op("flatten", lambda a: jnp.reshape(a, new), x)
 
 
+def _transpose_body(a, p):
+    return jnp.transpose(a, p)
+
+
 def transpose(x, perm, name=None) -> Tensor:
+    from .dispatch import stable_closure
+
     x = ensure_tensor(x)
-    p = _ints(perm)
-    return apply_op("transpose", lambda a: jnp.transpose(a, p), x)
+    p = tuple(_ints(perm))
+    return apply_op("transpose", stable_closure(_transpose_body, p), x)
 
 
 def t(x, name=None) -> Tensor:
@@ -371,12 +383,21 @@ def where(condition, x=None, y=None, name=None):
     return apply_op("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
 
 
+# Index dtype note (deliberate): jax runs with x64 disabled, so index
+# outputs are int32 — correct for any dimension < 2^31 (XLA itself caps
+# per-dimension sizes near this). Requesting int64 would only emit a
+# truncation warning and silently produce int32 anyway; int32 states the
+# actual contract. Paddle-compat callers that need int64 can .astype
+# after enabling jax_enable_x64.
+_INDEX_DTYPE = jnp.int32
+
+
 def nonzero(x, as_tuple=False):
     x = ensure_tensor(x)
     nz = np.nonzero(np.asarray(x._data))
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(v[:, None], jnp.int64)) for v in nz)
-    return Tensor(jnp.asarray(np.stack(nz, axis=1), jnp.int64))
+        return tuple(Tensor(jnp.asarray(v[:, None], _INDEX_DTYPE)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), _INDEX_DTYPE))
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
@@ -399,11 +420,11 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
         outs = [Tensor(jnp.asarray(out))]
         if return_inverse:
             inv = np.cumsum(keep) - 1
-            outs.append(Tensor(jnp.asarray(inv, np.int64)))
+            outs.append(Tensor(jnp.asarray(inv, _INDEX_DTYPE)))
         if return_counts:
             idx = np.flatnonzero(keep)
             counts = np.diff(np.concatenate([idx, [len(arr)]]))
-            outs.append(Tensor(jnp.asarray(counts, np.int64)))
+            outs.append(Tensor(jnp.asarray(counts, _INDEX_DTYPE)))
         return outs[0] if len(outs) == 1 else tuple(outs)
     raise NotImplementedError("unique_consecutive with axis")
 

@@ -185,32 +185,38 @@ def _axis(axis):
     return int(axis)
 
 
+def _reduce_body(a, jfn, ax, keepdim):
+    return jfn(a, axis=ax, keepdims=keepdim)
+
+
 def _reduce(opname, jfn, int_promote=False):
+    from .dispatch import stable_closure
+
     def op(x, axis=None, keepdim=False, name=None):
         x = ensure_tensor(x)
         ax = _axis(axis)
-
-        def _f(a):
-            out = jfn(a, axis=ax, keepdims=keepdim)
-            return out
-
-        return apply_op(opname, _f, x)
+        ax = tuple(ax) if isinstance(ax, list) else ax
+        return apply_op(opname, stable_closure(_reduce_body, jfn, ax, keepdim), x)
 
     op.__name__ = opname
     return op
 
 
+def _sum_body(a, ax, keepdim, d):
+    if a.dtype == jnp.bool_:
+        a = a.astype(jnp.int64)
+    return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=d)
+
+
 def sum(x, axis=None, dtype=None, keepdim=False, name=None) -> Tensor:
+    from .dispatch import stable_closure
+
     x = ensure_tensor(x)
     ax = _axis(axis)
+    ax = tuple(ax) if isinstance(ax, list) else ax
     d = dtypes.convert_dtype(dtype)
-
-    def _f(a):
-        if a.dtype == jnp.bool_:
-            a = a.astype(jnp.int64)
-        return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=d)
-
-    return apply_op("sum", _f, x)
+    d = np.dtype(d) if d is not None else None
+    return apply_op("sum", stable_closure(_sum_body, ax, keepdim, d), x)
 
 
 mean = _reduce("mean", jnp.mean)

@@ -1,0 +1,79 @@
+"""Device-kernel custom ops: register a Pallas TPU kernel as a framework op.
+
+Parity role: the reference's custom-op registration for DEVICE kernels
+(paddle/fluid/eager/custom_operator/ + utils/cpp_extension building CUDA
+kernels). On TPU the device-kernel language is Pallas, so a custom op is
+a pallas_call-built jax function plus an optional custom backward — this
+module wires both into the dispatch layer so the op gets AMP hooks, tape
+recording, NaN checks, and to_static capture exactly like built-ins
+(the host-callback path for CPU code lives in utils/cpp_extension.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import OP_REGISTRY, apply_op, ensure_tensor, register_op
+
+__all__ = ["register_pallas_op", "get_custom_op"]
+
+_CUSTOM_OPS = {}
+
+
+def register_pallas_op(name: str, forward: Callable, backward: Optional[Callable] = None,
+                       num_outputs: int = 1):
+    """Register ``forward`` (a jax function, typically wrapping
+    ``pl.pallas_call``) as custom op ``name``.
+
+    forward(*arrays) -> array | tuple: the device computation.
+    backward(residuals, *cotangents) -> input cotangents (optional): when
+    given, a ``jax.custom_vjp`` wraps the forward — residuals are
+    ``(inputs, outputs)`` — so the Pallas backward kernel provides the
+    gradient (the flash-attention pattern,
+    pallas_kernels/flash_attention.py). Without it the op is
+    NON-differentiable (Pallas kernels are opaque to autodiff), exactly
+    like the reference, where a custom op without a registered grad op
+    cannot be trained through.
+
+    Returns the op callable (also registered for ``get_custom_op``).
+    """
+    if backward is not None:
+        @jax.custom_vjp
+        def kernel(*arrays):
+            return forward(*arrays)
+
+        def fwd(*arrays):
+            out = forward(*arrays)
+            return out, (arrays, out)
+
+        def bwd(res, cots):
+            arrays, out = res
+            grads = backward(res, *(cots if isinstance(cots, tuple) else (cots,)))
+            return tuple(grads)
+
+        kernel.defvjp(fwd, bwd)
+    else:
+        kernel = forward
+
+    def op(*tensors):
+        ts = [ensure_tensor(t) for t in tensors]
+        if backward is None:
+            # opaque device kernel: no tape entry (non-differentiable)
+            from ..core.autograd import no_grad
+
+            with no_grad():
+                return apply_op(name, kernel, *ts)
+        return apply_op(name, kernel, *ts)
+
+    op.__name__ = name
+    register_op(name, kind="pallas_custom", num_outputs=num_outputs,
+                has_custom_backward=backward is not None)
+    _CUSTOM_OPS[name] = op
+    return op
+
+
+def get_custom_op(name: str) -> Callable:
+    return _CUSTOM_OPS[name]

@@ -44,6 +44,11 @@ def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
 
     def _f(feat, imgs):
         N, C, H, W = feat.shape
+        if iou_aware:
+            # layout: first na channels are IoU predictions (phi yolo_box
+            # iou-aware path); conf = conf^(1-f) * sigmoid(iou)^f
+            iou_pred = jax.nn.sigmoid(feat[:, :na].reshape(N, na, H, W))
+            feat = feat[:, na:]
         feat = feat.reshape(N, na, 5 + class_num, H, W)
         gx = jax.lax.broadcasted_iota(jnp.float32, (H, W), 1)
         gy = jax.lax.broadcasted_iota(jnp.float32, (H, W), 0)
@@ -54,6 +59,8 @@ def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
         bw = jnp.exp(feat[:, :, 2]) * anc[None, :, 0, None, None] / in_w
         bh = jnp.exp(feat[:, :, 3]) * anc[None, :, 1, None, None] / in_h
         conf = sig(feat[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * iou_pred ** iou_aware_factor
         cls = sig(feat[:, :, 5:])
         score = conf[:, :, None] * cls
         imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
@@ -81,13 +88,18 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
               downsample_ratio: int, gt_score=None, use_label_smooth: bool = True,
               scale_x_y: float = 1.0, name=None) -> Tensor:
     """YOLOv3 training loss (parity: phi yolo_loss_kernel): coordinate MSE
-    + objectness/class BCE against anchor-matched targets."""
+    + objectness/class BCE against anchor-matched targets. Negative cells
+    whose predicted box overlaps any gt above ``ignore_thresh`` are
+    excluded from the objectness loss; ``gt_score`` (mixup) weights the
+    positive terms."""
     x, gt_box, gt_label = ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)
+    gscore = ensure_tensor(gt_score) if gt_score is not None else None
     na = len(anchor_mask)
     anc = np.asarray(anchors, np.float32).reshape(-1, 2)
     mask_anc = anc[np.asarray(anchor_mask)]
 
-    def _f(feat, gboxes, glabels):
+    def _f(feat, gboxes, glabels, *rest):
+        gs = rest[0] if rest else None
         N, C, H, W = feat.shape
         feat = feat.reshape(N, na, 5 + class_num, H, W)
         in_w = W * downsample_ratio
@@ -124,14 +136,41 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
         bidx = jnp.arange(N)[:, None].repeat(B, 1)
         sel = (bidx, best_a, cj, ci)
         vf = valid.astype(feat.dtype)
+        if gs is not None:
+            vf = vf * gs  # mixup weighting of positive terms
         loss_xy = (((px[sel] - tx) ** 2 + (py[sel] - ty) ** 2) * tscale * vf).sum(-1)
         loss_wh = (((feat[:, :, 2][sel] - tw) ** 2 + (feat[:, :, 3][sel] - th) ** 2)
                    * tscale * vf).sum(-1)
 
-        # objectness: positives at assigned cells, negatives elsewhere
+        # objectness: positives at assigned cells; negatives elsewhere,
+        # except cells whose decoded box overlaps a gt above ignore_thresh
         obj_t = jnp.zeros((N, na, H, W), feat.dtype)
-        obj_t = obj_t.at[sel].max(vf)
-        bce = jax.nn.softplus(pobj) - pobj * obj_t  # log(1+e^x) - x*t
+        obj_t = obj_t.at[sel].max(valid.astype(feat.dtype))
+        # decoded predicted boxes (normalized, cell units)
+        gxg = jax.lax.broadcasted_iota(jnp.float32, (H, W), 1)
+        gyg = jax.lax.broadcasted_iota(jnp.float32, (H, W), 0)
+        pbx = (px + gxg) / W
+        pby = (py + gyg) / H
+        pbw = jnp.exp(jnp.clip(feat[:, :, 2], -10, 10)) * mask_anc[None, :, 0, None, None] / in_w
+        pbh = jnp.exp(jnp.clip(feat[:, :, 3], -10, 10)) * mask_anc[None, :, 1, None, None] / in_h
+        # IoU of each predicted box with each gt (normalized coords)
+        gx0 = (gboxes[:, :, 0] - gboxes[:, :, 2] / 2)[:, None, None, None, :]
+        gy0 = (gboxes[:, :, 1] - gboxes[:, :, 3] / 2)[:, None, None, None, :]
+        gx1 = (gboxes[:, :, 0] + gboxes[:, :, 2] / 2)[:, None, None, None, :]
+        gy1 = (gboxes[:, :, 1] + gboxes[:, :, 3] / 2)[:, None, None, None, :]
+        px0 = (pbx - pbw / 2)[..., None]
+        py0 = (pby - pbh / 2)[..., None]
+        px1 = (pbx + pbw / 2)[..., None]
+        py1 = (pby + pbh / 2)[..., None]
+        iw = jnp.maximum(jnp.minimum(px1, gx1) - jnp.maximum(px0, gx0), 0)
+        ih = jnp.maximum(jnp.minimum(py1, gy1) - jnp.maximum(py0, gy0), 0)
+        inter_p = iw * ih
+        union_p = (px1 - px0) * (py1 - py0) + (gx1 - gx0) * (gy1 - gy0) - inter_p
+        best_iou = jnp.where(valid[:, None, None, None, :], inter_p
+                             / jnp.maximum(union_p, 1e-9), 0.0).max(-1)
+        ignore = (best_iou > ignore_thresh) & (obj_t == 0)
+        w_obj = jnp.where(ignore, 0.0, 1.0)
+        bce = (jax.nn.softplus(pobj) - pobj * obj_t) * w_obj
         loss_obj = bce.sum((1, 2, 3))
 
         # classification at positive cells
@@ -145,7 +184,8 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
 
         return loss_xy + loss_wh + loss_obj + loss_cls
 
-    return apply_op("yolo_loss", _f, x, gt_box, gt_label)
+    args = (x, gt_box, gt_label) + ((gscore,) if gscore is not None else ())
+    return apply_op("yolo_loss", _f, *args)
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
@@ -285,17 +325,19 @@ def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
                 jnp.full((k, 1), c, jnp.float32), new_s[:, None].astype(jnp.float32),
                 b_sorted.astype(jnp.float32)], axis=1) * keep[:, None])
             idxs.append(order)
-        allr = jnp.concatenate(rows, 0)
-        alli = jnp.concatenate(idxs, 0)
-        order = jnp.argsort(-allr[:, 1])
+        allr = np.asarray(jnp.concatenate(rows, 0))
+        alli = np.asarray(jnp.concatenate(idxs, 0))
+        kept = allr[:, 1] > post_threshold  # drop suppressed (zeroed) rows
+        allr, alli = allr[kept], alli[kept]
+        order = np.argsort(-allr[:, 1])
         if keep_top_k > 0:
             order = order[:keep_top_k]
-        outs.append(allr[order])
-        inds.append(alli[order])
-    out = Tensor(jnp.stack(outs)[0] if N == 1 else jnp.stack(outs))
-    rois_num = Tensor(jnp.asarray([o.shape[0] for o in outs], jnp.int32))
+        outs.append(jnp.asarray(allr[order]))
+        inds.append(jnp.asarray(alli[order]))
+    out = Tensor(outs[0] if N == 1 else jnp.stack(outs))
+    rois_num = Tensor(jnp.asarray([int(o.shape[0]) for o in outs], jnp.int32))
     if return_index:
-        return out, Tensor(jnp.stack(inds)[0] if N == 1 else jnp.stack(inds)), rois_num
+        return out, Tensor(inds[0] if N == 1 else jnp.stack(inds)), rois_num
     return out, rois_num
 
 
@@ -425,7 +467,7 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
     N = sc.shape[0]
     A = anc.shape[0] // (sc.shape[2] * sc.shape[3]) if anc.ndim == 2 else sc.shape[1]
 
-    all_rois, all_nums = [], []
+    all_rois, all_scores, all_nums = [], [], []
     for n in range(N):
         s = sc[n].transpose(1, 2, 0).reshape(-1)
         d = bd[n].reshape(sc.shape[1], 4, sc.shape[2], sc.shape[3]).transpose(2, 3, 0, 1).reshape(-1, 4)
@@ -458,11 +500,13 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
                                          jnp.asarray(boxes[rest])))[0]
             order = rest[iou <= nms_thresh]
         all_rois.append(boxes[keep_idx])
+        all_scores.append(s[keep_idx])
         all_nums.append(len(keep_idx))
     rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0).astype(np.float32)))
     nums = Tensor(jnp.asarray(np.asarray(all_nums, np.int32)))
-    scores_out = Tensor(jnp.asarray(np.concatenate(
-        [np.zeros((k, 1), np.float32) for k in all_nums], 0) if all_nums else np.zeros((0, 1), np.float32)))
+    scores_out = Tensor(jnp.asarray(
+        (np.concatenate(all_scores, 0).astype(np.float32).reshape(-1, 1))
+        if all_scores else np.zeros((0, 1), np.float32)))
     if return_rois_num:
         return rois, scores_out, nums
     return rois, scores_out

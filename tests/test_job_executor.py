@@ -116,10 +116,12 @@ class TestOnnxExport:
 
         paddle.seed(0)
         net = nn.Sequential(nn.Linear(4, 2))
-        prefix = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
-                                    input_spec=[InputSpec([None, 4], "float32", name="x")])
+        out = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
+                                 input_spec=[InputSpec([None, 4], "float32", name="x")])
         import os
 
+        assert os.path.exists(out)  # real .onnx protobuf now written
+        prefix = out[:-5]
         assert os.path.exists(prefix + ".pdmodel")
         loaded = paddle.jit.load(prefix)
         x = paddle.to_tensor(np.ones((2, 4), "float32"))
