@@ -93,6 +93,41 @@ def recompute(function: Callable, *args, use_reentrant: bool = True,
 
     from ...core import dtype as dtypes
 
+    # create_graph=True re-derivation info (grad-of-grad through remat —
+    # the gradient-penalty + recompute combination): a pure re-forward over
+    # the explicit tensor inputs, taped OFF, with the recorded RNG stream.
+    # Closed-over parameters are constants of this function, so SECOND-order
+    # grads w.r.t. params do not flow through recompute (first-order param
+    # grads still do, via the inner tape in vjp_fn) — same scoping as the
+    # explicit-input contract of reference RecomputeFunction.
+    diff_pos = [i for i, t in enumerate(tensor_inputs)
+                if dtypes.is_floating_point(t._data.dtype)]
+
+    def fwd_fn(*diff_xs):
+        saved = get_rng_state() if preserve_rng_state else None
+        if preserve_rng_state:
+            set_rng_state(rng_state)
+        try:
+            re_inputs = [Tensor(t._data, stop_gradient=True) for t in tensor_inputs]
+            for p, x in zip(diff_pos, diff_xs):
+                re_inputs[p] = Tensor(x, stop_gradient=True)
+            it = iter(re_inputs)
+            re_args = [next(it) if i in tensor_idx else args[i] for i in range(len(args))]
+            with no_grad():
+                re_outs = function(*re_args, **kwargs)
+            re_list = [re_outs] if not isinstance(re_outs, (tuple, list)) else list(re_outs)
+            arrs = [re_list[i]._data for i in t_out_idx]
+            return arrs[0] if len(arrs) == 1 else tuple(arrs)
+        finally:
+            if preserve_rng_state:
+                set_rng_state(saved)
+
+    node.fwd_fn = fwd_fn
+    node.fwd_inputs = [tensor_inputs[i] for i in diff_pos]
+    node.fwd_datas = [tensor_inputs[i]._data for i in diff_pos]
+    node.diff_idx = diff_pos
+    node.multi = len(t_outs) > 1
+
     for slot, o in enumerate(t_outs):
         if dtypes.is_floating_point(o._data.dtype):
             o.stop_gradient = False

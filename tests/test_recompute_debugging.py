@@ -188,3 +188,29 @@ class TestRecomputeEdgeCases:
         from paddle_tpu.distributed.fleet.utils import recompute as r2
 
         assert r2 is recompute
+
+
+def test_gradient_penalty_through_recompute():
+    """create_graph=True through a recompute node (gradient penalty + remat
+    — VERDICT weak #8). The double-backward result must match the
+    no-recompute computation."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.recompute import recompute
+
+    def f(x):
+        return (x * x * x).sum()  # d/dx = 3x^2; penalty grad = d/dx (3x^2)^2 = 36 x^3
+
+    def run(use_recompute):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+        y = recompute(f, x) if use_recompute else f(x)
+        (g,) = paddle.grad([y], [x], create_graph=True)
+        penalty = (g * g).sum()
+        penalty.backward()
+        return np.asarray(x.grad.numpy())
+
+    ref = run(False)
+    got = run(True)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    np.testing.assert_allclose(ref, 36.0 * np.array([1.0, 8.0]), rtol=1e-5)
