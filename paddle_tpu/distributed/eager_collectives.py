@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["process_world_size", "eager_all_reduce", "eager_broadcast",
            "eager_all_gather", "eager_reduce_scatter", "eager_alltoall",
-           "eager_scatter", "is_concrete"]
+           "eager_scatter", "eager_shift", "is_concrete"]
 
 
 def process_world_size() -> int:
@@ -100,6 +100,21 @@ def _compiled(kind: str, shape, dtype, extra):
                                    axis=1 + concat_axis)
 
         return jax.jit(f, out_shardings=NamedSharding(mesh, P("world")))
+    if kind == "shift":
+        # p2p pipeline edge: rank r receives rank (r - shift)'s input;
+        # edge ranks (no source) receive zeros. One ppermute-shaped program
+        # all processes enter — the eager send/recv of the reference's
+        # ProcessGroup (process_group.h send:129/recv:139), deadlock-free
+        # because it is a collective.
+        shift = extra
+
+        def f(g):
+            r = jnp.roll(g, shift, axis=0)
+            idx = jnp.arange(W)
+            valid = (idx - shift >= 0) & (idx - shift < W)
+            return jnp.where(valid.reshape((W,) + (1,) * len(shape)), r, 0)
+
+        return jax.jit(f, out_shardings=NamedSharding(mesh, P("world")))
     if kind == "scatter":
         src, axis = extra
         def f(g):
@@ -143,6 +158,13 @@ def eager_reduce_scatter(arr, axis: int = 0):
 
 def eager_scatter(arr, src: int = 0, axis: int = 0):
     return _run("scatter", arr, (src, axis))
+
+
+def eager_shift(arr, shift: int = 1):
+    """Every process sends ``arr`` to rank+shift and receives from
+    rank-shift (zeros past the edges). The pipeline p2p primitive."""
+    out = _run("shift", arr, shift)
+    return out[0] if out.ndim == arr.ndim + 1 else out
 
 
 def eager_alltoall(arr, split_axis: int = 0, concat_axis: int = 0):

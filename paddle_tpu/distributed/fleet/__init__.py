@@ -8,7 +8,9 @@ parallel training engine.
 
 from .base import DistributedStrategy, Fleet, fleet
 from .topology import CommunicateTopology, HybridCommunicateGroup
-from . import mp_layers as meta_parallel
+from . import meta_parallel
+from .pipeline_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
+                                SharedLayerDesc)
 from .recompute import recompute, recompute_hybrid, recompute_sequential, remat
 from . import utils
 

@@ -1,0 +1,9 @@
+"""fleet.meta_parallel namespace (parity:
+python/paddle/distributed/fleet/meta_parallel/__init__.py): TP layers +
+the pipeline-parallel user API."""
+
+from .mp_layers import *  # noqa: F401,F403
+from .pipeline_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
+                                SharedLayerDesc)
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel"]

@@ -112,11 +112,15 @@ class HostPipelineEngine:
         self._opt_state = [optimizer.init(s.params) for s in self.stages]
         self._loss_fn = loss_fn
 
-        def _loss_seed(y, labels):
+        def _loss_seed(y, labels, scale):
             l, gy = jax.value_and_grad(loss_fn)(y, labels)
-            return l, jax.tree.map(lambda g: g / n_micro, gy)
+            # factor cast to g.dtype: a f32 scale must not promote bf16/fp16
+            # cotangents (vjp rejects mismatched cotangent dtypes)
+            return l, jax.tree.map(
+                lambda g: g * jnp.asarray(scale / n_micro, g.dtype), gy)
 
         self._loss_seed = jax.jit(_loss_seed)
+        self.last_found_inf = False
 
         if schedule == "fthenb":
             self.plan: Plan = create_fthenb_jobs(n_micro, n_stages)
@@ -131,13 +135,22 @@ class HostPipelineEngine:
             raise ValueError(f"unknown schedule {schedule!r}")
 
     # -- one training batch ------------------------------------------------
-    def train_batch(self, x_micro, labels_micro):
+    def train_batch(self, x_micro, labels_micro, grad_scale: float = 1.0,
+                    skip_update_if_nonfinite: bool = False):
         """x_micro/labels_micro: [n_micro, micro_batch, ...] arrays.
         Runs the full schedule (forwards, backwards, optimizer) and returns
-        the mean micro-batch loss as a float."""
+        the mean micro-batch loss as a float.
+
+        grad_scale: fp16 loss-scaling factor — backward seeds are scaled by
+        it and the summed grads unscaled before the update (parity:
+        GradScaler through pipeline_parallel.py:820). With
+        skip_update_if_nonfinite the optimizer step is skipped when any
+        unscaled grad is non-finite; ``self.last_found_inf`` reports it."""
         S, V, M = self.n_stages, self.total_v, self.n_micro
         x_micro = jnp.asarray(x_micro)
         labels_micro = jnp.asarray(labels_micro)
+        scale = jnp.asarray(grad_scale, jnp.float32)
+        self.last_found_inf = False
 
         acts: Dict[Tuple[int, int], Any] = {}      # (vs, m) -> stage input x
         outs: Dict[int, Any] = {}                  # m -> last-stage output y
@@ -170,7 +183,7 @@ class HostPipelineEngine:
             if vs == V - 1:
                 y = outs.pop(m)
                 lab = jax.device_put(labels_micro[m], device)
-                l, gy = self._loss_seed(y, lab)
+                l, gy = self._loss_seed(y, lab, scale)
                 losses[m] = l
                 return gy
             return grad_in.pop((vs, m))
@@ -206,6 +219,14 @@ class HostPipelineEngine:
             with lock:
                 grad_acc[vs].append(gp)
 
+        pending: Dict[int, Any] = {}  # vs -> unscaled total grads (scaler path)
+
+        def _apply(vs, total):
+            st = self.stages[vs]
+            lr = jnp.asarray(self.lr, jnp.float32)
+            st.params, self._opt_state[vs] = self._opt.update(
+                total, self._opt_state[vs], st.params, lr)
+
         def opt(rank, m, chunk):
             for c in range(self.n_chunks):
                 vs = _vs(rank, c)
@@ -214,15 +235,33 @@ class HostPipelineEngine:
                 total = gs[0]
                 for g in gs[1:]:
                     total = jax.tree.map(jnp.add, total, g)
-                st = self.stages[vs]
-                lr = jnp.asarray(self.lr, jnp.float32)
-                st.params, self._opt_state[vs] = self._opt.update(
-                    total, self._opt_state[vs], st.params, lr)
+                if grad_scale != 1.0:
+                    total = jax.tree.map(
+                        lambda g: g * jnp.asarray(1.0 / scale, g.dtype), total)
+                if skip_update_if_nonfinite:
+                    # GradScaler semantics: found-inf must gate the WHOLE
+                    # step, so stash and decide after the plan completes.
+                    with lock:
+                        pending[vs] = total
+                else:
+                    _apply(vs, total)
                 grad_acc[vs] = []
 
         handlers = {FORWARD: fwd, BACKWARD: bwd, BACKWARD_B: bwd_b,
                     BACKWARD_W: bwd_w, OPT: opt}
         execute_plan(self.plan, handlers, n_workers=self.n_workers)
+        if skip_update_if_nonfinite:
+            assert len(pending) == V
+            # one fused reduction + host fetch per STAGE (leaves of one stage
+            # share its device; cross-device stacking is not allowed)
+            finite = all(bool(jnp.all(jnp.stack(
+                [jnp.isfinite(l).all() for l in jax.tree.leaves(t)])))
+                for t in pending.values())
+            if finite:
+                for vs, total in pending.items():
+                    _apply(vs, total)
+            else:
+                self.last_found_inf = True
         assert len(losses) == M
         return float(sum(float(losses[m]) for m in range(M)) / M)
 

@@ -1,0 +1,182 @@
+"""fleet.distributed_model pipeline-parallel user API.
+
+Parity oracle: the reference's PP tests train the same model with and
+without the pipeline and assert loss equality
+(test/collective/fleet/hybrid_parallel_pp_layer.py segmentation checks,
+hybrid_parallel_pp_alexnet.py loss parity). Same structure here: the
+PipelineLayer trained through fleet.distributed_model(...).train_batch
+must match an eager full-batch loop exactly (equal-size micro-batches +
+mean loss => identical math).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                          PipelineParallel, SharedLayerDesc)
+
+
+def _strategy(pp=4, accumulate_steps=4, schedule="1F1B"):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": pp}
+    s.pipeline_configs = {"accumulate_steps": accumulate_steps,
+                          "micro_batch_size": 4, "schedule_mode": schedule}
+    return s
+
+
+def _make_descs(hidden=16, n_blocks=4, n_classes=4):
+    descs = [LayerDesc(nn.Linear, 8, hidden)]
+    for _ in range(n_blocks):
+        descs.append(LayerDesc(nn.GELU))
+        descs.append(LayerDesc(nn.Linear, hidden, hidden))
+    descs.append(LayerDesc(nn.Linear, hidden, n_classes))
+    return descs
+
+
+class TestPipelineLayer:
+    def test_segmentation_uniform(self):
+        paddle.seed(0)
+        pl = PipelineLayer(_make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        # 10 layers over 2 stages -> 5 + 5
+        assert pl.segment_bounds == [0, 5, 10]
+        assert pl.get_stage_from_index(0) == 0
+        assert pl.get_stage_from_index(4) == 0
+        assert pl.get_stage_from_index(5) == 1
+        assert pl.get_stage_from_index(9) == 1
+
+    def test_segmentation_by_layer_name(self):
+        paddle.seed(0)
+        pl = PipelineLayer(_make_descs(n_blocks=5), num_stages=3,
+                           seg_method="layer:Linear",
+                           loss_fn=nn.CrossEntropyLoss())
+        bounds = pl.segment_bounds
+        assert bounds[0] == 0 and bounds[-1] == 12
+        # every stage starts at a Linear layer
+        for b in bounds[1:-1]:
+            assert type(pl.run_function[b]).__name__ == "Linear"
+
+    def test_virtual_stages(self):
+        paddle.seed(0)
+        pl = PipelineLayer(_make_descs(n_blocks=3), num_stages=2,
+                           num_virtual_pipeline_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        assert pl.get_num_virtual_stages() == 2
+        assert len(pl.segment_bounds) == 5  # 4 parts
+        # interleave: part p runs on stage p % num_stages
+        assert pl.get_stage_from_index(0) == 0
+        last = len(pl.run_function) - 1
+        assert pl.get_stage_from_index(last) == 1
+
+    def test_forward_matches_plain_chain(self):
+        paddle.seed(0)
+        pl = PipelineLayer(_make_descs(), num_stages=2,
+                           loss_fn=nn.CrossEntropyLoss())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+        out = pl(x)
+        ref = x
+        for l in pl.run_function:
+            ref = l(ref)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+    def test_shared_desc_same_stage_ok_cross_stage_raises(self):
+        paddle.seed(0)
+        ok = [SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+              LayerDesc(nn.GELU),
+              LayerDesc(nn.Linear, 8, 4), LayerDesc(nn.GELU)]
+        PipelineLayer(ok, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+        bad = [SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+               LayerDesc(nn.GELU),
+               SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 4),
+               LayerDesc(nn.GELU)]
+        with pytest.raises(NotImplementedError):
+            PipelineLayer(bad, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+
+
+def _train_parity(schedule, pp=4, nvpp=None, steps=3):
+    """fleet PP train_batch vs eager full-batch loop on an identical model."""
+    paddle.seed(0)
+    loss_fn = nn.CrossEntropyLoss()
+    pl = PipelineLayer(_make_descs(), num_stages=pp, loss_fn=loss_fn,
+                       num_virtual_pipeline_stages=nvpp)
+
+    # eager twin with identical weights
+    paddle.seed(0)
+    twin = PipelineLayer(_make_descs(), num_stages=pp, loss_fn=loss_fn,
+                         num_virtual_pipeline_stages=nvpp)
+    twin.set_state_dict(pl.state_dict())
+
+    strategy = _strategy(pp=pp, accumulate_steps=4, schedule=schedule)
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(pl)
+    assert isinstance(model, PipelineParallel)
+    opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+    opt = fleet.distributed_optimizer(opt, strategy)
+
+    opt_t = paddle.optimizer.SGD(0.1, parameters=twin.parameters())
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype("float32")
+    y = rng.randint(0, 4, 16).astype("int64")
+
+    pp_losses, eager_losses = [], []
+    for _ in range(steps):
+        loss = model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+        pp_losses.append(float(loss))
+
+        l = loss_fn(twin(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l.backward()
+        opt_t.step()
+        opt_t.clear_grad()
+        eager_losses.append(float(l))
+
+    np.testing.assert_allclose(pp_losses, eager_losses, rtol=1e-4, atol=1e-5)
+    # weights must have been written back into the user's model
+    for (ka, va), (kb, vb) in zip(sorted(pl.state_dict().items()),
+                                  sorted(twin.state_dict().items())):
+        np.testing.assert_allclose(va.numpy(), vb.numpy(), rtol=1e-3, atol=1e-4)
+
+
+class TestPipelineParallelTrainBatch:
+    def test_1f1b_loss_parity(self):
+        _train_parity("1F1B")
+
+    def test_fthenb_loss_parity(self):
+        _train_parity("FThenB", pp=2)
+
+    def test_zero_bubble_loss_parity(self):
+        _train_parity("ZBH1", pp=2)
+
+    def test_vpp_loss_parity(self):
+        _train_parity("VPP", pp=2, nvpp=2)
+
+    def test_grad_scaler_path(self):
+        paddle.seed(0)
+        loss_fn = nn.CrossEntropyLoss()
+        pl = PipelineLayer(_make_descs(), num_stages=2, loss_fn=loss_fn)
+        strategy = _strategy(pp=2, accumulate_steps=2)
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(pl)
+        opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+        scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=1024.0)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 8).astype("float32")
+        y = rng.randint(0, 4, 8).astype("int64")
+        before = {k: v.numpy().copy() for k, v in pl.state_dict().items()}
+        loss = model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                                 opt, scaler=scaler)
+        assert np.isfinite(float(loss))
+        assert scaler._good_steps == 1  # finite grads -> counted good step
+        changed = any(not np.allclose(before[k], v.numpy())
+                      for k, v in pl.state_dict().items())
+        assert changed
+
+    def test_non_pipeline_layer_rejected(self):
+        strategy = _strategy(pp=2)
+        fleet.init(is_collective=True, strategy=strategy)
+        with pytest.raises(TypeError):
+            fleet.distributed_model(nn.Linear(4, 4))

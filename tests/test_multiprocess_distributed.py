@@ -118,6 +118,69 @@ open(os.path.join(os.getcwd(), f"ok{rank}"), "w").write("1")
     assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()
 
 
+def test_multiprocess_pipeline_parallel(tmp_path):
+    """fleet.distributed_model with pp_degree=2 across 2 REAL processes:
+    each process owns one stage; inter-stage edges are compiled shift
+    collectives. Loss parity vs a single-process eager replica."""
+    body = """
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
+
+def make_descs():
+    return [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.GELU),
+            LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 4)]
+
+paddle.seed(0)
+pl = PipelineLayer(make_descs(), num_stages=2, loss_fn=nn.CrossEntropyLoss())
+
+s = fleet.DistributedStrategy()
+s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+s.pipeline_configs = {"accumulate_steps": 2, "schedule_mode": "FThenB"}
+fleet.init(is_collective=True, strategy=s)
+model = fleet.distributed_model(pl)
+assert isinstance(model, PipelineParallel), type(model)
+opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+
+rng = np.random.RandomState(0)
+x = rng.randn(8, 8).astype(np.float32)
+y = rng.randint(0, 4, 8).astype(np.int64)
+losses = []
+for _ in range(3):
+    losses.append(float(model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)))
+
+if rank == 0:
+    import json
+    open(os.path.join(os.getcwd(), "pp_losses.json"), "w").write(json.dumps(losses))
+"""
+    _launch(tmp_path, body)
+    got = json.loads((tmp_path / "pp_losses.json").read_text())
+
+    # single-process eager replica
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    paddle.seed(0)
+    pl = PipelineLayer(
+        [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.GELU),
+         LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.int64)
+    ref = []
+    for _ in range(3):
+        l = loss_fn(pl(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+        ref.append(float(l))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
 def test_multiprocess_dp_loss_parity(tmp_path):
     """2-process data-parallel training must produce the same losses as the
     single-process full-batch replica (the reference's core parallelism
