@@ -30,7 +30,7 @@ def _v5e_peak_flops():
 
 
 def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
-                shard_opt=False):
+                shard_opt=False, report_hbm=False):
     from paddle_tpu.distributed.engine import ShardedTrainStep
     from paddle_tpu.distributed.mesh import ProcessMesh
     from paddle_tpu.models import LlamaForCausalLM, llama_pretrain_loss
@@ -71,7 +71,7 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
     flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size
     mfu = (tokens_per_sec * flops_per_token / (_v5e_peak_flops() * max(n_dev, 1))
            if on_tpu else None)
-    return {
+    out = {
         "tokens_per_sec_per_chip": round(tokens_per_sec / max(n_dev, 1), 2),
         "params_m": round(n_params / 1e6, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
@@ -79,6 +79,21 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
         "batch": batch, "seq": seq,
         "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
     }
+    if remat:
+        out["remat"] = remat if isinstance(remat, str) else "full"
+    if report_hbm:
+        # per-program HBM breakdown from XLA (args ≈ params+opt state,
+        # temps ≈ activations); device memory_stats is process-cumulative
+        # (and absent on some PJRT transports), so the compiled-program
+        # analysis is the per-config number
+        try:
+            ma = step.memory_analysis(ids, labels)
+            if ma and ma.get("temp_bytes") is not None:
+                out["hbm_args_gb"] = round((ma["argument_bytes"] or 0) / 2**30, 2)
+                out["hbm_temps_gb"] = round(ma["temp_bytes"] / 2**30, 2)
+        except Exception:
+            pass
+    return out
 
 
 def main():
@@ -101,7 +116,9 @@ def main():
     detail = {"backend": backend, "n_devices": len(jax.devices()), **primary}
 
     if on_tpu:
-        # memory-stressed point: ~0.9B params, remat + sharded opt states
+        # memory-stressed point: ~0.9B params, SELECTIVE remat (save MXU
+        # dot outputs, recompute elementwise — reference recompute modes,
+        # fleet/recompute/recompute.py:124) + sharded opt states
         try:
             big = LlamaConfig(
                 vocab_size=32000, hidden_size=1536, intermediate_size=4096,
@@ -110,9 +127,23 @@ def main():
                 use_flash_attention=True, dtype="bfloat16")
             detail["big_model"] = _run_config(
                 paddle, big, batch=8, seq=1024, steps=5, warmup=2,
-                remat=True, shard_opt=True)
+                remat="dots_with_no_batch_dims_saveable", shard_opt=True,
+                report_hbm=True)
         except Exception as e:  # noqa: BLE001 — degrade to the primary point
             detail["big_model_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # long-sequence point: seq 4096 where the Pallas flash-attention
+        # kernel's advantage over XLA dense is largest (1.9-2.3x microbench)
+        try:
+            long_cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                num_hidden_layers=12, num_attention_heads=12,
+                num_key_value_heads=12, max_position_embeddings=4096,
+                use_flash_attention=True, dtype="bfloat16")
+            detail["seq4096"] = _run_config(
+                paddle, long_cfg, batch=4, seq=4096, steps=10, warmup=2)
+        except Exception as e:  # noqa: BLE001
+            detail["seq4096_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",

@@ -59,7 +59,8 @@ class ShardedTrainStep:
     def __init__(self, model, loss_fn: Callable, optimizer, mesh: ProcessMesh,
                  dp_axis: str = "dp", batch_spec: Optional[Sequence] = None,
                  label_spec: Optional[Sequence] = None, grad_clip_norm: Optional[float] = None,
-                 shard_optimizer_states: bool = False, remat: bool = False,
+                 shard_optimizer_states: bool = False,
+                 remat: "bool | str" = False,
                  donate: bool = True):
         self.model = model
         self.loss_fn = loss_fn
@@ -71,6 +72,13 @@ class ShardedTrainStep:
         if grad_clip_norm is None and getattr(optimizer, "_grad_clip", None) is not None:
             clip = optimizer._grad_clip
             self.grad_clip_norm = getattr(clip, "clip_norm", None)
+        if isinstance(remat, str):
+            import jax as _jax
+            if not hasattr(_jax.checkpoint_policies, remat):
+                raise ValueError(
+                    f"unknown remat policy {remat!r}; valid: nothing_saveable, "
+                    "everything_saveable, dots_saveable, "
+                    "dots_with_no_batch_dims_saveable")
         self._remat = remat
 
         self._param_objs: Dict[str, Parameter] = model.named_parameters_dict()
@@ -134,7 +142,15 @@ class ShardedTrainStep:
                 return loss._data if isinstance(loss, Tensor) else loss
 
             if self._remat:
-                run = jax.checkpoint(run)
+                if isinstance(self._remat, str):
+                    # selective policy (reference recompute.py:124 'mode'):
+                    # e.g. 'dots_saveable' keeps MXU outputs and recomputes
+                    # only elementwise — recovers most of blanket-remat's
+                    # MFU loss while bounding activation memory
+                    from .fleet.recompute import remat as _remat_policy
+                    run = _remat_policy(run, policy=self._remat)
+                else:
+                    run = jax.checkpoint(run)
             return run(params)
 
         def step(params, opt_state, lr, inputs, labels):
@@ -151,8 +167,9 @@ class ShardedTrainStep:
         self._step_fn = jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
-    def step(self, inputs, labels) -> Tensor:
-        """One optimizer step. inputs/labels: Tensor or tuple of Tensors."""
+    def _stage_batch(self, inputs, labels):
+        """Normalize + device_put one batch with the engine's data specs;
+        lazily builds the compiled step."""
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
 
@@ -164,6 +181,11 @@ class ShardedTrainStep:
         lab_datas = tuple(put(y, self._label_spec) for y in labels)
         if self._step_fn is None:
             self._build()
+        return in_datas, lab_datas
+
+    def step(self, inputs, labels) -> Tensor:
+        """One optimizer step. inputs/labels: Tensor or tuple of Tensors."""
+        in_datas, lab_datas = self._stage_batch(inputs, labels)
         lr = jnp.asarray(self._eager_opt.get_lr(), jnp.float32)
         loss, self.params, self.opt_state = self._step_fn(self.params, self.opt_state, lr,
                                                           in_datas, lab_datas)
@@ -174,6 +196,33 @@ class ShardedTrainStep:
 
     def eval_step(self, inputs, labels=None):
         raise NotImplementedError("use to_static on the model for eval; engine.step is the train path")
+
+    def memory_analysis(self, inputs, labels):
+        """XLA's compiled-program HBM breakdown for the train step (device
+        memory_stats is process-cumulative and unavailable on some PJRT
+        transports). Returns dict of byte sizes: args/outputs/temps/
+        generated_code. Lowers from avals — no device allocation — but the
+        AOT compile does not share jit's dispatch cache, so this costs one
+        extra compile."""
+        in_datas, lab_datas = self._stage_batch(inputs, labels)
+
+        def aval(x):
+            sh = getattr(x, "sharding", None)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        ma = self._step_fn.lower(
+            jax.tree.map(aval, self.params), jax.tree.map(aval, self.opt_state),
+            lr, jax.tree.map(aval, in_datas), jax.tree.map(aval, lab_datas),
+        ).compile().memory_analysis()
+        if ma is None:
+            return None
+        return {
+            "argument_bytes": getattr(ma, "argument_size_in_bytes", None),
+            "output_bytes": getattr(ma, "output_size_in_bytes", None),
+            "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
+            "generated_code_bytes": getattr(ma, "generated_code_size_in_bytes", None),
+        }
 
     # ------------------------------------------------------------------
     def sync_weights_to_model(self):

@@ -216,6 +216,56 @@ class TestShardedTrainStep:
         engine_losses = [float(step.step(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(3)]
         np.testing.assert_allclose(eager_losses, engine_losses, rtol=1e-4, atol=1e-5)
 
+    def test_selective_remat_policies_match_no_remat(self):
+        """remat=False / remat=True / named checkpoint policies must be
+        numerically identical — they trade memory for recompute, not math
+        (reference recompute modes, fleet/recompute/recompute.py:124)."""
+        from paddle_tpu.distributed.engine import ShardedTrainStep
+
+        lossfn = nn.CrossEntropyLoss()
+        x = a(16, 8)
+        y = np.random.RandomState(1).randint(0, 4, 16).astype(np.int64)
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8), ["dp"])
+
+        losses = {}
+        for mode in (False, True, "dots_saveable",
+                     "dots_with_no_batch_dims_saveable"):
+            paddle.seed(0)
+            m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+            opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+            step = ShardedTrainStep(m, lambda o, lab: lossfn(o, lab), opt,
+                                    mesh, remat=mode)
+            losses[str(mode)] = [float(step.step(paddle.to_tensor(x),
+                                                 paddle.to_tensor(y)))
+                                 for _ in range(3)]
+        base = losses["False"]
+        for mode, ls in losses.items():
+            np.testing.assert_allclose(ls, base, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"remat={mode}")
+
+    def test_memory_analysis_reports_sizes(self):
+        from paddle_tpu.distributed.engine import ShardedTrainStep
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        lossfn = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8), ["dp"])
+        step = ShardedTrainStep(m, lambda o, lab: lossfn(o, lab), opt, mesh)
+        x = paddle.to_tensor(a(16, 8))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, 16).astype(np.int64))
+        ma = step.memory_analysis(x, y)
+        # CPU XLA always provides memory analysis
+        assert ma is not None
+        assert set(ma) == {"argument_bytes", "output_bytes", "temp_bytes",
+                           "generated_code_bytes"}
+        assert isinstance(ma["argument_bytes"], int) and ma["argument_bytes"] > 0
+
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="remat policy"):
+            ShardedTrainStep(m, lambda o, lab: lossfn(o, lab), opt, mesh,
+                             remat="dots")
+
     def test_tp_parity(self):
         from paddle_tpu.distributed.engine import ShardedTrainStep
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_pretrain_loss, llama_shard_fn
