@@ -390,3 +390,8 @@ def disable_signal_handler():
 # paddle.cast_ module-level twin (Tensor.cast_ already exists)
 def cast_(x, dtype):
     return x.cast_(dtype)
+
+
+# Populate OP_REGISTRY with the executable schema table (ops.yaml parity).
+# Import last: schemas resolve nothing at import time beyond scipy/numpy.
+from . import schemas as _schemas  # noqa: E402,F401

@@ -1,0 +1,364 @@
+"""Op schema registry: the single source of truth tying op -> argument
+sample spec -> supported dtypes -> differentiability -> numpy oracle.
+
+Parity: the reference's YAML op registry (paddle/phi/ops/yaml/ops.yaml —
+467 forward schemas; backward.yaml — 337 grad schemas) whose entries
+drive test/legacy_test/op_test.py's per-op dtype and gradient checks.
+Here the schema IS executable test metadata: tests/test_op_schema_sweep.py
+enumerates SCHEMAS and runs every op through the dtype sweep
+(fp32 oracle + bf16/fp16 tolerances) and finite-difference grad checks.
+
+Each schema registers into ops.dispatch.OP_REGISTRY at import with the
+light metadata (args/dtypes/has_grad); the heavyweight pieces (samplers,
+numpy references) stay here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dispatch import register_op
+
+FLOAT_SWEEP = ("float32", "bfloat16", "float16")
+INT_SWEEP = ("int32", "int64")
+
+# ---------------------------------------------------------------------------
+# input domains: FD grad checks perturb by ±1e-3, so every domain keeps the
+# op smooth in that neighbourhood
+# ---------------------------------------------------------------------------
+_DOMAINS: Dict[str, Callable] = {
+    "any":    lambda rng, sh: rng.uniform(-2.0, 2.0, sh).astype(np.float32),
+    # fractional part pinned to [0.2, 0.8]: FD-safe for integer-step ops
+    "offint": lambda rng, sh: (rng.randint(-2, 3, sh)
+                               + rng.uniform(0.2, 0.8, sh)).astype(np.float32),
+    # fractional part in [0.2, 0.4]: also away from round()'s .5 steps
+    "offhalf": lambda rng, sh: (rng.randint(-2, 3, sh)
+                                + rng.uniform(0.2, 0.4, sh)).astype(np.float32),
+    "idx3":   lambda rng, sh: rng.randint(0, 3, sh).astype(np.int32),
+    "pos":    lambda rng, sh: rng.uniform(0.5, 2.5, sh).astype(np.float32),
+    "nonzero": lambda rng, sh: rng.uniform(0.5, 2.0, sh).astype(np.float32)
+               * np.where(rng.rand(*sh) > 0.5, 1.0, -1.0).astype(np.float32),
+    "unit":   lambda rng, sh: rng.uniform(-0.9, 0.9, sh).astype(np.float32),
+    "gt1":    lambda rng, sh: rng.uniform(1.1, 3.0, sh).astype(np.float32),
+    "prob":   lambda rng, sh: rng.uniform(0.05, 0.95, sh).astype(np.float32),
+    "small":  lambda rng, sh: rng.uniform(-0.5, 0.5, sh).astype(np.float32),
+    "int":    lambda rng, sh: rng.randint(0, 5, sh).astype(np.int32),
+    "posint": lambda rng, sh: rng.randint(1, 9, sh).astype(np.int32),
+    "bool":   lambda rng, sh: rng.rand(*sh) > 0.5,
+}
+
+
+class OpSchema:
+    """One op's schema. ``inputs`` is a sequence of (shape, domain) pairs;
+    ``api`` is a dotted path under the package root (resolved lazily)."""
+
+    def __init__(self, name: str, api: str, np_ref: Callable,
+                 inputs: Sequence[Tuple[tuple, str]], *,
+                 kwargs: Optional[dict] = None,
+                 dtypes: Tuple[str, ...] = FLOAT_SWEEP,
+                 grad: bool = True,
+                 grad_inputs: Optional[Sequence[int]] = None,
+                 tol: Optional[dict] = None):
+        self.name = name
+        self.api = api
+        self.np_ref = np_ref
+        self.inputs = list(inputs)
+        self.kwargs = kwargs or {}
+        self.dtypes = dtypes
+        self.grad = grad
+        self.grad_inputs = grad_inputs
+        self.tol = tol
+
+    def sample(self, rng) -> list:
+        return [_DOMAINS[dom](rng, sh) for sh, dom in self.inputs]
+
+    def resolve(self):
+        import paddle_tpu as root
+
+        obj = root
+        for part in self.api.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+
+SCHEMAS: Dict[str, OpSchema] = {}
+
+
+def _S(name, np_ref, inputs, api=None, **kw):
+    s = OpSchema(name, api or name, np_ref, inputs, **kw)
+    assert name not in SCHEMAS, f"duplicate schema {name}"
+    SCHEMAS[name] = s
+    register_op(name, args=[d for _, d in s.inputs], dtypes=list(s.dtypes),
+                has_grad=s.grad, kwargs=sorted(s.kwargs))
+    return s
+
+
+# ---------------------------------------------------------------------------
+# unary float ops (reference ops.yaml unary family)
+# ---------------------------------------------------------------------------
+# scipy is a TEST-oracle dependency only: resolve it lazily so importing
+# the package (ops/__init__ imports this module for OP_REGISTRY metadata)
+# never requires scipy — references below call _sp() at test time.
+
+
+class _LazyScipySpecial:
+    def __getattr__(self, item):
+        from scipy import special
+
+        return getattr(special, item)
+
+
+sp = _LazyScipySpecial()
+
+_SH = (3, 4)
+_U = [(_SH, "any")]
+
+
+def _unary(table, domain="any", **kw):
+    for name, ref in table.items():
+        _S(name, ref, [(_SH, domain)], **kw)
+
+
+_unary({"tanh": np.tanh, "sin": np.sin, "cos": np.cos, "atan": np.arctan,
+        "asinh": np.arcsinh, "sinh": np.sinh, "erf": lambda x: sp.erf(x),
+        "neg": np.negative, "square": np.square, "sign": np.sign,
+        "deg2rad": np.deg2rad, "rad2deg": np.rad2deg,
+        "expm1": np.expm1, "sinc": np.sinc,
+        "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+        "abs": np.abs})
+_unary({"exp": np.exp, "exp2": np.exp2}, domain="small")
+_unary({"cosh": np.cosh}, domain="small")
+_unary({"tan": np.tan}, domain="unit")
+_unary({"asin": np.arcsin, "acos": np.arccos, "atanh": np.arctanh,
+        "erfinv": lambda x: sp.erfinv(x)}, domain="unit",
+       tol={"float16": (3e-2, 3e-2), "bfloat16": (8e-2, 8e-2)})
+_unary({"acosh": np.arccosh}, domain="gt1")
+_unary({"sqrt": np.sqrt, "rsqrt": lambda x: 1 / np.sqrt(x),
+        "log": np.log, "log2": np.log2, "log10": np.log10,
+        "log1p": np.log1p, "reciprocal": lambda x: 1 / x,
+        "lgamma": lambda x: sp.gammaln(x), "digamma": lambda x: sp.digamma(x),
+        "i0": lambda x: sp.i0(x), "i0e": lambda x: sp.i0e(x),
+        "i1": lambda x: sp.i1(x), "i1e": lambda x: sp.i1e(x)},
+       domain="pos")
+_unary({"gammaln": lambda x: sp.gammaln(x)}, domain="pos")
+_unary({"logit": lambda x: sp.logit(x)}, domain="prob",
+       tol={"float16": (3e-2, 3e-2), "bfloat16": (8e-2, 8e-2)})
+# integer-step functions: zero analytic grad == zero FD grad off the steps
+_unary({"ceil": np.ceil, "floor": np.floor,
+        "trunc": np.trunc, "frac": lambda x: x - np.trunc(x)},
+       domain="offint")
+_unary({"round": np.round}, domain="offhalf")  # steps at half-integers
+_S("stanh", lambda x: 0.7159 * np.tanh(0.66667 * x), _U,
+   kwargs={"scale_a": 0.66667, "scale_b": 0.7159})
+_S("polygamma", lambda x: sp.polygamma(1, x), [(_SH, "pos")], kwargs={"n": 1})
+_S("multigammaln", lambda x: sp.multigammaln(x, 2) if np.isscalar(x)
+   else np.vectorize(lambda v: sp.multigammaln(v, 2))(x),
+   [(_SH, "gt1")], kwargs={"p": 2})
+_S("nan_to_num", np.nan_to_num, _U)
+_S("scale", lambda x: 2.0 * x + 1.0, _U, kwargs={"scale": 2.0, "bias": 1.0})
+_S("clip", lambda x: np.clip(x, -0.5, 0.5), _U, kwargs={"min": -0.5, "max": 0.5})
+
+# ---------------------------------------------------------------------------
+# binary float ops
+# ---------------------------------------------------------------------------
+_B = [(_SH, "any"), (_SH, "any")]
+for name, ref in {"add": np.add, "subtract": np.subtract,
+                  "multiply": np.multiply, "maximum": np.maximum,
+                  "fmax": np.fmax, "fmin": np.fmin, "minimum": np.minimum,
+                  "atan2": np.arctan2, "hypot": np.hypot,
+                  "logaddexp": np.logaddexp,
+                  "copysign": np.copysign}.items():
+    _S(name, ref, _B)
+_S("divide", np.divide, [(_SH, "any"), (_SH, "nonzero")])
+_S("pow", np.power, [(_SH, "pos"), (_SH, "small")],
+   tol={"float16": (3e-2, 3e-2), "bfloat16": (8e-2, 8e-2)})
+_S("heaviside", np.heaviside, [(_SH, "nonzero"), (_SH, "any")])
+_S("mod", np.mod, [(_SH, "any"), (_SH, "nonzero")], grad_inputs=[0])
+_S("remainder", np.mod, [(_SH, "any"), (_SH, "nonzero")], grad_inputs=[0],
+   api="remainder")
+_S("floor_mod", np.mod, [(_SH, "any"), (_SH, "nonzero")], grad_inputs=[0])
+_S("nextafter", np.nextafter, _B, grad=False,
+   dtypes=("float32",))  # ULP-level op: low precision sweep meaningless
+_S("ldexp", np.ldexp, [(_SH, "any"), (_SH, "int")], grad=False)
+_S("lerp", lambda x, y: x + 0.3 * (y - x), _B, kwargs={"weight": 0.3})
+_S("dist", lambda x, y: np.linalg.norm((x - y).ravel()), _B)
+_S("cross", lambda a, b: np.cross(a, b, axis=-1),
+   [((4, 3), "any"), ((4, 3), "any")], kwargs={"axis": -1})
+_S("kron", np.kron, [((2, 3), "any"), ((3, 2), "any")])
+
+# ---------------------------------------------------------------------------
+# integer / bitwise / logical / comparison (no grad)
+# ---------------------------------------------------------------------------
+_I = [(_SH, "int"), (_SH, "int")]
+for name, ref in {"bitwise_and": np.bitwise_and, "bitwise_or": np.bitwise_or,
+                  "bitwise_xor": np.bitwise_xor}.items():
+    _S(name, ref, _I, dtypes=INT_SWEEP, grad=False)
+_S("bitwise_not", np.bitwise_not, [(_SH, "int")], dtypes=INT_SWEEP, grad=False)
+_S("bitwise_left_shift", np.left_shift, [(_SH, "int"), (_SH, "int")],
+   dtypes=INT_SWEEP, grad=False)
+_S("bitwise_right_shift", np.right_shift, [(_SH, "int"), (_SH, "int")],
+   dtypes=INT_SWEEP, grad=False)
+_S("gcd", np.gcd, _I, dtypes=INT_SWEEP, grad=False)
+_S("lcm", np.lcm, _I, dtypes=INT_SWEEP, grad=False)
+for name, ref in {"logical_and": np.logical_and,
+                  "logical_or": np.logical_or,
+                  "logical_xor": np.logical_xor}.items():
+    _S(name, ref, [(_SH, "bool"), (_SH, "bool")], dtypes=("bool",), grad=False)
+_S("logical_not", np.logical_not, [(_SH, "bool")], dtypes=("bool",), grad=False)
+for name, ref in {"equal": np.equal, "not_equal": np.not_equal,
+                  "greater_than": np.greater, "greater_equal": np.greater_equal,
+                  "less_than": np.less, "less_equal": np.less_equal}.items():
+    # fp32 only: low-precision rounding can collide two distinct values,
+    # flipping the comparison vs the fp32 oracle
+    _S(name, ref, _B, grad=False, dtypes=("float32",))
+for name, ref in {"isfinite": np.isfinite, "isinf": np.isinf,
+                  "isnan": np.isnan, "signbit": np.signbit}.items():
+    _S(name, ref, _U, grad=False)
+_S("isclose", np.isclose, _B, grad=False, dtypes=("float32",))
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+_S("sum", np.sum, _U)
+_S("mean", np.mean, _U)
+_S("prod", np.prod, [(_SH, "pos")],
+   tol={"float16": (3e-2, 3e-2), "bfloat16": (2e-1, 2e-1)})
+_S("max", np.max, _U)
+_S("min", np.min, _U)
+_S("amax", np.amax, _U)
+_S("amin", np.amin, _U)
+_S("std", lambda x: np.std(x, ddof=1), _U)
+_S("var", lambda x: np.var(x, ddof=1), _U)
+_S("logsumexp", lambda x: sp.logsumexp(x), _U)
+_S("nansum", np.nansum, _U)
+_S("nanmean", np.nanmean, _U)
+_S("count_nonzero", np.count_nonzero, [(_SH, "int")], dtypes=INT_SWEEP,
+   grad=False)
+_S("all", np.all, [(_SH, "bool")], dtypes=("bool",), grad=False)
+_S("any", np.any, [(_SH, "bool")], dtypes=("bool",), grad=False)
+_S("trace", np.trace, [((4, 4), "any")])
+_S("l1_norm", lambda x: np.abs(x).sum(), _U)
+_S("squared_l2_norm", lambda x: (x ** 2).sum(), _U)
+_S("p_norm", lambda x: np.linalg.norm(x.ravel(), 2), _U, kwargs={"p": 2})
+_S("median", np.median, [((3, 5), "any")], grad=False)
+_S("nanmedian", np.nanmedian, [((3, 5), "any")], grad=False)
+_S("cumsum", lambda x: np.cumsum(x, axis=0), _U, kwargs={"axis": 0})
+_S("cumprod", lambda x: np.cumprod(x, axis=0), [(_SH, "pos")],
+   kwargs={"dim": 0},
+   tol={"float16": (3e-2, 3e-2), "bfloat16": (2e-1, 2e-1)})
+_S("logcumsumexp", lambda x: np.log(np.cumsum(np.exp(x), axis=0)), _U,
+   kwargs={"axis": 0})
+_S("diff", lambda x: np.diff(x, axis=-1), _U)
+_S("trapezoid", lambda x: np.trapezoid(x, axis=-1), _U)
+_S("cumulative_trapezoid", lambda x: np.array(
+    [np.cumsum((x[..., 1:] + x[..., :-1]) / 2, axis=-1)])[0], _U)
+
+# ---------------------------------------------------------------------------
+# manipulation (linear ops; grads exact)
+# ---------------------------------------------------------------------------
+_S("reshape", lambda x: x.reshape(4, 3), _U, kwargs={"shape": [4, 3]})
+_S("transpose", lambda x: x.transpose(1, 0), _U, kwargs={"perm": [1, 0]})
+_S("t", lambda x: x.T, _U)
+_S("flatten", lambda x: x.reshape(-1), _U)
+_S("squeeze", lambda x: np.squeeze(x, 0), [((1, 3, 4), "any")],
+   kwargs={"axis": 0})
+_S("unsqueeze", lambda x: x[:, None], _U, kwargs={"axis": 1})
+_S("flip", lambda x: np.flip(x, 0), _U, kwargs={"axis": 0})
+_S("roll", lambda x: np.roll(x, 1, 0), _U, kwargs={"shifts": 1, "axis": 0})
+_S("tile", lambda x: np.tile(x, (2, 1)), _U, kwargs={"repeat_times": [2, 1]})
+_S("broadcast_to", lambda x: np.broadcast_to(x, (2, 3, 4)), _U,
+   kwargs={"shape": [2, 3, 4]})
+_S("expand", lambda x: np.broadcast_to(x, (2, 3, 4)), _U,
+   kwargs={"shape": [2, 3, 4]})
+_S("tril", np.tril, [((4, 4), "any")])
+_S("triu", np.triu, [((4, 4), "any")])
+_S("diag", np.diag, [((4,), "any")])
+_S("diagonal", lambda x: np.diagonal(x, 0, 0, 1), [((4, 4), "any")])
+_S("rot90", lambda x: np.rot90(x, 1, (0, 1)), _U)
+_S("moveaxis", lambda x: np.moveaxis(x, 0, 1), _U,
+   kwargs={"source": 0, "destination": 1})
+_S("swapaxes", lambda x: np.swapaxes(x, 0, 1), _U,
+   kwargs={"axis0": 0, "axis1": 1})
+_S("repeat_interleave", lambda x: np.repeat(x, 2, 0), _U,
+   kwargs={"repeats": 2, "axis": 0})
+_S("pad", lambda x: np.pad(x, ((1, 1), (2, 2))), _U,
+   kwargs={"pad": [1, 1, 2, 2]})
+
+# ---------------------------------------------------------------------------
+# matmul family (MXU ops; the bf16 tolerance IS the TPU numerics contract)
+# ---------------------------------------------------------------------------
+_MM_TOL = {"float16": (2e-2, 2e-2), "bfloat16": (1e-1, 1e-1)}
+_S("matmul", np.matmul, [((3, 4), "any"), ((4, 5), "any")], tol=_MM_TOL)
+_S("mm", np.matmul, [((3, 4), "any"), ((4, 5), "any")], tol=_MM_TOL)
+_S("bmm", np.matmul, [((2, 3, 4), "any"), ((2, 4, 5), "any")], tol=_MM_TOL)
+_S("mv", np.matmul, [((3, 4), "any"), ((4,), "any")], tol=_MM_TOL)
+_S("dot", lambda x, y: np.array((x * y).sum()), [((6,), "any"), ((6,), "any")],
+   tol=_MM_TOL)
+_S("inner", np.inner, [((3, 4), "any"), ((5, 4), "any")], tol=_MM_TOL)
+_S("outer", np.outer, [((3,), "any"), ((4,), "any")], tol=_MM_TOL)
+_S("addmm", lambda c, a, b: c + a @ b,
+   [((3, 5), "any"), ((3, 4), "any"), ((4, 5), "any")], tol=_MM_TOL)
+_S("cdist", lambda a, b: np.linalg.norm(a[:, None] - b[None], axis=-1),
+   [((3, 4), "any"), ((5, 4), "any")], tol=_MM_TOL)
+_S("tensordot", lambda a, b: np.tensordot(a, b, 1),
+   [((3, 4), "any"), ((4, 5), "any")], kwargs={"axes": 1}, tol=_MM_TOL)
+
+# ---------------------------------------------------------------------------
+# indexed ops
+# ---------------------------------------------------------------------------
+_S("gather", lambda x, i: x[i], [(_SH, "any"), ((2,), "idx3")],
+   grad_inputs=[0])
+_S("index_select", lambda x, i: x[i], [(_SH, "any"), ((2,), "idx3")],
+   kwargs={"axis": 0}, grad_inputs=[0])
+_S("take_along_axis", lambda x, i: np.take_along_axis(x, i, 0),
+   [(_SH, "any"), ((2, 4), "idx3")], kwargs={"axis": 0}, grad_inputs=[0])
+_S("index_sample", lambda x, i: np.take_along_axis(x, i, 1),
+   [(_SH, "any"), ((3, 2), "idx3")], grad_inputs=[0])
+
+# activations under nn.functional (the hot fused-elementwise family)
+_S("relu", lambda x: np.maximum(x, 0), _U, api="nn.functional.relu")
+_S("gelu", lambda x: x * 0.5 * (1 + sp.erf(x / np.sqrt(2))), _U,
+   api="nn.functional.gelu",
+   tol={"float16": (3e-2, 3e-2), "bfloat16": (8e-2, 8e-2)})
+_S("silu", lambda x: x / (1 + np.exp(-x)), _U, api="nn.functional.silu")
+_S("softplus", lambda x: np.log1p(np.exp(x)), _U, api="nn.functional.softplus")
+_S("softsign", lambda x: x / (1 + np.abs(x)), _U, api="nn.functional.softsign")
+_S("elu", lambda x: np.where(x > 0, x, np.exp(x) - 1), _U,
+   api="nn.functional.elu")
+_S("selu", lambda x: 1.0507009873554805 * np.where(
+    x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)), _U,
+   api="nn.functional.selu")
+_S("leaky_relu", lambda x: np.where(x > 0, x, 0.01 * x), _U,
+   api="nn.functional.leaky_relu")
+_S("hardtanh", lambda x: np.clip(x, -1, 1), [(_SH, "offint")],
+   api="nn.functional.hardtanh")
+_S("hardsigmoid", lambda x: np.clip(x / 6 + 0.5, 0, 1), [(_SH, "small")],
+   api="nn.functional.hardsigmoid")
+_S("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6, [(_SH, "small")],
+   api="nn.functional.hardswish")
+_S("mish", lambda x: x * np.tanh(np.log1p(np.exp(x))), _U,
+   api="nn.functional.mish")
+_S("tanhshrink", lambda x: x - np.tanh(x), _U, api="nn.functional.tanhshrink")
+_S("softmax", lambda x: sp.softmax(x, axis=-1), _U,
+   api="nn.functional.softmax")
+_S("log_softmax", lambda x: sp.log_softmax(x, axis=-1), _U,
+   api="nn.functional.log_softmax")
+
+# ---------------------------------------------------------------------------
+# white list: ops excluded from a specific check, with the reason recorded
+# (parity: test/white_list/op_accuracy_white_list.py). Keep < 10% of SCHEMAS.
+# ---------------------------------------------------------------------------
+WHITE_LIST: Dict[str, Dict[str, str]] = {
+    "erfinv": {"grad": "derivative ~ 1/erf'(x) explodes near ±1; FD unstable"},
+    "nextafter": {"sweep": "ULP-level op; only exact fp32 comparison is meaningful"},
+    "i1": {"grad": "scipy FD oracle noisy near 0"},
+    "sinc": {"grad": "removable singularity at 0 makes FD noisy"},
+    "logcumsumexp": {"sweep_low": "exp-space cumsum overflows fp16 quickly"},
+    "multigammaln": {"grad": "vectorized scipy oracle too slow for FD"},
+}
+
+
+def registered_op_names():
+    return sorted(SCHEMAS)
