@@ -41,6 +41,7 @@ class Tensor:
         "_hooks",
         "placements",
         "process_mesh",
+        "_prov",  # auto-shard dataflow provenance (distributed/auto_shard.py)
         "__weakref__",
     )
 

@@ -1,0 +1,193 @@
+"""Automatic SPMD shard propagation: derive Megatron-style tensor-parallel
+placements for an arbitrary Layer with NO hand-written recipe.
+
+Parity: the reference's SPMD rules + auto completion —
+paddle/phi/infermeta/spmd_rules/matmul.h:25 (MatmulInferSpmd derives
+output/partial placements from operand dist attrs) and
+python/paddle/distributed/auto_parallel/static/completion.py (propagates
+dist attrs over the whole program). 56 per-op rule files exist because
+the reference must annotate every op of a static program.
+
+TPU design: GSPMD already does intra-program propagation — the only
+decision XLA cannot make is the PARAMETER layout (which matmuls are
+column- vs row-parallel, which embeddings are vocab-sharded), because
+that is a global, cost-driven choice. So the TPU-form "completion" is a
+dataflow analysis over one eager trace:
+
+1. run the model once on tiny inputs with dispatch provenance ON — every
+   op output carries the set of upstream Linear/Embedding layers it
+   derives from (ops/dispatch.py _propagate_prov);
+2. the provider sets give the matmul dependency graph, residuals and all;
+3. apply the Megatron pairing rule: a Linear consuming any OPEN
+   column-parallel Linear closes the sandwich as row-parallel; otherwise
+   it opens a new sandwich as column-parallel. Parallel branches (q/k/v,
+   gate/up) all open columns and are closed together by their common
+   consumer (o_proj, down_proj). Vocab-sized embeddings shard their row
+   dim; the final projection back to vocab size shards its column dim.
+
+Sharding is applied only when the dim divides the mesh axis; everything
+else replicates. GSPMD inserts the same collectives the reference's
+ColumnParallelLinear/RowParallelLinear would issue.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers_common import Embedding, Linear
+from ..ops import dispatch as _dispatch
+from .mesh import ProcessMesh, Replicate, Shard
+
+__all__ = ["derive_placements", "auto_shard_layer"]
+
+# an embedding whose row count is at least this multiple of its feature
+# dim is treated as a vocabulary (positional tables stay replicated)
+_VOCAB_RATIO = 4
+
+
+class _Trace:
+    """One leaf-layer application observed during the provenance run."""
+
+    def __init__(self, name: str, layer: Layer, providers: frozenset):
+        self.name = name
+        self.layer = layer
+        self.providers = providers  # names of Linear/Embedding feeding it
+
+
+_trace_counter = [0]
+
+
+def _trace_leaves(model: Layer, sample_inputs: Sequence) -> List[_Trace]:
+    """Run one eager forward with provenance propagation and record, for
+    each Linear/Embedding application, which earlier leaves feed it.
+
+    Provenance entries are (trace_id, name) tuples: a fresh id per trace
+    means stale ``_prov`` sets surviving on tensors from an earlier trace
+    can never alias this trace's leaf names."""
+    from ..core.autograd import no_grad
+
+    _trace_counter[0] += 1
+    tid = _trace_counter[0]
+    traces: List[_Trace] = []
+    hooks = []
+
+    def make_hook(lname):
+        def post_hook(layer, inputs, output):
+            prov = set()
+            for t in inputs:
+                if isinstance(t, Tensor):
+                    prov |= {n for (i, n) in (getattr(t, "_prov", None) or ())
+                             if i == tid}
+            traces.append(_Trace(lname, layer, frozenset(prov)))
+            outs = output if isinstance(output, (tuple, list)) else (output,)
+            for o in outs:
+                if isinstance(o, Tensor):
+                    o._prov = frozenset({(tid, lname)})  # provenance resets here
+            return output
+
+        return post_hook
+
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Embedding)):
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+
+    prev = _dispatch._prov_enabled[0]
+    _dispatch._prov_enabled[0] = True
+    try:
+        with no_grad():
+            model(*sample_inputs)
+    finally:
+        _dispatch._prov_enabled[0] = prev
+        for h in hooks:
+            h.remove()
+    return traces
+
+
+def derive_placements(model: Layer, mesh: ProcessMesh,
+                      sample_inputs: Sequence, mp_axis: str = "mp",
+                      ) -> Dict[str, list]:
+    """Returns {sublayer_name: per-param placements dict} — 'weight' ->
+    placements list, 'bias' -> placements list — for every Linear and
+    Embedding the trace reaches."""
+    if mp_axis not in mesh.dim_names:
+        return {}
+    mp_idx = mesh.dim_names.index(mp_axis)
+    mp_size = mesh.shape[mp_idx]
+    if mp_size == 1:
+        return {}
+
+    traces = _trace_leaves(model, sample_inputs)
+
+    def repl():
+        return [Replicate()] * mesh.ndim
+
+    def shard(dim):
+        pl = repl()
+        pl[mp_idx] = Shard(dim)
+        return pl
+
+    decisions: Dict[str, Dict[str, list]] = {}
+    open_cols: set = set()  # column-parallel linears awaiting their row
+
+    for tr in traces:
+        if isinstance(tr.layer, Embedding):
+            if tr.name in decisions:
+                continue  # shared/tied embedding: first decision stands
+            n, d = tr.layer.weight.shape
+            if n >= _VOCAB_RATIO * d and n % mp_size == 0:
+                decisions[tr.name] = {"weight": shard(0)}  # vocab rows
+            else:
+                decisions[tr.name] = {"weight": repl()}
+            continue
+
+        # Linear: weight [in, out]. Self-edges (a tied layer reused later
+        # in the chain) never close their own sandwich.
+        w_in, w_out = tr.layer.weight.shape
+        consumed = (tr.providers & open_cols) - {tr.name}
+        if tr.name in decisions:
+            # shared/tied Linear applied again: keep the first decision but
+            # still close any columns this application consumes
+            open_cols -= consumed
+            continue
+        if consumed and w_in % mp_size == 0:
+            # closes the sandwich: row-parallel (contract over the
+            # sharded dim; GSPMD inserts the psum the reference's
+            # RowParallelLinear issues)
+            decisions[tr.name] = {"weight": shard(0), "bias": repl()}
+            open_cols -= consumed
+        elif w_out % mp_size == 0:
+            # opens a sandwich: column-parallel
+            decisions[tr.name] = {"weight": shard(1), "bias": shard(0)}
+            open_cols.add(tr.name)
+        else:
+            decisions[tr.name] = {"weight": repl(), "bias": repl()}
+
+    # a column whose row never arrived (e.g. the final lm_head) is fine:
+    # GSPMD all_gathers its output — that IS the reference's
+    # ColumnParallelLinear(gather_output=True) ending.
+    return decisions
+
+
+def auto_shard_layer(model: Layer, mesh: ProcessMesh, sample_inputs: Sequence,
+                     mp_axis: str = "mp") -> Dict[str, list]:
+    """shard_layer with a DERIVED recipe (reference shard_layer needs a
+    user shard_fn; here the completion pass provides it). Returns the
+    decision table for inspection/testing."""
+    from .api import shard_layer, shard_tensor
+
+    decisions = derive_placements(model, mesh, sample_inputs, mp_axis)
+
+    def derived_shard_fn(name, sub, m):
+        per_param = decisions.get(name)
+        if per_param is None:
+            return
+        for pname, p in list(sub._parameters.items()):
+            if p is None:
+                continue
+            placements = per_param.get(pname) or [Replicate()] * m.ndim
+            sub._parameters[pname] = shard_tensor(p, m, placements)
+
+    shard_layer(model, mesh, shard_fn=derived_shard_fn)
+    return decisions

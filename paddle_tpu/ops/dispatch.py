@@ -47,6 +47,25 @@ _op_stats: Optional[dict] = None
 # Op registry for introspection/testing (parity: phi/ops/yaml/ops.yaml registry role).
 OP_REGISTRY: dict = {}
 
+# Dataflow provenance mode (distributed/auto_shard.py): while enabled,
+# every op output carries the union of its inputs' ``_prov`` sets — the
+# TPU-form analogue of the reference's dist-attr propagation over a
+# program (auto_parallel/static/completion.py).
+_prov_enabled = [False]
+
+
+def _propagate_prov(tensors, outs):
+    # provenance sets are immutable and SHARED between tensors: the common
+    # case (single provider chain) costs one attribute write, no copies
+    acc = None
+    for t in tensors:
+        p = getattr(t, "_prov", None)
+        if p:
+            acc = p if acc is None or acc is p else (acc | p)
+    if acc:
+        for o in outs:
+            o._prov = acc
+
 
 def register_op(name: str, **meta):
     OP_REGISTRY[name] = meta
@@ -289,6 +308,8 @@ def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[in
 
     if not record:
         outs = [Tensor(d, stop_gradient=True) for d in outs_data]
+        if _prov_enabled[0]:
+            _propagate_prov(tensors, outs)
         return outs if multi else outs[0]
 
     edges: List[Edge] = []
@@ -320,6 +341,8 @@ def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[in
             t._grad_node = node
             t._out_slot = i
         outs.append(t)
+    if _prov_enabled[0]:
+        _propagate_prov(tensors, outs)
     return outs if multi else outs[0]
 
 
