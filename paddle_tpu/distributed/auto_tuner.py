@@ -215,9 +215,10 @@ class AutoTuner:
             return None
         return self._cands[self._cur]
 
-    def record(self, cand: Candidate, metric: float):
+    def record(self, cand: Candidate, metric: Optional[float]):
         cand.metric = metric
-        self.history.append(cand)
+        if cand not in self.history:
+            self.history.append(cand)
 
     def pick(self) -> Optional[Candidate]:
         """Best candidate by the roofline cost model (no measured runs) —
@@ -227,6 +228,89 @@ class AutoTuner:
     def best(self) -> Optional[Candidate]:
         done = [c for c in self.history if c.metric is not None]
         return max(done, key=lambda c: c.metric) if done else None
+
+    def run(self, top_k: int = 3, steps: int = 3, warmup: int = 1,
+            platform: str = "cpu", log_dir: Optional[str] = None,
+            timeout: int = 300) -> Optional[Candidate]:
+        """MEASURED mode (parity: auto_tuner/tuner.py:21 run loop): launch
+        the top-K estimate-ranked candidates as REAL jobs through the
+        launch CLI, record measured tokens/sec into the recorder, and
+        return the measured-best.
+
+        Measured scope is dp/mp/sharding candidates (pp throughput is
+        dominated by the bubble term the roofline already models; the
+        executed-schedule engine benches pp separately). platform="cpu"
+        gives each job a virtual world_size-device mesh — CI mode; on a
+        real slice pass platform=None."""
+        import os
+        import shutil
+        import signal
+        import subprocess
+        import sys
+        import tempfile
+
+        import paddle_tpu.distributed.auto_tuner_worker as worker_mod
+
+        # re-entrant: candidates already measured in a prior run() keep
+        # their metric and are not re-launched (no duplicate history rows)
+        cands = [c for c in self._cands
+                 if c.pp_degree == 1 and c not in self.history][:top_k]
+        if not cands:
+            return self.best()
+        own_workdir = log_dir is None
+        workdir = log_dir or tempfile.mkdtemp(prefix="autotuner_")
+        os.makedirs(workdir, exist_ok=True)
+        worker = worker_mod.__file__
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(worker))))
+
+        for i, cand in enumerate(cands):
+            cfg_path = os.path.join(workdir, f"cand{i}.json")
+            out_path = os.path.join(workdir, f"out{i}.json")
+            with open(cfg_path, "w") as f:
+                json.dump({
+                    "candidate": cand.to_dict(), "model_cfg": self.model_cfg,
+                    "world_size": self.world_size, "steps": steps,
+                    "warmup": warmup, "platform": platform,
+                }, f)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            if platform == "cpu":
+                env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                    + f" --xla_force_host_platform_device_count={self.world_size}")
+            # own session: on timeout we must kill the PROCESS GROUP, or
+            # the launcher's Popen'd worker survives the launcher's SIGKILL
+            # and keeps burning devices under later candidates
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node", "1",
+                 "--log_dir", os.path.join(workdir, f"logs{i}"),
+                 worker, "--config", cfg_path, "--out", out_path],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, start_new_session=True)
+            try:
+                _, stderr = proc.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait()
+                sys.stderr.write(f"[auto_tuner] candidate {i} timed out\n")
+                self.record(cand, None)
+                continue
+            if proc.returncode != 0 or not os.path.exists(out_path):
+                sys.stderr.write(
+                    f"[auto_tuner] candidate {i} failed (rc={proc.returncode}):\n"
+                    + (stderr or "")[-2000:] + "\n")
+                self.record(cand, None)
+                continue
+            with open(out_path) as f:
+                result = json.load(f)
+            self.record(cand, float(result["ips"]))
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return self.best()
 
     def save_history(self, path: str):
         with open(path, "w") as f:

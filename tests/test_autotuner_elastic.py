@@ -78,6 +78,46 @@ class TestAutoTuner:
         assert sharded < base
 
 
+class TestAutoTunerMeasuredMode:
+    def test_run_launches_real_jobs_and_ranks_by_measurement(self, tmp_path):
+        """Parity: auto_tuner/tuner.py:21 — candidates are launched as
+        real processes (through the launch CLI), measured ips lands in
+        the recorder, and best() is the measured argmax, not the
+        estimate argmax."""
+        from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+        cfg = {
+            "world_size": 2,
+            "dp_degree": "auto",
+            "mp_degree": "auto",
+            "pp_degree": [1],
+            "sharding_degree": [1],
+            "sharding_stage": [1],
+            "micro_batch_size": [1],
+            "use_recompute": [False],
+            "num_attention_heads": 4,
+            "num_layers": 2,
+            "global_batch_size": 4,
+            "model_cfg": {"hidden_size": 64, "num_layers": 2,
+                          "vocab_size": 256, "seq_length": 32,
+                          "num_attention_heads": 4, "intermediate_size": 128,
+                          "global_batch_size": 4},
+            "hbm_gb": 95.0,
+        }
+        tuner = AutoTuner(cfg)
+        assert len(tuner.candidates) >= 2  # dp2 and mp2 at least
+        best = tuner.run(top_k=2, steps=2, warmup=1,
+                         log_dir=str(tmp_path), timeout=280)
+        assert best is not None, [c.to_dict() for c in tuner.history]
+        measured = [c for c in tuner.history if c.metric is not None]
+        assert len(measured) >= 2, "fewer than 2 candidates produced metrics"
+        # best is the measured argmax (the recorder drives the pick)
+        assert best.metric == max(c.metric for c in measured)
+        # real subprocess jobs ran through the launcher
+        import os
+        assert os.path.isdir(str(tmp_path / "logs0"))
+
+
 class TestElastic:
     def test_membership_and_leave_detection(self):
         store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
