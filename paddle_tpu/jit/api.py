@@ -59,14 +59,32 @@ class StaticFunction:
         self._sot = None  # set on first graph break (SOT-lite fallback)
         functools.update_wrapper(self, fn, updated=[])
 
+        # compiled control flow (reference: dy2static AST transformers):
+        # simple tensor-valued while/if lower to lax.while_loop/lax.cond so
+        # ONE program covers all iteration counts; SOT-lite stays the
+        # fallback for whatever the pass declines
+        traced_fn = fn
+        try:
+            from .ast_transform import transform_control_flow
+
+            transformed = transform_control_flow(fn)
+        except Exception:
+            transformed = None
+        if transformed is not None:
+            traced_fn = transformed
+        self.uses_compiled_control_flow = transformed is not None
+        self._donate_argnums = donate_argnums
+        self._jitted = self._build_jitted(traced_fn)
+
+    def _build_jitted(self, traced_fn):
         def runner(*datas, **kw):
             with _TraceScope(), no_grad():
                 args = jax.tree.map(_wrap_in, datas, is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
                 kwargs = jax.tree.map(_wrap_in, kw, is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
-                out = fn(*args, **kwargs)
+                out = traced_fn(*args, **kwargs)
                 return jax.tree.map(_unwrap_out, out, is_leaf=lambda x: isinstance(x, Tensor))
 
-        self._jitted = jax.jit(runner, donate_argnums=donate_argnums)
+        return jax.jit(runner, donate_argnums=self._donate_argnums)
 
     def __call__(self, *args, **kwargs):
         datas = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x, args,
@@ -87,6 +105,16 @@ class StaticFunction:
                 from .sot_lite import SotFunction
 
                 self._sot = SotFunction(self._fn, _wrap_in, _unwrap_out)
+            except Exception:
+                if not self.uses_compiled_control_flow:
+                    raise
+                # the control-flow rewrite produced something lax cannot
+                # express (shape-changing carry, non-array state): retry on
+                # the ORIGINAL function, whose own failure modes route to
+                # SOT-lite as before
+                self.uses_compiled_control_flow = False
+                self._jitted = self._build_jitted(self._fn)
+                return self(*args, **kwargs)
         out = self._sot(*datas, **kw)
         return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
 

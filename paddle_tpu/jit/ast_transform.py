@@ -1,0 +1,372 @@
+"""Compiled control flow for dy2static: AST-transform simple ``while``/
+``if`` statements into lax.while_loop / lax.cond.
+
+Parity: python/paddle/jit/dy2static/transformers/loop_transformer.py and
+ifelse_transformer.py — the reference rewrites tensor control flow into
+IR while_op/cond_op so one static program covers all paths. Here the
+rewrite targets XLA's structured control flow: a transformed loop
+compiles to ONE program regardless of iteration count, instead of
+SOT-lite's per-outcome path specialization (jit/sot_lite.py remains the
+fallback for everything this pass cannot express).
+
+Mechanics: ``while test: body`` becomes
+
+    __pt_st = (v1, ..., vn)              # vars assigned in body
+    def __pt_cond(s): v... = s; return test
+    def __pt_body(s): v... = s; body; return (v...)
+    __pt_st = __pt_while__(cond, body, __pt_st)
+    (v1, ..., vn) = __pt_st
+
+``__pt_while__`` dispatches at RUNTIME: a traced predicate runs
+lax.while_loop; a concrete Python predicate runs the ordinary loop —
+so the transform is semantics-preserving for plain-Python control flow.
+
+A statement is transformed only when it is statically safe: no
+break/continue/return inside, and every assigned variable is already
+bound earlier in the function (so the state tuple is well-defined).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["transform_control_flow"]
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (injected as __pt_while__ / __pt_if__)
+# ---------------------------------------------------------------------------
+
+def _unwrap(v):
+    return v._data if isinstance(v, Tensor) else v
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _state_codec(state: tuple):
+    """(to_arr, to_state): strip Tensor wrappers for lax, restore them for
+    user code — wrapper positions recorded once at entry."""
+    flags = [isinstance(v, Tensor) for v in state]
+
+    def to_arr(s):
+        return tuple(_unwrap(v) for v in s)
+
+    def to_state(arrs):
+        return tuple(Tensor(a, stop_gradient=True) if f else a
+                     for f, a in zip(flags, arrs))
+
+    return to_arr, to_state
+
+
+def _pt_while(cond_fn: Callable, body_fn: Callable, state: tuple) -> tuple:
+    state = tuple(state)
+    p0 = _unwrap(cond_fn(state))
+    if not _is_traced(p0):
+        # concrete predicate: ordinary Python loop (identical semantics)
+        while bool(p0):
+            state = tuple(body_fn(state))
+            p0 = _unwrap(cond_fn(state))
+        return state
+
+    from jax import lax
+
+    to_arr, to_state = _state_codec(state)
+
+    def c(arrs):
+        return jnp.asarray(_unwrap(cond_fn(to_state(arrs)))).reshape(())
+
+    def b(arrs):
+        return to_arr(tuple(body_fn(to_state(arrs))))
+
+    out = lax.while_loop(c, b, to_arr(state))
+    return to_state(out)
+
+
+def _pt_if(pred, true_fn: Callable, false_fn: Callable, state: tuple) -> tuple:
+    state = tuple(state)
+    p = _unwrap(pred)
+    if not _is_traced(p):
+        return tuple(true_fn(state)) if bool(p) else tuple(false_fn(state))
+
+    from jax import lax
+
+    to_arr, to_state = _state_codec(state)
+
+    def tf(arrs):
+        return to_arr(tuple(true_fn(to_state(arrs))))
+
+    def ff(arrs):
+        return to_arr(tuple(false_fn(to_state(arrs))))
+
+    out = lax.cond(jnp.asarray(p).reshape(()), tf, ff, to_arr(state))
+    return to_state(out)
+
+
+# ---------------------------------------------------------------------------
+# the AST pass
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
+    """Names bound by the statements; None when a construct we don't
+    rewrite (nested defs, for-loops, with, try, del, star/attr targets)
+    appears."""
+    names: Set[str] = set()
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.For, ast.AsyncFor,
+                                 ast.With, ast.Try, ast.Delete,
+                                 ast.Global, ast.Nonlocal)):
+                return None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+            elif isinstance(node, (ast.Attribute, ast.Subscript)) and \
+                    isinstance(node.ctx, ast.Store):
+                return None  # mutation of containers: state unclear
+    return names
+
+
+def _has_jumps(stmts: List[ast.stmt]) -> bool:
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, (ast.Break, ast.Continue, ast.Return)):
+                return True
+    return False
+
+
+def _loaded_names(expr: ast.expr) -> Set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _definitely_bound(st: ast.stmt) -> Set[str]:
+    """Names bound on EVERY path through ``st`` — branch-only bindings must
+    not count (state tuples read them unconditionally)."""
+    if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        out: Set[str] = set()
+        for t in targets:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                    out.add(node.id)
+        return out
+    if isinstance(st, ast.If):
+        t = set().union(*(_definitely_bound(s) for s in st.body)) \
+            if st.body else set()
+        f = set().union(*(_definitely_bound(s) for s in st.orelse)) \
+            if st.orelse else set()
+        return t & f if st.orelse else set()
+    # loops may run zero times; with/try have exceptional paths — nothing
+    # is definitely bound by them
+    return set()
+
+
+class _Rewriter:
+    def __init__(self, func: ast.FunctionDef):
+        self.func = func
+        self.counter = 0
+        self.applied = 0
+        # names bound before a given lineno (params + prior assignments);
+        # source-order approximation of definedness
+        self.bound: Set[str] = {a.arg for a in func.args.args}
+        self.bound |= {a.arg for a in func.args.kwonlyargs}
+        if func.args.vararg:
+            self.bound.add(func.args.vararg.arg)
+        if func.args.kwarg:
+            self.bound.add(func.args.kwarg.arg)
+
+    def run(self):
+        self.func.body = self._rewrite_block(self.func.body)
+        return self.applied
+
+    def _rewrite_block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for st in stmts:
+            replaced = None
+            if isinstance(st, ast.While) and not st.orelse:
+                replaced = self._try_while(st)
+            elif isinstance(st, ast.If):
+                replaced = self._try_if(st)
+            if replaced is None:
+                # recurse into compound bodies with a scoped bound set,
+                # then record only this statement's DEFINITE bindings —
+                # branch-only names would make a later generated state
+                # tuple read unbound locals
+                saved = set(self.bound)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, attr, None)
+                    if sub:
+                        setattr(st, attr, self._rewrite_block(sub))
+                self.bound = saved | _definitely_bound(st)
+                out.append(st)
+            else:
+                out.extend(replaced)
+        return out
+
+    def _state_vars(self, body_names: Set[str], test: ast.expr) -> List[str]:
+        vars_ = body_names | (_loaded_names(test) & self.bound)
+        return sorted(vars_)
+
+    def _split_temps(self, body: List[ast.stmt], body_names: Set[str],
+                     after_lineno: int) -> Optional[Set[str]]:
+        """Partition body-assigned names: names NOT bound before the block
+        may stay block-local temps iff (a) assigned before first use inside
+        the block and (b) never read after the block (zero-iteration reads
+        would be NameErrors the transform may not introduce). Returns the
+        state-var subset, or None when the block can't be transformed."""
+        temps = body_names - self.bound
+        if not temps:
+            return body_names
+        # (b): loaded later in the function (source order)
+        for node in ast.walk(self.func):
+            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                    and node.id in temps
+                    and getattr(node, "lineno", 0) > after_lineno):
+                return None
+        # (a): within the block, stores must precede loads per temp
+        stored: Set[str] = set()
+        for st in body:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Name) and node.id in temps:
+                    if isinstance(node.ctx, ast.Load) and node.id not in stored:
+                        return None
+                    if isinstance(node.ctx, ast.Store):
+                        stored.add(node.id)
+        return body_names - temps
+
+    def _try_while(self, node: ast.While) -> Optional[List[ast.stmt]]:
+        if _has_jumps(node.body):
+            return None
+        body_names = _assigned_names(node.body)
+        if body_names is None or not body_names:
+            return None
+        body_names = self._split_temps(node.body, body_names,
+                                       getattr(node, "end_lineno", 10**9))
+        if body_names is None or not body_names:
+            return None
+        vars_ = self._state_vars(body_names, node.test)
+        i = self.counter
+        self.counter += 1
+        tup = ", ".join(vars_) + ("," if len(vars_) == 1 else "")
+        src = textwrap.dedent(f"""
+            __pt_st_{i} = ({tup})
+            def __pt_cond_{i}(__pt_s_{i}):
+                ({tup}) = __pt_s_{i}
+                return __PT_TEST__
+            def __pt_body_{i}(__pt_s_{i}):
+                ({tup}) = __pt_s_{i}
+                __PT_BODY__
+                return ({tup})
+            __pt_st_{i} = __pt_while__(__pt_cond_{i}, __pt_body_{i}, __pt_st_{i})
+            ({tup}) = __pt_st_{i}
+        """)
+        block = ast.parse(src).body
+        cond_def, body_def = block[1], block[2]
+        cond_def.body[1] = ast.Return(value=node.test)
+        body_def.body[1:2] = node.body  # replace __PT_BODY__ placeholder
+        self.applied += 1
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in block]
+
+    def _try_if(self, node: ast.If) -> Optional[List[ast.stmt]]:
+        if _has_jumps(node.body) or _has_jumps(node.orelse):
+            return None
+        tnames = _assigned_names(node.body)
+        fnames = _assigned_names(node.orelse) if node.orelse else set()
+        if tnames is None or fnames is None:
+            return None
+        end = getattr(node, "end_lineno", 10**9)
+        tnames = self._split_temps(node.body, tnames, end)
+        fnames = self._split_temps(node.orelse, fnames, end) \
+            if node.orelse else fnames
+        if tnames is None or fnames is None:
+            return None
+        body_names = tnames | fnames
+        if not body_names:
+            return None
+        vars_ = self._state_vars(body_names, node.test)
+        i = self.counter
+        self.counter += 1
+        tup = ", ".join(vars_) + ("," if len(vars_) == 1 else "")
+        src = textwrap.dedent(f"""
+            __pt_st_{i} = ({tup})
+            def __pt_true_{i}(__pt_s_{i}):
+                ({tup}) = __pt_s_{i}
+                __PT_BODY__
+                return ({tup})
+            def __pt_false_{i}(__pt_s_{i}):
+                ({tup}) = __pt_s_{i}
+                __PT_ELSE__
+                return ({tup})
+            __pt_st_{i} = __pt_if__(__PT_TEST__, __pt_true_{i}, __pt_false_{i}, __pt_st_{i})
+            ({tup}) = __pt_st_{i}
+        """)
+        block = ast.parse(src).body
+        true_def, false_def, call_stmt = block[1], block[2], block[3]
+        true_def.body[1:2] = node.body
+        if node.orelse:
+            false_def.body[1:2] = node.orelse
+        else:
+            del false_def.body[1]
+        call_stmt.value.args[0] = node.test
+        self.applied += 1
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in block]
+
+
+def transform_control_flow(fn: Callable) -> Optional[Callable]:
+    """Return a variant of ``fn`` whose simple while/if statements route
+    through __pt_while__/__pt_if__, or None when nothing applies (no
+    source, closures, or no eligible statement)."""
+    if getattr(fn, "__closure__", None):
+        return None  # freevars would be lost on re-exec
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    if not tree.body or not isinstance(tree.body[0], ast.FunctionDef):
+        return None
+    func = tree.body[0]
+    func.decorator_list = []
+    if _rewrite(func) == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static {fn.__name__}>", mode="exec")
+    # live-globals proxy: helpers resolve locally, everything else falls
+    # through to fn's REAL module globals — forward references defined
+    # after decoration and test monkeypatching keep working
+    glb = _GlobalsProxy(fn.__globals__,
+                        {"__pt_while__": _pt_while, "__pt_if__": _pt_if})
+    loc: dict = {}
+    exec(code, glb, loc)
+    new_fn = loc[func.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn
+
+
+class _GlobalsProxy(dict):
+    """exec globals that overlay helper names on a LIVE base dict
+    (CPython consults __missing__ for dict-subclass globals)."""
+
+    def __init__(self, base: dict, extra: dict):
+        super().__init__(extra)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
+
+
+def _rewrite(func: ast.FunctionDef) -> int:
+    return _Rewriter(func).run()

@@ -138,6 +138,7 @@ def _patch_tensor():
         # manipulation
         "reshape": manipulation.reshape, "reshape_": manipulation.reshape_,
         "flatten": manipulation.flatten, "transpose": manipulation.transpose,
+        "t": manipulation.t,
         "squeeze": manipulation.squeeze, "squeeze_": manipulation.squeeze_,
         "unsqueeze": manipulation.unsqueeze, "unsqueeze_": manipulation.unsqueeze_,
         "expand": manipulation.expand, "expand_as": manipulation.expand_as,

@@ -1,0 +1,200 @@
+"""Compiled control flow for dy2static (lax.while_loop / lax.cond).
+
+Parity oracle: the reference's dy2static transformers compile tensor
+while/if into IR control flow so one program serves every path
+(jit/dy2static/transformers/loop_transformer.py, ifelse_transformer.py;
+tests test/dygraph_to_static/test_loop.py). Done-criterion from the
+round-2 verdict: a training-style ``while loss > eps`` loop compiles to
+ONE program — sot_graph_count stays None (no graph break, no
+path-specialization)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.ast_transform import transform_control_flow
+
+
+class TestTransformApplies:
+    def test_while_on_tensor_compiles_one_program(self):
+        def countdown(x):
+            s = paddle.zeros([])
+            while (x > 0).all():
+                s = s + x.sum()
+                x = x - 1
+            return s
+
+        st = paddle.jit.to_static(countdown)
+        assert st.uses_compiled_control_flow
+        # different data -> different iteration counts -> SAME program
+        for start, expect in ((2.0, None), (5.0, None), (1.0, None)):
+            x = paddle.to_tensor(np.full((3,), start, np.float32))
+            out = st(x)
+            # python oracle
+            ref, xx = 0.0, np.full((3,), start, np.float32)
+            while (xx > 0).all():
+                ref += xx.sum()
+                xx = xx - 1
+            np.testing.assert_allclose(float(out), ref, rtol=1e-6)
+        assert st.sot_graph_count is None, "graph break happened"
+
+    def test_training_style_while_loss_gt_eps(self):
+        """The verdict's exact shape: while loss > eps: one more step."""
+
+        def refine(w, x, y):
+            loss = ((x.matmul(w) - y) ** 2).mean()
+            while loss > 0.05:
+                g = 2.0 * x.t().matmul(x.matmul(w) - y) / x.shape[0]
+                w = w - 0.1 * g
+                loss = ((x.matmul(w) - y) ** 2).mean()
+            return w, loss
+
+        st = paddle.jit.to_static(refine)
+        assert st.uses_compiled_control_flow
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype(np.float32)
+        true_w = rng.randn(4, 1).astype(np.float32)
+        y = x @ true_w
+        w0 = np.zeros((4, 1), np.float32)
+        w, loss = st(paddle.to_tensor(w0), paddle.to_tensor(x), paddle.to_tensor(y))
+        assert float(loss) <= 0.05
+        assert st.sot_graph_count is None  # ONE program, zero graph breaks
+
+    def test_if_on_tensor(self):
+        def branchy(x):
+            y = x * 0.0
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        st = paddle.jit.to_static(branchy)
+        assert st.uses_compiled_control_flow
+        pos = np.ones((3,), np.float32)
+        neg = -np.ones((3,), np.float32)
+        np.testing.assert_allclose(st(paddle.to_tensor(pos)).numpy(), pos * 2)
+        np.testing.assert_allclose(st(paddle.to_tensor(neg)).numpy(), neg - 1)
+        assert st.sot_graph_count is None
+
+    def test_python_control_flow_semantics_preserved(self):
+        """A transformed fn whose predicate is plain Python must behave
+        exactly as before (runtime dispatch, not blind lax lowering)."""
+
+        def loopy(x, n):
+            i = 0
+            while i < n:  # n is a static python int under jit
+                x = x + 1.0
+                i = i + 1
+            return x
+
+        tf = transform_control_flow(loopy)
+        assert tf is not None
+        out = tf(paddle.to_tensor(np.zeros(2, np.float32)), 3)
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+
+    def test_mixed_python_and_tensor_if(self):
+        def f(x, flag):
+            y = x
+            if flag:  # python bool stays python
+                y = y + 1.0
+            if (y > 0).all():  # tensor cond compiles
+                y = y * 2.0
+            return y
+
+        st = paddle.jit.to_static(f)
+        assert st.uses_compiled_control_flow
+        out = st(paddle.to_tensor(np.ones(2, np.float32)), True)
+        np.testing.assert_allclose(out.numpy(), [4.0, 4.0])
+        assert st.sot_graph_count is None
+
+
+class TestTransformDeclines:
+    def test_break_declines(self):
+        def f(x):
+            s = x * 0.0
+            while (x > 0).all():
+                if float(x.sum()) > 100:
+                    break
+                s = s + x
+                x = x - 1
+            return s
+
+        assert transform_control_flow(f) is None or \
+            not getattr(paddle.jit.to_static(f), "uses_compiled_control_flow", False)
+
+    def test_return_in_branch_declines_but_sot_covers(self):
+        def f(x):
+            if float(x.sum()) > 0:
+                return x * 2.0
+            return x - 1.0
+
+        st = paddle.jit.to_static(f)
+        out = st(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+    def test_closure_declines(self):
+        bias = 3.0
+
+        def f(x):
+            y = x
+            while (y < bias).all():
+                y = y + 1.0
+            return y
+
+        assert transform_control_flow(f) is None
+
+
+class TestFallbacksAndScoping:
+    def test_shape_changing_loop_falls_back_to_sot(self):
+        """lax cannot express a shape-changing carry; the transformed
+        program must fall back to the original SOT path, not crash."""
+
+        def grower(x):
+            while float(x.sum()) < 10:
+                x = paddle.concat([x, x])
+            return x
+
+        st = paddle.jit.to_static(grower)
+        out = st(paddle.to_tensor(np.ones(2, np.float32)))
+        assert out.shape[0] >= 8
+
+    def test_branch_only_binding_declines(self):
+        """A name bound only inside a conditional branch must not enter
+        the state tuple (UnboundLocalError territory)."""
+
+        def f(x, debug):
+            if debug:
+                acc = x * 1.0
+            while (x > 0).all():
+                acc = x  # only defined when debug was truthy
+                x = x - 1.0
+            return x
+
+        tf = transform_control_flow(f)
+        if tf is not None:
+            # if anything transformed, zero-iteration path must still work
+            out = tf(paddle.to_tensor(np.full(2, -1.0, np.float32)), False)
+            np.testing.assert_allclose(out.numpy(), [-1.0, -1.0])
+
+    def test_forward_reference_resolves_via_live_globals(self, tmp_path):
+        import importlib.util
+        import sys
+
+        src = ("def f(x):\n"
+               "    while (x > 0).all():\n"
+               "        x = helper(x)\n"
+               "    return x\n")
+        p = tmp_path / "fwdref_mod.py"
+        p.write_text(src)
+        spec = importlib.util.spec_from_file_location("fwdref_mod", p)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["fwdref_mod"] = mod
+        try:
+            spec.loader.exec_module(mod)
+            tf = transform_control_flow(mod.f)
+            assert tf is not None
+            mod.helper = lambda t: t - 1.0  # defined AFTER the transform
+            out = tf(paddle.to_tensor(np.full(2, 2.0, np.float32)))
+            np.testing.assert_allclose(out.numpy(), [0.0, 0.0])
+        finally:
+            sys.modules.pop("fwdref_mod", None)
