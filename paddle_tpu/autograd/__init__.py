@@ -92,6 +92,16 @@ class PyLayer(metaclass=PyLayerMeta):
                 else:
                     edges.append(Edge(leaf=t))
             node = GradNode(cls.__name__, vjp_fn, edges, out_specs)
+
+            def taped_vjp(cot_tensors):
+                # create_graph path (parity: py_layer.py:268): run the
+                # USER'S backward with the tape ON — its ops are recorded,
+                # so paddle.grad(..., create_graph=True) differentiates the
+                # custom backward itself (saved tensors keep their forward
+                # tape links, carrying d²/dx² through ctx.saved_tensor())
+                return cls.backward(ctx, *cot_tensors)  # caller normalizes
+
+            node.taped_vjp = taped_vjp
             for i, o in enumerate(outs_list):
                 from ..core import dtype as dtypes
 

@@ -105,7 +105,8 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "edges", "out_specs", "hooks", "released",
-                 "fwd_fn", "fwd_inputs", "fwd_datas", "diff_idx", "multi")
+                 "fwd_fn", "fwd_inputs", "fwd_datas", "diff_idx", "multi",
+                 "taped_vjp")
 
     def __init__(self, name: str, vjp_fn: Callable, edges: List[Edge], out_specs: List[Tuple[tuple, Any]]):
         self.name = name
@@ -119,6 +120,10 @@ class GradNode:
         self.fwd_datas = None
         self.diff_idx = None
         self.multi = False
+        # create_graph alternative to fwd_fn re-derivation: run a
+        # user-defined backward (PyLayer) WITH the tape on; its ops become
+        # differentiable (reference: py_layer.py:268 tracked backward)
+        self.taped_vjp = None
 
     def __repr__(self):
         return f"<GradNode {self.name} n_in={len(self.edges)} n_out={len(self.out_specs)}>"
@@ -211,6 +216,7 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
             node.fwd_fn = None
             node.fwd_inputs = None
             node.fwd_datas = None
+            node.taped_vjp = None  # PyLayer ctx pins saved tensors too
             node.released = True
         for e, g in zip(node.edges, in_cots):
             if e.leaf is not None:
@@ -278,13 +284,24 @@ def _backward_create_graph(roots, grad_tensors, capture: dict):
         if id(node) in processed:
             continue
         processed.add(id(node))
-        if node.fwd_fn is None:
-            raise NotImplementedError(
-                f"create_graph=True through node {node.name} is unsupported "
-                "(no re-derivation info — e.g. PyLayer/recompute nodes)")
         cots = pending.pop(id(node), [None] * len(node.out_specs))
         cot_ts = [c if c is not None else Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
                   for c, (shape, dtype) in zip(cots, node.out_specs)]
+        if node.taped_vjp is not None:
+            # user-defined backward (PyLayer) executed with the tape ON:
+            # second-order grads differentiate the CUSTOM backward, not
+            # vjp(forward) — STE-style PyLayers keep their semantics
+            grads = node.taped_vjp(cot_ts)
+            grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
+            full = [g if (g is None or isinstance(g, Tensor)) else
+                    Tensor(jnp.asarray(g), stop_gradient=True) for g in grads]
+            full += [None] * (len(node.edges) - len(full))
+            _scatter(node, full, seed, capture, indeg, ready)
+            continue
+        if node.fwd_fn is None:
+            raise NotImplementedError(
+                f"create_graph=True through node {node.name} is unsupported "
+                "(no re-derivation info — e.g. custom-op nodes)")
         n_in = len(node.fwd_inputs)
         fwd_fn, multi, out_specs = node.fwd_fn, node.multi, node.out_specs
 
@@ -323,23 +340,29 @@ def _backward_create_graph(roots, grad_tensors, capture: dict):
         full = [None] * len(node.edges)
         for i, g in zip(node.diff_idx, diff_cots):
             full[i] = g
-        for e, g in zip(node.edges, full):
-            if e.leaf is not None:
-                if g is not None:
-                    # leaf hooks (e.g. DP allreduce) must still fire; they
-                    # receive the live (graph-carrying) grad Tensor here
-                    for hook in e.leaf._hooks:
-                        out = hook(g)
-                        if out is not None:
-                            g = out
-                    key = id(e.leaf)
-                    capture[key] = g if capture.get(key) is None else capture[key] + g
-            elif e.node is not None:
-                if g is not None:
-                    seed(e.node, e.slot, g)
-                indeg[id(e.node)] -= 1
-                if indeg[id(e.node)] == 0:
-                    ready.append(e.node)
+        _scatter(node, full, seed, capture, indeg, ready)
+
+
+def _scatter(node, full, seed, capture, indeg, ready):
+    """Route per-edge grad Tensors: leaves accumulate into capture (hooks
+    fire), interior edges seed downstream nodes and update in-degrees."""
+    for e, g in zip(node.edges, full):
+        if e.leaf is not None:
+            if g is not None:
+                # leaf hooks (e.g. DP allreduce) must still fire; they
+                # receive the live (graph-carrying) grad Tensor here
+                for hook in e.leaf._hooks:
+                    out = hook(g)
+                    if out is not None:
+                        g = out
+                key = id(e.leaf)
+                capture[key] = g if capture.get(key) is None else capture[key] + g
+        elif e.node is not None:
+            if g is not None:
+                seed(e.node, e.slot, g)
+            indeg[id(e.node)] -= 1
+            if indeg[id(e.node)] == 0:
+                ready.append(e.node)
 
 
 def grad(

@@ -234,3 +234,66 @@ class TestHigherOrderGrad:
         node = y._grad_node
         y.backward()
         assert node.fwd_fn is None and node.fwd_inputs is None and node.fwd_datas is None
+
+
+class TestDoubleBackwardThroughPyLayer:
+    """create_graph=True through user-defined PyLayer backward
+    (reference: python/paddle/autograd/py_layer.py:268 — the backward's
+    ops are tracked so grad-of-grad differentiates the CUSTOM backward)."""
+
+    def test_pylayer_gradient_penalty(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor()
+                return gy * 2.0 * x
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32), stop_gradient=False)
+        y = Square.apply(x)
+        (g,) = paddle.grad([y.sum()], [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+        # WGAN-GP shape: penalty on the grad, differentiated again
+        penalty = (g * g).sum()          # sum (2x)^2 -> d/dx = 8x
+        (gg,) = paddle.grad([penalty], [x])
+        np.testing.assert_allclose(gg.numpy(), [8.0, 16.0, 24.0], rtol=1e-6)
+
+    def test_pylayer_custom_backward_semantics_preserved(self):
+        """Second-order must differentiate the CUSTOM backward, not
+        vjp(forward): an STE-style layer has zero second derivative."""
+        from paddle_tpu.autograd import PyLayer
+
+        class STE(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x.sign()
+
+            @staticmethod
+            def backward(ctx, gy):
+                return gy * 1.0  # straight-through: identity
+
+        x = paddle.to_tensor(np.array([0.5, -1.5], np.float32), stop_gradient=False)
+        y = STE.apply(x)
+        (g,) = paddle.grad([y.sum()], [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [1.0, 1.0], rtol=1e-6)
+        gg = paddle.grad([(g * g).sum()], [x], allow_unused=True)[0]
+        # d/dx of constant 1 is zero (or unused)
+        if gg is not None:
+            np.testing.assert_allclose(gg.numpy(), [0.0, 0.0], atol=1e-7)
+
+    def test_double_backward_through_recompute(self):
+        from paddle_tpu.distributed.fleet import recompute
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+
+        y = recompute(lambda t: (t * t * t).sum(), x)  # x^3
+        (g,) = paddle.grad([y], [x], create_graph=True)     # 3x^2
+        np.testing.assert_allclose(g.numpy(), [3.0, 12.0], rtol=1e-5)
+        (gg,) = paddle.grad([g.sum()], [x])                 # 6x
+        np.testing.assert_allclose(gg.numpy(), [6.0, 12.0], rtol=1e-5)
