@@ -10,6 +10,7 @@ Layers (parity map, SURVEY §2.4-§2.5):
 - checkpoint.py — distributed sharded checkpoint (§5.4)
 """
 
+from .eager_collectives import coalescing_manager, eager_all_reduce_coalesced
 from .collective import (
     Group,
     ReduceOp,

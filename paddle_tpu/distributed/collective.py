@@ -241,6 +241,15 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, s
         if _eager_multiprocess(tensor, group):
             from . import eager_collectives as ec
 
+            if ec.coalescing_active():
+                # deferred: the coalescer reads tensor._data at FLUSH time
+                # and rebinds it with the reduced payload at context exit
+                # (StartCoalescing semantics)
+                ec.defer_all_reduce(
+                    id(tensor),
+                    lambda _t=tensor: _t._data, _OP_NAMES[op],
+                    lambda data, _t=tensor: _eager_result(_t, data))
+                return tensor
             return _eager_result(tensor, ec.eager_all_reduce(tensor._data, _OP_NAMES[op]))
         return tensor
     f = _reduce_fn(op)

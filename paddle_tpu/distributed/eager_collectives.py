@@ -25,7 +25,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["process_world_size", "eager_all_reduce", "eager_broadcast",
            "eager_all_gather", "eager_reduce_scatter", "eager_alltoall",
-           "eager_scatter", "eager_shift", "is_concrete"]
+           "eager_scatter", "eager_shift", "is_concrete",
+           "coalescing_manager", "coalescing_active", "defer_all_reduce",
+           "eager_all_reduce_coalesced"]
 
 
 def process_world_size() -> int:
@@ -170,3 +172,109 @@ def eager_shift(arr, shift: int = 1):
 def eager_alltoall(arr, split_axis: int = 0, concat_axis: int = 0):
     out = _run("alltoall", arr, (split_axis, concat_axis))
     return out[0] if out.shape[0] == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# coalescing (parity: process_group.h:119-123 StartCoalescing/EndCoalescing
+# + collective/reducer.h:107 bucketed grad fusion). Individual eager
+# all-reduces inside the context are deferred and flushed as ONE flat
+# padded all-reduce per (op, dtype): the pad-to-power-of-two quantum makes
+# the compiled-program count O(log max_payload) per world size instead of
+# one program per distinct tensor shape.
+# ---------------------------------------------------------------------------
+
+_MIN_BUCKET = 1024  # elements
+
+
+def _bucket_len(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def eager_all_reduce_coalesced(arrs, op: str = "sum"):
+    """All-reduce a list of arrays (same dtype) as one flat padded
+    collective; returns the reduced arrays in order."""
+    if not arrs:
+        return []
+    shapes = [a.shape for a in arrs]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    flat = jnp.concatenate([jnp.ravel(a) for a in arrs])
+    total = flat.shape[0]
+    padded = _bucket_len(total)
+    if padded != total:
+        # pad with identity-ish values; the tail is discarded on split
+        flat = jnp.concatenate([flat, jnp.zeros((padded - total,), flat.dtype)])
+    reduced = eager_all_reduce(flat, op)
+    out, off = [], 0
+    for s, n in zip(shapes, sizes):
+        out.append(reduced[off:off + n].reshape(s))
+        off += n
+    return out
+
+
+class _Coalescer:
+    """Deferred entries hold a GETTER read at flush time (not a snapshot):
+    grad accumulation finishing after the defer point is still captured.
+    A key (tensor/param id) deduplicates; deferring the same tensor twice
+    in one block would drop a reduction, so it raises instead."""
+
+    def __init__(self):
+        self.pending = []  # (getter, op, setter)
+        self._seen: set = set()
+
+    def add(self, key, getter, op: str, setter, on_dup: str = "error"):
+        if key in self._seen:
+            if on_dup == "skip":
+                # flush-time getter reads the FINAL value, so one deferred
+                # sync per key is exactly right (multi-contribution grads)
+                return
+            raise RuntimeError(
+                "the same tensor was all-reduced twice inside one "
+                "coalescing_manager block; compose reductions outside the "
+                "block or use distinct tensors")
+        self._seen.add(key)
+        self.pending.append((getter, op, setter))
+
+    def flush(self):
+        groups = {}
+        for getter, op, setter in self.pending:
+            arr = getter()
+            groups.setdefault((op, str(arr.dtype)), []).append((arr, setter))
+        self.pending = []
+        self._seen = set()
+        for (op, _dt), items in groups.items():
+            reduced = eager_all_reduce_coalesced([a for a, _ in items], op)
+            for (_, setter), r in zip(items, reduced):
+                setter(r)
+
+
+_active: list = [None]
+
+
+def coalescing_active() -> bool:
+    return _active[0] is not None
+
+
+def defer_all_reduce(key, getter, op: str, setter,
+                     on_dup: str = "error") -> None:
+    _active[0].add(key, getter, op, setter, on_dup)
+
+
+class coalescing_manager:
+    """``with coalescing_manager(): loss.backward()`` — every eager
+    all_reduce issued inside (e.g. DataParallel grad hooks) is batched and
+    flushed as flat bucketed collectives on exit."""
+
+    def __enter__(self):
+        if _active[0] is not None:
+            raise RuntimeError("coalescing_manager does not nest")
+        _active[0] = _Coalescer()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        c, _active[0] = _active[0], None
+        if exc_type is None:
+            c.flush()
+        return False

@@ -34,11 +34,25 @@ class DataParallel(Layer):
         # group when running as a per-rank spmd program.
         for p in layers.parameters():
             if not p.stop_gradient:
-                p.register_hook(self._make_hook())
+                p.register_hook(self._make_hook(p))
 
-    def _make_hook(self):
+    def _make_hook(self, param):
         def hook(grad: Tensor):
             if _current_spmd() is None and get_world_size() <= 1:
+                return grad
+            from . import eager_collectives as ec
+
+            if _current_spmd() is None and ec.coalescing_active():
+                # coalesced DP (reducer.h:107): the hook's return value is
+                # snapshotted into param._grad_data immediately, so the
+                # deferred sync must target the PARAM's final accumulated
+                # grad at flush time, not this transient Tensor
+                def setter(data, _p=param):
+                    _p._grad_data = data
+
+                ec.defer_all_reduce(id(param),
+                                    lambda _p=param: _p._grad_data,
+                                    "avg", setter, on_dup="skip")
                 return grad
             return all_reduce(grad, op=ReduceOp.AVG, group=self._group)
 
@@ -57,4 +71,28 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        """Fused grad sync (parity: reducer.h:107 bucketed allreduce;
+        legacy no_sync + apply_collective_grads flow): one flat bucketed
+        collective per dtype over all current grads, instead of one
+        compiled program per grad shape."""
+        from . import eager_collectives as ec
+        from .collective import _eager_multiprocess
+
+        params = [p for p in self._layers.parameters()
+                  if not p.stop_gradient and p._grad_data is not None]
+        if not params:
+            return
+        # same guard as the per-grad hook path: no-op single process /
+        # traced grads, raise on proper subgroups (silent wrong-rank
+        # averaging is worse than an error)
+        if not _eager_multiprocess(Tensor(params[0]._grad_data),
+                                   self._group):
+            return
+        by_dtype = {}
+        for p in params:
+            by_dtype.setdefault(str(p._grad_data.dtype), []).append(p)
+        for ps in by_dtype.values():
+            reduced = ec.eager_all_reduce_coalesced(
+                [p._grad_data for p in ps], "avg")
+            for p, r in zip(ps, reduced):
+                p._grad_data = r

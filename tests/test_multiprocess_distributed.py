@@ -118,6 +118,98 @@ open(os.path.join(os.getcwd(), f"ok{rank}"), "w").write("1")
     assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()
 
 
+def test_multiprocess_coalesced_collectives(tmp_path):
+    """StartCoalescing-shaped batching (reference process_group.h:119-123,
+    reducer.h:107): N different-shaped all-reduces inside
+    coalescing_manager flush as ONE flat bucketed program, and DataParallel
+    apply_collective_grads fuses grad sync the same way."""
+    body = """
+from paddle_tpu.distributed import eager_collectives as ec
+
+# 5 different shapes, one deferred flush
+ts = [paddle.to_tensor(np.full(shape, float(rank + 1), np.float32))
+      for shape in [(3,), (2, 2), (5,), (1, 7), (4, 3)]]
+before = ec._compiled.cache_info().currsize
+with ec.coalescing_manager():
+    for t in ts:
+        dist.all_reduce(t)
+    # not flushed yet inside the context
+    assert np.allclose(ts[0].numpy(), float(rank + 1)), "flushed too early"
+after = ec._compiled.cache_info().currsize
+for t in ts:
+    assert np.allclose(t.numpy(), 3.0), t.numpy()  # 1 + 2
+assert after - before == 1, f"expected ONE new compiled program, got {after - before}"
+
+# repeat with different shapes but same padded bucket: ZERO new programs
+ts2 = [paddle.to_tensor(np.full(shape, float(rank), np.float32))
+       for shape in [(6,), (2, 3)]]
+before = ec._compiled.cache_info().currsize
+with ec.coalescing_manager():
+    for t in ts2:
+        dist.all_reduce(t)
+assert ec._compiled.cache_info().currsize == before, "bucket padding not reused"
+for t in ts2:
+    assert np.allclose(t.numpy(), 1.0)  # 0 + 1
+
+# fused DP grad sync: apply_collective_grads averages grads across ranks
+from paddle_tpu import nn
+paddle.seed(0)
+m = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+# duck-typed self: exercise ONLY the fused path, no per-grad hooks
+from types import SimpleNamespace
+dp = SimpleNamespace(_layers=m, _group=None)
+x = paddle.to_tensor(np.full((2, 4), float(rank + 1), np.float32))
+loss = m(x).sum()
+loss.backward()
+dist.parallel.DataParallel.apply_collective_grads(dp)
+# AVG over ranks: both ranks must now hold identical grads
+flat = np.concatenate([p.grad.numpy().ravel() for p in m.parameters()])
+out = np.asarray(ec.eager_all_gather(paddle.to_tensor(flat)._data))
+assert np.allclose(out[0], out[1], atol=1e-6), "grads differ across ranks"
+
+# the advertised primary path: DataParallel hooks inside coalescing_manager.
+# grads must equal the full-batch replica exactly (flush targets the
+# param's FINAL accumulated grad, not the transient hook tensor)
+paddle.seed(0)
+m2 = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+dp2 = dist.parallel.DataParallel(m2)
+X = np.arange(16, dtype=np.float32).reshape(4, 4) / 10.0
+half = 2
+xb = paddle.to_tensor(X[rank*half:(rank+1)*half])
+with ec.coalescing_manager():
+    dp2(xb).sum().backward()
+got = np.concatenate([p.grad.numpy().ravel() for p in m2.parameters()])
+# replica oracle: mean of per-rank grads == grads of (sum over full X)/ ...
+# per-rank loss is sum over its half; avg of grads = grad of mean of
+# per-rank sums
+import jax, jax.numpy as jnp
+from paddle_tpu.utils.functional import functional_call
+state = m2.state_dict()
+params_arr = {k: v._data for k, v in state.items()}
+def full_loss(p):
+    a = functional_call(m2, p, paddle.to_tensor(X[:2]))._data.sum()
+    b = functional_call(m2, p, paddle.to_tensor(X[2:]))._data.sum()
+    return (a + b) / 2.0
+jg = jax.grad(full_loss)(params_arr)
+ref = np.concatenate([np.asarray(jg[k]).ravel() for k in state])
+assert np.allclose(got, ref, atol=1e-5), float(np.abs(got - ref).max())
+
+# same tensor twice in one block -> loud error, not a dropped reduction
+try:
+    tdup = paddle.to_tensor(np.ones(2, np.float32))
+    with ec.coalescing_manager():
+        dist.all_reduce(tdup)
+        dist.all_reduce(tdup)
+    raise SystemExit("duplicate deferred all_reduce did not raise")
+except RuntimeError:
+    pass
+
+open(os.path.join(os.getcwd(), f"cok{rank}"), "w").write("1")
+"""
+    _launch(tmp_path, body)
+    assert (tmp_path / "cok0").exists() and (tmp_path / "cok1").exists()
+
+
 def test_multiprocess_pipeline_parallel(tmp_path):
     """fleet.distributed_model with pp_degree=2 across 2 REAL processes:
     each process owns one stage; inter-stage edges are compiled shift
