@@ -14,6 +14,7 @@ not accelerator compute, exactly as in the reference.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -107,6 +108,37 @@ class SparseTable:
                     row -= self.lr * g
 
 
+def _dense_state(t: DenseTable) -> dict:
+    with t._lock:
+        return {"kind": "dense", "shape": t.value.shape, "lr": t.lr,
+                "optimizer": t.optimizer, "value": t.value.copy(),
+                "g2": None if t._g2 is None else t._g2.copy()}
+
+
+def _sparse_state(t: SparseTable) -> dict:
+    with t._lock:
+        return {"kind": "sparse", "emb_dim": t.emb_dim, "lr": t.lr,
+                "optimizer": t.optimizer, "init_std": t.init_std,
+                "rows": {k: v.copy() for k, v in t.rows.items()},
+                "g2": {k: v.copy() for k, v in t._g2.items()},
+                "rng": t._rng.get_state()}
+
+
+def _table_from_state(name: str, st: dict):
+    if st["kind"] == "dense":
+        t = DenseTable(name, st["shape"], st["lr"], st["optimizer"])
+        t.value = np.array(st["value"], np.float32)
+        if st["g2"] is not None:
+            t._g2 = np.array(st["g2"], np.float32)
+        return t
+    t = SparseTable(name, st["emb_dim"], st["lr"], st["optimizer"],
+                    st["init_std"])
+    t.rows = {int(k): np.array(v, np.float32) for k, v in st["rows"].items()}
+    t._g2 = {int(k): np.array(v, np.float32) for k, v in st["g2"].items()}
+    t._rng.set_state(st["rng"])  # lazy-init streams resume, not repeat
+    return t
+
+
 class PsServer:
     """Hosts tables; methods are invoked remotely via rpc (the brpc service
     surface of the reference, minus protobuf). RPC requests run on a thread
@@ -190,6 +222,43 @@ class PsServer:
                                                    np.asarray(grads, np.float32))
         return True
 
+    # --- durability (parity: the_one_ps.py save/load persistables: a
+    # killed server resumes its tables, incl. optimizer accumulators) ---
+    @staticmethod
+    def save_tables(path: str):
+        import pickle
+        import tempfile
+
+        srv = PsServer.instance()
+        with srv._tables_lock:
+            snap = {name: (_dense_state(t) if isinstance(t, DenseTable)
+                           else _sparse_state(t))
+                    for name, t in srv.tables.items()}
+        # atomic write: a crash mid-save must not corrupt the last snapshot
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(snap, f)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return True
+
+    @staticmethod
+    def load_tables(path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        srv = PsServer.instance()
+        with srv._tables_lock:
+            for name, st in snap.items():
+                srv.tables[name] = _table_from_state(name, st)
+        return sorted(snap)
+
 
 class PsClient:
     """Worker-side handle (parity: the_one_ps worker API)."""
@@ -227,6 +296,12 @@ class PsClient:
         return rpc.rpc_sync(self.server, PsServer.push_sparse_grad,
                             args=(name, np.asarray(ids, np.int64),
                                   np.asarray(grads, np.float32)))
+
+    def save(self, path: str):
+        return rpc.rpc_sync(self.server, PsServer.save_tables, args=(path,))
+
+    def load(self, path: str):
+        return rpc.rpc_sync(self.server, PsServer.load_tables, args=(path,))
 
 
 def init_server(name: str = "ps_server", rank: Optional[int] = None,

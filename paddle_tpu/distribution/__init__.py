@@ -18,6 +18,7 @@ from .distribution import (
     Normal, Poisson, StudentT, TransformedDistribution, Uniform,
     kl_divergence, register_kl,
 )
+from .lkj_cholesky import LKJCholesky
 from .transform import (
     AbsTransform, AffineTransform, ChainTransform, ExpTransform,
     IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
@@ -30,7 +31,7 @@ __all__ = [
     "Categorical", "Beta", "Gamma", "Dirichlet", "Exponential", "Geometric",
     "Gumbel", "Laplace", "LogNormal", "Cauchy", "Chi2", "Poisson", "Binomial",
     "ContinuousBernoulli", "Multinomial", "MultivariateNormal", "StudentT",
-    "Independent", "TransformedDistribution", "kl_divergence", "register_kl",
+    "Independent", "LKJCholesky", "TransformedDistribution", "kl_divergence", "register_kl",
     "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
     "ExpTransform", "IndependentTransform", "PowerTransform",
     "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",

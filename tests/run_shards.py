@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Bounded-shard test runner driven by testslist.csv.
+
+Parity: the reference encodes per-test timeouts and run types in
+testslist.csv files consumed by tools/gen_ut_cmakelists.py, and
+test/collective/README.md mandates serial execution for timing-sensitive
+collective tests. Same contract here:
+
+- ``testslist.csv`` rows: file, timeout (seconds), run_type
+  (parallel | serial).
+- parallel files are greedily balanced into N shards by timeout budget;
+  each shard runs as one pytest invocation with a summed time bound.
+- serial files (sockets, subprocess launches, wall-clock watchdogs) run
+  one-per-invocation AFTER the parallel shards, never concurrently with
+  anything.
+
+Usage:
+  python tests/run_shards.py --shards 4            # everything, bounded
+  python tests/run_shards.py --shards 4 --shard 1  # one parallel shard
+  python tests/run_shards.py --serial-only
+  python tests/run_shards.py --list                # show the plan
+
+Exit code is non-zero if any pytest invocation fails or exceeds its
+budget. New test files must be added to testslist.csv — enforced by
+test_manifest_complete in this directory's suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+MANIFEST = os.path.join(HERE, "testslist.csv")
+
+
+def load_manifest():
+    rows = []
+    with open(MANIFEST) as f:
+        for row in csv.DictReader(f):
+            rows.append({"file": row["file"], "timeout": int(row["timeout"]),
+                         "run_type": row["run_type"].strip()})
+    return rows
+
+
+def partition(rows, n_shards):
+    """Greedy longest-first balancing by timeout budget."""
+    shards = [[] for _ in range(n_shards)]
+    budgets = [0] * n_shards
+    for row in sorted(rows, key=lambda r: -r["timeout"]):
+        i = budgets.index(min(budgets))
+        shards[i].append(row)
+        budgets[i] += row["timeout"]
+    return shards, budgets
+
+
+def run_pytest(files, budget, label):
+    cmd = [sys.executable, "-m", "pytest", "-q", "--no-header",
+           *(os.path.join(HERE, f) for f in files)]
+    print(f"[run_shards] {label}: {len(files)} files, budget {budget}s",
+          flush=True)
+    try:
+        proc = subprocess.run(cmd, timeout=budget, cwd=os.path.dirname(HERE))
+        return proc.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[run_shards] {label} EXCEEDED its {budget}s budget", flush=True)
+        return 124
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--shard", type=int, default=None,
+                    help="run only this parallel shard index")
+    ap.add_argument("--serial-only", action="store_true")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--slack", type=float, default=1.5,
+                    help="budget multiplier over summed timeouts")
+    args = ap.parse_args(argv)
+
+    rows = load_manifest()
+    par = [r for r in rows if r["run_type"] == "parallel"]
+    ser = [r for r in rows if r["run_type"] == "serial"]
+    shards, budgets = partition(par, args.shards)
+
+    if args.list:
+        for i, (sh, b) in enumerate(zip(shards, budgets)):
+            print(f"shard {i} (budget {b}s): "
+                  + " ".join(r["file"] for r in sh))
+        print("serial: " + " ".join(r["file"] for r in ser))
+        return 0
+
+    rc = 0
+    if not args.serial_only:
+        targets = range(args.shards) if args.shard is None else [args.shard]
+        for i in targets:
+            files = [r["file"] for r in shards[i]]
+            if not files:
+                continue
+            budget = int(budgets[i] * args.slack)
+            rc |= run_pytest(files, budget, f"shard {i}")
+    if args.shard is None:
+        for r in ser:
+            rc |= run_pytest([r["file"]], int(r["timeout"] * args.slack),
+                             f"serial {r['file']}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

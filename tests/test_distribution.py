@@ -258,3 +258,67 @@ def test_rsample_is_differentiable():
         grads.append(loc.grad.numpy())
         loc.clear_grad()
     np.testing.assert_allclose(np.mean(grads), 1.0, rtol=1e-6)
+
+
+class TestLKJCholesky:
+    """Parity: python/paddle/distribution/lkj_cholesky.py:127 — onion and
+    cvine samplers must both produce valid correlation Cholesky factors,
+    with higher concentration pulling correlations toward zero."""
+
+    def _check_valid(self, L, dim):
+        L = np.asarray(L)
+        # lower triangular, positive diagonal, unit-norm rows (corr diag 1)
+        assert np.allclose(np.triu(L, 1), 0, atol=1e-6)
+        assert (np.diagonal(L, axis1=-2, axis2=-1) > 0).all()
+        corr_diag = (L ** 2).sum(-1)
+        np.testing.assert_allclose(corr_diag, np.ones_like(corr_diag),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sample_validity_both_methods(self):
+        from paddle_tpu.distribution import LKJCholesky
+
+        paddle.seed(7)
+        for method in ("onion", "cvine"):
+            for dim in (2, 3, 5):
+                d = LKJCholesky(dim, concentration=1.5, sample_method=method)
+                s = d.sample((64,))
+                assert list(s.shape) == [64, dim, dim], (method, dim, s.shape)
+                self._check_valid(s.numpy(), dim)
+                single = d.sample()
+                assert list(single.shape) == [dim, dim]
+
+    def test_concentration_controls_spread(self):
+        from paddle_tpu.distribution import LKJCholesky
+
+        paddle.seed(3)
+        wide = LKJCholesky(3, concentration=1.0).sample((512,)).numpy()
+        tight = LKJCholesky(3, concentration=50.0).sample((512,)).numpy()
+
+        def mean_abs_offdiag(Ls):
+            corr = Ls @ np.swapaxes(Ls, -1, -2)
+            i, j = np.tril_indices(3, -1)
+            return np.abs(corr[..., i, j]).mean()
+
+        assert mean_abs_offdiag(tight) < 0.5 * mean_abs_offdiag(wide)
+
+    def test_log_prob_uniform_case_is_constant(self):
+        from paddle_tpu.distribution import LKJCholesky
+
+        # concentration=1: uniform over correlation matrices, so log_prob
+        # depends only on the Cholesky-parametrization Jacobian term
+        paddle.seed(11)
+        d = LKJCholesky(2, concentration=1.0)
+        s = d.sample((8,))
+        lp = d.log_prob(s).numpy()
+        assert np.isfinite(lp).all()
+        # dim=2, eta=1: density of L reduces to 1/2 (uniform corr in [-1,1])
+        np.testing.assert_allclose(lp, np.full_like(lp, np.log(0.5)),
+                                   rtol=1e-5)
+
+    def test_log_prob_increases_with_concentration_near_identity(self):
+        from paddle_tpu.distribution import LKJCholesky
+
+        eye = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        lp1 = float(LKJCholesky(3, 1.0).log_prob(eye))
+        lp5 = float(LKJCholesky(3, 5.0).log_prob(eye))
+        assert lp5 > lp1

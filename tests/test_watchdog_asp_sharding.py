@@ -32,12 +32,18 @@ class TestWatchdog:
     def test_completed_task_no_timeout(self):
         from paddle_tpu.distributed.watchdog import CommTaskManager
 
-        mgr = CommTaskManager(poll_interval=0.05, default_timeout=0.3)
+        # must-NOT-trigger case: a wide timeout so scheduler jitter under
+        # parallel shards can never fire it (run_type serial in
+        # testslist.csv besides)
+        mgr = CommTaskManager(poll_interval=0.05, default_timeout=5.0)
         task = mgr.register("broadcast")
         task.mark_done()
         assert task.wait(timeout=1)
-        time.sleep(0.4)
+        time.sleep(0.2)
         assert not task.timed_out
+        # deterministic done-exemption check (no wall-clock margin): even
+        # far past the deadline, a completed task never times out
+        assert not task.is_timeout(now=task.started_at + 1000.0)
         mgr.stop()
 
     def test_watch_async_wraps_blocking_call(self):
