@@ -21,9 +21,20 @@ simulation:
    true outcome prefix (conditions are trustworthy up to and including
    the first divergence), which selects/creates the right path program.
 
-Cache shape: {outcomes tuple -> jitted program}; discovery is one eager
-run per new path (the reference pays the same: a break triggers eager
-execution of the rest of the frame).
+Cache shape: {input aval spec -> {outcomes tuple -> jitted program}};
+discovery is one eager run per new path (the reference pays the same: a
+break triggers eager execution of the rest of the frame). The SPEC level
+is the shape guard: a path recorded under one set of input shapes/dtypes
+is never dispatched for another, mirroring the reference SOT's frame
+guards over tensor metadata.
+
+GUARD TOLERANCE CONTRACT: bool/int guards compare exactly; float guards
+compare to 1e-5 relative (1e-6 absolute at zero) because a fused
+program's float may lawfully differ from the eager probe in the last
+ulps. Two paths whose float outcomes differ by LESS than that tolerance
+are the same path by contract — code whose control flow flips on <1e-5
+relative float differences is outside SOT-lite's guarantee (use
+compiled control flow via jit/ast_transform.py, or int/bool guards).
 """
 
 from __future__ import annotations
@@ -94,7 +105,8 @@ class _PushCtx:
 
 def _match_outcome(reported, recorded) -> bool:
     """Guard comparison: exact for bools/ints, approximate for floats (a
-    fused program's float may differ from the eager probe in the last ulp)."""
+    fused program's float may differ from the eager probe in the last
+    ulp). See the module docstring's tolerance contract."""
     if isinstance(recorded, bool):
         return bool(reported) == recorded
     if isinstance(recorded, int):
@@ -105,7 +117,9 @@ def _match_outcome(reported, recorded) -> bool:
     return abs(rf - cf) <= 1e-5 * abs(cf)
 
 
-MAX_PATHS = 64  # value-specialized paths cap; beyond it -> permanent eager
+MAX_PATHS = 64    # value-specialized paths cap PER INPUT SPEC; a spec
+                  # that overflows degrades to eager for that spec only
+MAX_SPECS = 256   # total spec tables kept; oldest evicted beyond this
 
 
 class SotFunction:
@@ -115,12 +129,13 @@ class SotFunction:
         self._fn = fn
         self._wrap_in = wrap_in
         self._unwrap_out = unwrap_out
-        # outcomes -> jitted program | None (None = eager-only path: its
-        # replay trace failed, e.g. an unhookable concretization like
-        # np.asarray(tracer) — the reference SOT also stays eager there)
-        self._paths: Dict[Tuple, Any] = {}
-        self._mru: Optional[Tuple] = None
-        self._eager_only = False  # set when the path cache overflows
+        # spec -> {outcomes -> jitted program | None} (None = eager-only
+        # path: its replay trace failed, e.g. an unhookable concretization
+        # like np.asarray(tracer) — the reference SOT also stays eager
+        # there). spec = input (shape, dtype) tuple — the shape guard.
+        self._paths: Dict[Tuple, Dict[Tuple, Any]] = {}
+        self._mru: Dict[Tuple, Tuple] = {}
+        self._eager_specs: set = set()  # specs whose path cache overflowed
         _install_hook()
 
     # -- program construction ---------------------------------------------
@@ -143,8 +158,18 @@ class SotFunction:
 
         return jax.jit(runner)
 
+    @staticmethod
+    def _spec(datas, kw) -> Tuple:
+        """Input metadata guard: (shape, dtype) per array leaf."""
+        return tuple((tuple(x.shape), str(x.dtype))
+                     for x in jax.tree.leaves((datas, kw))
+                     if isinstance(x, jax.Array))
+
+    def _total_paths(self) -> int:
+        return sum(len(d) for d in self._paths.values())
+
     # -- discovery: eager fallback + path compile -------------------------
-    def _discover(self, datas, kw):
+    def _discover(self, datas, kw, spec=None):
         ctx = _Ctx("probe")
         with _PushCtx(ctx), no_grad():
             args = jax.tree.map(lambda x: Tensor(x, stop_gradient=True)
@@ -157,41 +182,57 @@ class SotFunction:
             out_datas = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x,
                                      out, is_leaf=lambda x: isinstance(x, Tensor))
         key = tuple(ctx.outcomes)
-        if key not in self._paths:
-            if len(self._paths) >= MAX_PATHS:
+        if spec is None:
+            spec = self._spec(datas, kw)
+        if spec in self._eager_specs:
+            return out_datas  # no cache bookkeeping for degraded specs
+        paths = self._paths.setdefault(spec, {})
+        if key not in paths:
+            if len(paths) >= MAX_PATHS:
                 # value-varying concretizations (e.g. float(loss) logged
-                # every step) would specialize forever: degrade to eager
-                self._eager_only = True
-            else:
-                self._paths[key] = self._build_program(key)
-        self._mru = key
+                # every step) would specialize forever: degrade THIS spec
+                # to eager and free its programs; other specs keep theirs
+                self._eager_specs.add(spec)
+                self._paths.pop(spec, None)
+                self._mru.pop(spec, None)
+                return out_datas
+            paths[key] = self._build_program(key)
+            while len(self._paths) > MAX_SPECS:  # bound total spec tables
+                oldest = next(iter(self._paths))
+                self._paths.pop(oldest)
+                self._mru.pop(oldest, None)
+        self._mru[spec] = key
         return out_datas
 
-    def _find_path(self, prefix: Tuple, tried) -> Optional[Tuple]:
+    def _find_path(self, spec: Tuple, prefix: Tuple, tried) -> Optional[Tuple]:
+        paths = self._paths.get(spec, {})
+
         def matches(key):
             return (key not in tried and len(key) >= len(prefix)
                     and all(_match_outcome(p, k) for p, k in zip(prefix, key)))
 
-        if self._mru is not None and matches(self._mru):
-            return self._mru
-        for key in self._paths:
+        mru = self._mru.get(spec)
+        if mru is not None and mru in paths and matches(mru):
+            return mru
+        for key in paths:
             if matches(key):
                 return key
         return None
 
     # -- dispatch ----------------------------------------------------------
     def __call__(self, *datas, **kw):
-        if self._eager_only:
-            return self._discover(datas, kw)
+        spec = self._spec(datas, kw)
+        if spec in self._eager_specs:
+            return self._discover(datas, kw, spec)
         tried = set()
         prefix: Tuple = ()
         while True:
-            key = self._find_path(prefix, tried)
+            key = self._find_path(spec, prefix, tried)
             if key is None:
-                return self._discover(datas, kw)
-            program = self._paths[key]
+                return self._discover(datas, kw, spec)
+            program = self._paths[spec][key]
             if program is None:  # known eager-only path
-                return self._discover(datas, kw)
+                return self._discover(datas, kw, spec)
             try:
                 out, conds = program(*datas, **kw)
             except (jax.errors.ConcretizationTypeError,
@@ -202,8 +243,8 @@ class SotFunction:
                 # retrace failed (unhookable concretization, or the
                 # concretization count depends on input shape): this path
                 # program can't serve these avals — run eagerly
-                self._paths[key] = None
-                return self._discover(datas, kw)
+                self._paths[spec][key] = None
+                return self._discover(datas, kw, spec)
             conds_py = [jax.device_get(c) for c in conds]
             mismatch = None
             for i, (rep, rec) in enumerate(zip(conds_py, key)):
@@ -211,7 +252,7 @@ class SotFunction:
                     mismatch = i
                     break
             if mismatch is None:
-                self._mru = key
+                self._mru[spec] = key
                 return out
             tried.add(key)
             # conditions are valid up to and including the first divergence
@@ -228,5 +269,5 @@ class SotFunction:
 
     @property
     def graph_count(self) -> int:
-        """Number of compiled sub-graphs (path programs)."""
-        return len(self._paths)
+        """Number of compiled sub-graphs (path programs, all input specs)."""
+        return self._total_paths()

@@ -89,3 +89,62 @@ def test_no_break_stays_on_fast_path():
     x = paddle.to_tensor(np.ones((2,), np.float32))
     np.testing.assert_allclose(fn(x).numpy(), 3.0)
     assert fn.sot_graph_count is None  # plain jit, no SOT engaged
+
+
+class TestShapeGuards:
+    def test_paths_isolated_per_input_spec(self):
+        """Shape guard (reference SOT frame guards over tensor metadata):
+        paths recorded under one input shape never serve another, even
+        when the outcome signature would match."""
+        import paddle_tpu as paddle
+
+        def f(x):
+            # one concretization with a SHAPE-INVARIANT outcome (True/False
+            # for both shapes): without spec keying these paths would
+            # cross-match between shapes
+            if bool((x.sum() > 0)):
+                return x * 2.0
+            return x - 1.0
+
+        st = paddle.jit.to_static(f)
+        a3 = np.ones(3, np.float32)
+        a5 = np.ones(5, np.float32)
+        np.testing.assert_allclose(st(paddle.to_tensor(a3)).numpy(), a3 * 2)
+        np.testing.assert_allclose(st(paddle.to_tensor(a5)).numpy(), a5 * 2)
+        np.testing.assert_allclose(st(paddle.to_tensor(-a3)).numpy(), -a3 - 1)
+        np.testing.assert_allclose(st(paddle.to_tensor(-a5)).numpy(), -a5 - 1)
+        sot = st._sot
+        assert sot is not None
+        # two specs, isolated path tables
+        assert len(sot._paths) == 2, list(sot._paths)
+        for spec, paths in sot._paths.items():
+            assert len(paths) == 2, (spec, list(paths))
+
+    def test_overflow_degrades_only_that_spec(self):
+        """A spec that blows the per-spec path cap goes eager alone; other
+        specs keep their compiled paths."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import sot_lite
+
+        def g(x):
+            return x * float(x.sum())  # value-specialized every call
+
+        st = paddle.jit.to_static(g)
+        old = sot_lite.MAX_PATHS
+        sot_lite.MAX_PATHS = 4
+        try:
+            # overflow spec (3,) with distinct values
+            for v in range(1, 8):
+                st(paddle.to_tensor(np.full(3, float(v), np.float32)))
+            sot = st._sot
+            assert sot is not None
+            spec3 = [sp for sp in sot._eager_specs]
+            assert len(spec3) == 1, sot._eager_specs
+            # a different spec still compiles paths
+            st(paddle.to_tensor(np.full(5, 2.0, np.float32)))
+            assert any(len(p) > 0 for p in sot._paths.values())
+            # overflowed spec stays correct, just eager
+            out = st(paddle.to_tensor(np.full(3, 4.0, np.float32)))
+            np.testing.assert_allclose(out.numpy(), np.full(3, 48.0), rtol=1e-6)
+        finally:
+            sot_lite.MAX_PATHS = old
