@@ -60,7 +60,8 @@ class OpSchema:
                  dtypes: Tuple[str, ...] = FLOAT_SWEEP,
                  grad: bool = True,
                  grad_inputs: Optional[Sequence[int]] = None,
-                 tol: Optional[dict] = None):
+                 tol: Optional[dict] = None,
+                 wrap: Optional[Callable] = None):
         self.name = name
         self.api = api
         self.np_ref = np_ref
@@ -70,6 +71,10 @@ class OpSchema:
         self.grad = grad
         self.grad_inputs = grad_inputs
         self.tol = tol
+        # call adapter: wrap(api_fn) -> fn(*tensors, **kwargs); for ops
+        # whose python signature isn't tensors-first (list inputs, einsum
+        # equations, tuple-returning selections)
+        self.wrap = wrap
 
     def sample(self, rng) -> list:
         return [_DOMAINS[dom](rng, sh) for sh, dom in self.inputs]
@@ -345,6 +350,173 @@ _S("softmax", lambda x: sp.softmax(x, axis=-1), _U,
    api="nn.functional.softmax")
 _S("log_softmax", lambda x: sp.log_softmax(x, axis=-1), _U,
    api="nn.functional.log_softmax")
+
+# ---------------------------------------------------------------------------
+# axis-variant reductions (the reference sweeps axis/keepdim per op)
+# ---------------------------------------------------------------------------
+_AX = (2, 3, 4)
+for base, npf in {"sum": np.sum, "mean": np.mean, "max": np.max,
+                  "min": np.min}.items():
+    _S(f"{base}_axis", lambda x, _f=npf: _f(x, axis=1), [(_AX, "any")],
+       api=base, kwargs={"axis": 1})
+    _S(f"{base}_keepdim", lambda x, _f=npf: _f(x, axis=-1, keepdims=True),
+       [(_AX, "any")], api=base, kwargs={"axis": -1, "keepdim": True})
+_S("logsumexp_axis", lambda x: sp.logsumexp(x, axis=0), [(_AX, "any")],
+   api="logsumexp", kwargs={"axis": 0})
+_S("std_axis", lambda x: np.std(x, axis=1, ddof=1), [(_AX, "any")],
+   api="std", kwargs={"axis": 1})
+_S("var_axis", lambda x: np.var(x, axis=1, ddof=1), [(_AX, "any")],
+   api="var", kwargs={"axis": 1})
+_S("prod_axis", lambda x: np.prod(x, axis=2), [(_AX, "pos")],
+   api="prod", kwargs={"axis": 2},
+   tol={"float16": (3e-2, 3e-2), "bfloat16": (2e-1, 2e-1)})
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+
+def _posdef(rng, n=4):
+    a = rng.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+_DOMAINS["posdef4"] = lambda rng, sh: _posdef(rng, sh[0])
+_DOMAINS["wellcond4"] = lambda rng, sh: (
+    rng.randn(*sh).astype(np.float32) + 3.0 * np.eye(sh[0], dtype=np.float32))
+
+_LTOL = {"float16": (3e-2, 3e-2), "bfloat16": (1.5e-1, 1.5e-1)}
+_S("cholesky", np.linalg.cholesky, [((4, 4), "posdef4")],
+   api="linalg.cholesky", dtypes=("float32",))
+_S("det", np.linalg.det, [((4, 4), "wellcond4")], api="linalg.det",
+   dtypes=("float32",))
+_S("slogdet", lambda a: np.stack(np.linalg.slogdet(a)),
+   [((4, 4), "wellcond4")], api="linalg.slogdet", grad=False,
+   wrap=lambda f: (lambda x, **k: _stack_pair(f(x))), dtypes=("float32",))
+_S("inverse", np.linalg.inv, [((4, 4), "wellcond4")], dtypes=("float32",))
+_S("matrix_power", lambda a: np.linalg.matrix_power(a, 3),
+   [((4, 4), "small")], api="linalg.matrix_power", kwargs={"n": 3},
+   tol=_LTOL)
+_S("solve", lambda a, b: np.linalg.solve(a, b),
+   [((4, 4), "wellcond4"), ((4, 2), "any")], api="linalg.solve",
+   dtypes=("float32",))
+_S("triangular_solve", lambda a, b: np.linalg.solve(np.tril(a) + 2 * np.eye(4,
+   dtype=a.dtype), b),
+   [((4, 4), "any"), ((4, 2), "any")], api="linalg.triangular_solve",
+   kwargs={"upper": False},
+   wrap=lambda f: (lambda a, b, **k: f(
+       a.tril() + 2.0 * _eye_like(a), b, **k)), dtypes=("float32",))
+_S("matrix_norm_fro", lambda a: np.linalg.norm(a),
+   [((3, 4), "any")], api="linalg.norm", tol=_LTOL)
+_S("vector_norm_1", lambda a: np.abs(a).sum(), [((6,), "any")],
+   api="linalg.norm", kwargs={"p": 1})
+_S("eigvalsh", lambda a: np.linalg.eigvalsh(a), [((4, 4), "posdef4")],
+   api="linalg.eigvalsh", grad=False, dtypes=("float32",))
+_S("matrix_rank", lambda a: np.array(np.linalg.matrix_rank(a)),
+   [((4, 4), "wellcond4")], api="linalg.matrix_rank", grad=False,
+   dtypes=("float32",))
+_S("pinv", np.linalg.pinv, [((4, 3), "any")], api="linalg.pinv", grad=False,
+   dtypes=("float32",))
+
+
+def _stack_pair(out):
+    import paddle_tpu as paddle
+
+    return paddle.stack(list(out))
+
+
+def _eye_like(a):
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(np.eye(a.shape[-1], dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# nn losses / similarity
+# ---------------------------------------------------------------------------
+_S("mse_loss", lambda x, y: ((x - y) ** 2).mean(), _B,
+   api="nn.functional.mse_loss")
+_S("l1_loss", lambda x, y: np.abs(x - y).mean(), _B,
+   api="nn.functional.l1_loss")
+_S("smooth_l1_loss", lambda x, y: np.where(
+    np.abs(x - y) < 1.0, 0.5 * (x - y) ** 2, np.abs(x - y) - 0.5).mean(),
+   _B, api="nn.functional.smooth_l1_loss")
+_S("binary_cross_entropy", lambda p, t: -(t * np.log(p)
+                                          + (1 - t) * np.log1p(-p)).mean(),
+   [(_SH, "prob"), (_SH, "prob")], api="nn.functional.binary_cross_entropy",
+   tol={"float16": (3e-2, 3e-2), "bfloat16": (8e-2, 8e-2)})
+_S("kl_div", lambda lp, t: (t * (np.log(t) - lp)).mean(),
+   [(_SH, "small"), (_SH, "prob")], api="nn.functional.kl_div",
+   kwargs={"reduction": "mean"}, grad_inputs=[0])
+_S("cosine_similarity", lambda a, b: (a * b).sum(-1)
+   / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)),
+   _B, api="nn.functional.cosine_similarity")
+_S("log_sigmoid", lambda x: -np.log1p(np.exp(-x)), _U,
+   api="nn.functional.log_sigmoid")
+_S("softshrink", lambda x: np.where(x > 0.5, x - 0.5,
+                                    np.where(x < -0.5, x + 0.5, 0.0)),
+   _U, api="nn.functional.softshrink")
+_S("hardshrink", lambda x: np.where(np.abs(x) > 0.5, x, 0.0), _U,
+   api="nn.functional.hardshrink")
+_S("celu", lambda x: np.where(x > 0, x, np.expm1(x)), _U,
+   api="nn.functional.celu")
+_S("thresholded_relu", lambda x: np.where(x > 1.0, x, 0.0),
+   [(_SH, "offint")], api="nn.functional.thresholded_relu")
+_S("relu6", lambda x: np.clip(x, 0, 6), _U, api="nn.functional.relu6")
+_S("normalize", lambda x: x / np.linalg.norm(x, axis=-1, keepdims=True),
+   [(_SH, "nonzero")], api="nn.functional.normalize")
+
+# ---------------------------------------------------------------------------
+# multi-input / tuple-output manipulation
+# ---------------------------------------------------------------------------
+_S("concat", lambda a, b: np.concatenate([a, b], axis=0), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b], **k)), kwargs={"axis": 0})
+_S("stack", lambda a, b: np.stack([a, b], axis=0), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b], **k)), kwargs={"axis": 0})
+_S("hstack", lambda a, b: np.hstack([a, b]), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b])))
+_S("vstack", lambda a, b: np.vstack([a, b]), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b])))
+_S("dstack", lambda a, b: np.dstack([a, b]), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b])))
+_S("column_stack", lambda a, b: np.column_stack([a, b]), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b])))
+_S("row_stack", lambda a, b: np.vstack([a, b]), _B,
+   wrap=lambda f: (lambda a, b, **k: f([a, b])))
+_S("block_diag", lambda a, b: np.block(
+    [[a, np.zeros((a.shape[0], b.shape[1]), a.dtype)],
+     [np.zeros((b.shape[0], a.shape[1]), a.dtype), b]]),
+   [((2, 3), "any"), ((3, 2), "any")],
+   wrap=lambda f: (lambda a, b, **k: f([a, b])))
+_S("split", lambda x: tuple(np.split(x, 2, axis=1)), [((3, 4), "any")],
+   kwargs={"num_or_sections": 2, "axis": 1})
+_S("chunk", lambda x: tuple(np.split(x, 2, axis=0)), [((4, 3), "any")],
+   kwargs={"chunks": 2, "axis": 0})
+_S("unbind", lambda x: tuple(x), [((2, 4), "any")],
+   wrap=lambda f: (lambda x, **k: tuple(f(x, **k))),
+   kwargs={"axis": 0})
+_S("unstack", lambda x: tuple(x), [((2, 4), "any")],
+   wrap=lambda f: (lambda x, **k: tuple(f(x, **k))), kwargs={"axis": 0})
+_S("where", np.where, [(_SH, "bool"), (_SH, "any"), (_SH, "any")],
+   grad_inputs=[1, 2])
+_S("einsum_matmul", lambda a, b: np.einsum("ij,jk->ik", a, b),
+   [((3, 4), "any"), ((4, 5), "any")], api="einsum",
+   wrap=lambda f: (lambda a, b, **k: f("ij,jk->ik", a, b)), tol=_MM_TOL)
+_S("einsum_trace", lambda a: np.einsum("ii->", a), [((4, 4), "any")],
+   api="einsum", wrap=lambda f: (lambda a, **k: f("ii->", a)))
+_S("masked_fill", lambda x, m: np.where(m, 0.5, x),
+   [(_SH, "any"), (_SH, "bool")], kwargs={"value": 0.5}, grad_inputs=[0])
+_S("diagflat", np.diagflat, [((4,), "any")])
+_S("diag_embed", lambda x: np.stack([np.diag(r) for r in x]),
+   [((3, 4), "any")])
+_S("flip_multi", lambda x: np.flip(x, (0, 1)), [(_SH, "any")], api="flip",
+   kwargs={"axis": [0, 1]})
+_DOMAINS["sorted"] = lambda rng, sh: np.sort(
+    rng.uniform(-2, 2, sh).astype(np.float32))
+_S("bucketize", lambda x, e: np.searchsorted(e, x, side="left")
+   .astype(np.int64),
+   [(_SH, "any"), ((5,), "sorted")], grad=False, dtypes=("float32",),
+   wrap=lambda f: (lambda x, e, **k: f(x, e, right=False)))
 
 # ---------------------------------------------------------------------------
 # white list: ops excluded from a specific check, with the reason recorded

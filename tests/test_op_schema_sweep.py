@@ -49,6 +49,8 @@ def test_output_dtype_sweep(name):
     rng = np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
     inputs = s.sample(rng)
     op = s.resolve()
+    if s.wrap is not None:
+        op = s.wrap(op)
 
     def op_fn(*ts):
         return op(*ts, **s.kwargs)
@@ -86,6 +88,8 @@ def test_grad_finite_difference(name):
     rng = np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
     inputs = s.sample(rng)
     op = s.resolve()
+    if s.wrap is not None:
+        op = s.wrap(op)
 
     def op_fn(*ts):
         return op(*ts, **s.kwargs)
