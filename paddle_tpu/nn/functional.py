@@ -799,6 +799,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             lab_i = lab.astype(jnp.int32)
             if lab_i.ndim == logp.ndim and lab_i.shape[axis] == 1:
                 lab_i = jnp.squeeze(lab_i, axis)
+            # one-hot contraction, NOT take_along_axis: on TPU the one-hot
+            # product lowers onto the MXU and is ~4% faster end-to-end at
+            # LM vocab sizes (measured on the 134M bench; gathers lower to
+            # slow dynamic-slice sequences)
             onehot = jax.nn.one_hot(lab_i, n_class, dtype=logp.dtype, axis=axis)
             if label_smoothing > 0.0:
                 onehot = onehot * (1 - label_smoothing) + label_smoothing / n_class
