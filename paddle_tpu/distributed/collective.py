@@ -439,19 +439,58 @@ def ppermute(tensor: Tensor, perm, group: Optional[Group] = None):
     return apply_op("ppermute", lambda x: jax.lax.ppermute(x, ax, perm), tensor)
 
 
+_P2P_SPMD_MSG = (
+    "point-to-point send/recv inside an SPMD program must be expressed as a "
+    "permutation: use paddle_tpu.distributed.ppermute (XLA collective-permute); "
+    "per-pair send/recv is not a compilable TPU primitive")
+
+
+def _eager_p2p_applies(tensor: Tensor, group, peer: int, role: str) -> bool:
+    """Gate for the eager 2-process p2p path. Misuse raises — a silent
+    no-op here would hand the caller an unfilled receive buffer."""
+    from . import eager_collectives as ec
+
+    if ec.process_world_size() <= 1 or not ec.is_concrete(tensor._data):
+        return False
+    _eager_multiprocess(tensor, group)  # raises on proper subgroups
+    W = ec.process_world_size()
+    if W != 2:
+        raise NotImplementedError(
+            f"eager send/recv is supported for 2-process worlds (the pair IS "
+            f"the world, so it compiles as one matched broadcast); with "
+            f"{W} processes route p2p through dist.eager_shift or ppermute")
+    me = jax.process_index()
+    if peer == me or peer not in (0, 1):
+        raise ValueError(
+            f"{role}={peer} is invalid for rank {me} in a 2-process world "
+            "(the peer must be the other rank)")
+    return True
+
+
 def send(tensor: Tensor, dst=0, group: Optional[Group] = None, sync_op=True):
+    """Eager p2p (parity: distributed/communication/send.py). In a
+    2-process world send/recv execute as one matched broadcast-shaped
+    compiled program (sender = source row)."""
     ctx = _current_spmd()
     if ctx is None:
+        if _eager_p2p_applies(tensor, group, dst, "dst"):
+            from . import eager_collectives as ec
+
+            ec.eager_broadcast(tensor._data, src=jax.process_index())
         return tensor
-    raise RuntimeError(
-        "point-to-point send/recv inside an SPMD program must be expressed as a "
-        "permutation: use paddle_tpu.distributed.ppermute (XLA collective-permute); "
-        "per-pair send/recv is not a compilable TPU primitive"
-    )
+    raise RuntimeError(_P2P_SPMD_MSG)
 
 
 def recv(tensor: Tensor, src=0, group: Optional[Group] = None, sync_op=True):
-    return send(tensor, src, group, sync_op)
+    ctx = _current_spmd()
+    if ctx is None:
+        if _eager_p2p_applies(tensor, group, src, "src"):
+            from . import eager_collectives as ec
+
+            return _eager_result(tensor,
+                                 ec.eager_broadcast(tensor._data, src=src))
+        return tensor
+    raise RuntimeError(_P2P_SPMD_MSG)
 
 
 isend = send

@@ -90,6 +90,28 @@ mx = paddle.to_tensor(np.full((3,), float(rank), np.float32))
 dist.all_reduce(mx, op=dist.ReduceOp.MAX)
 assert np.allclose(mx.numpy(), 1.0)
 
+# p2p send/recv: the 2-process pair runs one matched broadcast program
+if rank == 0:
+    dist.send(paddle.to_tensor(np.array([7.0, 8.0], np.float32)), dst=1)
+else:
+    rbuf = paddle.to_tensor(np.zeros(2, np.float32))
+    dist.recv(rbuf, src=0)
+    assert np.allclose(rbuf.numpy(), [7.0, 8.0]), rbuf.numpy()
+# reverse direction
+if rank == 1:
+    dist.send(paddle.to_tensor(np.array([3.0], np.float32)), dst=0)
+else:
+    rb2 = paddle.to_tensor(np.zeros(1, np.float32))
+    dist.recv(rb2, src=1)
+    assert np.allclose(rb2.numpy(), [3.0]), rb2.numpy()
+
+# p2p misuse raises, never silently no-ops
+try:
+    dist.recv(paddle.to_tensor(np.zeros(2, np.float32)), src=rank)  # self
+    raise SystemExit("recv from self did not raise")
+except ValueError:
+    pass
+
 # broadcast/all_reduce must preserve trainability (leaf stays a leaf)
 p0 = paddle.to_tensor(np.full((2,), float(rank), np.float32), stop_gradient=False)
 dist.broadcast(p0, src=0)
