@@ -50,6 +50,19 @@ def _param_sharding(p: Parameter, mesh: ProcessMesh, zero_axis: Optional[str]) -
     return NamedSharding(mesh.jax_mesh, PartitionSpec())
 
 
+def _place(arr, sharding) -> jax.Array:
+    """Place a host-complete array under a (possibly multi-host) sharding.
+    Single controller: device_put. Multi-controller (one process per
+    host — the TPU pod model): device_put cannot target non-addressable
+    devices, so assemble the global array from a callback that slices
+    this host's portions out of the full value every process holds."""
+    if jax.process_count() > 1:
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+    return jax.device_put(arr, sharding)
+
+
 class ShardedTrainStep:
     """Build and run a pjit training step for a Layer.
 
@@ -91,9 +104,9 @@ class ShardedTrainStep:
         self._replicated = NamedSharding(mesh.jax_mesh, PartitionSpec())
         # live sharded state
         self.params = {
-            k: jax.device_put(p._data, self._param_shardings[k]) for k, p in self._param_objs.items()
+            k: _place(p._data, self._param_shardings[k]) for k, p in self._param_objs.items()
         }
-        self.buffers = {k: jax.device_put(b._data, self._replicated)
+        self.buffers = {k: _place(b._data, self._replicated)
                         for k, b in self._buffer_objs.items()}
         self.opt_state = self._shard_opt_state(self._fopt.init(self.params))
         self._step_fn = None
@@ -110,8 +123,8 @@ class ShardedTrainStep:
 
         def place(subtree):
             if isinstance(subtree, dict) and set(subtree) == set(self.params):
-                return {k: jax.device_put(v, self._param_shardings[k]) for k, v in subtree.items()}
-            return jax.tree.map(lambda x: jax.device_put(x, self._replicated), subtree)
+                return {k: _place(v, self._param_shardings[k]) for k, v in subtree.items()}
+            return jax.tree.map(lambda x: _place(x, self._replicated), subtree)
 
         return {k: place(v) for k, v in state.items()}
 
@@ -169,13 +182,23 @@ class ShardedTrainStep:
     # ------------------------------------------------------------------
     def _stage_batch(self, inputs, labels):
         """Normalize + device_put one batch with the engine's data specs;
-        lazily builds the compiled step."""
+        lazily builds the compiled step.
+
+        Multi-controller (one process per host, the TPU pod execution
+        model): each process passes its PROCESS-LOCAL batch shard and the
+        global array is assembled with make_array_from_process_local_data
+        — jax.device_put cannot target non-addressable devices (reference
+        role: fleet's per-rank data feeding into the hybrid program)."""
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        multi = jax.process_count() > 1
 
         def put(x, spec):
             data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-            return jax.device_put(data, self._data_sharding(data.ndim, spec))
+            sharding = self._data_sharding(data.ndim, spec)
+            if multi:
+                return jax.make_array_from_process_local_data(sharding, data)
+            return jax.device_put(data, sharding)
 
         in_datas = tuple(put(x, self._batch_spec) for x in inputs)
         lab_datas = tuple(put(y, self._label_spec) for y in labels)
@@ -242,10 +265,10 @@ class ShardedTrainStep:
         silently ignored by the compiled step. Optimizer moments are kept
         (matching resume semantics where opt state is loaded separately)."""
         for k, p in self._param_objs.items():
-            self.params[k] = jax.device_put(jnp.asarray(p._data),
-                                            self._param_shardings[k])
+            self.params[k] = _place(jnp.asarray(p._data),
+                                    self._param_shardings[k])
         for k, b in self._buffer_objs.items():
-            self.buffers[k] = jax.device_put(jnp.asarray(b._data), self._replicated)
+            self.buffers[k] = _place(jnp.asarray(b._data), self._replicated)
 
     def state_dict(self):
         self.sync_weights_to_model()

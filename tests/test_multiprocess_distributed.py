@@ -38,9 +38,13 @@ assert jax.process_count() == world, (jax.process_count(), world)
 """
 
 
-def _launch(tmp_path, body: str, nproc: int = 2, timeout: int = 240):
+def _launch(tmp_path, body: str, nproc: int = 2, timeout: int = 240,
+            devices_per_proc: int = 1):
     script = tmp_path / "worker.py"
-    script.write_text(WORKER_PRELUDE.format(repo=REPO) + body)
+    prelude = WORKER_PRELUDE.replace(
+        "--xla_force_host_platform_device_count=1",
+        f"--xla_force_host_platform_device_count={devices_per_proc}")
+    script.write_text(prelude.format(repo=REPO) + body)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
@@ -292,6 +296,64 @@ if rank == 0:
         opt.step()
         opt.clear_grad()
         ref.append(float(l))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_multicontroller_gspmd_train_step(tmp_path):
+    """The TPU pod execution model: 2 PROCESSES x 4 devices each, one
+    GSPMD train step compiled over all 8 global devices (dp=4 x mp=2),
+    per-process local batch shards assembled via
+    make_array_from_process_local_data. Loss parity vs a single-process
+    replica — the reference's multi-node fleet hybrid-parallel oracle."""
+    body = """
+import jax as _jax
+assert _jax.device_count() == 8, _jax.device_count()
+assert _jax.local_device_count() == 4
+
+from paddle_tpu import nn
+from paddle_tpu.distributed.engine import ShardedTrainStep
+
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+lossfn = nn.CrossEntropyLoss()
+mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+step = ShardedTrainStep(model, lambda o, lab: lossfn(o, lab), opt, mesh,
+                        dp_axis="dp")
+
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype(np.float32)
+Y = rng.randint(0, 4, 16).astype(np.int64)
+half = 8
+xb = X[rank*half:(rank+1)*half]
+yb = Y[rank*half:(rank+1)*half]
+losses = [float(step.step(paddle.to_tensor(xb), paddle.to_tensor(yb)))
+          for _ in range(3)]
+if rank == 0:
+    import json
+    open(os.path.join(os.getcwd(), "mc_losses.json"), "w").write(json.dumps(losses))
+"""
+    _launch(tmp_path, body, nproc=2, timeout=300, devices_per_proc=4)
+    got = json.loads((tmp_path / "mc_losses.json").read_text())
+
+    # single-process full-batch replica
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.engine import ShardedTrainStep
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    lossfn = nn.CrossEntropyLoss()
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    step = ShardedTrainStep(model, lambda o, lab: lossfn(o, lab), opt, mesh,
+                            dp_axis="dp")
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randint(0, 4, 16).astype(np.int64)
+    ref = [float(step.step(paddle.to_tensor(X), paddle.to_tensor(Y)))
+           for _ in range(3)]
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 
