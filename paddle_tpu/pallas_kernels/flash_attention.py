@@ -36,9 +36,28 @@ try:  # TPU-only module; CPU tests run in interpret mode
     from jax.experimental.pallas import tpu as pltpu
 
     _HAS_TPU_PALLAS = True
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_TPU_PALLAS = False
+
+if _HAS_TPU_PALLAS:
+    # raise Mosaic's 16 MB default scoped-VMEM cap: the backward kernels
+    # hold full-sequence q/do (dK/dV pass) and k/v (dQ pass) refs, which
+    # at seq >= 8192 exceed 16 MB while the chip has 128 MB VMEM. A
+    # constructor failure must SURFACE (silently dropping the cap would
+    # break the documented seq-8192 support); older jax spells the class
+    # TPUCompilerParams.
+    _params_cls = (getattr(pltpu, "CompilerParams", None)
+                   or getattr(pltpu, "TPUCompilerParams"))
+    _VMEM_PARAMS = _params_cls(vmem_limit_bytes=100 * 1024 * 1024)
+else:
+    _VMEM_PARAMS = None
+
+
+def _compiler_kwargs():
+    if _VMEM_PARAMS is None or _interpret():
+        return {}
+    return {"compiler_params": _VMEM_PARAMS}
 
 NEG_INF = -1e30
 
@@ -170,6 +189,7 @@ def _flash_fwd(q, k, v, seg, *, causal: bool, sm_scale: float, block_q: int,
         out_specs=(pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                    pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0))),
         interpret=_interpret(),
+        **_compiler_kwargs(),
     )(*args)
     return out, lse[..., 0]
 
@@ -329,6 +349,7 @@ def _flash_bwd(q, k, v, seg, out, lse, do, *, causal: bool, sm_scale: float,
         out_specs=(pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
                    pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))),
         interpret=_interpret(),
+        **_compiler_kwargs(),
     )(*args)
 
     # dQ pass
@@ -364,6 +385,7 @@ def _flash_bwd(q, k, v, seg, out, lse, do, *, causal: bool, sm_scale: float,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         interpret=_interpret(),
+        **_compiler_kwargs(),
     )(*args)
     return dq, dk, dv
 
@@ -412,6 +434,10 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale=None,
     end-to-end train step gains +16% at seq 1024 and +39% at seq 4096
     (fewer grid launches, better MXU occupancy per block; VMEM still
     fits at head_dim <= 128). Blocks are clamped to the sequence length.
+    Sequences to at least 8192 train on one chip (the raised Mosaic VMEM
+    cap covers the backward's full-sequence refs); beyond that, shard the
+    sequence across chips with ring attention / Ulysses
+    (distributed/sequence_parallel.py).
 
     segment_ids: optional [b, s] int32 — packed-sequence (varlen) masking;
     attention only within equal segment ids.
