@@ -434,10 +434,10 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale=None,
     end-to-end train step gains +16% at seq 1024 and +39% at seq 4096
     (fewer grid launches, better MXU occupancy per block; VMEM still
     fits at head_dim <= 128). Blocks are clamped to the sequence length.
-    Sequences to at least 8192 train on one chip (the raised Mosaic VMEM
-    cap covers the backward's full-sequence refs); beyond that, shard the
-    sequence across chips with ring attention / Ulysses
-    (distributed/sequence_parallel.py).
+    Sequences to at least 16384 train on one chip (the raised Mosaic VMEM
+    cap covers the backward's full-sequence refs; measured 35.9k tok/s at
+    16k); beyond that, shard the sequence across chips with ring
+    attention / Ulysses (distributed/sequence_parallel.py).
 
     segment_ids: optional [b, s] int32 — packed-sequence (varlen) masking;
     attention only within equal segment ids.
