@@ -129,3 +129,89 @@ def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
     if not isinstance(data, jax.core.Tracer):
         data = jax.device_put(data, jax.devices()[0])
     return Tensor(data, stop_gradient=dist_tensor.stop_gradient)
+
+
+def put_global(arr, sharding, process_local: bool = False):
+    """Place a host array under a (possibly multi-host) sharding — the ONE
+    pod data-path rule (engine._stage_batch and ShardDataloader share it).
+
+    Single controller: plain device_put. Multi-controller (one process per
+    host): device_put cannot target non-addressable devices, so either
+    ``arr`` is this process's LOCAL shard (process_local=True,
+    make_array_from_process_local_data) or every process holds the FULL
+    value and a callback slices out the local portions."""
+    if jax.process_count() > 1:
+        a = np.asarray(arr)
+        if process_local:
+            return jax.make_array_from_process_local_data(sharding, a)
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+    return jax.device_put(arr, sharding)
+
+
+class ShardDataloader:
+    """Distributed data feeding (parity: auto_parallel/api.py:2953
+    ShardDataloader / :3230 shard_dataloader).
+
+    TPU form: each yielded tensor becomes a DistTensor batch-sharded over
+    its mesh's ``shard_dim`` axis (GSPMD splits the batch — the
+    reference's "split dataloader by shard_dim" collapses into a
+    placement). With ``is_dataset_splitted=True`` under multi-controller
+    execution, each process contributes its LOCAL shard and the global
+    batch is assembled process-locally (the pod data path)."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted: bool = False):
+        self._loader = dataloader
+        self._meshes = list(meshes) if isinstance(meshes, (list, tuple)) \
+            else [meshes]
+        self._input_keys = list(input_keys) if input_keys else None
+        if shard_dims is None or isinstance(shard_dims, (str, int)):
+            shard_dims = [shard_dims] * len(self._meshes)
+        self._shard_dims = list(shard_dims)
+        if is_dataset_splitted and all(d is None for d in self._shard_dims):
+            raise ValueError(
+                "is_dataset_splitted=True requires shard_dims: per-process "
+                "local shards must map onto a sharded mesh dimension")
+        self._splitted = is_dataset_splitted
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _mesh_dim(self, i: int):
+        mesh = self._meshes[i if i < len(self._meshes) else -1]
+        dim = self._shard_dims[i if i < len(self._shard_dims) else -1]
+        if isinstance(dim, int):
+            dim = mesh.dim_names[dim]
+        return mesh, dim
+
+    def _place(self, t, i: int):
+        mesh, dim = self._mesh_dim(i)
+        placements = [Replicate()] * mesh.ndim
+        if dim is not None:
+            placements[mesh.dim_names.index(dim)] = Shard(0)
+        arr = t._data if isinstance(t, Tensor) else np.asarray(t)
+        sharding = named_sharding(mesh, placements, np.ndim(arr))
+        out = Tensor(put_global(arr, sharding, process_local=self._splitted),
+                     stop_gradient=True)
+        out.process_mesh = mesh
+        out.placements = placements
+        return out
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                keys = self._input_keys or list(batch.keys())
+                yield {k: self._place(batch[k], i)
+                       for i, k in enumerate(keys)}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(t, i)
+                                  for i, t in enumerate(batch))
+            else:
+                yield self._place(batch, 0)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted: bool = False) -> ShardDataloader:
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)

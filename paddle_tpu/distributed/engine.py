@@ -51,16 +51,11 @@ def _param_sharding(p: Parameter, mesh: ProcessMesh, zero_axis: Optional[str]) -
 
 
 def _place(arr, sharding) -> jax.Array:
-    """Place a host-complete array under a (possibly multi-host) sharding.
-    Single controller: device_put. Multi-controller (one process per
-    host — the TPU pod model): device_put cannot target non-addressable
-    devices, so assemble the global array from a callback that slices
-    this host's portions out of the full value every process holds."""
-    if jax.process_count() > 1:
-        arr = np.asarray(arr)
-        return jax.make_array_from_callback(arr.shape, sharding,
-                                            lambda idx: arr[idx])
-    return jax.device_put(arr, sharding)
+    """Host-complete value -> sharded global array (shared pod data-path
+    rule; see distributed.api.put_global)."""
+    from .api import put_global
+
+    return put_global(arr, sharding, process_local=False)
 
 
 class ShardedTrainStep:
@@ -194,11 +189,15 @@ class ShardedTrainStep:
         multi = jax.process_count() > 1
 
         def put(x, spec):
+            from .api import put_global
+
             data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
             sharding = self._data_sharding(data.ndim, spec)
-            if multi:
-                return jax.make_array_from_process_local_data(sharding, data)
-            return jax.device_put(data, sharding)
+            # a pre-placed DistTensor batch (ShardDataloader) is already
+            # global — hand it to jit as-is
+            if multi and getattr(data, "sharding", None) == sharding:
+                return data
+            return put_global(data, sharding, process_local=multi)
 
         in_datas = tuple(put(x, self._batch_spec) for x in inputs)
         lab_datas = tuple(put(y, self._label_spec) for y in labels)
