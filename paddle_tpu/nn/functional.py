@@ -506,14 +506,17 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None) -> Tensor
         if h % oh == 0 and w % ow == 0:
             out = a2.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
         else:
-            # general adaptive: interpolate region means
-            hi = [int(pymath.floor(i * h / oh)) for i in range(oh)] + [h]
-            wi = [int(pymath.floor(i * w / ow)) for i in range(ow)] + [w]
+            # general adaptive regions (reference pooling.h AdaptStartIndex/
+            # AdaptEndIndex): start = floor(i*in/out), end = ceil((i+1)*in/out)
+            h0 = [int(pymath.floor(i * h / oh)) for i in range(oh)]
+            h1 = [int(pymath.ceil((i + 1) * h / oh)) for i in range(oh)]
+            w0 = [int(pymath.floor(j * w / ow)) for j in range(ow)]
+            w1 = [int(pymath.ceil((j + 1) * w / ow)) for j in range(ow)]
             rows = []
             for i in range(oh):
                 cols = []
                 for j in range(ow):
-                    cols.append(a2[:, :, hi[i]:hi[i + 1], wi[j]:wi[j + 1]].mean(axis=(2, 3)))
+                    cols.append(a2[:, :, h0[i]:h1[i], w0[j]:w1[j]].mean(axis=(2, 3)))
                 rows.append(jnp.stack(cols, axis=-1))
             out = jnp.stack(rows, axis=-2)
         if data_format != "NCHW":
@@ -1031,8 +1034,43 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Te
     return apply_op("unfold", _f, x)
 
 
+def _interp_ratio(in_len: int, out_len: int, align_corners: bool) -> float:
+    """Reference ratio (interpolate_kernel.cc): (in-1)/(out-1) with corner
+    alignment, in/out otherwise; 0 for single-pixel outputs."""
+    if out_len <= 1:
+        return 0.0
+    if align_corners:
+        return (in_len - 1) / (out_len - 1)
+    return in_len / out_len
+
+
+def _nearest_idx(in_len, out_len, align_corners):
+    k = jnp.arange(out_len, dtype=jnp.float32)
+    r = _interp_ratio(in_len, out_len, align_corners)
+    # half-UP rounding (reference lround), not round-half-to-even
+    idx = jnp.floor(r * k + 0.5) if align_corners else jnp.floor(r * k)
+    return jnp.clip(idx.astype(jnp.int32), 0, in_len - 1)
+
+
+def _linear_lo_hi_w(in_len, out_len, align_corners, align_mode):
+    k = jnp.arange(out_len, dtype=jnp.float32)
+    r = _interp_ratio(in_len, out_len, align_corners)
+    if align_mode == 0 and not align_corners:
+        src = jnp.maximum(r * (k + 0.5) - 0.5, 0.0)  # half-pixel, clamped
+    else:
+        src = r * k
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_len - 1)
+    hi = jnp.minimum(lo + 1, in_len - 1)
+    w = (src - lo.astype(jnp.float32)).astype(jnp.float32)
+    return lo, hi, w
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None) -> Tensor:
+    """Parity: phi/kernels/cpu/interpolate_kernel.cc — EXACT index math
+    (nearest floor/lround split, bilinear align_mode/align_corners source
+    positions, area as adaptive block means); jax.image.resize only for
+    bicubic."""
     x = ensure_tensor(x)
 
     def _f(a):
@@ -1046,8 +1084,42 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
             oh, ow = int(h * sf[0]), int(w * sf[1])
-        method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "cubic", "area": "linear"}.get(mode, mode)
-        out = jax.image.resize(a, (a.shape[0], a.shape[1], oh, ow), method=method)
+        if mode == "nearest":
+            iy = _nearest_idx(h, oh, align_corners)
+            ix = _nearest_idx(w, ow, align_corners)
+            out = a[:, :, iy[:, None], ix[None, :]]
+        elif mode == "bilinear":
+            ylo, yhi, wy = _linear_lo_hi_w(h, oh, align_corners, align_mode)
+            xlo, xhi, wx = _linear_lo_hi_w(w, ow, align_corners, align_mode)
+            cal = jnp.float64 if a.dtype == jnp.float64 else jnp.float32
+            af = a.astype(cal)
+            wy = wy.astype(cal)
+            wx = wx.astype(cal)
+            top = af[:, :, ylo, :] * (1 - wy)[None, None, :, None] \
+                + af[:, :, yhi, :] * wy[None, None, :, None]
+            out = (top[:, :, :, xlo] * (1 - wx)[None, None, None, :]
+                   + top[:, :, :, xhi] * wx[None, None, None, :]).astype(a.dtype)
+        elif mode == "area":
+            # reference/torch area = adaptive average pooling block means,
+            # NOT an antialiased linear resize
+            if h % oh == 0 and w % ow == 0:
+                out = a.reshape(a.shape[0], a.shape[1], oh, h // oh,
+                                ow, w // ow).mean(axis=(3, 5)).astype(a.dtype)
+            else:
+                h0 = [int(pymath.floor(i * h / oh)) for i in range(oh)]
+                h1 = [int(pymath.ceil((i + 1) * h / oh)) for i in range(oh)]
+                w0 = [int(pymath.floor(j * w / ow)) for j in range(ow)]
+                w1 = [int(pymath.ceil((j + 1) * w / ow)) for j in range(ow)]
+                rows = []
+                for i in range(oh):
+                    cols = [a[:, :, h0[i]:h1[i], w0[j]:w1[j]].mean(axis=(2, 3))
+                            for j in range(ow)]
+                    rows.append(jnp.stack(cols, axis=-1))
+                out = jnp.stack(rows, axis=-2).astype(a.dtype)
+        else:
+            method = {"bicubic": "cubic"}.get(mode, mode)
+            out = jax.image.resize(a, (a.shape[0], a.shape[1], oh, ow),
+                                   method=method)
         if data_format != "NCHW":
             out = jnp.transpose(out, (0, 2, 3, 1))
         return out

@@ -1,0 +1,253 @@
+"""Independent-oracle validation: structured nn ops vs torch CPU.
+
+The reference validates GPU kernels against independently-implemented
+CPU kernels (test/legacy_test op tests run both backends). Our XLA ops
+need the same independence: numpy oracles cover elementwise/reduction
+ops (test_op_schema_sweep), and torch (CPU, baked into the image)
+provides the oracle for the structured ops — convolutions, pooling,
+normalization, interpolation, grid_sample — whose hand-written numpy
+references would just re-implement the same algorithm twice.
+
+Forward AND input-gradient parity per op.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch.manual_seed(0)
+
+
+def _t(a):
+    return torch.tensor(a, requires_grad=np.issubdtype(a.dtype, np.floating))
+
+
+def _check(p_out, t_out, atol=1e-4, rtol=1e-4):
+    np.testing.assert_allclose(p_out.numpy(), t_out.detach().numpy(),
+                               atol=atol, rtol=rtol)
+
+
+def _check_grad(p_fn, t_fn, arrays, grad_idx=0, atol=1e-3, rtol=1e-3):
+    """Compare d(sum(out * w))/d input between the frameworks."""
+    pts = [paddle.to_tensor(a) for a in arrays]
+    pts[grad_idx].stop_gradient = False
+    p_out = p_fn(*pts)
+    w = np.linspace(0.5, 1.5, int(np.prod(p_out.shape)),
+                    dtype=np.float32).reshape(p_out.shape)
+    (p_out * paddle.to_tensor(w)).sum().backward()
+    p_grad = pts[grad_idx].grad.numpy()
+
+    tts = [_t(a) for a in arrays]
+    t_out = t_fn(*tts)
+    (t_out * torch.tensor(w)).sum().backward()
+    t_grad = tts[grad_idx].grad.numpy()
+    np.testing.assert_allclose(p_grad, t_grad, atol=atol, rtol=rtol)
+
+
+class TestConvFamily:
+    def test_conv2d(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 10, 10).astype(np.float32)
+        w = rng.randn(5, 3, 3, 3).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        for stride, pad, dil in [(1, 0, 1), (2, 1, 1), (1, 2, 2)]:
+            p = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                         paddle.to_tensor(b), stride=stride, padding=pad,
+                         dilation=dil)
+            t = torch.nn.functional.conv2d(_t(x), _t(w), _t(b), stride=stride,
+                                           padding=pad, dilation=dil)
+            _check(p, t)
+        _check_grad(
+            lambda x_, w_: F.conv2d(x_, w_, stride=2, padding=1),
+            lambda x_, w_: torch.nn.functional.conv2d(x_, w_, stride=2,
+                                                      padding=1),
+            [x, w])
+
+    def test_conv2d_groups(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = rng.randn(8, 2, 3, 3).astype(np.float32)  # groups=2
+        p = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), groups=2,
+                     padding=1)
+        t = torch.nn.functional.conv2d(_t(x), _t(w), groups=2, padding=1)
+        _check(p, t)
+
+    def test_conv1d_conv3d(self):
+        rng = np.random.RandomState(2)
+        x1 = rng.randn(2, 3, 12).astype(np.float32)
+        w1 = rng.randn(4, 3, 3).astype(np.float32)
+        _check(F.conv1d(paddle.to_tensor(x1), paddle.to_tensor(w1), padding=1),
+               torch.nn.functional.conv1d(_t(x1), _t(w1), padding=1))
+        x3 = rng.randn(1, 2, 5, 6, 6).astype(np.float32)
+        w3 = rng.randn(3, 2, 2, 3, 3).astype(np.float32)
+        _check(F.conv3d(paddle.to_tensor(x3), paddle.to_tensor(w3)),
+               torch.nn.functional.conv3d(_t(x3), _t(w3)))
+
+    def test_conv2d_transpose(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 4, 6, 6).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        for stride, pad in [(1, 0), (2, 1)]:
+            p = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                   stride=stride, padding=pad)
+            t = torch.nn.functional.conv_transpose2d(_t(x), _t(w),
+                                                     stride=stride,
+                                                     padding=pad)
+            _check(p, t)
+
+
+class TestPooling:
+    def test_max_avg_pool2d(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3, 9, 9).astype(np.float32)
+        for ks, st, pad in [(2, 2, 0), (3, 2, 1), (3, 1, 0)]:
+            _check(F.max_pool2d(paddle.to_tensor(x), ks, stride=st,
+                                padding=pad),
+                   torch.nn.functional.max_pool2d(_t(x), ks, stride=st,
+                                                  padding=pad))
+            # paddle's exclusive=True default == torch count_include_pad=False
+            _check(F.avg_pool2d(paddle.to_tensor(x), ks, stride=st,
+                                padding=pad),
+                   torch.nn.functional.avg_pool2d(_t(x), ks, stride=st,
+                                                  padding=pad,
+                                                  count_include_pad=False))
+        _check_grad(
+            lambda x_: F.max_pool2d(x_, 2, stride=2),
+            lambda x_: torch.nn.functional.max_pool2d(x_, 2, stride=2), [x])
+        _check_grad(
+            lambda x_: F.avg_pool2d(x_, 2, stride=2),
+            lambda x_: torch.nn.functional.avg_pool2d(x_, 2, stride=2), [x])
+
+    def test_adaptive_avg_pool2d(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        _check(F.adaptive_avg_pool2d(paddle.to_tensor(x), 4),
+               torch.nn.functional.adaptive_avg_pool2d(_t(x), 4))
+
+
+class TestNormalization:
+    def test_layer_norm(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(4, 6, 8).astype(np.float32)
+        g = rng.randn(8).astype(np.float32)
+        b = rng.randn(8).astype(np.float32)
+        p = F.layer_norm(paddle.to_tensor(x), 8, weight=paddle.to_tensor(g),
+                         bias=paddle.to_tensor(b))
+        t = torch.nn.functional.layer_norm(_t(x), (8,), _t(g), _t(b))
+        _check(p, t)
+        _check_grad(
+            lambda x_: F.layer_norm(x_, 8),
+            lambda x_: torch.nn.functional.layer_norm(x_, (8,)), [x])
+
+    def test_batch_norm_eval(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(4, 3, 5, 5).astype(np.float32)
+        mean = rng.randn(3).astype(np.float32)
+        var = rng.rand(3).astype(np.float32) + 0.5
+        g = rng.randn(3).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        p = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(mean),
+                         paddle.to_tensor(var), weight=paddle.to_tensor(g),
+                         bias=paddle.to_tensor(b), training=False)
+        t = torch.nn.functional.batch_norm(_t(x), torch.tensor(mean),
+                                           torch.tensor(var), _t(g), _t(b),
+                                           training=False)
+        _check(p, t)
+
+    def test_group_norm(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(2, 6, 4, 4).astype(np.float32)
+        p = F.group_norm(paddle.to_tensor(x), num_groups=3)
+        t = torch.nn.functional.group_norm(_t(x), 3)
+        _check(p, t)
+
+
+class TestResampling:
+    def test_interpolate_modes(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 3, 6, 6).astype(np.float32)
+        for mode, align in [("nearest", False), ("bilinear", False),
+                            ("bilinear", True)]:
+            p = F.interpolate(paddle.to_tensor(x), size=[9, 9], mode=mode,
+                              align_corners=align)
+            t = torch.nn.functional.interpolate(
+                _t(x), size=(9, 9), mode=mode,
+                **({} if mode == "nearest" else {"align_corners": align}))
+            _check(p, t, atol=1e-4)
+
+    def test_grid_sample(self):
+        rng = np.random.RandomState(10)
+        x = rng.randn(2, 3, 5, 5).astype(np.float32)
+        grid = rng.uniform(-0.9, 0.9, (2, 4, 4, 2)).astype(np.float32)
+        p = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                          mode="bilinear", padding_mode="zeros",
+                          align_corners=True)
+        t = torch.nn.functional.grid_sample(_t(x), torch.tensor(grid),
+                                            mode="bilinear",
+                                            padding_mode="zeros",
+                                            align_corners=True)
+        _check(p, t, atol=1e-4)
+
+    def test_pixel_shuffle(self):
+        rng = np.random.RandomState(11)
+        x = rng.randn(2, 8, 3, 3).astype(np.float32)
+        _check(F.pixel_shuffle(paddle.to_tensor(x), 2),
+               torch.nn.functional.pixel_shuffle(_t(x), 2))
+
+
+class TestSoftmaxLosses:
+    def test_cross_entropy_matches_torch(self):
+        rng = np.random.RandomState(12)
+        logits = rng.randn(16, 10).astype(np.float32)
+        labels = rng.randint(0, 10, 16).astype(np.int64)
+        p = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        t = torch.nn.functional.cross_entropy(_t(logits),
+                                              torch.tensor(labels))
+        _check(p, t)
+        _check_grad(
+            lambda lg: F.cross_entropy(lg, paddle.to_tensor(labels)),
+            lambda lg: torch.nn.functional.cross_entropy(
+                lg, torch.tensor(labels)),
+            [logits])
+
+    def test_nll_and_log_softmax(self):
+        rng = np.random.RandomState(13)
+        x = rng.randn(8, 5).astype(np.float32)
+        labels = rng.randint(0, 5, 8).astype(np.int64)
+        logp_p = F.log_softmax(paddle.to_tensor(x), axis=-1)
+        logp_t = torch.nn.functional.log_softmax(_t(x), dim=-1)
+        _check(logp_p, logp_t)
+        p = F.nll_loss(logp_p, paddle.to_tensor(labels))
+        t = torch.nn.functional.nll_loss(logp_t, torch.tensor(labels))
+        _check(p, t)
+
+
+class TestInterpolateExtra:
+    def test_nearest_align_corners_exact_half(self):
+        # in=3 -> out=5 with align_corners: src index 0.5 must round UP
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        p = F.interpolate(paddle.to_tensor(x), size=[5, 5], mode="nearest",
+                          align_corners=True).numpy()
+        # reference rows: lround(0.5*k) = [0, 1, 1, 2, 2]
+        np.testing.assert_array_equal(p[0, 0, :, 0], x[0, 0, [0, 1, 1, 2, 2], 0])
+
+    def test_area_is_block_mean(self):
+        rng = np.random.RandomState(14)
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        p = F.interpolate(paddle.to_tensor(x), size=[2, 2], mode="area")
+        t = torch.nn.functional.interpolate(_t(x), size=(2, 2), mode="area")
+        _check(p, t)
+        # non-divisible case
+        x2 = rng.randn(1, 2, 5, 7).astype(np.float32)
+        p2 = F.interpolate(paddle.to_tensor(x2), size=[2, 3], mode="area")
+        t2 = torch.nn.functional.interpolate(_t(x2), size=(2, 3), mode="area")
+        _check(p2, t2)
+
+    def test_adaptive_avg_pool2d_non_divisible(self):
+        rng = np.random.RandomState(15)
+        x = rng.randn(1, 2, 5, 7).astype(np.float32)
+        _check(F.adaptive_avg_pool2d(paddle.to_tensor(x), [2, 3]),
+               torch.nn.functional.adaptive_avg_pool2d(_t(x), (2, 3)))
