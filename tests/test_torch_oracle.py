@@ -251,3 +251,95 @@ class TestInterpolateExtra:
         x = rng.randn(1, 2, 5, 7).astype(np.float32)
         _check(F.adaptive_avg_pool2d(paddle.to_tensor(x), [2, 3]),
                torch.nn.functional.adaptive_avg_pool2d(_t(x), (2, 3)))
+
+
+class TestSequenceAlgorithms:
+    def test_ctc_loss(self):
+        """CTC's alpha recursion is the hardest oracle in the file — a
+        numpy reimplementation would mirror our own lax.scan; torch's
+        independent C++ implementation is the real check."""
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(16)
+        T, B, C, S = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, S)).astype(np.int32)
+        in_lens = np.array([12, 10, 8], np.int64)
+        lab_lens = np.array([4, 3, 2], np.int64)
+
+        p = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                       paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                       blank=0, reduction="none")
+        t = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), dim=-1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_lens), torch.tensor(lab_lens),
+            blank=0, reduction="none")
+        np.testing.assert_allclose(np.ravel(p.numpy()), t.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        # gradient parity through the alpha recursion (the file contract:
+        # forward AND input-gradient per op)
+        _check_grad(
+            lambda lg: F.ctc_loss(lg, paddle.to_tensor(labels),
+                                  paddle.to_tensor(in_lens),
+                                  paddle.to_tensor(lab_lens), blank=0,
+                                  reduction="none"),
+            lambda lg: torch.nn.functional.ctc_loss(
+                torch.log_softmax(lg, dim=-1),
+                torch.tensor(labels.astype(np.int64)),
+                torch.tensor(in_lens), torch.tensor(lab_lens),
+                blank=0, reduction="none"),
+            [logits])
+
+    def test_lstm_gru_forward_and_grad(self):
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(17)
+        x = rng.randn(4, 7, 5).astype(np.float32)  # [batch, time, feat]
+
+        for kind in ("lstm", "gru"):
+            paddle.seed(0)
+            if kind == "lstm":
+                p_rnn = nn.LSTM(5, 8)
+                t_rnn = torch.nn.LSTM(5, 8, batch_first=True)
+            else:
+                p_rnn = nn.GRU(5, 8)
+                t_rnn = torch.nn.GRU(5, 8, batch_first=True)
+            # copy paddle weights into torch: both frameworks use
+            # [gates*H, in] with LSTM gate order i,f,g,o and GRU order
+            # r,z,c (layers_rnn.py documents ours; torch matches)
+            sd = {k: v.numpy() for k, v in p_rnn.state_dict().items()}
+            with torch.no_grad():
+                t_rnn.weight_ih_l0.copy_(torch.tensor(sd["weight_ih_l0"]))
+                t_rnn.weight_hh_l0.copy_(torch.tensor(sd["weight_hh_l0"]))
+                t_rnn.bias_ih_l0.copy_(torch.tensor(sd["bias_ih_l0"]))
+                t_rnn.bias_hh_l0.copy_(torch.tensor(sd["bias_hh_l0"]))
+            p_out, _ = p_rnn(paddle.to_tensor(x))
+            t_out, _ = t_rnn(torch.tensor(x))
+            np.testing.assert_allclose(p_out.numpy(), t_out.detach().numpy(),
+                                       atol=1e-5, rtol=1e-4, err_msg=kind)
+            _check_grad(lambda x_: p_rnn(x_)[0],
+                        lambda x_: t_rnn(x_)[0], [x])
+
+    def test_unfold_fold_roundtrip_vs_torch(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(18)
+        x = rng.randn(2, 3, 6, 6).astype(np.float32)
+        p = F.unfold(paddle.to_tensor(x), 3, strides=1, paddings=1)
+        t = torch.nn.functional.unfold(_t(x), 3, stride=1, padding=1)
+        _check(p, t)
+        folded_p = F.fold(p, [6, 6], 3, strides=1, paddings=1)
+        folded_t = torch.nn.functional.fold(t, (6, 6), 3, stride=1, padding=1)
+        _check(folded_p, folded_t)
+
+    def test_affine_grid(self):
+        rng = np.random.RandomState(19)
+        theta = rng.randn(2, 2, 3).astype(np.float32) * 0.3
+        for align in (True, False):
+            p = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                              align_corners=align)
+            t = torch.nn.functional.affine_grid(torch.tensor(theta),
+                                                (2, 3, 4, 5),
+                                                align_corners=align)
+            _check(p, t, atol=1e-5)
