@@ -247,7 +247,7 @@ _S("trace", np.trace, [((4, 4), "any")])
 _S("l1_norm", lambda x: np.abs(x).sum(), _U)
 _S("squared_l2_norm", lambda x: (x ** 2).sum(), _U)
 _S("p_norm", lambda x: np.linalg.norm(x.ravel(), 2), _U, kwargs={"p": 2})
-_S("median", np.median, [((3, 5), "any")], grad=False)
+_S("median", np.median, [((3, 5), "any")])  # subgradient at the pick
 _S("nanmedian", np.nanmedian, [((3, 5), "any")], grad=False)
 _S("cumsum", lambda x: np.cumsum(x, axis=0), _U, kwargs={"axis": 0})
 _S("cumprod", lambda x: np.cumprod(x, axis=0), [(_SH, "pos")],
