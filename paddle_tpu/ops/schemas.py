@@ -518,6 +518,73 @@ _S("bucketize", lambda x, e: np.searchsorted(e, x, side="left")
    [(_SH, "any"), ((5,), "sorted")], grad=False, dtypes=("float32",),
    wrap=lambda f: (lambda x, e, **k: f(x, e, right=False)))
 
+
+# ---------------------------------------------------------------------------
+# ordering / selection (tuple outputs exercise the harness's multi-out path)
+# ---------------------------------------------------------------------------
+_DOMAINS["distinct"] = lambda rng, sh: rng.permutation(
+    np.linspace(-2, 2, int(np.prod(sh)))).astype(np.float32).reshape(sh)
+
+
+def _modal(rng, sh):
+    """Rows with one value repeated 3x (unambiguous mode), rest distinct."""
+    rows = []
+    n = sh[-1]
+    for _ in range(int(np.prod(sh[:-1]))):
+        row = rng.permutation(np.linspace(-2, 2, n)).astype(np.float32)
+        rep = row[0]
+        pos = rng.choice(np.arange(1, n), size=2, replace=False)
+        row[pos] = rep
+        rows.append(row)
+    return np.stack(rows).reshape(sh)
+
+
+_DOMAINS["modal"] = _modal
+
+
+def _mode_ref(x):
+    """Reference/torch semantics: modal value, LAST occurrence index."""
+    flat = x.reshape(-1, x.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        uv, counts = np.unique(row, return_counts=True)
+        m = uv[np.argmax(counts)]
+        vals.append(m)
+        idxs.append(np.where(row == m)[0][-1])
+    return (np.array(vals, x.dtype).reshape(x.shape[:-1]),
+            np.array(idxs, np.int64).reshape(x.shape[:-1]))
+
+
+def _cum_argext(x, op):
+    """Running arg-extreme with LAST-occurrence tie-break (impl + torch)."""
+    ext = (np.maximum if op == "max" else np.minimum).accumulate(x, axis=-1)
+    idx = np.zeros(x.shape, np.int64)
+    flat_x = x.reshape(-1, x.shape[-1])
+    flat_e = ext.reshape(-1, x.shape[-1])
+    flat_i = idx.reshape(-1, x.shape[-1])
+    for r in range(flat_x.shape[0]):
+        for i in range(flat_x.shape[1]):
+            pre = flat_x[r, :i + 1]
+            flat_i[r, i] = i - np.argmax((pre == flat_e[r, i])[::-1])
+    return ext, idx
+
+_S("sort", lambda x: np.sort(x, axis=-1), [(_SH, "distinct")])
+_S("argsort", lambda x: np.argsort(x, axis=-1, kind="stable"),
+   [(_SH, "distinct")], grad=False, dtypes=("float32",))
+_S("topk", lambda x: (np.sort(x, axis=-1)[..., ::-1][..., :3].copy(),
+                      np.argsort(-x, axis=-1, kind="stable")[..., :3].copy()),
+   [(_SH, "distinct")], kwargs={"k": 3}, dtypes=("float32",))
+_S("kthvalue", lambda x: (np.sort(x, axis=-1)[..., 1],
+                          np.argsort(x, axis=-1, kind="stable")[..., 1]),
+   [(_SH, "distinct")], kwargs={"k": 2}, dtypes=("float32",))
+_S("mode", _mode_ref, [((3, 6), "modal")], grad=False, dtypes=("float32",))
+_S("cummax", lambda x: _cum_argext(x, "max"), [((3, 6), "modal")],
+   kwargs={"axis": -1}, dtypes=("float32",))  # modal domain exercises ties
+_S("cummin", lambda x: _cum_argext(x, "min"), [((3, 6), "modal")],
+   kwargs={"axis": -1}, dtypes=("float32",))
+_S("searchsorted", lambda seq, x: np.searchsorted(seq, x, side="left")
+   .astype(np.int64),
+   [((6,), "sorted"), ((3, 4), "any")], grad=False, dtypes=("float32",))
 # ---------------------------------------------------------------------------
 # white list: ops excluded from a specific check, with the reason recorded
 # (parity: test/white_list/op_accuracy_white_list.py). Keep < 10% of SCHEMAS.
@@ -529,6 +596,8 @@ WHITE_LIST: Dict[str, Dict[str, str]] = {
     "sinc": {"grad": "removable singularity at 0 makes FD noisy"},
     "logcumsumexp": {"sweep_low": "exp-space cumsum overflows fp16 quickly"},
     "multigammaln": {"grad": "vectorized scipy oracle too slow for FD"},
+    "cummax": {"grad": "modal (tie) inputs make the FD subgradient non-unique"},
+    "cummin": {"grad": "modal (tie) inputs make the FD subgradient non-unique"},
 }
 
 
