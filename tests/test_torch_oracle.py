@@ -343,3 +343,63 @@ class TestSequenceAlgorithms:
                                                 (2, 3, 4, 5),
                                                 align_corners=align)
             _check(p, t, atol=1e-5)
+
+
+class TestAttention:
+    def test_multi_head_attention_vs_torch(self):
+        """Weight-mapped MHA parity: paddle Linear weights are [in, out],
+        torch's packed in_proj is [3E, E] of [out, in] blocks."""
+        from paddle_tpu import nn
+
+        E, H, B, S = 16, 4, 2, 6
+        paddle.seed(0)
+        p_mha = nn.MultiHeadAttention(E, H)
+        p_mha.eval()
+        t_mha = torch.nn.MultiheadAttention(E, H, batch_first=True)
+        t_mha.eval()
+        sd = {k: v.numpy() for k, v in p_mha.state_dict().items()}
+        with torch.no_grad():
+            t_mha.in_proj_weight.copy_(torch.tensor(np.concatenate(
+                [sd["q_proj.weight"].T, sd["k_proj.weight"].T,
+                 sd["v_proj.weight"].T], axis=0)))
+            t_mha.in_proj_bias.copy_(torch.tensor(np.concatenate(
+                [sd["q_proj.bias"], sd["k_proj.bias"], sd["v_proj.bias"]])))
+            t_mha.out_proj.weight.copy_(torch.tensor(sd["out_proj.weight"].T))
+            t_mha.out_proj.bias.copy_(torch.tensor(sd["out_proj.bias"]))
+
+        rng = np.random.RandomState(20)
+        x = rng.randn(B, S, E).astype(np.float32)
+        p_out = p_mha(paddle.to_tensor(x))
+        t_out, _ = t_mha(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+        _check(p_out, t_out, atol=1e-5)
+
+        # causal mask parity: paddle additive float mask vs torch bool mask
+        causal_add = np.where(np.tril(np.ones((S, S), bool)), 0.0,
+                              -1e30).astype(np.float32)
+        p_c = p_mha(paddle.to_tensor(x), attn_mask=paddle.to_tensor(causal_add))
+        t_c, _ = t_mha(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                       attn_mask=torch.tensor(
+                           ~np.tril(np.ones((S, S), bool))))
+        _check(p_c, t_c, atol=1e-5)
+
+    def test_scaled_dot_product_attention(self):
+        rng = np.random.RandomState(21)
+        b, s, h, d = 2, 5, 3, 8
+        q = rng.randn(b, s, h, d).astype(np.float32)
+        k = rng.randn(b, s, h, d).astype(np.float32)
+        v = rng.randn(b, s, h, d).astype(np.float32)
+        p = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+        # torch sdpa uses [b, h, s, d]
+        t = torch.nn.functional.scaled_dot_product_attention(
+            torch.tensor(q).transpose(1, 2), torch.tensor(k).transpose(1, 2),
+            torch.tensor(v).transpose(1, 2), is_causal=True).transpose(1, 2)
+        _check(p, t, atol=1e-5)
+        _check_grad(
+            lambda q_, k_, v_: F.scaled_dot_product_attention(
+                q_, k_, v_, is_causal=True),
+            lambda q_, k_, v_: torch.nn.functional.scaled_dot_product_attention(
+                q_.transpose(1, 2), k_.transpose(1, 2),
+                v_.transpose(1, 2), is_causal=True).transpose(1, 2),
+            [q, k, v])
