@@ -463,8 +463,7 @@ class PipelineParallel:
                         jax.tree.map(jnp.add, grad_total, gp)
                 if s > 0:
                     payload = gx if rank == s else jnp.zeros(
-                        (bshapes[s - 1].shape if s - 1 >= 0 else micro_shape.shape),
-                        bshapes[s - 1].dtype)
+                        bshapes[s - 1].shape, bshapes[s - 1].dtype)
                     r = eager_shift(payload, -1)
                     if rank == s - 1:
                         gy = r

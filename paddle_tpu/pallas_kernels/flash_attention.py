@@ -40,24 +40,37 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_TPU_PALLAS = False
 
-if _HAS_TPU_PALLAS:
-    # raise Mosaic's 16 MB default scoped-VMEM cap: the backward kernels
+_VMEM_PARAMS = None
+
+
+def _vmem_params():
+    # Raise Mosaic's 16 MB default scoped-VMEM cap: the backward kernels
     # hold full-sequence q/do (dK/dV pass) and k/v (dQ pass) refs, which
-    # at seq >= 8192 exceed 16 MB while the chip has 128 MB VMEM. A
-    # constructor failure must SURFACE (silently dropping the cap would
-    # break the documented seq-8192 support); older jax spells the class
-    # TPUCompilerParams.
-    _params_cls = (getattr(pltpu, "CompilerParams", None)
-                   or getattr(pltpu, "TPUCompilerParams"))
-    _VMEM_PARAMS = _params_cls(vmem_limit_bytes=100 * 1024 * 1024)
-else:
-    _VMEM_PARAMS = None
+    # at seq >= 8192 exceed 16 MB while the chip has 128 MB VMEM. Looked
+    # up lazily at first kernel launch so that a renamed class on a future
+    # jax only breaks the TPU compile path, not `import paddle_tpu`
+    # (interpret/CPU mode never needs the cap). A constructor failure must
+    # still SURFACE here: silently dropping the cap would break the
+    # documented seq-8192 support. Older jax spells it TPUCompilerParams.
+    global _VMEM_PARAMS
+    if _VMEM_PARAMS is None:
+        params_cls = (getattr(pltpu, "CompilerParams", None)
+                      or getattr(pltpu, "TPUCompilerParams", None))
+        if params_cls is None:
+            raise RuntimeError(
+                "paddle_tpu flash attention needs pallas TPU compiler params "
+                "(jax.experimental.pallas.tpu.CompilerParams or "
+                "TPUCompilerParams) to raise the scoped-VMEM cap for "
+                "seq>=8192 support; this jax version exposes neither. "
+                f"jax=={jax.__version__}")
+        _VMEM_PARAMS = params_cls(vmem_limit_bytes=100 * 1024 * 1024)
+    return _VMEM_PARAMS
 
 
 def _compiler_kwargs():
-    if _VMEM_PARAMS is None or _interpret():
+    if not _HAS_TPU_PALLAS or _interpret():
         return {}
-    return {"compiler_params": _VMEM_PARAMS}
+    return {"compiler_params": _vmem_params()}
 
 NEG_INF = -1e30
 
@@ -464,8 +477,15 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale=None,
         seg = None
         if seg_arr is not None:
             seg = jnp.repeat(seg_arr[:, None, :], h, axis=1).reshape(b * h, s)
-        bq = _pick_block(s, block_q)
-        bk = _pick_block(s, block_k)
+        # The 512x512 default's VMEM budget assumes head_dim <= 128; wider
+        # heads scale the per-block q/k/v refs linearly, so halve the block
+        # cap to stay inside the (raised) scoped-VMEM limit.
+        want_q, want_k = block_q, block_k
+        if d > 128:
+            want_q = min(want_q, 256)
+            want_k = min(want_k, 256)
+        bq = _pick_block(s, want_q)
+        bk = _pick_block(s, want_k)
         if seg is not None and not _interpret():
             # varlen lane slices need 128-multiple blocks on TPU
             bq = max(bq, 128)

@@ -102,7 +102,7 @@ def main(argv=None):
                 continue
             budget = int(budgets[i] * args.slack)
             rc |= run_pytest(files, budget, f"shard {i}")
-    if args.shard is None:
+    if args.shard is None or args.serial_only:
         for r in ser:
             rc |= run_pytest([r["file"]], int(r["timeout"] * args.slack),
                              f"serial {r['file']}")
