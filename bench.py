@@ -96,6 +96,59 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
     return out
 
 
+def _run_offload_config(paddle):
+    """~2B-param single-chip point: only fits because optimizer state is
+    host-offloaded (device = bf16 params + bf16 grad accumulator)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.distributed.offload import (HostOffloadAdamW,
+                                                HostOffloadTrainStep)
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   llama_pretrain_loss)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+        num_hidden_layers=24, num_attention_heads=20, num_key_value_heads=20,
+        max_position_embeddings=2048, use_flash_attention=True,
+        dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.llama.rope_cos._data = model.llama.rope_cos._data.astype(np.float32)
+    model.llama.rope_sin._data = model.llama.rope_sin._data.astype(np.float32)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    ACC, B, S = 24, 4, 1024
+    step = HostOffloadTrainStep(
+        model, llama_pretrain_loss, ProcessMesh(np.arange(1), ["dp"]),
+        accum_steps=ACC, learning_rate=1e-4, accum_dtype=jnp.bfloat16)
+    kinds = HostOffloadAdamW.state_memory_kinds(step.opt_state)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    for _ in range(ACC):  # warmup cycle: compiles accum + per-shape updates
+        loss = step.step(ids, labels)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(ACC):
+        loss = step.step(ids, labels)
+    _ = float(loss)
+    dt = time.perf_counter() - t0
+    tps = B * S * ACC / dt
+    fpt = 6 * n_params + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
+    return {
+        "tokens_per_sec_per_chip": round(tps, 2),
+        "params_m": round(n_params / 1e6, 1),
+        "mfu": round(tps * fpt / _v5e_peak_flops(), 4),
+        "final_loss": round(float(loss), 4),
+        "batch": B, "seq": S, "accum_steps": ACC,
+        "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+        "opt_state_memory": sorted(kinds),
+        "opt_state_gb_host": round(3 * 4 * n_params / 2**30, 1),
+        "accum_dtype": "bfloat16",
+    }
+
+
 def main():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig
@@ -132,6 +185,16 @@ def main():
         except Exception as e:  # noqa: BLE001 — degrade to the primary point
             detail["big_model_error"] = f"{type(e).__name__}: {e}"[:200]
 
+        # host-offload point: ~2B params on ONE 16 GB chip — fp32 AdamW
+        # master/m/v (24 GB) live in pinned host memory and stream through
+        # the chip once per 24-micro-batch accumulation cycle
+        # (distributed/offload.py; reference group_sharded stage-3
+        # offload=True + gradient_merge)
+        try:
+            detail["big2b_offload"] = _run_offload_config(paddle)
+        except Exception as e:  # noqa: BLE001
+            detail["big2b_offload_error"] = f"{type(e).__name__}: {e}"[:200]
+
         # long-sequence point: seq 4096 where the Pallas flash-attention
         # kernel's advantage over XLA dense is largest (1.9-2.3x microbench)
         try:
@@ -144,6 +207,39 @@ def main():
                 paddle, long_cfg, batch=4, seq=4096, steps=10, warmup=2)
         except Exception as e:  # noqa: BLE001
             detail["seq4096_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    if on_tpu:
+        # long-context point: seq 8192 on one chip — exercises the raised
+        # Mosaic scoped-VMEM cap (pallas_kernels/flash_attention.py
+        # _vmem_params) that the backward kernels need at this length
+        try:
+            cfg8k = LlamaConfig(
+                vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                num_hidden_layers=12, num_attention_heads=12,
+                num_key_value_heads=12, max_position_embeddings=8192,
+                use_flash_attention=True, dtype="bfloat16")
+            detail["seq8192"] = _run_config(
+                paddle, cfg8k, batch=2, seq=8192, steps=6, warmup=2)
+        except Exception as e:  # noqa: BLE001
+            detail["seq8192_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # 16k capability assert: one fwd+bwd flash-attention step at seq
+        # 16384 must execute (the documented single-chip ceiling,
+        # flash_attention.py docstring)
+        try:
+            from paddle_tpu.pallas_kernels.flash_attention import _flash
+            rng16 = np.random.RandomState(0)
+            import jax.numpy as jnp
+            import math as _math
+            qkv = [jnp.asarray(rng16.randn(4, 16384, 64), jnp.bfloat16)
+                   for _ in range(3)]
+            f16 = jax.jit(jax.grad(lambda q, k, v: _flash(
+                q, k, v, None, True, 1.0 / _math.sqrt(64), 512, 512)
+                .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+            jax.block_until_ready(f16(*qkv))
+            detail["seq16384_fwd_bwd"] = "ok"
+        except Exception as e:  # noqa: BLE001
+            detail["seq16384_fwd_bwd"] = f"{type(e).__name__}: {e}"[:160]
 
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",

@@ -75,7 +75,10 @@ class ShardedTrainStep:
         self.mesh = mesh
         self.dp_axis = dp_axis if dp_axis in mesh.dim_names else None
         self._eager_opt = optimizer
-        self._fopt = fopt.from_eager(optimizer)
+        # optimizer=None: forward/backward machinery only — the caller owns
+        # the update (HostOffloadTrainStep keeps state in pinned host
+        # memory; eagerly allocating device m/v here would defeat it)
+        self._fopt = fopt.from_eager(optimizer) if optimizer is not None else None
         self.grad_clip_norm = grad_clip_norm
         if grad_clip_norm is None and getattr(optimizer, "_grad_clip", None) is not None:
             clip = optimizer._grad_clip
@@ -88,6 +91,7 @@ class ShardedTrainStep:
                     "everything_saveable, dots_saveable, "
                     "dots_with_no_batch_dims_saveable")
         self._remat = remat
+        self._donate = donate
 
         self._param_objs: Dict[str, Parameter] = model.named_parameters_dict()
         self._buffer_objs: Dict[str, Tensor] = model.named_buffers_dict()
@@ -103,7 +107,8 @@ class ShardedTrainStep:
         }
         self.buffers = {k: _place(b._data, self._replicated)
                         for k, b in self._buffer_objs.items()}
-        self.opt_state = self._shard_opt_state(self._fopt.init(self.params))
+        self.opt_state = (self._shard_opt_state(self._fopt.init(self.params))
+                          if self._fopt is not None else None)
         self._step_fn = None
         self._batch_spec = batch_spec
         self._label_spec = label_spec
@@ -131,9 +136,11 @@ class ShardedTrainStep:
         entries = [self.dp_axis] + [None] * (ndim - 1)
         return NamedSharding(self.mesh.jax_mesh, PartitionSpec(*entries))
 
-    def _build(self):
-        model, loss_fn, f = self.model, self.loss_fn, self._fopt
-        clip_norm = self.grad_clip_norm
+    def _make_forward_loss(self):
+        """The (params, buffers, inputs, labels) -> scalar loss closure,
+        remat applied — shared by the standard step and the host-offload
+        accumulating step (distributed/offload.py)."""
+        model, loss_fn = self.model, self.loss_fn
 
         def forward_loss(params, buffers, inputs, labels):
             def run(params):
@@ -161,6 +168,13 @@ class ShardedTrainStep:
                     run = jax.checkpoint(run)
             return run(params)
 
+        return forward_loss
+
+    def _build(self):
+        f = self._fopt
+        clip_norm = self.grad_clip_norm
+        forward_loss = self._make_forward_loss()
+
         def step(params, opt_state, lr, inputs, labels):
             loss, grads = jax.value_and_grad(forward_loss)(params, self.buffers, inputs, labels)
             if clip_norm is not None:
@@ -171,7 +185,7 @@ class ShardedTrainStep:
                           for k, v in new_params.items()}
             return loss, new_params, new_state
 
-        donate = (0, 1)
+        donate = (0, 1) if self._donate else ()
         self._step_fn = jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------

@@ -83,6 +83,109 @@ def _wrap_optimizer_state_sharding(optimizer, mesh: ProcessMesh, n: int):
     return optimizer
 
 
+def _wrap_adamw_offload(optimizer, mesh: ProcessMesh, n: int):
+    """Host-offload the AdamW accumulators: moment1/moment2 live in
+    pinned host memory (sharded over dp when n>1) and stream through the
+    device inside a per-shape jitted update (reference:
+    offload_helper.py's h2d→update→d2h around each optimizer op;
+    group_sharded_stage3.py:110 `offload=True`)."""
+    import jax.numpy as jnp
+
+    from ..optimizer.optimizer import AdamW
+    from .offload import supports_inline_transfers
+
+    if not isinstance(optimizer, AdamW):
+        raise NotImplementedError(
+            f"offload=True supports AdamW (got {type(optimizer).__name__}); "
+            "use paddle.optimizer.AdamW, or the engine-level "
+            "distributed.offload.HostOffloadTrainStep for functional "
+            "optimizers")
+
+    axis = mesh.dim_names[0]
+    inner_acc = optimizer._acc
+
+    def _host_sharding(shape):
+        spec = (_shard_spec_for(shape, n, axis) if n > 1 else None) \
+            or PartitionSpec()
+        return NamedSharding(mesh.jax_mesh, spec, memory_kind="pinned_host")
+
+    def offloaded_acc(name, p, init=jnp.zeros_like):
+        created = id(p) not in optimizer._accumulators.get(name, {})
+        value = inner_acc(name, p, init)
+        if created:
+            value = jax.device_put(value, _host_sharding(tuple(value.shape)))
+            optimizer._set_acc(name, p, value)
+        return value
+
+    optimizer._acc = offloaded_acc
+
+    inline = supports_inline_transfers()
+    fns = {}
+
+    def make_fn(host_sh, dev_sh):
+        from ..optimizer.optimizer import _adamw_update_math
+
+        if inline:
+            from jax.memory import Space
+
+            def upd(param, g, m, v, *scalars):
+                m_d = jax.device_put(m, Space.Device)
+                v_d = jax.device_put(v, Space.Device)
+                new_p, m2, v2 = _adamw_update_math(param, g, m_d, v_d, *scalars)
+                return (new_p, jax.device_put(m2, Space.Host),
+                        jax.device_put(v2, Space.Host))
+
+            return jax.jit(upd, donate_argnums=(0, 2, 3),
+                           in_shardings=(dev_sh, dev_sh, host_sh, host_sh)
+                           + (None,) * 7,
+                           out_shardings=(dev_sh, host_sh, host_sh))
+
+        math_jit = jax.jit(_adamw_update_math, donate_argnums=(0, 2, 3))
+
+        def upd_eager(param, g, m, v, *scalars):
+            # stage onto the PARAM's placement (params may span the mesh)
+            dev = host_sh.with_memory_kind("device")
+            m_d = jax.device_put(m, dev)
+            v_d = jax.device_put(v, dev)
+            new_p, m2, v2 = math_jit(param, g, m_d, v_d, *scalars)
+            return (new_p, jax.device_put(m2, host_sh),
+                    jax.device_put(v2, host_sh))
+
+        return upd_eager
+
+    def offloaded_update(p, g):
+        import jax.numpy as jnp
+
+        wd = optimizer._wd
+        if (optimizer._apply_decay_param_fun is not None
+                and not optimizer._apply_decay_param_fun(p.name)):
+            wd = 0.0
+        lr_ratio = (1.0 if optimizer._lr_ratio is None
+                    else float(optimizer._lr_ratio(p)))
+        m = optimizer._acc("moment1", p, optimizer._f32_zeros)
+        v = optimizer._acc("moment2", p, optimizer._f32_zeros)
+        from jax.sharding import SingleDeviceSharding
+
+        dev_sh = getattr(p._data, "sharding", None) or \
+            SingleDeviceSharding(jax.devices()[0])
+        # shardings in the key: same-shaped params can be placed
+        # differently (exclude_layer replicas vs dp shards)
+        key = (tuple(p.shape), str(p._data.dtype), m.sharding, dev_sh)
+        fn = fns.get(key)
+        if fn is None:
+            fn = fns[key] = make_fn(m.sharding, dev_sh)
+        scalars = tuple(jnp.asarray(s, jnp.float32) for s in (
+            optimizer.get_lr(), optimizer._beta1, optimizer._beta2,
+            optimizer._epsilon, optimizer._step_count, wd, lr_ratio))
+        p._data, m2, v2 = fn(p._data, g, m, v, *scalars)
+        optimizer._set_acc("moment1", p, m2)
+        optimizer._set_acc("moment2", p, v2)
+
+    optimizer._update_param = offloaded_update
+    optimizer._offloaded = True
+    return optimizer
+
+
 def group_sharded_parallel(model, optimizer, level: str, scaler=None, group=None,
                            offload: bool = False, sync_buffers: bool = False,
                            buffer_max_size: int = 2 ** 23, segment_size: int = 2 ** 20,
@@ -91,29 +194,55 @@ def group_sharded_parallel(model, optimizer, level: str, scaler=None, group=None
     """Apply ZeRO-style sharding to (model, optimizer[, scaler]).
 
     level: 'os' (stage 1) | 'os_g' (stage 2) | 'p_g_os' (stage 3).
+    offload=True: optimizer state lives in pinned host memory and streams
+    through the device during the update (AdamW; see
+    distributed/offload.py for the engine-level form + measured rates).
     Returns (model, optimizer, scaler) like the reference.
     """
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be 'os'|'os_g'|'p_g_os', got {level!r}")
+    # comm-fusion buffer sizing is a CUDA-runtime concern the compiled
+    # GSPMD path has no analogue for: XLA owns collective scheduling.
+    # Accepting a non-default value silently would misrepresent that.
+    if buffer_max_size != 2 ** 23 or segment_size != 2 ** 20:
+        raise NotImplementedError(
+            "buffer_max_size/segment_size tune the reference's CUDA comm "
+            "fusion buffers; XLA schedules collectives itself — remove "
+            "the argument (defaults are accepted for signature parity)")
+    if sync_comm:
+        raise NotImplementedError(
+            "sync_comm=True forces synchronous CUDA comm streams; XLA "
+            "programs are already synchronous at step boundaries — "
+            "remove the argument")
     mesh = _dp_mesh(group)
     # shard over the mesh's FIRST axis only; divisibility must be checked
     # against that axis's size, not the total device count
     n = int(mesh.shape[0])
-    if n <= 1:
+    if n <= 1 and not offload:
         return model, optimizer, scaler
 
-    if level == "p_g_os":
+    if level == "p_g_os" and n > 1:
         excluded = set(exclude_layer or [])
         for name, p in model.named_parameters_dict().items():
             if any(name.startswith(e) for e in excluded):
                 _replicate_param(p, mesh)
             elif not _shard_param(p, mesh, n):
                 _replicate_param(p, mesh)
-    else:
+    elif n > 1:
         for p in model.parameters():
             _replicate_param(p, mesh)
 
-    _wrap_optimizer_state_sharding(optimizer, mesh, n)
+    if sync_buffers and n > 1:
+        # reference: broadcast buffers from rank 0 so all replicas agree;
+        # GSPMD form: place every model buffer replicated over the mesh
+        for b in model.buffers():
+            b._data = jax.device_put(
+                b._data, NamedSharding(mesh.jax_mesh, PartitionSpec()))
+
+    if offload:
+        _wrap_adamw_offload(optimizer, mesh, n)
+    elif n > 1:
+        _wrap_optimizer_state_sharding(optimizer, mesh, n)
     model._group_sharded_level = level
     model._group_sharded_mesh = mesh
     return model, optimizer, scaler

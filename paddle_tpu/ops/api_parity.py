@@ -230,6 +230,9 @@ def unfold(x, axis: int, size: int, step: int, name=None) -> Tensor:
     """Sliding windows along ``axis`` (parity: paddle.unfold /
     ops.yaml tensor_unfold): out[..., i, ..., k] = x[..., i*step + k, ...]."""
     x = ensure_tensor(x)
+    # normalize: a negative axis as moveaxis DESTINATION would land the
+    # window axis after the size axis (e.g. axis=-1 gave [..., size, n_win])
+    axis = axis % len(x.shape)
 
     def _f(a):
         moved = jnp.moveaxis(a, axis, -1)

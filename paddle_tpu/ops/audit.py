@@ -1,0 +1,181 @@
+"""Dispatch-surface audit: enumerate every op name that can reach apply_op.
+
+Parity: the reference's single YAML registry guarantees that every op that
+dispatches has a schema (paddle/phi/ops/yaml/ops.yaml — an op cannot exist
+without an entry; op_test.py then sweeps each entry per dtype). Our eager
+ops are plain Python, so the equivalent guarantee is recovered by static
+analysis: this module walks the package AST and collects
+
+  1. direct literal calls         apply_op("name", ...)
+  2. dispatcher forwarding        def _binop(opname, ...): apply_op(opname,)
+     + literal call sites         _binop("add", jnp.add)
+     (transitively: a function forwarding its parameter into another
+     dispatcher's name slot is itself a dispatcher)
+  3. dynamic name sites           apply_op(f"rnn_{mode}", ...) — returned
+     separately; each must be covered by an explicit enumeration in
+     ops.schemas.DYNAMIC_DISPATCH.
+
+tests/test_schema_enforcement.py asserts: every collected name has a
+schema in ops.schemas.SCHEMAS or an entry in ops.schemas.WHITE_LIST, and
+every dynamic site matches a DYNAMIC_DISPATCH pattern.  A runtime
+recorder in ops.dispatch cross-checks the same invariant over names that
+actually dispatched during a test session (run_shards.py merges and
+enforces per-process records).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _iter_py_files(root: str = _PKG_ROOT):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for f in filenames:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _func_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+class _Visitor(ast.NodeVisitor):
+    """One pass over one module: records apply_op call sites and, for each
+    enclosing function, which of its parameters flow into a dispatcher's
+    name slot."""
+
+    def __init__(self, dispatchers: Dict[str, int]):
+        # dispatcher function name -> positional index of its name arg
+        self.dispatchers = dispatchers
+        self.literals: Set[str] = set()
+        self.dynamic: List[Tuple[str, int, str]] = []  # (file, line, repr)
+        self.new_dispatchers: Dict[str, int] = {}
+        self._fn_stack: List[ast.FunctionDef] = []
+        self._file = "?"
+
+    def visit_FunctionDef(self, node):
+        self._fn_stack.append(node)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _name_arg(self, call: ast.Call, idx: int):
+        if idx < len(call.args):
+            return call.args[idx]
+        return None
+
+    def visit_Call(self, node):
+        fname = _func_name(node)
+        idx = self.dispatchers.get(fname)
+        if idx is not None:
+            arg = self._name_arg(node, idx)
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self.literals.add(arg.value)
+            elif isinstance(arg, ast.Name) and self._fn_stack:
+                # parameter forwarding: the enclosing function owning the
+                # parameter becomes a dispatcher (apply_op often sits in a
+                # closure nested inside the factory that owns the name arg)
+                for fn in reversed(self._fn_stack):
+                    params = [a.arg for a in fn.args.args]
+                    if arg.id in params:
+                        self.new_dispatchers.setdefault(fn.name,
+                                                        params.index(arg.id))
+                        break
+                else:
+                    self.dynamic.append(
+                        (self._file, node.lineno, ast.dump(arg)[:80]))
+            elif arg is not None:
+                self.dynamic.append(
+                    (self._file, node.lineno, ast.dump(arg)[:80]))
+        self.generic_visit(node)
+
+
+def _resolve_module(path: str, level: int, module: str, root: str):
+    """Resolve a relative/absolute intra-package import to a file path."""
+    if level == 0:
+        if not module or not module.startswith("paddle_tpu"):
+            return None
+        parts = module.split(".")[1:]
+        base = root
+    else:
+        base = os.path.dirname(path)
+        for _ in range(level - 1):
+            base = os.path.dirname(base)
+        parts = module.split(".") if module else []
+    cand = os.path.join(base, *parts)
+    if os.path.isfile(cand + ".py"):
+        return cand + ".py"
+    if os.path.isfile(os.path.join(cand, "__init__.py")):
+        return os.path.join(cand, "__init__.py")
+    return None
+
+
+def _imported_names(path: str, tree: ast.AST, root: str) -> Dict[str, tuple]:
+    """alias -> (defining_file, original_name) for intra-package imports."""
+    out: Dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            target = _resolve_module(path, node.level, node.module or "", root)
+            if target is None:
+                continue
+            for alias in node.names:
+                out[alias.asname or alias.name] = (target, alias.name)
+    return out
+
+
+def collect_dispatch_surface(root: str = _PKG_ROOT):
+    """Returns (literal_names, dynamic_sites, dispatchers_per_module).
+
+    Dispatcher resolution is module-scoped (a module's own defs plus names
+    it explicitly imports) so same-named helpers in unrelated modules
+    (e.g. a loss `_reduce(value, reduction)` vs math.py's `_reduce`
+    dispatch factory) don't cross-contaminate.  Iterates to a fixed point
+    so dispatchers-of-dispatchers and cross-module factory imports
+    resolve."""
+    sources = {}
+    for path in _iter_py_files(root):
+        try:
+            with open(path, "r") as fh:
+                sources[path] = ast.parse(fh.read())
+        except SyntaxError:  # pragma: no cover
+            continue
+
+    imports = {p: _imported_names(p, t, root) for p, t in sources.items()}
+    # pool of discovered dispatchers keyed by (defining_file, name); a
+    # module sees a foreign dispatcher only by explicitly importing it
+    pool: Dict[tuple, int] = {}
+    literals: Set[str] = set()
+    dynamic: List[Tuple[str, int, str]] = []
+    for _round in range(10):
+        literals = set()
+        dynamic = []
+        grown = False
+        for path, tree in sources.items():
+            scope = {"apply_op": 0}
+            for alias, (target, orig) in imports[path].items():
+                idx = pool.get((target, orig))
+                if idx is not None:
+                    scope[alias] = idx
+            scope.update({n: i for (p, n), i in pool.items() if p == path})
+            v = _Visitor(scope)
+            v._file = os.path.relpath(path, root)
+            v.visit(tree)
+            literals |= v.literals
+            dynamic.extend(v.dynamic)
+            for k, i in v.new_dispatchers.items():
+                if (path, k) not in pool:
+                    pool[(path, k)] = i
+                    grown = True
+        if not grown:
+            break
+    return literals, dynamic, pool

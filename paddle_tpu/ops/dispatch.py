@@ -47,6 +47,19 @@ _op_stats: Optional[dict] = None
 # Op registry for introspection/testing (parity: phi/ops/yaml/ops.yaml registry role).
 OP_REGISTRY: dict = {}
 
+# Dispatch-name recorder (tests/test_schema_enforcement.py): while the
+# list holds a set, every apply_op name is added to it. The enforcement
+# test diffs recorded names against SCHEMAS ∪ NO_SCHEMA_WHITE_LIST ∪
+# DYNAMIC_DISPATCH — the runtime cross-check of the static audit
+# (parity role: ops.yaml's "no kernel without a schema" guarantee).
+_dispatch_record = [None]
+
+
+def record_dispatch(sink: Optional[set]):
+    """Install (or clear, with None) the dispatch-name sink."""
+    _dispatch_record[0] = sink
+
+
 # Dataflow provenance mode (distributed/auto_shard.py): while enabled,
 # every op output carries the union of its inputs' ``_prov`` sets — the
 # TPU-form analogue of the reference's dist-attr propagation over a
@@ -223,6 +236,8 @@ def apply_op(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = N
 
 
 def _apply_op_impl(name: str, fn: Callable, *tensors: Tensor, nouts: Optional[int] = None):
+    if _dispatch_record[0] is not None:
+        _dispatch_record[0].add(name)
     if _static_hook is not None:
         res = _static_hook(name, fn, tensors, nouts)
         if res is not NotImplemented:

@@ -223,8 +223,9 @@ class Adam(Optimizer):
         self._set_acc("moment2", p, v)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
-def _adamw_update(param, grad, m, v, lr, beta1, beta2, eps, t, wd, lr_ratio):
+def _adamw_update_math(param, grad, m, v, lr, beta1, beta2, eps, t, wd, lr_ratio):
+    # raw (unjitted) form: reused by the host-offload path, which wraps it
+    # in its own jit with pinned_host in/out shardings (distributed/sharding.py)
     g = grad.astype(jnp.float32)
     p32 = param.astype(jnp.float32)
     p32 = p32 * (1 - lr * lr_ratio * wd)  # decoupled decay
@@ -234,6 +235,10 @@ def _adamw_update(param, grad, m, v, lr, beta1, beta2, eps, t, wd, lr_ratio):
     vhat = v / (1 - beta2**t)
     new_p = p32 - lr * lr_ratio * mhat / (jnp.sqrt(vhat) + eps)
     return new_p.astype(param.dtype), m, v
+
+
+_adamw_update = functools.partial(jax.jit, donate_argnums=(0, 2, 3))(
+    _adamw_update_math)
 
 
 class AdamW(Optimizer):

@@ -13,8 +13,52 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 import jax
 
 # The axon TPU plugin pins jax_platforms; force CPU for unit tests.
-jax.config.update("jax_platforms", "cpu")
-try:
-    jax.config.update("jax_num_cpu_devices", 8)
-except Exception:
-    pass  # older jax: XLA_FLAGS above covers it
+if os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass  # older jax: XLA_FLAGS above covers it
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-name recorder: every op name that goes through apply_op during
+# this pytest session is recorded and checked at session end against the
+# schema registry + white lists (reference role: ops cannot exist outside
+# ops.yaml). Strays fail the run. The same record is also dumped for
+# run_shards.py to merge across shard processes.
+# ---------------------------------------------------------------------------
+_RECORDED_NAMES = set()
+
+
+def pytest_configure(config):
+    from paddle_tpu.ops.dispatch import record_dispatch
+
+    record_dispatch(_RECORDED_NAMES)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    from paddle_tpu.ops.dispatch import record_dispatch
+    from paddle_tpu.ops.schemas import SCHEMAS
+    from paddle_tpu.ops.schemas_extended import (DYNAMIC_DISPATCH,
+                                                 NO_SCHEMA_WHITE_LIST)
+
+    record_dispatch(None)
+    dump = os.environ.get("PADDLE_TPU_DISPATCH_DUMP")
+    if dump:
+        with open(f"{dump}.{os.getpid()}", "w") as fh:
+            fh.write("\n".join(sorted(_RECORDED_NAMES)))
+    strays = {
+        n for n in _RECORDED_NAMES
+        if n not in SCHEMAS and n not in NO_SCHEMA_WHITE_LIST
+        and n not in DYNAMIC_DISPATCH["enumerated"]
+        and not n.startswith(DYNAMIC_DISPATCH["prefixes"])
+    }
+    if strays:
+        reporter = session.config.pluginmanager.get_plugin("terminalreporter")
+        msg = ("ops dispatched without a schema or white-list entry "
+               f"(add to ops/schemas*.py): {sorted(strays)}")
+        if reporter:
+            reporter.write_sep("=", "SCHEMA ENFORCEMENT FAILURE")
+            reporter.write_line(msg)
+        session.exitstatus = 1

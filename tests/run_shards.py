@@ -57,6 +57,40 @@ def partition(rows, n_shards):
     return shards, budgets
 
 
+def merge_dispatch_records(dump_prefix):
+    """Cross-shard schema enforcement: union the per-process dispatch
+    records the conftest dumped and diff against the registries (each
+    pytest process already enforces its own record at sessionfinish;
+    this re-checks the union and cleans up)."""
+    import glob
+
+    root = os.path.dirname(HERE)
+    if root not in sys.path:  # launched as `python tests/run_shards.py`
+        sys.path.insert(0, root)
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.ops.schemas import SCHEMAS
+    from paddle_tpu.ops.schemas_extended import (DYNAMIC_DISPATCH,
+                                                 NO_SCHEMA_WHITE_LIST)
+
+    names = set()
+    for path in glob.glob(dump_prefix + ".*"):
+        with open(path) as fh:
+            names |= {ln.strip() for ln in fh if ln.strip()}
+        os.remove(path)
+    strays = {n for n in names
+              if n not in SCHEMAS and n not in NO_SCHEMA_WHITE_LIST
+              and n not in DYNAMIC_DISPATCH["enumerated"]
+              and not n.startswith(DYNAMIC_DISPATCH["prefixes"])}
+    if strays:
+        print(f"[run_shards] dispatch enforcement: {len(strays)} op(s) "
+              f"ran without schema/white-list: {sorted(strays)}",
+              flush=True)
+        return 1
+    print(f"[run_shards] dispatch enforcement: {len(names)} recorded op "
+          "names all covered", flush=True)
+    return 0
+
+
 def run_pytest(files, budget, label):
     cmd = [sys.executable, "-m", "pytest", "-q", "--no-header",
            *(os.path.join(HERE, f) for f in files)]
@@ -79,7 +113,20 @@ def main(argv=None):
     ap.add_argument("--list", action="store_true")
     ap.add_argument("--slack", type=float, default=1.5,
                     help="budget multiplier over summed timeouts")
+    ap.add_argument("--enforce-dispatch", action="store_true",
+                    help="merge per-shard dispatch records and fail on "
+                         "ops without schema/white-list coverage")
     args = ap.parse_args(argv)
+
+    if args.enforce_dispatch:
+        import glob
+
+        os.environ["PADDLE_TPU_DISPATCH_DUMP"] = os.path.join(
+            HERE, ".dispatch_record")
+        # stale dumps from an interrupted previous run would be merged
+        # into this run's enforcement — clear them up front
+        for stale in glob.glob(os.environ["PADDLE_TPU_DISPATCH_DUMP"] + ".*"):
+            os.remove(stale)
 
     rows = load_manifest()
     par = [r for r in rows if r["run_type"] == "parallel"]
@@ -106,6 +153,8 @@ def main(argv=None):
         for r in ser:
             rc |= run_pytest([r["file"]], int(r["timeout"] * args.slack),
                              f"serial {r['file']}")
+    if args.enforce_dispatch:
+        rc |= merge_dispatch_records(os.environ["PADDLE_TPU_DISPATCH_DUMP"])
     return rc
 
 

@@ -98,4 +98,7 @@ def test_grad_finite_difference(name):
     if grad_inputs is None:
         grad_inputs = [i for i, a in enumerate(inputs)
                        if np.issubdtype(a.dtype, np.floating)]
-    check_grad(op_fn, inputs, grad_inputs=grad_inputs, kwargs=None)
+    tol_kw = {}
+    if s.grad_tol is not None:
+        tol_kw = {"atol": s.grad_tol[0], "rtol": s.grad_tol[1]}
+    check_grad(op_fn, inputs, grad_inputs=grad_inputs, kwargs=None, **tol_kw)

@@ -1,0 +1,90 @@
+"""Schema-coverage enforcement over the full dispatch surface.
+
+Parity: in the reference an op literally cannot exist without an
+ops.yaml entry (paddle/phi/ops/yaml/ops.yaml — 467 forward schemas), and
+op_test.py sweeps each entry per dtype/grad. Our eager ops are plain
+Python, so the equivalent invariant is recovered two ways:
+
+1. statically — ops.audit walks the package AST and enumerates every op
+   name that can reach apply_op (direct literals + dispatcher-factory
+   call sites); this test fails on any name with neither a schema nor a
+   NO_SCHEMA_WHITE_LIST entry, on any unexplained dynamic name site, and
+   on white-list bloat (>10% of the surface);
+2. at runtime — conftest.py records every name apply_op actually sees
+   during the pytest session and fails the session on strays
+   (run_shards.py --enforce-dispatch merges the per-shard records).
+"""
+
+import numpy as np
+
+import paddle_tpu  # noqa: F401  (populates SCHEMAS)
+from paddle_tpu.ops.audit import collect_dispatch_surface
+from paddle_tpu.ops.schemas import SCHEMAS
+from paddle_tpu.ops.schemas_extended import (DYNAMIC_DISPATCH,
+                                             NO_SCHEMA_WHITE_LIST)
+
+_LITERALS, _DYNAMIC_SITES, _DISPATCHERS = collect_dispatch_surface()
+_SURFACE = set(_LITERALS) | set(DYNAMIC_DISPATCH["enumerated"])
+
+
+def test_every_dispatched_op_has_schema_or_whitelist_entry():
+    strays = sorted(n for n in _SURFACE
+                    if n not in SCHEMAS and n not in NO_SCHEMA_WHITE_LIST)
+    assert not strays, (
+        f"{len(strays)} op(s) dispatch through apply_op without a schema "
+        f"or NO_SCHEMA_WHITE_LIST entry: {strays} — add an executable "
+        "schema in ops/schemas*.py (preferred) or a white-list entry "
+        "with the reason + where the op IS tested")
+
+
+def test_dynamic_name_sites_are_explained():
+    # every apply_op site whose name the audit could not resolve must be
+    # a known site: either its names are enumerated or it uses a
+    # registered open prefix (spmd:/grad_/custom_)
+    known_files = {"fft.py", "nn/layers_rnn.py", "distributed/collective.py",
+                   "core/autograd.py", "utils/cpp_extension.py"}
+    unknown = [(f, ln, repr_) for f, ln, repr_ in _DYNAMIC_SITES
+               if f not in known_files]
+    assert not unknown, (
+        "apply_op call sites with names the static audit cannot resolve "
+        f"appeared outside the registered dynamic sites: {unknown} — "
+        "either make the name a literal/factory argument or register the "
+        "site + its enumeration in DYNAMIC_DISPATCH")
+
+
+def test_white_list_is_bounded_and_consistent():
+    assert len(NO_SCHEMA_WHITE_LIST) <= len(_SURFACE) // 10, (
+        f"NO_SCHEMA_WHITE_LIST has {len(NO_SCHEMA_WHITE_LIST)} entries — "
+        f"over 10% of the {len(_SURFACE)}-op dispatch surface; write "
+        "schemas instead")
+    # no dead white-list entries for ops that meanwhile got schemas
+    dead = sorted(n for n in NO_SCHEMA_WHITE_LIST if n in SCHEMAS)
+    assert not dead, f"white-listed ops now have schemas: {dead}"
+    # entries must name where the op is tested
+    for name, reason in NO_SCHEMA_WHITE_LIST.items():
+        assert "test" in reason, (
+            f"white-list entry {name!r} must cite the test that covers "
+            f"the op; got: {reason!r}")
+
+
+def test_surface_is_substantial():
+    # regression floor: the audit must keep seeing the whole package
+    # (a path bug silently shrinking the walk would void the guarantee)
+    assert len(_LITERALS) >= 430, len(_LITERALS)
+    assert len(SCHEMAS) >= 430, len(SCHEMAS)
+    assert len(_DISPATCHERS) >= 8, sorted(_DISPATCHERS)
+
+
+def test_recorder_round_trip():
+    from paddle_tpu.ops.dispatch import record_dispatch, _dispatch_record
+
+    prev = _dispatch_record[0]
+    sink = set()
+    record_dispatch(sink)
+    try:
+        paddle_tpu.tanh(paddle_tpu.to_tensor(np.ones((2, 2), np.float32)))
+    finally:
+        record_dispatch(prev)
+        if prev is not None:
+            prev |= sink  # keep names visible to the session-level check
+    assert "tanh" in sink
