@@ -57,11 +57,17 @@ class LayerDesc:
 
 class SharedLayerDesc(LayerDesc):
     """Weight-shared layer (parity: pp_layers.py:76) — e.g. tied input
-    embedding / output projection. Supported when every occurrence of a
-    ``key`` lands in the same pipeline segment (the engine's segments are
-    independent compiled programs; cross-segment ties would need a
-    cross-stage grad reduction, which the compiled GSPMD pipeline path in
-    ``distributed/pipeline.py`` handles instead)."""
+    embedding / output projection.
+
+    All occurrences of a ``key`` hold per-stage COPIES of the tied weight
+    (initialized from the first occurrence) and the engine sums the tied
+    weight's gradients across stages before the per-stage optimizer
+    update — identical optimizer state + identical summed grads keeps
+    every copy in lockstep, exactly the reference's shared-comm-group
+    protocol (pp_layers.py:453 _construct_shared_comm, :454
+    _synchronize_shared_weights, allreduce of shared grads at :481).
+    ``forward_func(layer, x)`` customizes an occurrence's forward (the
+    canonical tied lm-head: matmul against the embedding table)."""
 
     def __init__(self, key, layer_func, forward_func=None,
                  shared_weight_attr="weight", *inputs, **kwargs):
@@ -69,6 +75,25 @@ class SharedLayerDesc(LayerDesc):
         self.layer_name = key
         self.forward_func = forward_func
         self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedForward(Layer):
+    """Occurrence of a shared layer driven by its ``forward_func``."""
+
+    def __init__(self, inner: Layer, forward_func: Callable):
+        super().__init__()
+        self.add_sublayer("inner", inner)
+        self._forward_func = forward_func
+
+    def forward(self, x):
+        return self._forward_func(self.inner, x)
+
+
+def _get_attr_path(layer: Layer, path: str):
+    obj = layer
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
 
 
 class _Lambda(Layer):
@@ -158,38 +183,76 @@ class PipelineLayer(Layer):
         self._topology = topology
 
         self._descs = list(layers)
-        built = [_materialize(it) for it in self._descs]
+        built = self._materialize_all(self._descs)
         self.run_function = built
         for i, l in enumerate(built):
             self.add_sublayer(str(i), l)
 
         num_parts = self._num_stages * self._num_chunks
         self._bounds = SegmentLayers(self._descs, num_parts, seg_method).do_segment()
-        self._check_shared(built)
         self._segments: List[Sequential] = [
             Sequential(*built[self._bounds[p]:self._bounds[p + 1]])
             for p in range(num_parts)
         ]
+        self._shared_groups = self._compute_shared_groups(built)
 
-    def _check_shared(self, built):
-        by_key: Dict[str, set] = {}
+    def _materialize_all(self, descs) -> List[Layer]:
+        """Build every desc; SharedLayerDesc occurrences after the first
+        copy the owner's tied weight (identical start values — the
+        engine's summed-grad protocol then keeps the copies in lockstep)
+        and apply their forward_func when given."""
+        built: List[Layer] = []
+        owners: Dict[str, Tuple[int, Layer]] = {}
+        for i, item in enumerate(descs):
+            layer = _materialize(item)
+            if isinstance(item, SharedLayerDesc):
+                key = item.layer_name
+                if key in owners:
+                    _, owner = owners[key]
+                    tied = _get_attr_path(layer, item.shared_weight_attr)
+                    src = _get_attr_path(owner, item.shared_weight_attr)
+                    if tuple(tied.shape) != tuple(src.shape):
+                        raise ValueError(
+                            f"SharedLayerDesc {key!r}: occurrence {i} tied "
+                            f"weight shape {tuple(tied.shape)} != owner "
+                            f"{tuple(src.shape)}")
+                    tied._data = src._data
+                else:
+                    owners[key] = (i, layer)
+                if item.forward_func is not None:
+                    layer = _SharedForward(layer, item.forward_func)
+            built.append(layer)
+        return built
+
+    def _compute_shared_groups(self, built) -> List[List[Tuple[int, str]]]:
+        """[(virtual_stage, param_key_in_segment)] per tied key — the
+        engine's shared-grad reduction groups."""
+        by_key: Dict[str, List[Tuple[int, str]]] = {}
         for i, d in enumerate(self._descs):
-            if isinstance(d, SharedLayerDesc):
-                by_key.setdefault(d.layer_name, set()).add(self.get_stage_from_index(i))
-        for key, stages in by_key.items():
-            if len(stages) > 1:
-                raise NotImplementedError(
-                    f"SharedLayerDesc key {key!r} spans pp stages {sorted(stages)}; "
-                    "cross-stage weight tying is supported by the compiled GSPMD "
-                    "pipeline (distributed.pipeline.gpipe_spmd), not the host engine")
+            if not isinstance(d, SharedLayerDesc):
+                continue
+            part = self._part_from_index(i)
+            local = i - self._bounds[part]
+            attr = d.shared_weight_attr
+            if isinstance(built[i], _SharedForward):
+                attr = "inner." + attr
+            by_key.setdefault(d.layer_name, []).append(
+                (part, f"{local}.{attr}"))
+        return [g for g in by_key.values() if len(g) > 1]
+
+    def shared_groups(self) -> List[List[Tuple[int, str]]]:
+        return [list(g) for g in self._shared_groups]
 
     # -- reference introspection API --------------------------------------
-    def get_stage_from_index(self, layer_idx: int) -> int:
+    def _part_from_index(self, layer_idx: int) -> int:
         assert 0 <= layer_idx < len(self._descs)
         for p in range(len(self._bounds) - 1):
             if self._bounds[p] <= layer_idx < self._bounds[p + 1]:
-                return p % self._num_stages
+                return p
         raise AssertionError
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        return self._part_from_index(layer_idx) % self._num_stages
 
     def get_num_virtual_stages(self) -> int:
         return self._num_chunks
@@ -330,6 +393,7 @@ class PipelineParallel:
             optimizer=from_eager(inner),
             lr=float(inner.get_lr()) if hasattr(inner, "get_lr") else 0.1,
             devices=self.pp_devices(),
+            shared_groups=self._layers.shared_groups(),
         )
         self._engine_opt_id = id(inner)
 
@@ -398,6 +462,11 @@ class PipelineParallel:
             f"{jax.process_count()}")
         if self._layers.get_num_virtual_stages() > 1:
             raise NotImplementedError("VPP over processes not supported")
+        if self._layers.shared_groups():
+            raise NotImplementedError(
+                "cross-stage tied weights over the lockstep multi-process "
+                "path need an eager shared-grad allreduce; use the "
+                "single-controller engine or the compiled GSPMD pipeline")
         rank = jax.process_index()
         inner = getattr(optimizer, "_inner_opt", optimizer)
 

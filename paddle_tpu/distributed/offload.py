@@ -27,7 +27,7 @@ update — same semantics and the same host-resident state, less overlap.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,52 @@ def _adamw_math(master, m, v, g, lr, t, beta1, beta2, eps, wd):
 
     return _adamw_update_math(master, g, m, v, lr, beta1, beta2, eps, t,
                               wd, jnp.float32(1.0))
+
+
+def make_streamed_update(body, n_host: int, n_rest: int, host_sh, dev_sh,
+                         out_host: Sequence[int], out_dev: Sequence[int]):
+    """Compile ``body(*host_args_on_device, *rest) -> outs`` with the first
+    ``n_host`` arguments resident in pinned host memory, streamed through
+    the device in-program (TPU) or staged eagerly (backends without
+    in-program memory-space annotation, e.g. XLA:CPU).
+
+    out_host/out_dev: indices of body outputs that return to host /
+    stay on device. Host inputs are donated (their buffers are replaced
+    by the returned state); nothing else is.
+
+    The single implementation of the h2d→update→d2h schedule shared by
+    HostOffloadAdamW (functional path) and sharding._wrap_adamw_offload
+    (eager AdamW path) — reference offload_helper.py's per-param copy
+    schedule."""
+    donate = tuple(range(n_host))
+    if supports_inline_transfers():
+        def upd(*args):
+            staged = [jax.device_put(a, Space.Device)
+                      for a in args[:n_host]]
+            outs = list(body(*staged, *args[n_host:]))
+            for i in out_host:
+                outs[i] = jax.device_put(outs[i], Space.Host)
+            return tuple(outs)
+
+        n_out = len(out_host) + len(out_dev)
+        out_shardings = tuple(host_sh if i in out_host else dev_sh
+                              for i in range(n_out))
+        return jax.jit(upd,
+                       in_shardings=(host_sh,) * n_host + (None,) * n_rest,
+                       out_shardings=out_shardings,
+                       donate_argnums=donate)
+
+    body_jit = jax.jit(body, donate_argnums=donate)
+    dev_stage = host_sh.with_memory_kind("device")
+
+    def upd_eager(*args):
+        staged = [jax.device_put(a, dev_stage) for a in args[:n_host]]
+        outs = list(body_jit(*staged, *args[n_host:]))
+        for i in out_host:
+            outs[i] = jax.device_put(outs[i], host_sh)
+        return tuple(outs)
+
+    return upd_eager
 
 
 class HostOffloadAdamW:
@@ -110,41 +156,14 @@ class HostOffloadAdamW:
             return fn
         beta1, beta2, eps, wd = self.beta1, self.beta2, self.eps, self.wd
 
-        if self._inline:
-            def upd(master, m, v, g, lr, t):
-                master_d = jax.device_put(master, Space.Device)
-                m_d = jax.device_put(m, Space.Device)
-                v_d = jax.device_put(v, Space.Device)
-                master2, m2, v2 = _adamw_math(master_d, m_d, v_d, g,
-                                              lr, t, beta1, beta2, eps, wd)
-                return (jax.device_put(master2, Space.Host),
-                        jax.device_put(m2, Space.Host),
-                        jax.device_put(v2, Space.Host),
-                        master2.astype(pdtype))
+        def body(master, m, v, g, lr, t):
+            master2, m2, v2 = _adamw_math(master, m, v, g, lr, t,
+                                          beta1, beta2, eps, wd)
+            return master2, m2, v2, master2.astype(pdtype)
 
-            fn = jax.jit(
-                upd,
-                in_shardings=(host_sh, host_sh, host_sh, dev_sh, None, None),
-                out_shardings=(host_sh, host_sh, host_sh, dev_sh),
-                donate_argnums=(0, 1, 2, 3))
-        else:
-            # CPU fallback: stage eagerly, compute in one jitted program
-            math_jit = jax.jit(_adamw_math, static_argnums=(6, 7, 8, 9),
-                               donate_argnums=(0, 1, 2))
-
-            def fn_eager(master, m, v, g, lr, t):
-                dev = SingleDeviceSharding(jax.devices()[0])
-                master_d = jax.device_put(master, dev)
-                m_d = jax.device_put(m, dev)
-                v_d = jax.device_put(v, dev)
-                master2, m2, v2 = math_jit(master_d, m_d, v_d, g, lr, t,
-                                           beta1, beta2, eps, wd)
-                return (jax.device_put(master2, host_sh),
-                        jax.device_put(m2, host_sh),
-                        jax.device_put(v2, host_sh),
-                        master2.astype(pdtype))
-
-            fn = fn_eager
+        fn = make_streamed_update(body, n_host=3, n_rest=3,
+                                  host_sh=host_sh, dev_sh=dev_sh,
+                                  out_host=(0, 1, 2), out_dev=(3,))
         self._fns[key] = fn
         return fn
 

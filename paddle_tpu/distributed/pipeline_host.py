@@ -87,7 +87,8 @@ class HostPipelineEngine:
                  loss_fn: Callable, n_stages: int, n_micro: int,
                  schedule: str = "1f1b", n_chunks: int = 1,
                  optimizer=None, lr: float = 0.1,
-                 devices: Optional[Sequence] = None, n_workers: int = 4):
+                 devices: Optional[Sequence] = None, n_workers: int = 4,
+                 shared_groups: Optional[Sequence] = None):
         total_v = n_stages * n_chunks
         assert len(stage_fns) == total_v, (
             f"need {total_v} virtual stages, got {len(stage_fns)}")
@@ -111,6 +112,18 @@ class HostPipelineEngine:
         self._opt = optimizer
         self._opt_state = [optimizer.init(s.params) for s in self.stages]
         self._loss_fn = loss_fn
+        # tied weights across virtual stages: [(vs, param_name), ...] per
+        # group. Each member's grad is replaced by the group SUM before
+        # the (deferred) update — with identical start values and opt
+        # state, every copy stays in lockstep (reference pp_layers.py:481
+        # allreduce over the shared comm group).
+        self.shared_groups = [list(g) for g in (shared_groups or [])]
+        self._shared_stages = {vs for g in self.shared_groups for vs, _ in g}
+        for g in self.shared_groups:
+            for vs, name in g:
+                assert name in self.stages[vs].params, (
+                    f"shared group member ({vs}, {name!r}) not in stage "
+                    f"params {sorted(self.stages[vs].params)}")
 
         def _loss_seed(y, labels, scale):
             l, gy = jax.value_and_grad(loss_fn)(y, labels)
@@ -219,7 +232,8 @@ class HostPipelineEngine:
             with lock:
                 grad_acc[vs].append(gp)
 
-        pending: Dict[int, Any] = {}  # vs -> unscaled total grads (scaler path)
+        pending: Dict[int, Any] = {}  # vs -> unscaled total grads, applied
+        # after the plan (scaler gating and/or shared-grad reduction)
 
         def _apply(vs, total):
             st = self.stages[vs]
@@ -238,9 +252,10 @@ class HostPipelineEngine:
                 if grad_scale != 1.0:
                     total = jax.tree.map(
                         lambda g: g * jnp.asarray(1.0 / scale, g.dtype), total)
-                if skip_update_if_nonfinite:
-                    # GradScaler semantics: found-inf must gate the WHOLE
-                    # step, so stash and decide after the plan completes.
+                if skip_update_if_nonfinite or vs in self._shared_stages:
+                    # deferred: found-inf must gate the WHOLE step, and a
+                    # shared stage's grads await the cross-stage sum (the
+                    # peer stage's OPT job may not have run yet).
                     with lock:
                         pending[vs] = total
                 else:
@@ -250,6 +265,19 @@ class HostPipelineEngine:
         handlers = {FORWARD: fwd, BACKWARD: bwd, BACKWARD_B: bwd_b,
                     BACKWARD_W: bwd_w, OPT: opt}
         execute_plan(self.plan, handlers, n_workers=self.n_workers)
+        # cross-stage shared-grad reduction: sum each tied group's grads
+        # and write the sum back to every member (device-to-device
+        # transfers ride the same host path as activations)
+        for group in self.shared_groups:
+            total = None
+            vs0, _ = group[0]
+            dev0 = self.stages[vs0].device
+            for vs, name in group:
+                g = jax.device_put(pending[vs][name], dev0)
+                total = g if total is None else total + g
+            for vs, name in group:
+                pending[vs][name] = jax.device_put(
+                    total, self.stages[vs].device)
         if skip_update_if_nonfinite:
             assert len(pending) == V
             # one fused reduction + host fetch per STAGE (leaves of one stage
@@ -262,6 +290,9 @@ class HostPipelineEngine:
                     _apply(vs, total)
             else:
                 self.last_found_inf = True
+        else:
+            for vs, total in pending.items():
+                _apply(vs, total)
         assert len(losses) == M
         return float(sum(float(losses[m]) for m in range(M)) / M)
 

@@ -92,8 +92,6 @@ def _wrap_adamw_offload(optimizer, mesh: ProcessMesh, n: int):
     import jax.numpy as jnp
 
     from ..optimizer.optimizer import AdamW
-    from .offload import supports_inline_transfers
-
     if not isinstance(optimizer, AdamW):
         raise NotImplementedError(
             f"offload=True supports AdamW (got {type(optimizer).__name__}); "
@@ -119,39 +117,35 @@ def _wrap_adamw_offload(optimizer, mesh: ProcessMesh, n: int):
 
     optimizer._acc = offloaded_acc
 
-    inline = supports_inline_transfers()
+    # checkpoint restore writes accumulators straight into _accumulators,
+    # bypassing offloaded_acc — re-place restored state on the host or the
+    # streamed update's out_shardings would conflict (and the memory
+    # savings silently vanish)
+    inner_set_state = optimizer.set_state_dict
+
+    def offloaded_set_state(state):
+        inner_set_state(state)
+        for name, store in optimizer._accumulators.items():
+            for pid, arr in list(store.items()):
+                store[pid] = jax.device_put(
+                    arr, _host_sharding(tuple(arr.shape)))
+
+    optimizer.set_state_dict = offloaded_set_state
+
     fns = {}
 
     def make_fn(host_sh, dev_sh):
         from ..optimizer.optimizer import _adamw_update_math
+        from .offload import make_streamed_update
 
-        if inline:
-            from jax.memory import Space
+        def body(m, v, param, g, lr, beta1, beta2, eps, t, wd, lr_ratio):
+            new_p, m2, v2 = _adamw_update_math(param, g, m, v, lr, beta1,
+                                               beta2, eps, t, wd, lr_ratio)
+            return m2, v2, new_p
 
-            def upd(param, g, m, v, *scalars):
-                m_d = jax.device_put(m, Space.Device)
-                v_d = jax.device_put(v, Space.Device)
-                new_p, m2, v2 = _adamw_update_math(param, g, m_d, v_d, *scalars)
-                return (new_p, jax.device_put(m2, Space.Host),
-                        jax.device_put(v2, Space.Host))
-
-            return jax.jit(upd, donate_argnums=(0, 2, 3),
-                           in_shardings=(dev_sh, dev_sh, host_sh, host_sh)
-                           + (None,) * 7,
-                           out_shardings=(dev_sh, host_sh, host_sh))
-
-        math_jit = jax.jit(_adamw_update_math, donate_argnums=(0, 2, 3))
-
-        def upd_eager(param, g, m, v, *scalars):
-            # stage onto the PARAM's placement (params may span the mesh)
-            dev = host_sh.with_memory_kind("device")
-            m_d = jax.device_put(m, dev)
-            v_d = jax.device_put(v, dev)
-            new_p, m2, v2 = math_jit(param, g, m_d, v_d, *scalars)
-            return (new_p, jax.device_put(m2, host_sh),
-                    jax.device_put(v2, host_sh))
-
-        return upd_eager
+        return make_streamed_update(body, n_host=2, n_rest=9,
+                                    host_sh=host_sh, dev_sh=dev_sh,
+                                    out_host=(0, 1), out_dev=(2,))
 
     def offloaded_update(p, g):
         import jax.numpy as jnp
@@ -177,7 +171,7 @@ def _wrap_adamw_offload(optimizer, mesh: ProcessMesh, n: int):
         scalars = tuple(jnp.asarray(s, jnp.float32) for s in (
             optimizer.get_lr(), optimizer._beta1, optimizer._beta2,
             optimizer._epsilon, optimizer._step_count, wd, lr_ratio))
-        p._data, m2, v2 = fn(p._data, g, m, v, *scalars)
+        m2, v2, p._data = fn(m, v, p._data, g, *scalars)
         optimizer._set_acc("moment1", p, m2)
         optimizer._set_acc("moment2", p, v2)
 

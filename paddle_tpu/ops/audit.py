@@ -53,8 +53,10 @@ class _Visitor(ast.NodeVisitor):
     enclosing function, which of its parameters flow into a dispatcher's
     name slot."""
 
-    def __init__(self, dispatchers: Dict[str, int]):
-        # dispatcher function name -> positional index of its name arg
+    def __init__(self, dispatchers: Dict[str, tuple]):
+        # dispatcher function name -> (positional index, parameter name)
+        # of its op-name slot; the parameter name resolves keyword calls
+        # like apply_op(name="foo", ...)
         self.dispatchers = dispatchers
         self.literals: Set[str] = set()
         self.dynamic: List[Tuple[str, int, str]] = []  # (file, line, repr)
@@ -69,17 +71,26 @@ class _Visitor(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
-    def _name_arg(self, call: ast.Call, idx: int):
+    def _name_arg(self, call: ast.Call, idx: int, pname: str):
         if idx < len(call.args):
             return call.args[idx]
+        for kw in call.keywords:
+            if kw.arg == pname:
+                return kw.value
         return None
 
     def visit_Call(self, node):
         fname = _func_name(node)
-        idx = self.dispatchers.get(fname)
-        if idx is not None:
-            arg = self._name_arg(node, idx)
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        slot = self.dispatchers.get(fname)
+        if slot is not None:
+            idx, pname = slot
+            arg = self._name_arg(node, idx, pname)
+            if arg is None:
+                # name slot not found positionally or by keyword — flag
+                # rather than silently skip (the guarantee depends on it)
+                self.dynamic.append((self._file, node.lineno,
+                                     f"{fname}(...): no name arg"))
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
                 self.literals.add(arg.value)
             elif isinstance(arg, ast.Name) and self._fn_stack:
                 # parameter forwarding: the enclosing function owning the
@@ -88,13 +99,13 @@ class _Visitor(ast.NodeVisitor):
                 for fn in reversed(self._fn_stack):
                     params = [a.arg for a in fn.args.args]
                     if arg.id in params:
-                        self.new_dispatchers.setdefault(fn.name,
-                                                        params.index(arg.id))
+                        self.new_dispatchers.setdefault(
+                            fn.name, (params.index(arg.id), arg.id))
                         break
                 else:
                     self.dynamic.append(
                         (self._file, node.lineno, ast.dump(arg)[:80]))
-            elif arg is not None:
+            else:
                 self.dynamic.append(
                     (self._file, node.lineno, ast.dump(arg)[:80]))
         self.generic_visit(node)
@@ -161,7 +172,7 @@ def collect_dispatch_surface(root: str = _PKG_ROOT):
         dynamic = []
         grown = False
         for path, tree in sources.items():
-            scope = {"apply_op": 0}
+            scope = {"apply_op": (0, "name")}
             for alias, (target, orig) in imports[path].items():
                 idx = pool.get((target, orig))
                 if idx is not None:

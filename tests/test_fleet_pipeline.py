@@ -82,17 +82,25 @@ class TestPipelineLayer:
             ref = l(ref)
         np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
 
-    def test_shared_desc_same_stage_ok_cross_stage_raises(self):
+    def test_shared_desc_cross_stage_groups(self):
         paddle.seed(0)
-        ok = [SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
-              LayerDesc(nn.GELU),
-              LayerDesc(nn.Linear, 8, 4), LayerDesc(nn.GELU)]
-        PipelineLayer(ok, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+        tied = [SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+                LayerDesc(nn.GELU),
+                SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+                LayerDesc(nn.GELU)]
+        pl = PipelineLayer(tied, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+        groups = pl.shared_groups()
+        assert groups == [[(0, "0.weight"), (1, "0.weight")]], groups
+        # copies start identical
+        sd = pl.state_dict()
+        np.testing.assert_array_equal(sd["0.weight"].numpy(),
+                                      sd["2.weight"].numpy())
+        # shape mismatch between occurrences must be rejected
         bad = [SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
                LayerDesc(nn.GELU),
                SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 4),
                LayerDesc(nn.GELU)]
-        with pytest.raises(NotImplementedError):
+        with pytest.raises(ValueError, match="tied weight shape"):
             PipelineLayer(bad, num_stages=2, loss_fn=nn.CrossEntropyLoss())
 
 
@@ -180,3 +188,96 @@ class TestPipelineParallelTrainBatch:
         fleet.init(is_collective=True, strategy=strategy)
         with pytest.raises(TypeError):
             fleet.distributed_model(nn.Linear(4, 4))
+
+
+def _tied_gpt_descs(vocab=12, hidden=16, n_blocks=4):
+    """Tied input-embedding / lm-head — THE canonical GPT pipeline layout
+    (reference pp_layers.py SharedLayerDesc example)."""
+
+    def head_fwd(layer, x):
+        return paddle.matmul(x, layer.weight, transpose_y=True)
+
+    descs = [SharedLayerDesc("emb", nn.Embedding, None, "weight",
+                             vocab, hidden)]
+    for _ in range(n_blocks):
+        descs.append(LayerDesc(nn.Linear, hidden, hidden))
+        descs.append(LayerDesc(nn.GELU))
+    descs.append(SharedLayerDesc("emb", nn.Embedding, head_fwd, "weight",
+                                 vocab, hidden))
+    return descs
+
+
+class TestCrossStageTiedWeights:
+    """Round-2/3 gap closed: SharedLayerDesc keys spanning pp stages
+    (reference _construct_shared_comm/_synchronize_shared_weights,
+    pp_layers.py:453,454,481)."""
+
+    def _run(self, schedule, pp, nvpp=None, steps=3):
+        paddle.seed(0)
+        ce = nn.CrossEntropyLoss()
+
+        def loss_fn(out, lab):
+            return ce(out.reshape([-1, 12]), lab.reshape([-1]))
+
+        pl = PipelineLayer(_tied_gpt_descs(), num_stages=pp, loss_fn=loss_fn,
+                           num_virtual_pipeline_stages=nvpp)
+        assert pl.shared_groups(), "tie must span stages in this layout"
+
+        paddle.seed(0)
+        twin = PipelineLayer(_tied_gpt_descs(), num_stages=pp,
+                             loss_fn=loss_fn,
+                             num_virtual_pipeline_stages=nvpp)
+        twin.set_state_dict(pl.state_dict())
+
+        strategy = _strategy(pp=pp, accumulate_steps=4, schedule=schedule)
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(pl)
+        opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+        opt = fleet.distributed_optimizer(opt, strategy)
+        opt_t = paddle.optimizer.SGD(0.1, parameters=twin.parameters())
+
+        # the twin's tied-weight semantics: sum the two copies' grads and
+        # give both the sum before the step — the engine's shared-grad
+        # reduction does exactly this inside train_batch
+        tw = twin.state_dict()
+        tied_names = ["0.weight", f"{len(twin.run_function)-1}.inner.weight"]
+        t0, t1 = tw[tied_names[0]], tw[tied_names[1]]
+
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, 12, (16, 6)).astype("int64")
+        y = rng.randint(0, 12, (16, 6)).astype("int64")
+
+        pp_losses, eager_losses = [], []
+        for _ in range(steps):
+            loss = model.train_batch(
+                (paddle.to_tensor(x), paddle.to_tensor(y)), opt)
+            pp_losses.append(float(loss))
+
+            out = twin(paddle.to_tensor(x))
+            l = loss_fn(out, paddle.to_tensor(y))
+            l.backward()
+            gsum = t0.grad + t1.grad
+            t0.grad = gsum
+            t1.grad = gsum
+            opt_t.step()
+            opt_t.clear_grad()
+            eager_losses.append(float(l))
+
+        np.testing.assert_allclose(pp_losses, eager_losses,
+                                   rtol=1e-4, atol=1e-5)
+        # both tied copies must remain bit-identical after training, and
+        # training must match the twin's tied weight value
+        sd = pl.state_dict()
+        np.testing.assert_array_equal(sd[tied_names[0]].numpy(),
+                                      sd[tied_names[1]].numpy())
+        np.testing.assert_allclose(sd[tied_names[0]].numpy(), t0.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_tied_1f1b(self):
+        self._run("1F1B", pp=2)
+
+    def test_tied_fthenb_4stage(self):
+        self._run("FThenB", pp=4)
+
+    def test_tied_vpp(self):
+        self._run("VPP", pp=2, nvpp=2)
