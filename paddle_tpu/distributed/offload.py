@@ -62,21 +62,24 @@ def _adamw_math(master, m, v, g, lr, t, beta1, beta2, eps, wd):
 
 
 def make_streamed_update(body, n_host: int, n_rest: int, host_sh, dev_sh,
-                         out_host: Sequence[int], out_dev: Sequence[int]):
+                         out_host: Sequence[int], out_dev: Sequence[int],
+                         donate_rest: Sequence[int] = ()):
     """Compile ``body(*host_args_on_device, *rest) -> outs`` with the first
     ``n_host`` arguments resident in pinned host memory, streamed through
     the device in-program (TPU) or staged eagerly (backends without
     in-program memory-space annotation, e.g. XLA:CPU).
 
     out_host/out_dev: indices of body outputs that return to host /
-    stay on device. Host inputs are donated (their buffers are replaced
-    by the returned state); nothing else is.
+    stay on device. Host inputs are always donated (their buffers are
+    replaced by the returned state); donate_rest names additional
+    ABSOLUTE argument indices the caller promises not to reuse (e.g. the
+    old param buffer an eager optimizer overwrites in place).
 
     The single implementation of the h2d→update→d2h schedule shared by
     HostOffloadAdamW (functional path) and sharding._wrap_adamw_offload
     (eager AdamW path) — reference offload_helper.py's per-param copy
     schedule."""
-    donate = tuple(range(n_host))
+    donate = tuple(range(n_host)) + tuple(donate_rest)
     if supports_inline_transfers():
         def upd(*args):
             staged = [jax.device_put(a, Space.Device)

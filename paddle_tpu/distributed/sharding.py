@@ -143,9 +143,12 @@ def _wrap_adamw_offload(optimizer, mesh: ProcessMesh, n: int):
                                                beta2, eps, t, wd, lr_ratio)
             return m2, v2, new_p
 
+        # arg 2 (the old param) is donated: p._data is overwritten with
+        # the returned update, so the transient old+new copy never holds
         return make_streamed_update(body, n_host=2, n_rest=9,
                                     host_sh=host_sh, dev_sh=dev_sh,
-                                    out_host=(0, 1), out_dev=(2,))
+                                    out_host=(0, 1), out_dev=(2,),
+                                    donate_rest=(2,))
 
     def offloaded_update(p, g):
         import jax.numpy as jnp

@@ -105,6 +105,7 @@ class StaticFunction:
                 from .sot_lite import SotFunction
 
                 self._sot = SotFunction(self._fn, _wrap_in, _unwrap_out)
+                self.uses_compiled_control_flow = False  # SOT serves calls
             except Exception:
                 if not self.uses_compiled_control_flow:
                     raise

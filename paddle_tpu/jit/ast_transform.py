@@ -1,5 +1,5 @@
-"""Compiled control flow for dy2static: AST-transform simple ``while``/
-``if`` statements into lax.while_loop / lax.cond.
+"""Compiled control flow for dy2static: AST-transform ``while``/``if``/
+``for range`` statements (with break/continue) into lax control flow.
 
 Parity: python/paddle/jit/dy2static/transformers/loop_transformer.py and
 ifelse_transformer.py — the reference rewrites tensor control flow into
@@ -21,9 +21,14 @@ Mechanics: ``while test: body`` becomes
 lax.while_loop; a concrete Python predicate runs the ordinary loop —
 so the transform is semantics-preserving for plain-Python control flow.
 
-A statement is transformed only when it is statically safe: no
-break/continue/return inside, and every assigned variable is already
-bound earlier in the function (so the state tuple is well-defined).
+``for v in range(...)`` desugars to an index while (loop_transformer.py
+:111 converts gast.For the same way); ``break``/``continue`` lower to
+boolean state gating the rest of the body and the loop condition
+(reference break_continue_transformer). Inner blocks are rewritten
+before outer ones, so nested tensor loops compose into nested lax
+control flow. A statement is transformed only when it is statically
+safe: no ``return`` inside, and every state variable is already bound
+earlier in the function (so the state tuple is well-defined).
 """
 
 from __future__ import annotations
@@ -72,11 +77,19 @@ def _pt_while(cond_fn: Callable, body_fn: Callable, state: tuple) -> tuple:
     state = tuple(state)
     p0 = _unwrap(cond_fn(state))
     if not _is_traced(p0):
-        # concrete predicate: ordinary Python loop (identical semantics)
-        while bool(p0):
-            state = tuple(body_fn(state))
-            p0 = _unwrap(cond_fn(state))
-        return state
+        # concrete predicate: ordinary Python loop (identical semantics).
+        # The predicate can BECOME traced mid-loop — e.g. a lowered break
+        # flag is concrete False on entry and a tracer after the first
+        # body (its branch ran under lax.cond); switch to the lax loop
+        # from the CURRENT state (completed iterations stay applied, the
+        # failed bool() was only the next predicate check).
+        try:
+            while bool(p0):
+                state = tuple(body_fn(state))
+                p0 = _unwrap(cond_fn(state))
+            return state
+        except jax.errors.TracerBoolConversionError:
+            pass
 
     from jax import lax
 
@@ -90,6 +103,38 @@ def _pt_while(cond_fn: Callable, body_fn: Callable, state: tuple) -> tuple:
 
     out = lax.while_loop(c, b, to_arr(state))
     return to_state(out)
+
+
+def _pt_and_not(flag, test_val):
+    """``(not flag) and test`` without Python short-circuit bool() —
+    traced flags lower to logical ops (loop conditions after break
+    lowering)."""
+    b, t = _unwrap(flag), _unwrap(test_val)
+    if _is_traced(b) or _is_traced(t):
+        return jnp.logical_and(jnp.logical_not(b), t)
+    return (not bool(b)) and bool(t)
+
+
+def _pt_not_any(*flags):
+    """``not (f1 or f2 ...)`` traced-safe (jump-guard predicates)."""
+    vals = [_unwrap(f) for f in flags]
+    if any(_is_traced(v) for v in vals):
+        out = jnp.logical_not(vals[0])
+        for v in vals[1:]:
+            out = jnp.logical_and(out, jnp.logical_not(v))
+        return out
+    return not any(bool(v) for v in vals)
+
+
+def _pt_range_cont(i, stop, step):
+    """Continuation predicate of a desugared ``for ... in range``:
+    direction-aware so negative literal/traced steps work."""
+    iv, sv, st = _unwrap(i), _unwrap(stop), _unwrap(step)
+    if _is_traced(iv) or _is_traced(sv) or _is_traced(st):
+        return jnp.where(st > 0, iv < sv, iv > sv)
+    if st == 0:  # match Python range() semantics, don't spin
+        raise ValueError("range() arg 3 must not be zero")
+    return iv < sv if st > 0 else iv > sv
 
 
 def _pt_if(pred, true_fn: Callable, false_fn: Callable, state: tuple) -> tuple:
@@ -116,13 +161,29 @@ def _pt_if(pred, true_fn: Callable, false_fn: Callable, state: tuple) -> tuple:
 # the AST pass
 # ---------------------------------------------------------------------------
 
+def _iter_nodes(st: ast.stmt):
+    """ast.walk, but generated ``__pt_*`` function defs (an already-
+    transformed inner loop/if) are opaque: their bodies are
+    self-contained state machines and must not contaminate the enclosing
+    block's analysis."""
+    yield st
+    if isinstance(st, ast.FunctionDef) and st.name.startswith("__pt_"):
+        return
+    for child in ast.iter_child_nodes(st):
+        yield from _iter_nodes(child)
+
+
 def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
     """Names bound by the statements; None when a construct we don't
     rewrite (nested defs, for-loops, with, try, del, star/attr targets)
     appears."""
     names: Set[str] = set()
     for st in stmts:
-        for node in ast.walk(st):
+        for node in _iter_nodes(st):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name.startswith("__pt_"):
+                names.add(node.name)
+                continue
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef, ast.For, ast.AsyncFor,
                                  ast.With, ast.Try, ast.Delete,
@@ -142,6 +203,25 @@ def _has_jumps(stmts: List[ast.stmt]) -> bool:
             if isinstance(node, (ast.Break, ast.Continue, ast.Return)):
                 return True
     return False
+
+
+def _has_returns(stmts: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Return)
+               for st in stmts for n in ast.walk(st))
+
+
+def _assign_flag(name: str, value: bool) -> ast.Assign:
+    return ast.fix_missing_locations(ast.Assign(
+        targets=[ast.Name(id=name, ctx=ast.Store())],
+        value=ast.Constant(value=value)))
+
+
+def _not_flags(names: List[str]) -> ast.expr:
+    # traced-safe: __pt_not_any__(f1, ...) — a plain `not (f1 or f2)`
+    # would bool() traced flags inside the compiled body
+    return ast.Call(func=ast.Name(id="__pt_not_any__", ctx=ast.Load()),
+                    args=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                    keywords=[])
 
 
 def _loaded_names(expr: ast.expr) -> Set[str]:
@@ -192,21 +272,26 @@ class _Rewriter:
     def _rewrite_block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
         out: List[ast.stmt] = []
         for st in stmts:
+            # recurse into sub-blocks FIRST: an inner tensor loop becomes
+            # a plain __pt_while__ call, so the OUTER statement then
+            # qualifies too (nested lax control flow composes)
+            saved = set(self.bound)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    setattr(st, attr, self._rewrite_block(sub))
+            self.bound = saved
             replaced = None
             if isinstance(st, ast.While) and not st.orelse:
                 replaced = self._try_while(st)
+            elif isinstance(st, ast.For) and not st.orelse:
+                replaced = self._try_for(st)
             elif isinstance(st, ast.If):
                 replaced = self._try_if(st)
             if replaced is None:
-                # recurse into compound bodies with a scoped bound set,
-                # then record only this statement's DEFINITE bindings —
+                # record only this statement's DEFINITE bindings —
                 # branch-only names would make a later generated state
                 # tuple read unbound locals
-                saved = set(self.bound)
-                for attr in ("body", "orelse", "finalbody"):
-                    sub = getattr(st, attr, None)
-                    if sub:
-                        setattr(st, attr, self._rewrite_block(sub))
                 self.bound = saved | _definitely_bound(st)
                 out.append(st)
             else:
@@ -236,7 +321,11 @@ class _Rewriter:
         # (a): within the block, stores must precede loads per temp
         stored: Set[str] = set()
         for st in body:
-            for node in ast.walk(st):
+            for node in _iter_nodes(st):
+                if isinstance(node, ast.FunctionDef) and \
+                        node.name.startswith("__pt_"):
+                    stored.add(node.name)
+                    continue
                 if isinstance(node, ast.Name) and node.id in temps:
                     if isinstance(node.ctx, ast.Load) and node.id not in stored:
                         return None
@@ -244,14 +333,123 @@ class _Rewriter:
                         stored.add(node.id)
         return body_names - temps
 
-    def _try_while(self, node: ast.While) -> Optional[List[ast.stmt]]:
-        if _has_jumps(node.body):
+    # -- break/continue lowering (reference: dy2static
+    # break_continue_transformer — jumps become boolean state gating the
+    # rest of the body and the loop condition) --------------------------
+
+    def _guard_block(self, stmts: List[ast.stmt], brk: str, cont: str):
+        """Rewrite Break/Continue into flag assignments; every statement
+        after a possible jump is guarded by ``if not (flags):``. Returns
+        (new_stmts, used_brk, used_cont) or None when a jump sits inside
+        a construct we cannot gate (with/try)."""
+        out: List[ast.stmt] = []
+        used_b = used_c = False
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(ast.copy_location(_assign_flag(brk, True), st))
+                return out, True, used_c  # code after a bare break is dead
+            if isinstance(st, ast.Continue):
+                out.append(ast.copy_location(_assign_flag(cont, True), st))
+                return out, used_b, True
+            if isinstance(st, ast.If) and _has_jumps([st]):
+                res_t = self._guard_block(st.body, brk, cont)
+                res_f = self._guard_block(st.orelse, brk, cont)
+                if res_t is None or res_f is None:
+                    return None
+                st = ast.copy_location(
+                    ast.If(test=st.test, body=res_t[0],
+                           orelse=res_f[0]), st)
+                ast.fix_missing_locations(st)
+                used_b |= res_t[1] | res_f[1]
+                used_c |= res_t[2] | res_f[2]
+                out.append(st)
+                rest = self._guard_block(stmts[idx + 1:], brk, cont)
+                if rest is None:
+                    return None
+                rest_stmts, rb, rc = rest
+                used_b |= rb
+                used_c |= rc
+                if rest_stmts:
+                    flags = [n for n, u in ((brk, used_b), (cont, used_c))
+                             if u]
+                    guard = ast.copy_location(ast.If(
+                        test=_not_flags(flags), body=rest_stmts, orelse=[]),
+                        st)
+                    out.append(ast.fix_missing_locations(guard))
+                return out, used_b, used_c
+            if not isinstance(st, (ast.While, ast.For)) and _has_jumps([st]):
+                return None  # jump under with/try/etc: cannot gate
+            out.append(st)
+        return out, used_b, used_c
+
+    def _lower_jumps(self, node: ast.While):
+        """(body, test, prologue) with break/continue lowered, or None."""
+        i = self.counter  # flag names share the loop's counter
+        brk, cont = f"__pt_brk_{i}", f"__pt_cont_{i}"
+        res = self._guard_block(node.body, brk, cont)
+        if res is None:
             return None
+        body, used_b, used_c = res
+        prologue: List[ast.stmt] = []
+        test = node.test
+        if used_c:
+            body = [ast.copy_location(_assign_flag(cont, False), node)] + body
+        if used_b:
+            prologue.append(ast.copy_location(_assign_flag(brk, False), node))
+            test = ast.copy_location(ast.Call(
+                func=ast.Name(id="__pt_and_not__", ctx=ast.Load()),
+                args=[ast.Name(id=brk, ctx=ast.Load()), node.test],
+                keywords=[]), node.test)
+            ast.fix_missing_locations(test)
+            self.bound.add(brk)
+        # the synthesized guards are tensor `if`s over flag state — run
+        # them through the if-transform so traced flags become lax.cond.
+        # Scope the bound set: body-local bindings must NOT leak into the
+        # enclosing _split_temps decision (they are not pre-bound there)
+        saved = set(self.bound)
+        body = self._rewrite_block(body)
+        self.bound = saved
+        return body, test, prologue
+
+    def _try_while(self, node: ast.While,
+                   min_one_trip: bool = False) -> Optional[List[ast.stmt]]:
+        if _has_returns(node.body):
+            return None
+        prologue: List[ast.stmt] = []
+        body, test = node.body, node.test
+        if _has_jumps(node.body):
+            lowered = self._lower_jumps(node)
+            if lowered is None:
+                return None
+            body, test, prologue = lowered
+        node = ast.copy_location(ast.While(test=test, body=body, orelse=[]),
+                                 node)
+        ast.fix_missing_locations(node)
+        end_lineno = getattr(node, "end_lineno", 10**9)
         body_names = _assigned_names(node.body)
         if body_names is None or not body_names:
             return None
-        body_names = self._split_temps(node.body, body_names,
-                                       getattr(node, "end_lineno", 10**9))
+        split = self._split_temps(node.body, body_names, end_lineno)
+        if split is None and min_one_trip:
+            # names defined in the body and read AFTER the loop (e.g. the
+            # final ``loss`` of a for-range training loop): peel one
+            # guaranteed iteration so they are bound before the lax loop
+            # (the reference's UndefinedVar machinery has no XLA analogue
+            # — carries need concrete avals)
+            import copy as _copy
+
+            peel = [_copy.deepcopy(s) for s in node.body]
+            # promote only USER names: generated __pt_* machinery (inner
+            # state tuples / branch defs) must stay per-iteration temps —
+            # a tuple-valued __pt_st_k in the carry is not a lax aval
+            self.bound = self.bound | {
+                n for n in set().union(
+                    *(_definitely_bound(s) for s in node.body))
+                if not n.startswith("__pt_")}
+            split = self._split_temps(node.body, body_names, end_lineno)
+            if split is not None:
+                prologue = prologue + peel
+        body_names = split
         if body_names is None or not body_names:
             return None
         vars_ = self._state_vars(body_names, node.test)
@@ -276,7 +474,70 @@ class _Rewriter:
         body_def.body[1:2] = node.body  # replace __PT_BODY__ placeholder
         self.applied += 1
         return [ast.fix_missing_locations(ast.copy_location(s, node))
-                for s in block]
+                for s in prologue + block]
+
+    def _try_for(self, node: ast.For) -> Optional[List[ast.stmt]]:
+        """``for v in range(...)`` desugars to an index while (increment
+        BEFORE the user body so ``continue`` cannot skip it), then the
+        while transform compiles it — XLA folds the counted while into
+        fori_loop-style control flow (reference loop_transformer.py:111
+        converts gast.For the same way)."""
+        if not isinstance(node.target, ast.Name):
+            return None
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return None
+        if _has_returns(node.body):
+            return None
+        k = self.counter
+        iv, stopv, stepv = (f"__pt_fi_{k}", f"__pt_fstop_{k}",
+                            f"__pt_fstep_{k}")
+        args = it.args
+        start = args[0] if len(args) >= 2 else ast.Constant(value=0)
+        stop = args[1] if len(args) >= 2 else args[0]
+        step = args[2] if len(args) == 3 else ast.Constant(value=1)
+
+        def _assign(name, expr):
+            return ast.fix_missing_locations(ast.copy_location(ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())], value=expr),
+                node))
+
+        prologue = [_assign(iv, start), _assign(stopv, stop),
+                    _assign(stepv, step)]
+        test = ast.fix_missing_locations(ast.copy_location(ast.Call(
+            func=ast.Name(id="__pt_range_cont__", ctx=ast.Load()),
+            args=[ast.Name(id=iv, ctx=ast.Load()),
+                  ast.Name(id=stopv, ctx=ast.Load()),
+                  ast.Name(id=stepv, ctx=ast.Load())],
+            keywords=[]), node))
+        bind_v = _assign(node.target.id, ast.Name(id=iv, ctx=ast.Load()))
+        incr = _assign(iv, ast.BinOp(
+            left=ast.Name(id=iv, ctx=ast.Load()), op=ast.Add(),
+            right=ast.Name(id=stepv, ctx=ast.Load())))
+        # constant range with a guaranteed first trip enables one-iteration
+        # peeling for body-defined names read after the loop
+        const = []
+        for a in (start, stop, step):
+            const.append(a.value if isinstance(a, ast.Constant)
+                         and isinstance(a.value, int) else None)
+        if const[2] == 0:
+            # range(..., 0) raises ValueError in Python; the desugared
+            # direction test would spin forever — keep the original
+            return None
+        min_one = (None not in const
+                   and len(range(const[0], const[1], const[2])) >= 1)
+
+        wl = ast.fix_missing_locations(ast.copy_location(ast.While(
+            test=test, body=[bind_v, incr] + node.body, orelse=[]), node))
+        saved = set(self.bound)
+        self.bound |= {iv, stopv, stepv}
+        replaced = self._try_while(wl, min_one_trip=min_one)
+        if replaced is None:
+            self.bound = saved
+            return None
+        return prologue + replaced
 
     def _try_if(self, node: ast.If) -> Optional[List[ast.stmt]]:
         if _has_jumps(node.body) or _has_jumps(node.orelse):
@@ -347,7 +608,10 @@ def transform_control_flow(fn: Callable) -> Optional[Callable]:
     # through to fn's REAL module globals — forward references defined
     # after decoration and test monkeypatching keep working
     glb = _GlobalsProxy(fn.__globals__,
-                        {"__pt_while__": _pt_while, "__pt_if__": _pt_if})
+                        {"__pt_while__": _pt_while, "__pt_if__": _pt_if,
+                         "__pt_range_cont__": _pt_range_cont,
+                         "__pt_and_not__": _pt_and_not,
+                         "__pt_not_any__": _pt_not_any})
     loc: dict = {}
     exec(code, glb, loc)
     new_fn = loc[func.name]
