@@ -100,9 +100,32 @@ amp_guard = auto_cast
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
              save_dtype=None, master_grad=False, excluded_layers=None):
     """O2: cast model params to low precision (master weights live in the
-    optimizer's fp32 state). Parity: python/paddle/amp/auto_cast.py:1114."""
+    optimizer's fp32 state — ``master_weight`` asks for exactly what the
+    fp32 accumulators already provide, so None/True are both satisfied).
+    O1 keeps params fp32 (autocast handles per-op precision) — decorate
+    is then an identity on the model. Parity: amp/auto_cast.py:1114."""
     d = dtypes.convert_dtype(dtype)
     from ..nn.layer import Layer
+
+    if level == "O1":
+        # O1 never casts parameters; auto_cast() does per-op casting
+        if optimizers is None:
+            return models
+        return models, optimizers
+    if level != "O2":
+        raise ValueError(f"decorate level must be 'O1' or 'O2', got {level!r}")
+    if master_weight is False:
+        raise NotImplementedError(
+            "master_weight=False (low-precision optimizer state) is not "
+            "implemented: optimizers keep fp32 accumulators by design")
+    if master_grad:
+        raise NotImplementedError(
+            "master_grad=True (fp32 gradient copies) is not implemented; "
+            "grads follow param dtype and the update math is fp32")
+    if save_dtype is not None:
+        raise NotImplementedError(
+            "save_dtype is not implemented; cast state_dicts explicitly "
+            "before saving")
 
     def _cast_layer(layer):
         from ..nn.layers_conv_norm import _BatchNormBase, GroupNorm, LayerNorm

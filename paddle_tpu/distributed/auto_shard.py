@@ -40,11 +40,44 @@ from ..nn.layers_common import Embedding, Linear
 from ..ops import dispatch as _dispatch
 from .mesh import ProcessMesh, Replicate, Shard
 
-__all__ = ["derive_placements", "auto_shard_layer"]
+__all__ = ["derive_placements", "auto_shard_layer", "ShardDecisions"]
 
-# an embedding whose row count is at least this multiple of its feature
-# dim is treated as a vocabulary (positional tables stay replicated)
+# THE VOCAB HEURISTIC (documented contract): an embedding whose row count
+# is >= _VOCAB_RATIO x its feature dim is treated as a vocabulary table
+# and row-sharded over mp; anything squatter (positional tables, but ALSO
+# genuinely small vocabularies like a 256-token char model with hidden
+# 768) replicates. This is a heuristic, not an inference — models outside
+# the LLM shape should pass an explicit recipe to shard_layer, and every
+# embedding the heuristic declines is listed in ShardDecisions.replicated
+# with this reason so the choice is visible, never silent.
 _VOCAB_RATIO = 4
+
+
+class ShardDecisions(dict):
+    """The decision table {layer_name: {param: placements}} plus the
+    audit trail the reference's completion pass logs (auto_parallel
+    completion.py verbose mode): every shardable layer the pass saw but
+    REPLICATED (with the reason), every shardable layer the trace never
+    reached, and every param-bearing leaf outside the pass's scope
+    (convs etc. — Linear/Embedding/ExpertMLP only)."""
+
+    def __init__(self):
+        super().__init__()
+        self.replicated: Dict[str, str] = {}
+        self.unreached: List[str] = []
+        self.out_of_scope: List[str] = []
+
+    def report(self) -> str:
+        lines = [f"auto_shard: {len(self)} layers sharded"]
+        for name, why in self.replicated.items():
+            lines.append(f"  replicated {name}: {why}")
+        for name in self.unreached:
+            lines.append(f"  UNREACHED {name}: trace never saw it — its "
+                         "params stay as-is")
+        for name in self.out_of_scope:
+            lines.append(f"  out-of-scope {name}: not Linear/Embedding/"
+                         "ExpertMLP; pass an explicit shard_fn to cover it")
+        return "\n".join(lines)
 
 
 class _Trace:
@@ -89,8 +122,10 @@ def _trace_leaves(model: Layer, sample_inputs: Sequence) -> List[_Trace]:
 
         return post_hook
 
+    from .moe import ExpertMLP
+
     for name, sub in model.named_sublayers(include_self=True):
-        if isinstance(sub, (Linear, Embedding)):
+        if isinstance(sub, (Linear, Embedding, ExpertMLP)):
             hooks.append(sub.register_forward_post_hook(make_hook(name)))
 
     prev = _dispatch._prov_enabled[0]
@@ -107,16 +142,27 @@ def _trace_leaves(model: Layer, sample_inputs: Sequence) -> List[_Trace]:
 
 def derive_placements(model: Layer, mesh: ProcessMesh,
                       sample_inputs: Sequence, mp_axis: str = "mp",
-                      ) -> Dict[str, list]:
-    """Returns {sublayer_name: per-param placements dict} — 'weight' ->
-    placements list, 'bias' -> placements list — for every Linear and
-    Embedding the trace reaches."""
+                      ep_axis: str = "ep") -> ShardDecisions:
+    """Returns a ShardDecisions table {sublayer_name: per-param
+    placements} for every Linear/Embedding/ExpertMLP the trace reaches,
+    plus the audit trail of what was replicated/unreached/out-of-scope.
+
+    ExpertMLP stacks shard their expert dim over ``ep_axis`` (when
+    present and divisible) AND derive column/row INSIDE each expert over
+    ``mp_axis`` — w1 [E, d, h] is the column (Shard(2)), w2 [E, h, d]
+    the row (Shard(1)), the per-expert Megatron sandwich."""
+    decisions = ShardDecisions()
     if mp_axis not in mesh.dim_names:
-        return {}
+        return decisions
     mp_idx = mesh.dim_names.index(mp_axis)
     mp_size = mesh.shape[mp_idx]
     if mp_size == 1:
-        return {}
+        return decisions
+    ep_idx = (mesh.dim_names.index(ep_axis)
+              if ep_axis in mesh.dim_names else None)
+    ep_size = mesh.shape[ep_idx] if ep_idx is not None else 1
+
+    from .moe import ExpertMLP
 
     traces = _trace_leaves(model, sample_inputs)
 
@@ -128,10 +174,38 @@ def derive_placements(model: Layer, mesh: ProcessMesh,
         pl[mp_idx] = Shard(dim)
         return pl
 
-    decisions: Dict[str, Dict[str, list]] = {}
     open_cols: set = set()  # column-parallel linears awaiting their row
 
     for tr in traces:
+        if isinstance(tr.layer, ExpertMLP):
+            if tr.name in decisions:
+                continue
+            E, d_model, d_hidden = tr.layer.w1.shape
+            on_ep = ep_idx is not None and E % ep_size == 0
+            on_mp = d_hidden % mp_size == 0
+
+            def expert_pl(ep_dim, mp_dim):
+                pl = repl()
+                if on_ep:
+                    pl[ep_idx] = Shard(ep_dim)
+                if on_mp and mp_dim is not None:
+                    pl[mp_idx] = Shard(mp_dim)
+                return pl
+
+            decisions[tr.name] = {
+                "w1": expert_pl(0, 2),   # per-expert column
+                "b1": expert_pl(0, 1),
+                "w2": expert_pl(0, 1),   # per-expert row
+                "b2": expert_pl(0, None),
+            }
+            if not on_ep and ep_idx is not None:
+                decisions.replicated[tr.name + " (ep)"] = (
+                    f"{E} experts not divisible by ep={ep_size}")
+            if not on_mp:
+                decisions.replicated[tr.name + " (mp)"] = (
+                    f"expert hidden {d_hidden} not divisible by "
+                    f"mp={mp_size}")
+            continue
         if isinstance(tr.layer, Embedding):
             if tr.name in decisions:
                 continue  # shared/tied embedding: first decision stands
@@ -140,6 +214,14 @@ def derive_placements(model: Layer, mesh: ProcessMesh,
                 decisions[tr.name] = {"weight": shard(0)}  # vocab rows
             else:
                 decisions[tr.name] = {"weight": repl()}
+                if n < _VOCAB_RATIO * d:
+                    decisions.replicated[tr.name] = (
+                        f"rows {n} < {_VOCAB_RATIO}x cols {d}: treated as "
+                        "a positional/small table per the _VOCAB_RATIO "
+                        "contract — pass an explicit recipe to shard it")
+                else:
+                    decisions.replicated[tr.name] = (
+                        f"vocab {n} not divisible by mp={mp_size}")
             continue
 
         # Linear: weight [in, out]. Self-edges (a tied layer reused later
@@ -163,10 +245,28 @@ def derive_placements(model: Layer, mesh: ProcessMesh,
             open_cols.add(tr.name)
         else:
             decisions[tr.name] = {"weight": repl(), "bias": repl()}
+            decisions.replicated[tr.name] = (
+                f"neither dim of ({w_in}, {w_out}) divisible by "
+                f"mp={mp_size}")
 
     # a column whose row never arrived (e.g. the final lm_head) is fine:
     # GSPMD all_gathers its output — that IS the reference's
     # ColumnParallelLinear(gather_output=True) ending.
+
+    # audit trail: shardable layers the trace never reached, and
+    # param-bearing leaves outside the pass's scope
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Embedding, ExpertMLP)):
+            if name not in decisions:
+                decisions.unreached.append(name)
+        elif sub._parameters and "Norm" not in type(sub).__name__ \
+                and not any(
+                    isinstance(s, (Linear, Embedding, ExpertMLP))
+                    for _, s in sub.named_sublayers(include_self=False)):
+            # norm layers replicate by design (their params are O(d));
+            # convs and other shardable exotics ARE out of scope — listed
+            # so the limitation is visible, never silent
+            decisions.out_of_scope.append(name)
     return decisions
 
 

@@ -392,6 +392,14 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group: Optional[Group] = No
 
 def alltoall_single(tensor: Tensor, output=None, in_split_sizes=None, out_split_sizes=None,
                     group: Optional[Group] = None, sync_op=True, split_axis=0, concat_axis=0):
+    def _uneven(sizes):
+        return sizes is not None and len(set(sizes)) > 1
+
+    if _uneven(in_split_sizes) or _uneven(out_split_sizes):
+        raise NotImplementedError(
+            "alltoall_single with UNEVEN split sizes is not implemented; "
+            "pad to equal splits (XLA all-to-all requires them). Equal "
+            "explicit splits are accepted.")
     ax = _axis(group)
     if ax is None:
         if _eager_multiprocess(tensor, group):

@@ -104,18 +104,24 @@ def _compiled(kind: str, shape, dtype, extra):
         return jax.jit(f, out_shardings=NamedSharding(mesh, P("world")))
     if kind == "shift":
         # p2p pipeline edge: rank r receives rank (r - shift)'s input;
-        # edge ranks (no source) receive zeros. One ppermute-shaped program
-        # all processes enter — the eager send/recv of the reference's
-        # ProcessGroup (process_group.h send:129/recv:139), deadlock-free
-        # because it is a collective.
+        # edge ranks (no source) receive zeros. TRUE neighbor p2p: a
+        # lax.ppermute over the world mesh — each payload moves along ONE
+        # edge instead of the roll-over-gathered-world form (which was
+        # all-gather-shaped: W x payload traffic). Deadlock-free for any
+        # world size because every process enters the same collective
+        # (the eager send/recv of the reference's ProcessGroup,
+        # process_group.h send:129/recv:139 / pp_utils
+        # p2p_communication.py:576 _p2p_helper).
         shift = extra
+        from jax.experimental.shard_map import shard_map
 
-        def f(g):
-            r = jnp.roll(g, shift, axis=0)
-            idx = jnp.arange(W)
-            valid = (idx - shift >= 0) & (idx - shift < W)
-            return jnp.where(valid.reshape((W,) + (1,) * len(shape)), r, 0)
+        perm = [(i, i + shift) for i in range(W) if 0 <= i + shift < W]
 
+        def body(local):  # [1, *shape] — this process's row
+            return jax.lax.ppermute(local, "world", perm)
+
+        f = shard_map(body, mesh=mesh, in_specs=P("world"),
+                      out_specs=P("world"))
         return jax.jit(f, out_shardings=NamedSharding(mesh, P("world")))
     if kind == "scatter":
         src, axis = extra

@@ -503,6 +503,36 @@ class PipelineParallel:
             aval = jax.eval_shape(fns[s], mp["all_params"][s], aval)
             bshapes.append(aval)
 
+        if self._schedule == "1f1b":
+            grad_total, losses = self._lockstep_1f1b(
+                x_micro, y_micro, mp, bshapes, rank, S, M)
+        elif self._schedule == "fthenb":
+            grad_total, losses = self._lockstep_fthenb(
+                x_micro, y_micro, mp, bshapes, rank, S, M)
+        else:
+            raise NotImplementedError(
+                f"cross-process schedule {self._schedule!r}: FThenB and "
+                "1F1B run over processes; ZBH1 is single-controller only")
+        lr = jnp.asarray(float(inner.get_lr()) if hasattr(inner, "get_lr") else 0.1,
+                         jnp.float32)
+        mp["params"], mp["opt_state"] = mp["opt"].update(
+            grad_total, mp["opt_state"], mp["params"], lr)
+        seg_state = self._layers._segments[rank].state_dict()
+        for name, arr in mp["params"].items():
+            seg_state[name]._data = arr
+        if hasattr(inner, "_step_count"):
+            inner._step_count += 1
+        mean_loss = jnp.asarray(sum(losses) / M if losses else 0.0, jnp.float32)
+        return float(eager_broadcast(mean_loss, src=S - 1))
+
+    @staticmethod
+    def _lockstep_fthenb(x_micro, y_micro, mp, bshapes, rank, S, M):
+        """Per-micro sequential FThenB: every inter-stage edge is one
+        shift collective all processes enter in the same order."""
+        import jax
+
+        from ..eager_collectives import eager_shift
+
         acts = {}
         grad_total = None
         losses = []
@@ -536,14 +566,93 @@ class PipelineParallel:
                     r = eager_shift(payload, -1)
                     if rank == s - 1:
                         gy = r
-        lr = jnp.asarray(float(inner.get_lr()) if hasattr(inner, "get_lr") else 0.1,
-                         jnp.float32)
-        mp["params"], mp["opt_state"] = mp["opt"].update(
-            grad_total, mp["opt_state"], mp["params"], lr)
-        seg_state = self._layers._segments[rank].state_dict()
-        for name, arr in mp["params"].items():
-            seg_state[name]._data = arr
-        if hasattr(inner, "_step_count"):
-            inner._step_count += 1
-        mean_loss = jnp.asarray(sum(losses) / M if losses else 0.0, jnp.float32)
-        return float(eager_broadcast(mean_loss, src=S - 1))
+        return grad_total, losses
+
+    @staticmethod
+    def _timetable_1f1b(S: int, M: int):
+        """Clocked 1F1B: per tick, each rank's job ('F'|'B', micro) or
+        None, plus the set of active fwd/bwd edges. Pure-integer greedy
+        simulation (prefer backward, else forward) — deterministic, so
+        every process derives the identical table and stays in lockstep
+        (reference steady-state discipline: pipeline_parallel.py:575
+        forward_backward_pipeline's 1F1B phase)."""
+        fwd_q = [list(range(M)) if r == 0 else [] for r in range(S)]
+        bwd_q = [[] for _ in range(S)]
+        done_b = [0] * S
+        ticks = []
+        while any(d < M for d in done_b):
+            jobs = [None] * S
+            fwd_sent = {}  # edge s -> micro (rank s -> s+1)
+            bwd_sent = {}  # edge s -> micro (rank s -> s-1)
+            for r in range(S):
+                if bwd_q[r]:
+                    m = bwd_q[r].pop(0)
+                    jobs[r] = ("B", m)
+                    done_b[r] += 1
+                    if r > 0:
+                        bwd_sent[r] = m
+                elif fwd_q[r]:
+                    m = fwd_q[r].pop(0)
+                    jobs[r] = ("F", m)
+                    if r < S - 1:
+                        fwd_sent[r] = m
+                    else:
+                        bwd_q[r].append(m)  # loss seed: bwd next tick
+            # deliveries land AFTER the exchange phase of this tick
+            for s, m in fwd_sent.items():
+                fwd_q[s + 1].append(m)
+            for s, m in bwd_sent.items():
+                bwd_q[s - 1].append(m)
+            ticks.append((jobs, fwd_sent, bwd_sent))
+            assert len(ticks) < 4 * (M + S) + 8, "1f1b timetable diverged"
+        return ticks
+
+    def _lockstep_1f1b(self, x_micro, y_micro, mp, bshapes, rank, S, M):
+        """Steady-state 1F1B across processes: each tick every rank runs
+        its scheduled job CONCURRENTLY (rank r forwards micro m+1 while
+        rank r+1 backwards micro m — the bubble-filling overlap FThenB
+        lacks), then all ranks enter one shift collective per active
+        edge (warmup/cooldown send/recv interleaving; reference
+        pp_utils/p2p_communication.py:576 _p2p_helper)."""
+        import jax
+
+        from ..eager_collectives import eager_shift
+
+        acts = {}       # micro -> saved stage input
+        recv_act = {}   # micro -> arrived activation
+        gys = {}        # micro -> arrived/seeded output grad
+        grad_total = None
+        losses = []
+        for jobs, fwd_sent, bwd_sent in self._timetable_1f1b(S, M):
+            job = jobs[rank]
+            out = gx = None
+            if job is not None:
+                kind, m = job
+                if kind == "F":
+                    inp = x_micro[m] if rank == 0 else recv_act.pop(m)
+                    out = mp["fwd"](mp["params"], inp)
+                    acts[m] = inp
+                    if rank == S - 1:
+                        l, gy = mp["loss_seed"](out, y_micro[m])
+                        losses.append(float(l))
+                        gys[m] = jax.tree.map(lambda g: g / M, gy)
+                else:
+                    gp, gx = mp["bwd"](mp["params"], acts.pop(m),
+                                       gys.pop(m))
+                    grad_total = gp if grad_total is None else \
+                        jax.tree.map(jnp.add, grad_total, gp)
+            # exchange: one shift per ACTIVE edge, entered by all ranks in
+            # the same (edge-ordered) sequence — deadlock-free
+            for s in sorted(fwd_sent):
+                payload = out if rank == s else jnp.zeros(
+                    bshapes[s].shape, bshapes[s].dtype)
+                r_ = eager_shift(payload, 1)
+                if rank == s + 1:
+                    recv_act[fwd_sent[s]] = r_
+            for s in sorted(bwd_sent):
+                payload = gx if rank == s else jnp.zeros(
+                    bshapes[s - 1].shape, bshapes[s - 1].dtype)
+                r_ = eager_shift(payload, -1)
+                if rank == s - 1:
+                    gys[bwd_sent[s]] = r_
+        return grad_total, losses

@@ -30,6 +30,12 @@ __all__ = [
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
                    begin_norm_axis: int = -1, **kwargs):
+    nd = len(x.shape)
+    if begin_norm_axis % nd != nd - 1:
+        raise NotImplementedError(
+            "fused_rms_norm normalizes the last axis; reshape for "
+            f"begin_norm_axis={begin_norm_axis}")
+
     def fn(x, w, *rest):
         xf = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
@@ -156,13 +162,26 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm: boo
                                training: bool = True, num_heads: Optional[int] = None, **kwargs):
     """Fused transformer MHA block (parity: incubate
     fused_multi_head_attention; kernel phi/kernels/fusion/gpu/
-    fused_attention_kernel). Dropout is omitted under inference semantics."""
+    fused_attention_kernel)."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv is not implemented; use "
+            "the models' kv-cache decode path (models/llama.py)")
     h = x
     if pre_layer_norm:
         h = fused_layer_norm(h, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
     # qkv_weight: [3, num_heads, head_dim, embed_dim]
     n_heads = int(qkv_weight.shape[1])
     head_dim = int(qkv_weight.shape[2])
+    if num_heads is not None and int(num_heads) != n_heads:
+        raise ValueError(
+            f"num_heads={num_heads} contradicts qkv_weight layout "
+            f"({n_heads} heads)")
+    drop = training and (dropout_rate > 0 or attn_dropout_rate > 0)
+    if drop:
+        from ...ops.random import split_key
+
+        dk1, dk2 = jax.random.split(split_key())
 
     def attn_fn(h, qkvw, *rest):
         i = 0
@@ -186,10 +205,19 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm: boo
         if mask is not None:
             logits = logits + mask
         probs = jax.nn.softmax(logits, axis=-1)
+        if drop and attn_dropout_rate > 0:
+            keep = jax.random.bernoulli(dk1, 1.0 - attn_dropout_rate,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - attn_dropout_rate),
+                              0.0).astype(probs.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, n_heads * head_dim)
         out = ctx @ lw
         if lb is not None:
             out = out + lb
+        if drop and dropout_rate > 0:
+            keep = jax.random.bernoulli(dk2, 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate),
+                            0.0).astype(out.dtype)
         return out
 
     args = [h, qkv_weight, linear_weight]
@@ -216,6 +244,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None, line
     if pre_layer_norm:
         h = fused_layer_norm(h, ln1_scale, ln1_bias, ln1_epsilon)
     act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu, "silu": jax.nn.silu}[activation]
+    drop = training and (dropout1_rate > 0 or dropout2_rate > 0)
+    if drop:
+        from ...ops.random import split_key
+
+        k1, k2 = jax.random.split(split_key())
 
     def fn(h, w1, w2, *bs):
         i = 0
@@ -227,9 +260,15 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None, line
         if b1 is not None:
             u = u + b1
         u = act(u)
+        if drop and dropout1_rate > 0:
+            keep = jax.random.bernoulli(k1, 1.0 - dropout1_rate, u.shape)
+            u = jnp.where(keep, u / (1.0 - dropout1_rate), 0.0).astype(u.dtype)
         v = u @ w2
         if b2 is not None:
             v = v + b2
+        if drop and dropout2_rate > 0:
+            keep = jax.random.bernoulli(k2, 1.0 - dropout2_rate, v.shape)
+            v = jnp.where(keep, v / (1.0 - dropout2_rate), 0.0).astype(v.dtype)
         return v
 
     args = [h, linear1_weight, linear2_weight]

@@ -116,6 +116,10 @@ def qr(x, mode: str = "reduced", name=None):
 
 def lu(x, pivot: bool = True, get_infos: bool = False, name=None):
     """Packed LU with 1-based pivots (reference lu_op semantics)."""
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False) (no partial pivoting) has no LAPACK/XLA "
+            "lowering; use the default pivoted factorization")
     t = ensure_tensor(x)
 
     def f(a):
@@ -157,7 +161,12 @@ def lu_unpack(x, y, unpack_ludata: bool = True, unpack_pivots: bool = True, name
             P = jnp.vectorize(perm_from_pivots, signature="(k)->(m,m)")(piv)
         return P, L, U
 
-    return apply_op("lu_unpack", f, xt, yt)
+    P, L, U = apply_op("lu_unpack", f, xt, yt)
+    # reference flags: unpack_ludata=False suppresses L/U, unpack_pivots=
+    # False suppresses P (None placeholders keep the 3-tuple shape)
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
 
 
 def eigh(x, UPLO: str = "L", name=None):
@@ -241,6 +250,10 @@ def pinv(x, rcond=1e-15, hermitian: bool = False, name=None) -> Tensor:
 def lstsq(x, y, rcond=None, driver=None, name=None):
     """Returns (solution, residuals, rank, singular_values) like the
     reference lstsq_op."""
+    if driver not in (None, "gels", "gelsd"):
+        raise NotImplementedError(
+            f"lstsq driver {driver!r}: only the default SVD-backed path "
+            "('gelsd'-equivalent) exists on XLA")
     xt, yt = ensure_tensor(x), ensure_tensor(y)
 
     def f(a, b):

@@ -90,6 +90,10 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
         norm = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
     else:
         norm = jnp.sum(jnp.stack([jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(norm)):
+        raise RuntimeError(
+            f"grad norm is non-finite ({float(norm)}); set "
+            "error_if_nonfinite=False to clip anyway")
     clip_coef = jnp.minimum(max_norm / (norm + 1e-6), 1.0)
     for p in parameters:
         if p._grad_data is not None:

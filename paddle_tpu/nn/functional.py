@@ -350,6 +350,23 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     dil = _pair(dilation)
     opad = _pair(output_padding)
     p = _pair(padding)
+    if output_size is not None:
+        # reference: output_size overrides output_padding — back out the
+        # padding that yields the requested spatial dims
+        target = _pair(output_size)
+        sp = 2 if data_format == "NCHW" else 1
+        opad = []
+        for d in range(2):
+            in_d = int(x.shape[sp + d])
+            k_d = (int(weight.shape[2 + d]) - 1) * dil[d] + 1
+            base = (in_d - 1) * strides[d] - 2 * p[d] + k_d
+            extra = int(target[d]) - base
+            if not 0 <= extra < max(strides[d], dil[d]):
+                raise ValueError(
+                    f"conv2d_transpose output_size[{d}]={target[d]} not "
+                    f"reachable from base {base} with stride {strides[d]}")
+            opad.append(extra)
+        opad = tuple(opad)
     dn = ("NCHW", "IOHW", "NCHW") if data_format == "NCHW" else ("NHWC", "IOHW", "NHWC")
 
     def _f(a, w, *b):
@@ -390,11 +407,18 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format, count_include_
 
     if isinstance(padding, str):
         pad_cfg = padding.upper()
+        if ceil_mode:
+            raise NotImplementedError("ceil_mode with SAME/VALID string "
+                                      "padding is not supported")
     else:
         p = _pair(padding)
         pad_cfg = [(0, 0)] * nd
         for i, ax in enumerate(spatial):
-            pad_cfg[ax] = (p[i], p[i])
+            extra = 0
+            if ceil_mode:
+                extra, _ = _ceil_pool_extra(int(x.shape[ax]), ksize[i],
+                                            strides[i], p[i])
+            pad_cfg[ax] = (p[i], p[i] + extra)
 
     def _f(a):
         if is_avg:
@@ -485,8 +509,21 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                divisor_override=None, data_format="NCHW", name=None) -> Tensor:
     x = ensure_tensor(x)
+    if divisor_override is not None:
+        # reference semantics: the window SUM divided by the override
+        # (pads included — count_include_pad path gives the raw sum)
+        kh, kw = _pair(kernel_size)
+        f = _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0,
+                  data_format, count_include_pad=True, is_avg=True,
+                  ceil_mode=ceil_mode)
+
+        def _f(a, _inner=f):
+            return _inner(a) * (kh * kw / float(divisor_override))
+
+        return apply_op("avg_pool2d", _f, x)
     f = _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, data_format,
-              count_include_pad=not exclusive, is_avg=True)
+              count_include_pad=not exclusive, is_avg=True,
+              ceil_mode=ceil_mode)
     return apply_op("avg_pool2d", f, x)
 
 
@@ -534,18 +571,38 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None) -> Tensor:
         n, c, h, w = a.shape
         oh, ow = out_hw
         if h % oh == 0 and w % ow == 0:
-            return a.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+            bh, bw = h // oh, w // ow
+            win = a.reshape(n, c, oh, bh, ow, bw).transpose(0, 1, 2, 4, 3, 5)
+            flat = win.reshape(n, c, oh, ow, bh * bw)
+            out = flat.max(-1)
+            if not return_mask:
+                return out
+            arg = flat.argmax(-1)
+            dh, dw = arg // bw, arg % bw
+            gh = jnp.arange(oh)[None, None, :, None] * bh + dh
+            gw = jnp.arange(ow)[None, None, None, :] * bw + dw
+            return out, (gh * w + gw).astype(jnp.int32)
         hi = [int(pymath.floor(i * h / oh)) for i in range(oh)] + [h]
         wi = [int(pymath.floor(i * w / ow)) for i in range(ow)] + [w]
-        rows = []
+        rows, irow = [], []
         for i in range(oh):
-            cols = []
+            cols, icol = [], []
             for j in range(ow):
-                cols.append(a[:, :, hi[i]:hi[i + 1], wi[j]:wi[j + 1]].max(axis=(2, 3)))
+                patch = a[:, :, hi[i]:hi[i + 1], wi[j]:wi[j + 1]]
+                ph, pw = patch.shape[2], patch.shape[3]
+                flat = patch.reshape(n, c, ph * pw)
+                cols.append(flat.max(-1))
+                arg = flat.argmax(-1)
+                icol.append((hi[i] + arg // pw) * w + (wi[j] + arg % pw))
             rows.append(jnp.stack(cols, axis=-1))
-        return jnp.stack(rows, axis=-2)
+            irow.append(jnp.stack(icol, axis=-1))
+        out = jnp.stack(rows, axis=-2)
+        if not return_mask:
+            return out
+        return out, jnp.stack(irow, axis=-2).astype(jnp.int32)
 
-    return apply_op("adaptive_max_pool2d", _f, x)
+    nouts = 2 if return_mask else None
+    return apply_op("adaptive_max_pool2d", _f, x, nouts=nouts)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None) -> Tensor:
@@ -556,9 +613,26 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     p = padding if isinstance(padding, int) else padding[0]
 
     def _f(a):
-        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)])
+        extra = _ceil_pool_extra(a.shape[-1], k, s, p)[0] if ceil_mode else 0
+        out = jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, k),
+                                    (1, 1, s),
+                                    [(0, 0), (0, 0), (p, p + extra)])
+        if not return_mask:
+            return out
+        # windows gather: argmax position -> index into the UNPADDED axis
+        n_win = out.shape[-1]
+        pos = jnp.arange(n_win)[:, None] * s - p + jnp.arange(k)[None, :]
+        valid = (pos >= 0) & (pos < a.shape[-1])
+        g = jnp.where(valid[None, None], a[..., jnp.clip(pos, 0, a.shape[-1] - 1)],
+                      -jnp.inf)
+        arg = g.argmax(-1)
+        idx = jnp.take_along_axis(jnp.broadcast_to(pos, arg.shape + (k,)),
+                                  arg[..., None], -1)[..., 0]
+        return out, idx.astype(jnp.int32)
 
-    return apply_op("max_pool1d", _f, x)
+    nouts = 2 if return_mask else None
+    res = apply_op("max_pool1d", _f, x, nouts=nouts)
+    return res
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None) -> Tensor:
@@ -569,8 +643,17 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
     p = padding if isinstance(padding, int) else padding[0]
 
     def _f(a):
-        t = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)])
-        return t / k
+        extra = _ceil_pool_extra(a.shape[-1], k, s, p)[0] if ceil_mode else 0
+        t = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, s),
+                                  [(0, 0), (0, 0), (p, p + extra)])
+        if not exclusive:
+            return t / k
+        # exclusive: divide by the VALID element count per window
+        ones = jnp.ones((1, 1, a.shape[-1]), a.dtype)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 1, k),
+                                    (1, 1, s),
+                                    [(0, 0), (0, 0), (p, p + extra)])
+        return t / jnp.maximum(cnt, 1.0)
 
     return apply_op("avg_pool1d", _f, x)
 
@@ -725,14 +808,33 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
     if has_b:
         tensors.append(ensure_tensor(bias))
 
-    def _f(a, *wb):
-        axes = tuple(range(2, a.ndim))
-        m = jnp.mean(a, axis=axes, keepdims=True)
-        v = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - m) * jax.lax.rsqrt(v + eps)
+    if not use_input_stats and (running_mean is None or running_var is None):
+        raise ValueError(
+            "instance_norm(use_input_stats=False) needs running_mean and "
+            "running_var")
+    rm = ensure_tensor(running_mean) if (not use_input_stats
+                                         and running_mean is not None) else None
+    rv = ensure_tensor(running_var) if (not use_input_stats
+                                        and running_var is not None) else None
+    if rm is not None:
+        tensors += [rm, rv]
+
+    def _f(a, *rest):
         c = a.shape[1]
         bshape = (1, c) + (1,) * (a.ndim - 2)
         i = 0
+        wb = rest[:has_w + has_b]
+        i_stats = has_w + has_b
+        if rm is not None:
+            # reference use_input_stats=False: normalize by the provided
+            # running statistics instead of per-instance moments
+            m = rest[i_stats].reshape(bshape)
+            v = rest[i_stats + 1].reshape(bshape)
+        else:
+            axes = tuple(range(2, a.ndim))
+            m = jnp.mean(a, axis=axes, keepdims=True)
+            v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
         if has_w:
             out = out * wb[i].reshape(bshape)
             i += 1
@@ -837,18 +939,23 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None) -> Tensor:
     input, label = ensure_tensor(input), ensure_tensor(label)
+    w_t = ensure_tensor(weight) if weight is not None else None
 
-    def _f(logp, lab):
+    def _f(logp, lab, *wargs):
         lab_i = lab.astype(jnp.int32)
         loss = -jnp.take_along_axis(logp, lab_i[..., None] if logp.ndim > 1 else lab_i, axis=-1 if logp.ndim > 1 else 0)
         loss = loss.squeeze(-1) if logp.ndim > 1 else loss
         mask = (lab_i != ignore_index).astype(loss.dtype)
+        if wargs:  # per-class weights (reference nll_loss weight arg)
+            cls_w = wargs[0][jnp.clip(lab_i, 0, wargs[0].shape[0] - 1)]
+            mask = mask * cls_w.astype(loss.dtype)
         loss = loss * mask
         if reduction == "mean":
-            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1e-12)
         return _reduce_loss(loss, reduction)
 
-    return apply_op("nll_loss", _f, input, label)
+    args = (input, label) + ((w_t,) if w_t is not None else ())
+    return apply_op("nll_loss", _f, *args)
 
 
 def mse_loss(input, label, reduction="mean", name=None) -> Tensor:
@@ -1145,12 +1252,16 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None) -> Tensor:
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None) -> Tensor:
     label = ensure_tensor(label)
+    prior = ensure_tensor(prior_dist) if prior_dist is not None else None
 
-    def _f(y):
+    def _f(y, *pd):
+        if pd:  # reference: smooth toward the given prior distribution
+            return (1 - epsilon) * y + epsilon * pd[0]
         k = y.shape[-1]
         return (1 - epsilon) * y + epsilon / k
 
-    return apply_op("label_smooth", _f, label)
+    args = (label,) + ((prior,) if prior is not None else ())
+    return apply_op("label_smooth", _f, *args)
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):

@@ -333,9 +333,23 @@ def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
     return apply_op("index_put", _f, x, value, *idx_ts)
 
 
+def _broadcast_indices(i, a_shape, axis):
+    """paddle broadcast=True: indices broadcast against arr on every dim
+    except ``axis`` (phi take_along_axis broadcast semantics)."""
+    target = list(a_shape)
+    target[axis] = i.shape[axis]
+    return jnp.broadcast_to(i, tuple(target))
+
+
 def take_along_axis(arr, indices, axis, broadcast=True) -> Tensor:
     arr, indices = ensure_tensor(arr), ensure_tensor(indices)
-    return apply_op("take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+    def _f(a, i):
+        if broadcast:
+            i = _broadcast_indices(i, a.shape, axis)
+        return jnp.take_along_axis(a, i, axis=axis)
+
+    return apply_op("take_along_axis", _f, arr, indices)
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True) -> Tensor:
@@ -343,12 +357,25 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=Tru
     values = ensure_tensor(values)
 
     def _f(a, i, v):
+        if broadcast:
+            i = _broadcast_indices(i, a.shape, axis)
         v = jnp.broadcast_to(v, i.shape) if v.ndim < i.ndim or v.shape != i.shape else v
         if reduce == "assign":
             return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
-        dims = list(range(a.ndim))
         idx = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
         idx[axis] = i
+        if not include_self:
+            # reference include_self=False: touched positions start from
+            # the reduction identity instead of a's original value
+            ident = {"add": 0, "sum": 0, "mul": 1, "multiply": 1,
+                     "amax": None, "amin": None}[reduce]
+            if ident is None:
+                ident = (jnp.finfo(a.dtype).min if reduce == "amax"
+                         else jnp.finfo(a.dtype).max) \
+                    if jnp.issubdtype(a.dtype, jnp.floating) else (
+                        jnp.iinfo(a.dtype).min if reduce == "amax"
+                        else jnp.iinfo(a.dtype).max)
+            a = a.at[tuple(idx)].set(jnp.asarray(ident, a.dtype))
         if reduce in ("add", "sum"):
             return a.at[tuple(idx)].add(v)
         if reduce in ("mul", "multiply"):
@@ -406,7 +433,10 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
                     return_counts=return_counts, axis=axis)
     if not isinstance(res, tuple):
         return Tensor(jnp.asarray(res))
-    outs = [Tensor(jnp.asarray(r)) for r in res]
+    # dtype applies to the INDEX outputs (reference unique signature)
+    idt = np.dtype(dtype) if dtype != "int64" else np.dtype(_INDEX_DTYPE)
+    outs = [Tensor(jnp.asarray(res[0]))] + [
+        Tensor(jnp.asarray(r.astype(idt))) for r in res[1:]]
     return tuple(outs)
 
 
@@ -418,13 +448,14 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
         keep = np.concatenate([[True], arr[1:] != arr[:-1]])
         out = arr[keep]
         outs = [Tensor(jnp.asarray(out))]
+        idt = np.dtype(dtype) if dtype != "int64" else np.dtype(_INDEX_DTYPE)
         if return_inverse:
             inv = np.cumsum(keep) - 1
-            outs.append(Tensor(jnp.asarray(inv, _INDEX_DTYPE)))
+            outs.append(Tensor(jnp.asarray(inv.astype(idt))))
         if return_counts:
             idx = np.flatnonzero(keep)
             counts = np.diff(np.concatenate([idx, [len(arr)]]))
-            outs.append(Tensor(jnp.asarray(counts, _INDEX_DTYPE)))
+            outs.append(Tensor(jnp.asarray(counts.astype(idt))))
         return outs[0] if len(outs) == 1 else tuple(outs)
     raise NotImplementedError("unique_consecutive with axis")
 
@@ -503,7 +534,18 @@ def crop(x, shape=None, offsets=None, name=None) -> Tensor:
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None) -> Tensor:
     x = ensure_tensor(x)
-    n = builtins.min(x._data.shape[0], x._data.shape[1])
+    rows, cols = x._data.shape[0], x._data.shape[1]
+    if wrap and rows > cols:
+        # np.fill_diagonal(wrap=True): the diagonal restarts after each
+        # (cols+1)-row block of a tall matrix; offset shifts the start
+        # (positive: right/col offset, negative: down/row offset)
+        start = offset if offset >= 0 else -offset * cols
+        flat = x._data.reshape(-1)
+        pos = jnp.arange(start, rows * cols, cols + 1)
+        x._data = flat.at[pos].set(
+            jnp.asarray(value, x._data.dtype)).reshape(rows, cols)
+        return x
+    n = builtins.min(rows, cols)
     idx = jnp.arange(n - builtins.max(offset, 0))
     x._data = x._data.at[idx, idx + offset].set(jnp.asarray(value, x._data.dtype))
     return x

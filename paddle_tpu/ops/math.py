@@ -143,6 +143,10 @@ def clip(x, min=None, max=None, name=None) -> Tensor:
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None) -> Tensor:
     x = ensure_tensor(x)
+    if act is not None:
+        raise NotImplementedError(
+            "scale(act=...) is the legacy fused-activation arg; apply the "
+            "activation explicitly (XLA fuses it anyway)")
     s = scale._data if isinstance(scale, Tensor) else scale
 
     def _f(a):
@@ -248,8 +252,22 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None) -> Tensor:
+    """mode='avg': mean of the two middles (even length); 'min': the
+    lower middle (reference median mode arg)."""
     x = ensure_tensor(x)
     ax = _axis(axis)
+    if mode == "min":
+        def _f(a):
+            flat_ax = -1 if ax is None else ax
+            srt = jnp.sort(a.reshape(-1) if ax is None else a, axis=flat_ax)
+            n = srt.shape[flat_ax]
+            out = jnp.take(srt, (n - 1) // 2, axis=flat_ax)
+            if keepdim:
+                out = (out.reshape((1,) * a.ndim) if ax is None
+                       else jnp.expand_dims(out, ax))
+            return out
+
+        return apply_op("median", _f, x)
     return apply_op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
 
 

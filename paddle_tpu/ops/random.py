@@ -38,8 +38,13 @@ def seed(s: int):
     return None
 
 
-def split_key():
-    """Pop a fresh subkey from the global generator (host-side state update)."""
+def split_key(seed: int = 0):
+    """Pop a fresh subkey from the global generator (host-side state
+    update). A nonzero ``seed`` bypasses the global stream entirely —
+    reference semantics of per-call seed args (phi uniform/gaussian
+    kernels: seed!=0 seeds a dedicated generator)."""
+    if seed:
+        return jax.random.PRNGKey(seed)
     with _lock:
         _KEY[0], sub = jax.random.split(_key())
     return sub
@@ -72,11 +77,11 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
     d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
     from .creation import _shape
 
-    return Tensor(jax.random.uniform(split_key(), _shape(shape), d, minval=min, maxval=max))
+    return Tensor(jax.random.uniform(split_key(seed), _shape(shape), d, minval=min, maxval=max))
 
 
 def uniform_(x: Tensor, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
-    x._data = jax.random.uniform(split_key(), x._data.shape, x._data.dtype, minval=min, maxval=max)
+    x._data = jax.random.uniform(split_key(seed), x._data.shape, x._data.dtype, minval=min, maxval=max)
     return x
 
 
@@ -101,7 +106,7 @@ def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
     d = dtypes.convert_dtype(dtype) or dtypes.get_default_dtype()
     from .creation import _shape
 
-    return Tensor(jax.random.normal(split_key(), _shape(shape), d) * std + mean)
+    return Tensor(jax.random.normal(split_key(seed), _shape(shape), d) * std + mean)
 
 
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:

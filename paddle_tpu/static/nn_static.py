@@ -17,6 +17,11 @@ from .graph import create_parameter
 def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None, bias_attr=None,
        activation: Optional[str] = None, name=None):
     """Fully-connected layer (parity: paddle.static.nn.fc)."""
+    if weight_attr is not None:
+        raise NotImplementedError(
+            "static.nn.fc weight_attr (custom initializer/regularizer) is "
+            "not implemented; initialize via paddle.seed + nn.initializer "
+            "defaults, or build the graph from nn.Linear")
     declared = getattr(x, "_declared_shape", None) or tuple(x.shape)
     in_dim = 1
     for d in x.shape[num_flatten_dims:]:
@@ -41,6 +46,10 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None, bias_attr=None
 def conv2d(input, num_filters: int, filter_size, stride=1, padding=0, dilation=1,
            groups: int = 1, param_attr=None, bias_attr=None, act: Optional[str] = None,
            data_format: str = "NCHW", name=None):
+    if param_attr is not None:
+        raise NotImplementedError(
+            "static.nn.conv2d param_attr is not implemented; use the "
+            "default initializers or nn.Conv2D")
     ks = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
     cin = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
     w = create_parameter([num_filters, cin // groups, ks[0], ks[1]], str(input.dtype))
@@ -59,7 +68,13 @@ def batch_norm(input, act=None, momentum: float = 0.9, epsilon: float = 1e-5,
                param_attr=None, bias_attr=None, data_layout: str = "NCHW",
                is_test: bool = False, name=None):
     """Inference-form BN built from recorded ops (running stats are
-    non-trainable globals, so static_minimize never updates them)."""
+    non-trainable globals, so static_minimize never updates them —
+    ``momentum`` would only matter for that absent update; ``is_test``
+    is therefore the only supported behavior either way)."""
+    if param_attr is not None or bias_attr is not None:
+        raise NotImplementedError(
+            "static.nn.batch_norm param_attr/bias_attr are not "
+            "implemented; use default initializers or nn.BatchNorm2D")
     from .graph import create_global_var
 
     c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
@@ -83,5 +98,9 @@ def batch_norm(input, act=None, momentum: float = 0.9, epsilon: float = 1e-5,
 
 def embedding(input, size: Sequence[int], is_sparse: bool = False, padding_idx=None,
               param_attr=None, dtype="float32"):
+    if param_attr is not None:
+        raise NotImplementedError(
+            "static.nn.embedding param_attr is not implemented; use "
+            "default initializers or nn.Embedding")
     w = create_parameter(list(size), dtype)
     return F.embedding(input, w, padding_idx=padding_idx)

@@ -92,6 +92,10 @@ def yolo_loss(x, gt_box, gt_label, anchors: Sequence[int],
     whose predicted box overlaps any gt above ``ignore_thresh`` are
     excluded from the objectness loss; ``gt_score`` (mixup) weights the
     positive terms."""
+    if scale_x_y != 1.0:
+        raise NotImplementedError(
+            "yolo_loss scale_x_y != 1.0 (grid-sensitive decode) is not "
+            "implemented; yolo_box supports it for inference decode")
     x, gt_box, gt_label = ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)
     gscore = ensure_tensor(gt_score) if gt_score is not None else None
     na = len(anchor_mask)
@@ -193,6 +197,10 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
               clip: bool = False, steps=(0.0, 0.0), offset: float = 0.5,
               min_max_aspect_ratios_order: bool = False, name=None):
     """SSD prior boxes (parity: phi prior_box_kernel)."""
+    if min_max_aspect_ratios_order:
+        raise NotImplementedError(
+            "prior_box min_max_aspect_ratios_order=True (caffe box "
+            "ordering) not implemented")
     input, image = ensure_tensor(input), ensure_tensor(image)
     ars = [1.0]
     for ar in aspect_ratios:
@@ -292,6 +300,10 @@ def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
     """Matrix NMS (parity: phi matrix_nms_kernel): soft suppression via the
     pairwise IoU matrix — sort, compute decay, rescore. Fully vectorized
     (SOLOv2's TPU-friendly alternative to sequential NMS)."""
+    if not normalized:
+        raise NotImplementedError(
+            "matrix_nms normalized=False (+1 pixel box widths) not "
+            "implemented; pass normalized coordinates")
     bb = _arr(bboxes)
     sc = _arr(scores)
     if bb.ndim == 2:
@@ -350,6 +362,11 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,
     """Hard multiclass NMS (parity: ops.yaml multiclass_nms3). Greedy
     per-class suppression on host (sequential by nature, like the
     reference CPU kernel)."""
+    if not normalized or nms_eta != 1.0 or rois_num is not None:
+        raise NotImplementedError(
+            "multiclass_nms: normalized=False / adaptive nms_eta / "
+            "rois_num batching are not implemented — raise instead of "
+            "silently ignoring them")
     bb = np.asarray(_arr(bboxes))
     sc = np.asarray(_arr(scores))
     if bb.ndim == 2:
@@ -459,6 +476,10 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
                        return_rois_num: bool = False, name=None):
     """RPN proposal generation (parity: phi generate_proposals_v2): decode
     anchors with deltas, clip, filter small, NMS, top-k."""
+    if eta != 1.0 or pixel_offset:
+        raise NotImplementedError(
+            "generate_proposals: adaptive eta / pixel_offset box widths "
+            "are not implemented — raise instead of silently ignoring")
     sc = np.asarray(_arr(scores))       # [N, A, H, W]
     bd = np.asarray(_arr(bbox_deltas))  # [N, 4A, H, W]
     ims = np.asarray(_arr(im_shape))    # [N, 2]

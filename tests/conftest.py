@@ -13,12 +13,20 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 import jax
 
 # The axon TPU plugin pins jax_platforms; force CPU for unit tests.
+# PADDLE_TPU_TEST_PLATFORM=tpu switches to the on-chip lane
+# (run_shards.py --platform=tpu): tests run on the real chip with fp32
+# matmuls forced to full precision — TPU fp32 dots default to a
+# bf16-class mode whose error (~1e-2) would void the sweep's 1e-5
+# oracle comparisons (reference device-lane discipline:
+# op_test.py:2925 check_output_with_place).
 if os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.config.update("jax_num_cpu_devices", 8)
     except Exception:
         pass  # older jax: XLA_FLAGS above covers it
+else:
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 
 # ---------------------------------------------------------------------------

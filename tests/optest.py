@@ -39,7 +39,17 @@ def check_output(op_fn: Callable, np_fn: Callable, inputs: Sequence[np.ndarray],
 
 def check_grad(op_fn: Callable, inputs: Sequence[np.ndarray], grad_inputs=None,
                atol=1e-3, rtol=5e-3, eps=1e-3, kwargs=None, reduce_output=True):
-    """Compare tape gradients against central finite differences."""
+    """Compare tape gradients against central finite differences.
+
+    On the TPU lane the forward carries transcendental-unit rounding
+    (~1e-4 relative); divided by the 2e-3 FD step that is ~5e-2 of
+    honest FD noise — floor the tolerances there (reference per-place
+    grad tolerances: op_accuracy_white_list)."""
+    import os as _os
+
+    if _os.environ.get("PADDLE_TPU_TEST_PLATFORM") == "tpu":
+        atol = max(atol, 1e-2)
+        rtol = max(rtol, 2e-2)
     kwargs = kwargs or {}
     grad_inputs = grad_inputs if grad_inputs is not None else list(range(len(inputs)))
 
@@ -93,6 +103,16 @@ DTYPE_TOL = {
     "int32": (0, 0),
     "int64": (0, 0),
 }
+
+# on-chip lane: TPU transcendentals (VPU log/exp/erf...) differ from the
+# CPU libm oracle by a few ULP more than fp32 1e-5 — matmul precision is
+# already forced to "highest" in conftest, but the elementwise units have
+# their own rounding (reference: per-place tolerances in
+# op_accuracy_white_list)
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_TEST_PLATFORM") == "tpu":
+    DTYPE_TOL["float32"] = (1e-4, 1e-4)
 
 
 def check_output_dtypes(op_fn, np_fn, inputs, dtypes=("float32", "bfloat16", "float16"),

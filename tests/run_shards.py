@@ -36,6 +36,21 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 MANIFEST = os.path.join(HERE, "testslist.csv")
 
+# --platform=tpu lane: a marked subset that runs on the REAL chip,
+# sequentially (one device), with fp32 matmuls at full precision
+# (conftest.py). Budgets are wall-clock seconds incl. remote compiles.
+# shard_map surfaces stay on the virtual CPU mesh (they hang on the
+# single-chip tunnel — see .claude/skills/verify).
+TPU_LANE = [
+    # (file, timeout_s, extra_env)
+    ("test_tpu_lane.py", 420, {}),
+    ("test_flash_attention.py", 420, {}),
+    ("test_ast_control_flow.py", 180, {}),
+    ("test_generation.py", 600, {}),  # decode loops: many remote compiles
+    ("test_offload.py", 420, {}),
+    ("test_op_schema_sweep.py", 600, {"PADDLE_TPU_SWEEP_STRIDE": "16"}),
+]
+
 
 def load_manifest():
     rows = []
@@ -91,17 +106,30 @@ def merge_dispatch_records(dump_prefix):
     return 0
 
 
-def run_pytest(files, budget, label):
+def run_pytest(files, budget, label, extra_env=None):
     cmd = [sys.executable, "-m", "pytest", "-q", "--no-header",
            *(os.path.join(HERE, f) for f in files)]
     print(f"[run_shards] {label}: {len(files)} files, budget {budget}s",
           flush=True)
+    env = None
+    if extra_env:
+        env = {**os.environ, **extra_env}
     try:
-        proc = subprocess.run(cmd, timeout=budget, cwd=os.path.dirname(HERE))
+        proc = subprocess.run(cmd, timeout=budget, cwd=os.path.dirname(HERE),
+                              env=env)
         return proc.returncode
     except subprocess.TimeoutExpired:
         print(f"[run_shards] {label} EXCEEDED its {budget}s budget", flush=True)
         return 124
+
+
+def run_tpu_lane(slack: float) -> int:
+    rc = 0
+    for f, timeout, extra in TPU_LANE:
+        rc |= run_pytest([f], int(timeout * slack), f"tpu-lane {f}",
+                         extra_env={"PADDLE_TPU_TEST_PLATFORM": "tpu",
+                                    **extra})
+    return rc
 
 
 def main(argv=None):
@@ -116,7 +144,13 @@ def main(argv=None):
     ap.add_argument("--enforce-dispatch", action="store_true",
                     help="merge per-shard dispatch records and fail on "
                          "ops without schema/white-list coverage")
+    ap.add_argument("--platform", choices=("cpu", "tpu"), default="cpu",
+                    help="tpu: run the marked on-chip lane instead of "
+                         "the CPU shards")
     args = ap.parse_args(argv)
+
+    if args.platform == "tpu":
+        return run_tpu_lane(args.slack)
 
     if args.enforce_dispatch:
         import glob

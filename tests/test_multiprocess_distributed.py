@@ -440,3 +440,86 @@ if rank == 0:
         ref.append(float(l))
         w = w - LR * g
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multiprocess_pipeline_1f1b(tmp_path):
+    """Round-4: steady-state 1F1B across 2 REAL processes — clocked
+    timetable, concurrent per-tick compute, per-edge ppermute shifts for
+    warmup/cooldown interleaving (reference pp_utils/
+    p2p_communication.py:576, pipeline_parallel.py:575). Loss parity vs
+    the single-process 1F1B engine AND the eager replica."""
+    body = """
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
+
+def make_descs():
+    return [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.GELU),
+            LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 4)]
+
+paddle.seed(0)
+pl = PipelineLayer(make_descs(), num_stages=2, loss_fn=nn.CrossEntropyLoss())
+
+s = fleet.DistributedStrategy()
+s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
+fleet.init(is_collective=True, strategy=s)
+model = fleet.distributed_model(pl)
+opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+
+rng = np.random.RandomState(0)
+x = rng.randn(8, 8).astype(np.float32)
+y = rng.randint(0, 4, 8).astype(np.int64)
+losses = []
+for _ in range(3):
+    losses.append(float(model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)))
+
+if rank == 0:
+    import json
+    open(os.path.join(os.getcwd(), "pp_1f1b_losses.json"), "w").write(json.dumps(losses))
+"""
+    _launch(tmp_path, body)
+    got = json.loads((tmp_path / "pp_1f1b_losses.json").read_text())
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                              PipelineParallel)
+
+    def make_descs():
+        return [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.GELU),
+                LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 4)]
+
+    # single-process 1F1B through the host engine
+    paddle.seed(0)
+    pl = PipelineLayer(make_descs(), num_stages=2,
+                       loss_fn=nn.CrossEntropyLoss())
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+    s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=s)
+    model = fleet.distributed_model(pl)
+    assert isinstance(model, PipelineParallel)
+    opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.int64)
+    engine_losses = [float(model.train_batch(
+        (paddle.to_tensor(x), paddle.to_tensor(y)), opt)) for _ in range(3)]
+    np.testing.assert_allclose(got, engine_losses, rtol=1e-4, atol=1e-5)
+
+    # eager replica (same oracle the FThenB test uses)
+    paddle.seed(0)
+    twin = PipelineLayer(make_descs(), num_stages=2,
+                         loss_fn=nn.CrossEntropyLoss())
+    loss_fn = nn.CrossEntropyLoss()
+    opt_t = paddle.optimizer.SGD(0.1, parameters=twin.parameters())
+    ref = []
+    for _ in range(3):
+        l = loss_fn(twin(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l.backward()
+        opt_t.step()
+        opt_t.clear_grad()
+        ref.append(float(l))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

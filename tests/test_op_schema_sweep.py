@@ -20,12 +20,34 @@ from optest import check_grad, check_output_dtypes
 
 _NAMES = registered_op_names()
 
+# on-chip lane subset: PADDLE_TPU_SWEEP_STRIDE=N keeps every Nth schema —
+# the chip pays a remote compile per case, so the TPU lane samples the
+# registry deterministically instead of running all ~800 cases
+import os as _os
+
+_STRIDE = int(_os.environ.get("PADDLE_TPU_SWEEP_STRIDE", "1"))
+if _STRIDE > 1:
+    _NAMES = _NAMES[::_STRIDE]
+
+# complex dtypes have NO TPU backend support (an eager complex op also
+# wedges the session's subsequent dispatches) — platform skip, like the
+# reference's per-place test gating (check_output_with_place). The CPU
+# lane fully covers these schemas.
+_COMPLEX_OPS = {
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "as_complex", "as_real", "complex", "polar",
+}
+if _os.environ.get("PADDLE_TPU_TEST_PLATFORM") == "tpu":
+    _NAMES = [n for n in _NAMES if n not in _COMPLEX_OPS]
+
 
 def test_registry_is_populated():
     # the schema registry must stay substantial and feed OP_REGISTRY
     from paddle_tpu.ops.dispatch import OP_REGISTRY
 
-    assert len(_NAMES) >= 150, len(_NAMES)
+    assert len(registered_op_names()) >= 150, len(registered_op_names())
     for n in _NAMES:
         assert n in OP_REGISTRY
         meta = OP_REGISTRY[n]
