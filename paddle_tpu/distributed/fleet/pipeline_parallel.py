@@ -579,21 +579,27 @@ class PipelineParallel:
         fwd_q = [list(range(M)) if r == 0 else [] for r in range(S)]
         bwd_q = [[] for _ in range(S)]
         done_b = [0] * S
+        done_f = [0] * S
         ticks = []
         while any(d < M for d in done_b):
             jobs = [None] * S
             fwd_sent = {}  # edge s -> micro (rank s -> s+1)
             bwd_sent = {}  # edge s -> micro (rank s -> s-1)
             for r in range(S):
+                # 1F1B warmup depth: rank r holds at most S - r
+                # activations in flight — forwarding past that buffers
+                # activations FThenB-style and voids 1F1B's memory cap
+                in_flight = done_f[r] - done_b[r]
                 if bwd_q[r]:
                     m = bwd_q[r].pop(0)
                     jobs[r] = ("B", m)
                     done_b[r] += 1
                     if r > 0:
                         bwd_sent[r] = m
-                elif fwd_q[r]:
+                elif fwd_q[r] and in_flight < S - r:
                     m = fwd_q[r].pop(0)
                     jobs[r] = ("F", m)
+                    done_f[r] += 1
                     if r < S - 1:
                         fwd_sent[r] = m
                     else:

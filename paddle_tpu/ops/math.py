@@ -257,17 +257,30 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None) -> Tensor:
     x = ensure_tensor(x)
     ax = _axis(axis)
     if mode == "min":
-        def _f(a):
-            flat_ax = -1 if ax is None else ax
-            srt = jnp.sort(a.reshape(-1) if ax is None else a, axis=flat_ax)
-            n = srt.shape[flat_ax]
-            out = jnp.take(srt, (n - 1) // 2, axis=flat_ax)
-            if keepdim:
-                out = (out.reshape((1,) * a.ndim) if ax is None
-                       else jnp.expand_dims(out, ax))
-            return out
+        # reference contract: mode='min' with an axis returns
+        # (values, int64 indices of the lower middle); axis=None returns
+        # the value only (paddle.median signature)
+        if ax is None:
+            def _f0(a):
+                srt = jnp.sort(a.reshape(-1))
+                out = srt[(srt.shape[0] - 1) // 2]
+                return out.reshape((1,) * a.ndim) if keepdim else out
 
-        return apply_op("median", _f, x)
+            return apply_op("median", _f0, x)
+
+        def _f(a):
+            n = a.shape[ax]
+            order = jnp.argsort(a, axis=ax)
+            mid = (n - 1) // 2
+            idx = jnp.take(order, mid, axis=ax)
+            val = jnp.take_along_axis(
+                a, jnp.expand_dims(idx, ax), axis=ax).squeeze(ax)
+            if keepdim:
+                val = jnp.expand_dims(val, ax)
+                idx = jnp.expand_dims(idx, ax)
+            return val, idx.astype(jnp.int64)
+
+        return apply_op("median", _f, x, nouts=2)
     return apply_op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
 
 
