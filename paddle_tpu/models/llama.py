@@ -281,6 +281,8 @@ def llama_pretrain_loss(logits: Tensor, labels: Tensor) -> Tensor:
 
     b, s, v = logits.shape
     lab = labels._data
+    if lab.ndim == 3 and lab.shape[-1] == 1:  # (b, s, 1) label convention
+        lab = lab[..., 0]
 
     def _f(lg):
         lab_s = jnp.concatenate(

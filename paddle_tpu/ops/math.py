@@ -251,9 +251,11 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
     return apply_op("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
 
 
-def median(x, axis=None, keepdim=False, mode="avg", name=None) -> Tensor:
-    """mode='avg': mean of the two middles (even length); 'min': the
-    lower middle (reference median mode arg)."""
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    """mode='avg': mean of the two middles (even length) -> Tensor.
+    mode='min': the lower middle; with an axis this returns
+    (values, int64 indices) like the reference median signature,
+    axis=None returns the value only."""
     x = ensure_tensor(x)
     ax = _axis(axis)
     if mode == "min":

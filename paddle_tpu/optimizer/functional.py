@@ -29,6 +29,13 @@ def _tree_f32_zeros(params):
     return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
 
 
+def _path_name(path):
+    """Parameter name from tree-path entries (DictKey.key for dict trees;
+    keystr-ish fallback for others) — shared by the decay-mask lookups."""
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
 def sgd(weight_decay: float = 0.0) -> FunctionalOptimizer:
     def init(params):
         return {}
@@ -87,12 +94,6 @@ def adamw(beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
 
     def update(grads, state, params, lr):
         t = state["t"] + 1.0
-
-        def _path_name(path):
-            # recover the parameter name from tree path entries (DictKey.key
-            # for dict trees; fall back to keystr-ish for others)
-            return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-
         p_flat_path, treedef = jax.tree_util.tree_flatten_with_path(params)
         g_flat = treedef.flatten_up_to(grads)
         m_flat = treedef.flatten_up_to(state["m"])
@@ -169,11 +170,6 @@ def adamw_flat(beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
     def _groups(params):
         """Leaf indices grouped by (shape, dtype, wd)."""
         p_flat_path, treedef = jax.tree_util.tree_flatten_with_path(params)
-
-        def _path_name(path):
-            return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                            for k in path)
-
         groups = {}
         for i, (path, p) in enumerate(p_flat_path):
             wd = weight_decay if (decay_mask_fn is None
@@ -235,6 +231,11 @@ def from_eager(opt, fused: bool = False) -> FunctionalOptimizer:
                               decay_mask_fn=fn)
         return adamw(opt._beta1, opt._beta2, opt._epsilon, opt._wd,
                      decay_mask_fn=fn)
+    if fused:
+        raise NotImplementedError(
+            f"fused=True is implemented for AdamW only (got "
+            f"{type(opt).__name__}) — silently falling back would "
+            "misreport any A/B the caller runs")
     if isinstance(opt, eager.Adam):
         return adam(opt._beta1, opt._beta2, opt._epsilon, opt._weight_decay)
     if isinstance(opt, eager.Momentum):

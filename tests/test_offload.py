@@ -116,3 +116,34 @@ def test_group_sharded_rejects_decorative_kwargs():
         group_sharded_parallel(model, opt, "os", buffer_max_size=1024)
     with pytest.raises(NotImplementedError, match="sync_comm"):
         group_sharded_parallel(model, opt, "os", sync_comm=True)
+
+
+def test_group_sharded_offload_survives_checkpoint_restore():
+    """set_state_dict must re-place restored accumulators in pinned host
+    memory (a plain restore would silently move the state on-device and
+    void the offload)."""
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.models import llama_pretrain_loss
+
+    model, ids, lab = _tiny_model_batch()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, "os", offload=True)
+
+    def one_step():
+        out = model(ids)
+        loss = llama_pretrain_loss(out, lab)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    one_step()
+    ckpt = opt.state_dict()
+    opt.set_state_dict(ckpt)
+    for store in opt._accumulators.values():
+        for arr in store.values():
+            assert arr.sharding.memory_kind == "pinned_host"
+    l1 = one_step()
+    l2 = one_step()
+    assert np.isfinite(l1) and l2 < l1 + 1e-3
