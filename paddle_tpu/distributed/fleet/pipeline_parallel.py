@@ -457,11 +457,11 @@ class PipelineParallel:
         from ..eager_collectives import eager_broadcast, eager_shift
 
         S, M = self.num_stages, self.accumulate_steps
+        C = self._layers.get_num_virtual_stages()
+        V = S * C
         assert jax.process_count() == S, (
             f"lockstep pp needs one process per stage ({S}), have "
             f"{jax.process_count()}")
-        if self._layers.get_num_virtual_stages() > 1:
-            raise NotImplementedError("VPP over processes not supported")
         if self._layers.shared_groups():
             raise NotImplementedError(
                 "cross-stage tied weights over the lockstep multi-process "
@@ -469,6 +469,7 @@ class PipelineParallel:
                 "single-controller engine or the compiled GSPMD pipeline")
         rank = jax.process_index()
         inner = getattr(optimizer, "_inner_opt", optimizer)
+        owned = list(range(rank, V, S))  # virtual stages of this process
 
         if self._engine is None or self._engine_opt_id != id(inner):
             fns, params_list = self._layers.stage_programs()
@@ -478,19 +479,23 @@ class PipelineParallel:
                 out = raw_loss(Tensor(o), Tensor(lab))
                 return out._data if isinstance(out, Tensor) else out
 
+            def _make_bwd(_f):
+                def _bwd(params, xx, gy):
+                    _, vjp = jax.vjp(_f, params, xx)
+                    return vjp(gy)
+
+                return jax.jit(_bwd)
+
             fopt = from_eager(inner)
             self._mp = {
-                "fns": fns, "all_params": params_list, "params": params_list[rank],
-                "fwd": jax.jit(fns[rank]),
+                "fns": fns, "all_params": params_list,
+                "params": {vs: params_list[vs] for vs in owned},
+                "fwd": {vs: jax.jit(fns[vs]) for vs in owned},
+                "bwd": {vs: _make_bwd(fns[vs]) for vs in owned},
                 "loss_seed": jax.jit(lambda y, l: jax.value_and_grad(loss_fn)(y, l)),
-                "opt": fopt, "opt_state": fopt.init(params_list[rank]),
+                "opt": fopt,
+                "opt_state": {vs: fopt.init(params_list[vs]) for vs in owned},
             }
-
-            def _bwd(params, xx, gy, _f=fns[rank]):
-                _, vjp = jax.vjp(_f, params, xx)
-                return vjp(gy)
-
-            self._mp["bwd"] = jax.jit(_bwd)
             self._engine = self._mp  # marks built
             self._engine_opt_id = id(inner)
 
@@ -499,31 +504,35 @@ class PipelineParallel:
         # boundary avals (identical on every rank: all ranks hold the descs)
         bshapes = []
         aval = jax.eval_shape(lambda a: a, x_micro[0])
-        for s in range(S):
-            aval = jax.eval_shape(fns[s], mp["all_params"][s], aval)
+        for vs in range(V):
+            aval = jax.eval_shape(fns[vs], mp["all_params"][vs], aval)
             bshapes.append(aval)
 
-        if self._schedule == "1f1b":
-            grad_total, losses = self._lockstep_1f1b(
-                x_micro, y_micro, mp, bshapes, rank, S, M)
+        if C > 1 or self._schedule in ("1f1b", "vpp"):
+            # one clocked engine: _timetable_vpp(S, M, 1) is byte-identical
+            # to the plain 1F1B timetable, and a C==1 'VPP' config is just
+            # 1F1B (the reference treats them the same way)
+            grad_total, losses = self._lockstep_vpp(
+                x_micro, y_micro, mp, bshapes, rank, S, M, C)
         elif self._schedule == "fthenb":
             grad_total, losses = self._lockstep_fthenb(
                 x_micro, y_micro, mp, bshapes, rank, S, M)
         else:
             raise NotImplementedError(
-                f"cross-process schedule {self._schedule!r}: FThenB and "
-                "1F1B run over processes; ZBH1 is single-controller only")
+                f"cross-process schedule {self._schedule!r}: FThenB, 1F1B "
+                "and VPP run over processes; ZBH1 is single-controller only")
         lr = jnp.asarray(float(inner.get_lr()) if hasattr(inner, "get_lr") else 0.1,
                          jnp.float32)
-        mp["params"], mp["opt_state"] = mp["opt"].update(
-            grad_total, mp["opt_state"], mp["params"], lr)
-        seg_state = self._layers._segments[rank].state_dict()
-        for name, arr in mp["params"].items():
-            seg_state[name]._data = arr
+        for vs in owned:
+            mp["params"][vs], mp["opt_state"][vs] = mp["opt"].update(
+                grad_total[vs], mp["opt_state"][vs], mp["params"][vs], lr)
+            seg_state = self._layers._segments[vs].state_dict()
+            for name, arr in mp["params"][vs].items():
+                seg_state[name]._data = arr
         if hasattr(inner, "_step_count"):
             inner._step_count += 1
         mean_loss = jnp.asarray(sum(losses) / M if losses else 0.0, jnp.float32)
-        return float(eager_broadcast(mean_loss, src=S - 1))
+        return float(eager_broadcast(mean_loss, src=(V - 1) % S))
 
     @staticmethod
     def _lockstep_fthenb(x_micro, y_micro, mp, bshapes, rank, S, M):
@@ -541,7 +550,7 @@ class PipelineParallel:
             out = None
             for s in range(S):
                 if rank == s:
-                    out = mp["fwd"](mp["params"], inp)
+                    out = mp["fwd"][rank](mp["params"][rank], inp)
                     acts[m] = inp
                 if s < S - 1:
                     payload = out if rank == s else jnp.zeros(
@@ -557,7 +566,8 @@ class PipelineParallel:
                 gy = None
             for s in range(S - 1, -1, -1):
                 if rank == s:
-                    gp, gx = mp["bwd"](mp["params"], acts.pop(m), gy)
+                    gp, gx = mp["bwd"][rank](mp["params"][rank],
+                                             acts.pop(m), gy)
                     grad_total = gp if grad_total is None else \
                         jax.tree.map(jnp.add, grad_total, gp)
                 if s > 0:
@@ -566,99 +576,108 @@ class PipelineParallel:
                     r = eager_shift(payload, -1)
                     if rank == s - 1:
                         gy = r
-        return grad_total, losses
+        return {rank: grad_total}, losses
 
     @staticmethod
-    def _timetable_1f1b(S: int, M: int):
-        """Clocked 1F1B: per tick, each rank's job ('F'|'B', micro) or
-        None, plus the set of active fwd/bwd edges. Pure-integer greedy
-        simulation (prefer backward, else forward) — deterministic, so
-        every process derives the identical table and stays in lockstep
-        (reference steady-state discipline: pipeline_parallel.py:575
-        forward_backward_pipeline's 1F1B phase)."""
-        fwd_q = [list(range(M)) if r == 0 else [] for r in range(S)]
-        bwd_q = [[] for _ in range(S)]
-        done_b = [0] * S
-        done_f = [0] * S
+    def _timetable_vpp(S: int, M: int, C: int):
+        """Clocked interleaved-VPP over V = S*C virtual stages (rank of
+        vs = vs % S). Greedy prefer-backward per RANK among its chunks;
+        forwards bounded by V - vs in flight. Deterministic pure-int
+        simulation — identical on every process."""
+        V = S * C
+        fwd_q = [list(range(M)) if v == 0 else [] for v in range(V)]
+        bwd_q = [[] for _ in range(V)]
+        done_b = [0] * V
+        done_f = [0] * V
         ticks = []
-        while any(d < M for d in done_b):
+        while any(done_b[v] < M for v in range(V)):
             jobs = [None] * S
-            fwd_sent = {}  # edge s -> micro (rank s -> s+1)
-            bwd_sent = {}  # edge s -> micro (rank s -> s-1)
+            fwd_sent = {}  # edge vs -> micro (vs -> vs+1)
+            bwd_sent = {}  # edge vs -> micro (vs -> vs-1)
             for r in range(S):
-                # 1F1B warmup depth: rank r holds at most S - r
-                # activations in flight — forwarding past that buffers
-                # activations FThenB-style and voids 1F1B's memory cap
-                in_flight = done_f[r] - done_b[r]
-                if bwd_q[r]:
-                    m = bwd_q[r].pop(0)
-                    jobs[r] = ("B", m)
-                    done_b[r] += 1
-                    if r > 0:
-                        bwd_sent[r] = m
-                elif fwd_q[r] and in_flight < S - r:
-                    m = fwd_q[r].pop(0)
-                    jobs[r] = ("F", m)
-                    done_f[r] += 1
-                    if r < S - 1:
-                        fwd_sent[r] = m
+                chunks = list(range(r, V, S))
+                vs_b = next((v for v in reversed(chunks) if bwd_q[v]), None)
+                if vs_b is not None:
+                    m = bwd_q[vs_b].pop(0)
+                    jobs[r] = ("B", vs_b, m)
+                    done_b[vs_b] += 1
+                    if vs_b > 0:
+                        bwd_sent[vs_b] = m
+                    continue
+                vs_f = next((v for v in chunks
+                             if fwd_q[v]
+                             and done_f[v] - done_b[v] < V - v), None)
+                if vs_f is not None:
+                    m = fwd_q[vs_f].pop(0)
+                    jobs[r] = ("F", vs_f, m)
+                    done_f[vs_f] += 1
+                    if vs_f < V - 1:
+                        fwd_sent[vs_f] = m
                     else:
-                        bwd_q[r].append(m)  # loss seed: bwd next tick
-            # deliveries land AFTER the exchange phase of this tick
-            for s, m in fwd_sent.items():
-                fwd_q[s + 1].append(m)
-            for s, m in bwd_sent.items():
-                bwd_q[s - 1].append(m)
+                        bwd_q[vs_f].append(m)  # loss seed next tick
+            for v, m in fwd_sent.items():
+                fwd_q[v + 1].append(m)
+            for v, m in bwd_sent.items():
+                bwd_q[v - 1].append(m)
             ticks.append((jobs, fwd_sent, bwd_sent))
-            assert len(ticks) < 4 * (M + S) + 8, "1f1b timetable diverged"
+            assert len(ticks) < 4 * M * C + 6 * V + 16, \
+                "vpp timetable diverged"
         return ticks
 
-    def _lockstep_1f1b(self, x_micro, y_micro, mp, bshapes, rank, S, M):
-        """Steady-state 1F1B across processes: each tick every rank runs
-        its scheduled job CONCURRENTLY (rank r forwards micro m+1 while
-        rank r+1 backwards micro m — the bubble-filling overlap FThenB
-        lacks), then all ranks enter one shift collective per active
-        edge (warmup/cooldown send/recv interleaving; reference
-        pp_utils/p2p_communication.py:576 _p2p_helper)."""
+    def _lockstep_vpp(self, x_micro, y_micro, mp, bshapes, rank, S, M, C):
+        """Interleaved VPP across processes: per tick each rank runs one
+        job among its C chunks, then all ranks enter one shift per active
+        edge. Edge vs->vs+1 is rank +1 except at chunk boundaries (rank
+        S-1 -> 0, shift -(S-1)); the reverse for backward — the
+        wrap-around send/recv of the reference's interleaved 1F1B
+        (pipeline_parallel.py:1174)."""
         import jax
 
         from ..eager_collectives import eager_shift
 
-        acts = {}       # micro -> saved stage input
-        recv_act = {}   # micro -> arrived activation
-        gys = {}        # micro -> arrived/seeded output grad
-        grad_total = None
+        V = S * C
+        acts = {}       # (vs, micro) -> saved input
+        recv_act = {}   # (vs, micro) -> arrived activation
+        gys = {}        # (vs, micro) -> arrived/seeded grad
+        grad_total = {vs: None for vs in range(rank, V, S)}
         losses = []
-        for jobs, fwd_sent, bwd_sent in self._timetable_1f1b(S, M):
+
+        def _rank(v):
+            return v % S
+
+        for jobs, fwd_sent, bwd_sent in self._timetable_vpp(S, M, C):
             job = jobs[rank]
             out = gx = None
             if job is not None:
-                kind, m = job
+                kind, vs, m = job
                 if kind == "F":
-                    inp = x_micro[m] if rank == 0 else recv_act.pop(m)
-                    out = mp["fwd"](mp["params"], inp)
-                    acts[m] = inp
-                    if rank == S - 1:
+                    inp = x_micro[m] if vs == 0 else recv_act.pop((vs, m))
+                    out = mp["fwd"][vs](mp["params"][vs], inp)
+                    acts[(vs, m)] = inp
+                    if vs == V - 1:
                         l, gy = mp["loss_seed"](out, y_micro[m])
                         losses.append(float(l))
-                        gys[m] = jax.tree.map(lambda g: g / M, gy)
+                        gys[(vs, m)] = jax.tree.map(lambda g: g / M, gy)
                 else:
-                    gp, gx = mp["bwd"](mp["params"], acts.pop(m),
-                                       gys.pop(m))
-                    grad_total = gp if grad_total is None else \
-                        jax.tree.map(jnp.add, grad_total, gp)
-            # exchange: one shift per ACTIVE edge, entered by all ranks in
-            # the same (edge-ordered) sequence — deadlock-free
-            for s in sorted(fwd_sent):
-                payload = out if rank == s else jnp.zeros(
-                    bshapes[s].shape, bshapes[s].dtype)
-                r_ = eager_shift(payload, 1)
-                if rank == s + 1:
-                    recv_act[fwd_sent[s]] = r_
-            for s in sorted(bwd_sent):
-                payload = gx if rank == s else jnp.zeros(
-                    bshapes[s - 1].shape, bshapes[s - 1].dtype)
-                r_ = eager_shift(payload, -1)
-                if rank == s - 1:
-                    gys[bwd_sent[s]] = r_
+                    gp, gx = mp["bwd"][vs](mp["params"][vs],
+                                           acts.pop((vs, m)),
+                                           gys.pop((vs, m)))
+                    grad_total[vs] = gp if grad_total[vs] is None else \
+                        jax.tree.map(jnp.add, grad_total[vs], gp)
+            for v in sorted(fwd_sent):
+                src, dst = _rank(v), _rank(v + 1)
+                shift = dst - src  # +1, or -(S-1) at a chunk boundary
+                payload = out if rank == src else jnp.zeros(
+                    bshapes[v].shape, bshapes[v].dtype)
+                r_ = eager_shift(payload, shift)
+                if rank == dst:
+                    recv_act[(v + 1, fwd_sent[v])] = r_
+            for v in sorted(bwd_sent):
+                src, dst = _rank(v), _rank(v - 1)
+                shift = dst - src  # -1, or +(S-1) at a chunk boundary
+                payload = gx if rank == src else jnp.zeros(
+                    bshapes[v - 1].shape, bshapes[v - 1].dtype)
+                r_ = eager_shift(payload, shift)
+                if rank == dst:
+                    gys[(v - 1, bwd_sent[v])] = r_
         return grad_total, losses

@@ -523,3 +523,88 @@ if rank == 0:
         opt_t.clear_grad()
         ref.append(float(l))
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_multiprocess_pipeline_vpp(tmp_path):
+    """Round-4: interleaved VPP across 2 REAL processes — each process
+    owns 2 virtual stages (chunks); edges wrap around at chunk
+    boundaries (reference interleaved 1F1B, pipeline_parallel.py:1174).
+    Loss parity vs the single-process VPP engine and the eager replica."""
+    body = """
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer, PipelineParallel
+
+def make_descs():
+    return [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.GELU),
+            LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.GELU),
+            LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 4)]
+
+paddle.seed(0)
+pl = PipelineLayer(make_descs(), num_stages=2, loss_fn=nn.CrossEntropyLoss(),
+                   num_virtual_pipeline_stages=2)
+
+s = fleet.DistributedStrategy()
+s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "VPP"}
+fleet.init(is_collective=True, strategy=s)
+model = fleet.distributed_model(pl)
+opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+
+rng = np.random.RandomState(0)
+x = rng.randn(8, 8).astype(np.float32)
+y = rng.randint(0, 4, 8).astype(np.int64)
+losses = []
+for _ in range(3):
+    losses.append(float(model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)))
+
+if rank == 0:
+    import json
+    open(os.path.join(os.getcwd(), "pp_vpp_losses.json"), "w").write(json.dumps(losses))
+"""
+    _launch(tmp_path, body)
+    got = json.loads((tmp_path / "pp_vpp_losses.json").read_text())
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    def make_descs():
+        return [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.GELU),
+                LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.GELU),
+                LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 4)]
+
+    # single-process VPP engine
+    paddle.seed(0)
+    pl = PipelineLayer(make_descs(), num_stages=2,
+                       loss_fn=nn.CrossEntropyLoss(),
+                       num_virtual_pipeline_stages=2)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2}
+    s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "VPP"}
+    fleet.init(is_collective=True, strategy=s)
+    model = fleet.distributed_model(pl)
+    opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.int64)
+    engine_losses = [float(model.train_batch(
+        (paddle.to_tensor(x), paddle.to_tensor(y)), opt)) for _ in range(3)]
+    np.testing.assert_allclose(got, engine_losses, rtol=1e-4, atol=1e-5)
+
+    # eager replica
+    paddle.seed(0)
+    twin = PipelineLayer(make_descs(), num_stages=2,
+                         loss_fn=nn.CrossEntropyLoss(),
+                         num_virtual_pipeline_stages=2)
+    loss_fn = nn.CrossEntropyLoss()
+    opt_t = paddle.optimizer.SGD(0.1, parameters=twin.parameters())
+    ref = []
+    for _ in range(3):
+        l = loss_fn(twin(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l.backward()
+        opt_t.step()
+        opt_t.clear_grad()
+        ref.append(float(l))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
