@@ -281,3 +281,38 @@ class TestCrossStageTiedWeights:
 
     def test_tied_vpp(self):
         self._run("VPP", pp=2, nvpp=2)
+
+
+class TestLockstepTimetable:
+    """Invariants of the clocked cross-process schedule generator."""
+
+    @pytest.mark.parametrize("S,C,M", [(2, 1, 4), (4, 1, 8), (2, 2, 4),
+                                       (2, 4, 32), (3, 8, 16), (4, 8, 32)])
+    def test_terminates_completes_and_bounds_memory(self, S, C, M):
+        import collections
+
+        ticks = PipelineParallel._timetable_vpp(S, M, C)
+        V = S * C
+        done = collections.Counter()
+        inflight = [0] * V
+        peak = [0] * V
+        for jobs, fwd_sent, bwd_sent in ticks:
+            assert len(jobs) == S
+            for j in jobs:
+                if j is None:
+                    continue
+                kind, vs, m = j
+                if kind == "F":
+                    inflight[vs] += 1
+                else:
+                    inflight[vs] -= 1
+                    done[vs] += 1
+                peak[vs] = max(peak[vs], inflight[vs])
+            # senders must match this tick's jobs
+            for v, m in fwd_sent.items():
+                assert jobs[v % S] == ("F", v, m)
+            for v, m in bwd_sent.items():
+                assert jobs[v % S] == ("B", v, m)
+        assert all(done[v] == M for v in range(V)), done
+        # in-flight bound: at most V - v activations live per virtual stage
+        assert all(peak[v] <= V - v for v in range(V)), peak
