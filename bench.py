@@ -29,6 +29,29 @@ def _v5e_peak_flops():
     return _HW_DEFAULTS["peak_tflops"] * 1e12
 
 
+def _bf16_llama(model):
+    """Cast to bf16 but keep the RoPE tables fp32 (position phases lose
+    too much precision in bf16; the matmuls stay bf16 either way)."""
+    model.to(dtype="bfloat16")
+    model.llama.rope_cos._data = model.llama.rope_cos._data.astype(np.float32)
+    model.llama.rope_sin._data = model.llama.rope_sin._data.astype(np.float32)
+
+
+def _timed(step_fn, steps, warmup):
+    """Warmup-skip timing window (reference profiler/timer.py ips
+    semantics): run ``warmup`` steps, sync, time ``steps`` steps, sync.
+    Returns (elapsed_seconds, last_loss). The float() on the loss is the
+    synchronization point that bounds the measured window."""
+    for _ in range(warmup):
+        loss = step_fn()
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step_fn()
+    _ = float(loss)
+    return time.perf_counter() - t0, loss
+
+
 def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
                 shard_opt=False, report_hbm=False):
     from paddle_tpu.distributed.engine import ShardedTrainStep
@@ -39,9 +62,7 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
     model = LlamaForCausalLM(cfg)
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
-        model.to(dtype="bfloat16")
-        model.llama.rope_cos._data = model.llama.rope_cos._data.astype(np.float32)
-        model.llama.rope_sin._data = model.llama.rope_sin._data.astype(np.float32)
+        _bf16_llama(model)
 
     n_dev = len(jax.devices())
     mesh = ProcessMesh(np.arange(n_dev), ["dp"])
@@ -54,15 +75,7 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
-    for _ in range(warmup):
-        loss = step.step(ids, labels)
-    _ = float(loss)  # sync
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step.step(ids, labels)
-    _ = float(loss)  # sync
-    dt = time.perf_counter() - t0
+    dt, loss = _timed(lambda: step.step(ids, labels), steps, warmup)
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens_per_sec = batch * seq * steps / dt
@@ -114,9 +127,7 @@ def _run_offload_config(paddle):
         max_position_embeddings=2048, use_flash_attention=True,
         dtype="bfloat16")
     model = LlamaForCausalLM(cfg)
-    model.to(dtype="bfloat16")
-    model.llama.rope_cos._data = model.llama.rope_cos._data.astype(np.float32)
-    model.llama.rope_sin._data = model.llama.rope_sin._data.astype(np.float32)
+    _bf16_llama(model)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     ACC, B, S = 24, 4, 1024
     step = HostOffloadTrainStep(
@@ -126,14 +137,8 @@ def _run_offload_config(paddle):
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
-    for _ in range(ACC):  # warmup cycle: compiles accum + per-shape updates
-        loss = step.step(ids, labels)
-    _ = float(loss)
-    t0 = time.perf_counter()
-    for _ in range(ACC):
-        loss = step.step(ids, labels)
-    _ = float(loss)
-    dt = time.perf_counter() - t0
+    # warmup = one full accumulation cycle: compiles accum + per-shape updates
+    dt, loss = _timed(lambda: step.step(ids, labels), ACC, ACC)
     tps = B * S * ACC / dt
     fpt = 6 * n_params + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
     return {
@@ -146,6 +151,81 @@ def _run_offload_config(paddle):
         "opt_state_memory": sorted(kinds),
         "opt_state_gb_host": round(3 * 4 * n_params / 2**30, 1),
         "accum_dtype": "bfloat16",
+    }
+
+
+def _run_resnet50(paddle):
+    """ResNet-50 train step images/sec — BASELINE.json's second headline
+    metric family (PaddleClas ResNet-50, reference config 2). bf16 params
+    + batch, Momentum(+wd) update, whole step one XLA program; MFU from
+    the compiled program's own cost analysis (conv FLOPs, not the LLM 6N
+    estimate)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.engine import ShardedTrainStep
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+        parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits, labels).mean()
+
+    mesh = ProcessMesh(np.arange(1), ["dp"])
+    step = ShardedTrainStep(model, loss_fn, opt, mesh, dp_axis=None)
+
+    B = 256
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+    x = paddle.to_tensor(jnp.asarray(rng.randn(B, 3, 224, 224), jnp.bfloat16))
+    y = paddle.to_tensor(rng.randint(0, 1000, (B,)).astype(np.int64))
+
+    steps, warmup = 10, 2
+    dt, loss = _timed(lambda: step.step(x, y), steps, warmup)
+    images_per_sec = B * steps / dt
+    out = {
+        "images_per_sec": round(images_per_sec, 1),
+        "batch": B,
+        "final_loss": round(float(loss), 4),
+    }
+    try:
+        ca = step.cost_analysis(x, y)
+        if ca and ca.get("flops"):
+            out["step_tflops"] = round(ca["flops"] / 1e12, 2)
+            out["mfu"] = round(
+                (images_per_sec / B) * ca["flops"] / _v5e_peak_flops(), 4)
+    except Exception:
+        pass
+    return out
+
+
+def _run_decode(paddle, cfg):
+    """Serving-side point: autoregressive decode throughput with the
+    static-KV-cache jitted step (generation.py; reference surface =
+    inference predictor + PaddleNLP generation loop). Whole second
+    generate() call timed — compiled prefill + N-1 donated decode steps."""
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    _bf16_llama(model)
+    B, S, N = 16, 128, 256
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    out = model.generate(ids, max_new_tokens=N)
+    np.asarray(out.numpy())  # sync: compile + warmup execution fully drained
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=N)
+    np.asarray(out.numpy())  # sync
+    dt = time.perf_counter() - t0
+    return {
+        "decode_tokens_per_sec": round(B * N / dt, 1),
+        "ms_per_token": round(1e3 * dt / N, 3),
+        "batch": B, "prompt": S, "new_tokens": N,
     }
 
 
@@ -222,6 +302,18 @@ def main():
                 paddle, cfg8k, batch=2, seq=8192, steps=6, warmup=2)
         except Exception as e:  # noqa: BLE001
             detail["seq8192_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # vision point: ResNet-50 train step (BASELINE's second metric)
+        try:
+            detail["resnet50"] = _run_resnet50(paddle)
+        except Exception as e:  # noqa: BLE001
+            detail["resnet50_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # serving point: KV-cache decode throughput on the primary model
+        try:
+            detail["decode"] = _run_decode(paddle, cfg)
+        except Exception as e:  # noqa: BLE001
+            detail["decode_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # 16k capability assert: one fwd+bwd flash-attention step at seq
         # 16384 must execute (the documented single-chip ceiling,

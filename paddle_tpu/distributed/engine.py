@@ -239,13 +239,11 @@ class ShardedTrainStep:
     def eval_step(self, inputs, labels=None):
         raise NotImplementedError("use to_static on the model for eval; engine.step is the train path")
 
-    def memory_analysis(self, inputs, labels):
-        """XLA's compiled-program HBM breakdown for the train step (device
-        memory_stats is process-cumulative and unavailable on some PJRT
-        transports). Returns dict of byte sizes: args/outputs/temps/
-        generated_code. Lowers from avals — no device allocation — but the
-        AOT compile does not share jit's dispatch cache, so this costs one
-        extra compile."""
+    def _aot_compiled(self, inputs, labels):
+        """AOT-compile the step from avals (no device allocation) for the
+        XLA analyses below. Does not share jit's dispatch cache, so each
+        call costs one extra compile — callers wanting both analyses
+        should reuse the returned object."""
         in_datas, lab_datas = self._stage_batch(inputs, labels)
 
         def aval(x):
@@ -253,10 +251,17 @@ class ShardedTrainStep:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
 
         lr = jax.ShapeDtypeStruct((), jnp.float32)
-        ma = self._step_fn.lower(
+        return self._step_fn.lower(
             jax.tree.map(aval, self.params), jax.tree.map(aval, self.opt_state),
             lr, jax.tree.map(aval, in_datas), jax.tree.map(aval, lab_datas),
-        ).compile().memory_analysis()
+        ).compile()
+
+    def memory_analysis(self, inputs, labels):
+        """XLA's compiled-program HBM breakdown for the train step (device
+        memory_stats is process-cumulative and unavailable on some PJRT
+        transports). Returns dict of byte sizes: args/outputs/temps/
+        generated_code."""
+        ma = self._aot_compiled(inputs, labels).memory_analysis()
         if ma is None:
             return None
         return {
@@ -265,6 +270,20 @@ class ShardedTrainStep:
             "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
             "generated_code_bytes": getattr(ma, "generated_code_size_in_bytes", None),
         }
+
+    def cost_analysis(self, inputs, labels):
+        """XLA's per-execution cost model for the compiled step (flops /
+        bytes accessed). Used by bench.py to compute MFU for conv models
+        where the 6N-per-token LLM estimate does not apply. NOTE: for a
+        GSPMD-partitioned step the numbers are PER PARTITION (one
+        device's share), matching the per-chip MFU convention."""
+        ca = self._aot_compiled(inputs, labels).cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        return {"flops": ca.get("flops"),
+                "bytes_accessed": ca.get("bytes accessed")}
 
     # ------------------------------------------------------------------
     def sync_weights_to_model(self):

@@ -266,6 +266,24 @@ class TestShardedTrainStep:
             ShardedTrainStep(m, lambda o, lab: lossfn(o, lab), opt, mesh,
                              remat="dots")
 
+    def test_cost_analysis_reports_flops(self):
+        # bench.py's conv-MFU source: XLA's own per-execution cost model
+        from paddle_tpu.distributed.engine import ShardedTrainStep
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        lossfn = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8), ["dp"])
+        step = ShardedTrainStep(m, lambda o, lab: lossfn(o, lab), opt, mesh)
+        x = paddle.to_tensor(a(16, 8))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, 16).astype(np.int64))
+        ca = step.cost_analysis(x, y)
+        assert ca is not None
+        # flops are PER PARTITION (dp=8 → local batch 2): at least the
+        # first matmul's local FLOPs must be accounted
+        assert ca["flops"] and ca["flops"] > 2 * 2 * 8 * 16
+
     def test_tp_parity(self):
         from paddle_tpu.distributed.engine import ShardedTrainStep
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, llama_pretrain_loss, llama_shard_fn

@@ -83,6 +83,20 @@ class TestGeneration:
         model.generate(ids, max_new_tokens=3)
         assert len(store) == n  # same shapes/config: reused, not re-built
 
+    def test_scan_and_python_loops_agree(self, tiny_model):
+        # the one-program lax.scan decode must reproduce the per-token
+        # jitted-step loop exactly, greedy and sampled
+        model, cfg = tiny_model
+        rng = np.random.RandomState(3)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 5)).astype("int32"))
+        for kw in ({}, dict(do_sample=True, temperature=0.9, top_k=8, seed=11)):
+            a = model.generate(ids, max_new_tokens=7, loop_mode="scan", **kw).numpy()
+            b = model.generate(ids, max_new_tokens=7, loop_mode="python", **kw).numpy()
+            np.testing.assert_array_equal(a, b)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="loop_mode"):
+            model.generate(ids, max_new_tokens=2, loop_mode="vectorized")
+
 
 class TestUncachedGeneration:
     def test_gpt_generate_greedy(self):
