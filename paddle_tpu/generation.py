@@ -150,8 +150,12 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     # jitted executables are cached on the model so repeat generate() calls
     # with the same shapes/config reuse the compiled programs; the KV cache
     # pytree is donated so decode updates buffers in place
+    # eos only shapes the scan-mode whole-generate program; python-mode
+    # executables are eos-independent (masking happens outside jit) and
+    # must not recompile per eos id
     gen_key = (B, S, cfg.max_new_tokens, cfg.do_sample, cfg.temperature,
-               cfg.top_k, cfg.top_p, cfg.eos_token_id, loop_mode)
+               cfg.top_k, cfg.top_p,
+               cfg.eos_token_id if loop_mode == "scan" else None, loop_mode)
     cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
     if gen_key not in cache_store:
 

@@ -137,6 +137,62 @@ def _pt_range_cont(i, stop, step):
     return iv < sv if st > 0 else iv > sv
 
 
+class _PTUndefined:
+    """Placeholder bound to a loop target when the sequence is empty —
+    the python loop would leave the name unbound; reading this raises
+    loudly at first use (the reference dy2static's UndefinedVar role)."""
+
+    def __repr__(self):
+        return "<undefined loop variable (sequence was empty)>"
+
+
+def _pt_seq_len(seq):
+    """Static iteration count of a ``for x in seq`` iterable: leading-dim
+    size for tensors/arrays (a python int — shapes are static under
+    trace), len() for positional sequences. Anything whose iteration
+    order is not positional indexing (dict: iterates KEYS but d[i] reads
+    VALUES; sets/generators) must NOT be desugared — raise so to_static
+    falls back to the original function."""
+    v = _unwrap(seq)
+    shape = getattr(v, "shape", None)
+    if shape is not None and getattr(v, "ndim", 1) >= 1:
+        return int(shape[0])
+    if not isinstance(seq, (list, tuple, str)):
+        raise TypeError(
+            f"for-seq transform supports tensors/arrays and list/tuple/str, "
+            f"not {type(seq).__name__}")
+    return len(seq)
+
+
+def _pt_seq_fidx(seq):
+    """Pre-bind for the enumerate index: 0 when the loop will run, the
+    undefined sentinel for an empty sequence (plain python would leave
+    the name unbound)."""
+    return 0 if _pt_seq_len(seq) else _PTUndefined()
+
+
+def _pt_seq_first(seq):
+    """Pre-bind value for the loop target (lax carries need a concrete
+    aval before the loop): element 0, or the undefined sentinel for an
+    empty sequence."""
+    if _pt_seq_len(seq) == 0:
+        return _PTUndefined()
+    v = _unwrap(seq)
+    first = v[0] if getattr(v, "shape", None) is not None else seq[0]
+    return Tensor(first, stop_gradient=True) if isinstance(seq, Tensor) else first
+
+
+def _pt_seq_item(seq, i):
+    """seq[i] with a possibly-traced index: dynamic_index_in_dim for
+    tensors/arrays, plain indexing (concrete i) for python sequences."""
+    v = _unwrap(seq)
+    if getattr(v, "shape", None) is not None and getattr(v, "ndim", None):
+        out = jax.lax.dynamic_index_in_dim(v, jnp.asarray(i, jnp.int32), 0,
+                                           keepdims=False)
+        return Tensor(out, stop_gradient=True) if isinstance(seq, Tensor) else out
+    return seq[int(i)]
+
+
 def _pt_if(pred, true_fn: Callable, false_fn: Callable, state: tuple) -> tuple:
     state = tuple(state)
     p = _unwrap(pred)
@@ -482,12 +538,12 @@ class _Rewriter:
         while transform compiles it — XLA folds the counted while into
         fori_loop-style control flow (reference loop_transformer.py:111
         converts gast.For the same way)."""
-        if not isinstance(node.target, ast.Name):
-            return None
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range" and not it.keywords
                 and 1 <= len(it.args) <= 3):
+            return self._try_for_seq(node)
+        if not isinstance(node.target, ast.Name):
             return None
         if _has_returns(node.body):
             return None
@@ -534,6 +590,81 @@ class _Rewriter:
         saved = set(self.bound)
         self.bound |= {iv, stopv, stepv}
         replaced = self._try_while(wl, min_one_trip=min_one)
+        if replaced is None:
+            self.bound = saved
+            return None
+        return prologue + replaced
+
+    def _try_for_seq(self, node: ast.For) -> Optional[List[ast.stmt]]:
+        """``for x in seq`` / ``for j, x in enumerate(seq)`` desugars to an
+        index while over ``__pt_seq_item__(seq, i)`` (reference
+        loop_transformer converts iterable For the same way). The
+        iteration count is static (tensor shapes / len()), so the
+        constant-trip loop unrolls at trace time — one program, same as
+        constant-bound for-range. The payoff is JUMPS: a ``break``/
+        ``continue`` on a tensor condition sets a traced flag, the while
+        predicate becomes traced mid-loop, and __pt_while__ switches to
+        lax.while_loop — ONE compiled program where the plain loop would
+        path-specialize per break position. The target is pre-bound to
+        element 0 (lax carries need an aval; an empty sequence pre-binds
+        an undefined-sentinel and the loop never enters lax)."""
+        it = node.iter
+        enum = (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "enumerate" and not it.keywords
+                and len(it.args) == 1)
+        if enum:
+            seq_expr = it.args[0]
+            if not (isinstance(node.target, ast.Tuple)
+                    and len(node.target.elts) == 2
+                    and all(isinstance(e, ast.Name) for e in node.target.elts)):
+                return None
+            idx_name = node.target.elts[0].id
+            tgt_name = node.target.elts[1].id
+        else:
+            if not isinstance(node.target, ast.Name):
+                return None
+            seq_expr, idx_name, tgt_name = it, None, node.target.id
+        if _has_returns(node.body):
+            return None
+        k = self.counter
+        seqv, iv, stopv, stepv = (f"__pt_fseq_{k}", f"__pt_fi_{k}",
+                                  f"__pt_fstop_{k}", f"__pt_fstep_{k}")
+
+        def _assign(name, expr):
+            return ast.fix_missing_locations(ast.copy_location(ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())], value=expr),
+                node))
+
+        def _helper(fname, *argnames):
+            return ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
+                            args=[ast.Name(id=a, ctx=ast.Load())
+                                  for a in argnames], keywords=[])
+
+        prologue = [
+            _assign(seqv, seq_expr),
+            _assign(iv, ast.Constant(value=0)),
+            _assign(stopv, _helper("__pt_seq_len__", seqv)),
+            _assign(stepv, ast.Constant(value=1)),
+            _assign(tgt_name, _helper("__pt_seq_first__", seqv)),
+        ]
+        test = ast.fix_missing_locations(ast.copy_location(
+            _helper("__pt_range_cont__", iv, stopv, stepv), node))
+        bind_v = _assign(tgt_name, _helper("__pt_seq_item__", seqv, iv))
+        binds = [bind_v]
+        if idx_name is not None:
+            binds.append(_assign(idx_name, ast.Name(id=iv, ctx=ast.Load())))
+            prologue.append(_assign(idx_name, _helper("__pt_seq_fidx__", seqv)))
+        incr = _assign(iv, ast.BinOp(
+            left=ast.Name(id=iv, ctx=ast.Load()), op=ast.Add(),
+            right=ast.Name(id=stepv, ctx=ast.Load())))
+
+        wl = ast.fix_missing_locations(ast.copy_location(ast.While(
+            test=test, body=binds + [incr] + node.body, orelse=[]), node))
+        saved = set(self.bound)
+        self.bound |= {seqv, iv, stopv, stepv, tgt_name}
+        if idx_name is not None:
+            self.bound.add(idx_name)
+        replaced = self._try_while(wl)
         if replaced is None:
             self.bound = saved
             return None
@@ -611,7 +742,11 @@ def transform_control_flow(fn: Callable) -> Optional[Callable]:
                         {"__pt_while__": _pt_while, "__pt_if__": _pt_if,
                          "__pt_range_cont__": _pt_range_cont,
                          "__pt_and_not__": _pt_and_not,
-                         "__pt_not_any__": _pt_not_any})
+                         "__pt_not_any__": _pt_not_any,
+                         "__pt_seq_len__": _pt_seq_len,
+                         "__pt_seq_fidx__": _pt_seq_fidx,
+                         "__pt_seq_first__": _pt_seq_first,
+                         "__pt_seq_item__": _pt_seq_item})
     loc: dict = {}
     exec(code, glb, loc)
     new_fn = loc[func.name]

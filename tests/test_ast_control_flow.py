@@ -324,7 +324,9 @@ class TestForRangeAndJumps:
         np.testing.assert_allclose(out.numpy(), np.full(2, 24.0), rtol=1e-6)
         assert st.sot_graph_count is None
 
-    def test_for_over_list_falls_back(self):
+    def test_for_over_list_semantics_preserved(self):
+        # desugared to an index while that stays a plain python loop
+        # (concrete predicate) — identical results
         def f(x):
             s = x * 0.0
             for v in [1.0, 2.0]:
@@ -334,3 +336,123 @@ class TestForRangeAndJumps:
         st = paddle.jit.to_static(f)
         out = st(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+
+
+class TestForOverTensor:
+    """Round-4: ``for x in tensor`` / ``enumerate(tensor)`` iteration
+    (reference loop_transformer converts iterable gast.For; here rows
+    read through dynamic_index_in_dim and jumps compile to lax)."""
+
+    def test_row_iteration_matches_numpy(self):
+        def f(t):
+            acc = t[0] * 0.0
+            for row in t:
+                acc = acc + row * row
+            return acc
+
+        st = paddle.jit.to_static(f)
+        assert st.uses_compiled_control_flow
+        x = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+        out = st(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), (x * x).sum(0), rtol=1e-5)
+        assert st.sot_graph_count is None  # ONE program
+
+    def test_enumerate_tensor(self):
+        def f(t):
+            acc = t[0] * 0.0
+            for j, row in enumerate(t):
+                acc = acc + row * float(j + 1)
+            return acc
+
+        st = paddle.jit.to_static(f)
+        assert st.uses_compiled_control_flow
+        x = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        out = st(paddle.to_tensor(x))
+        ref = sum(x[j] * (j + 1) for j in range(4))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        assert st.sot_graph_count is None
+
+    def test_tensor_break_in_tensor_for_one_program(self):
+        # break on a TENSOR condition: the flag turns the predicate
+        # traced and the loop compiles — no per-break-position
+        # specialization
+        def f(t, cap):
+            acc = t[0] * 0.0
+            for row in t:
+                acc = acc + row
+                if (acc.sum() > cap).all():
+                    break
+            return acc
+
+        st = paddle.jit.to_static(f)
+        assert st.uses_compiled_control_flow
+        x = np.ones((6, 2), np.float32)
+        for cap, expect_rows in ((3.5, 2), (7.5, 4), (100.0, 6)):
+            out = st(paddle.to_tensor(x), paddle.to_tensor(np.float32(cap)))
+            np.testing.assert_allclose(out.numpy(), np.full(2, float(expect_rows)))
+        assert st.sot_graph_count is None  # same program for every cap
+
+    def test_loop_var_read_after_loop(self):
+        # `row` first bound by the loop, read after it: the pre-bind
+        # covers the state tuple
+        def f(t):
+            for row in t:
+                pass
+            return row * 2.0
+
+        st = paddle.jit.to_static(f)
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        out = st(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), x[-1] * 2.0)
+
+    def test_empty_python_sequence(self):
+        def f(x, seq):
+            s = x * 0.0
+            for v in seq:
+                s = s + v
+            return s
+
+        st = paddle.jit.to_static(f)
+        out = st(paddle.to_tensor(np.ones(2, np.float32)), [])
+        np.testing.assert_allclose(out.numpy(), [0.0, 0.0])
+
+    def test_dict_iteration_keeps_eager_semantics(self):
+        # dict iterates KEYS but d[i] reads VALUES — the desugar must
+        # decline (runtime TypeError -> fall back to the original fn)
+        def f(x):
+            s = x * 0.0
+            for k in {0: 5.0, 1: 7.0}:
+                s = s + k
+            return s
+
+        st = paddle.jit.to_static(f)
+        out = st(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [1.0, 1.0])  # keys 0+1
+
+    def test_empty_enumerate_idx_stays_unbound(self):
+        # python leaves j unbound when the sequence is empty; the
+        # transform must not silently bind it to 0
+        import pytest
+
+        def g(x, seq):
+            s = x * 0.0
+            for j, v in enumerate(seq):
+                s = s + v
+            return s + float(j)
+
+        st = paddle.jit.to_static(g)
+        with pytest.raises((UnboundLocalError, TypeError)):
+            st(paddle.to_tensor(np.ones(2, np.float32)), [])
+
+    def test_list_of_tensors(self):
+        def f(a, b, c):
+            s = a * 0.0
+            for v in [a, b, c]:
+                s = s + v
+            return s
+
+        st = paddle.jit.to_static(f)
+        xs = [paddle.to_tensor(np.full(2, float(i), np.float32))
+              for i in (1, 2, 3)]
+        out = st(*xs)
+        np.testing.assert_allclose(out.numpy(), [6.0, 6.0])
