@@ -34,6 +34,7 @@ earlier in the function (so the state tuple is well-defined).
 from __future__ import annotations
 
 import ast
+import functools
 import inspect
 import textwrap
 from typing import Callable, List, Optional, Set
@@ -139,8 +140,18 @@ def _pt_range_cont(i, stop, step):
 
 class _PTUndefined:
     """Placeholder bound to a loop target when the sequence is empty —
-    the python loop would leave the name unbound; reading this raises
-    loudly at first use (the reference dy2static's UndefinedVar role)."""
+    the python loop would leave the name unbound; any use raises the
+    same UnboundLocalError plain python would (the reference dy2static's
+    UndefinedVar role)."""
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "loop variable used but never bound: the iterated sequence "
+            "was empty")
+
+    __bool__ = __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __float__ = __int__ = __len__ = __iter__ = __array__ = _raise
+    __add__ = __radd__ = __mul__ = __rmul__ = __getitem__ = __call__ = _raise
 
     def __repr__(self):
         return "<undefined loop variable (sequence was empty)>"
@@ -264,6 +275,21 @@ def _has_jumps(stmts: List[ast.stmt]) -> bool:
 def _has_returns(stmts: List[ast.stmt]) -> bool:
     return any(isinstance(n, ast.Return)
                for st in stmts for n in ast.walk(st))
+
+
+def _assign_stmt(loc_node: ast.stmt, name: str, expr: ast.expr) -> ast.Assign:
+    """``name = expr`` located at ``loc_node`` (shared by the for
+    desugars)."""
+    return ast.fix_missing_locations(ast.copy_location(ast.Assign(
+        targets=[ast.Name(id=name, ctx=ast.Store())], value=expr),
+        loc_node))
+
+
+def _helper_call(fname: str, *argnames: str) -> ast.Call:
+    """``__pt_helper__(name1, name2, ...)`` call expression."""
+    return ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
+                    args=[ast.Name(id=a, ctx=ast.Load())
+                          for a in argnames], keywords=[])
 
 
 def _assign_flag(name: str, value: bool) -> ast.Assign:
@@ -554,11 +580,7 @@ class _Rewriter:
         start = args[0] if len(args) >= 2 else ast.Constant(value=0)
         stop = args[1] if len(args) >= 2 else args[0]
         step = args[2] if len(args) == 3 else ast.Constant(value=1)
-
-        def _assign(name, expr):
-            return ast.fix_missing_locations(ast.copy_location(ast.Assign(
-                targets=[ast.Name(id=name, ctx=ast.Store())], value=expr),
-                node))
+        _assign = functools.partial(_assign_stmt, node)
 
         prologue = [_assign(iv, start), _assign(stopv, stop),
                     _assign(stepv, step)]
@@ -629,31 +651,28 @@ class _Rewriter:
         k = self.counter
         seqv, iv, stopv, stepv = (f"__pt_fseq_{k}", f"__pt_fi_{k}",
                                   f"__pt_fstop_{k}", f"__pt_fstep_{k}")
-
-        def _assign(name, expr):
-            return ast.fix_missing_locations(ast.copy_location(ast.Assign(
-                targets=[ast.Name(id=name, ctx=ast.Store())], value=expr),
-                node))
-
-        def _helper(fname, *argnames):
-            return ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
-                            args=[ast.Name(id=a, ctx=ast.Load())
-                                  for a in argnames], keywords=[])
+        _assign = functools.partial(_assign_stmt, node)
+        _helper = _helper_call
 
         prologue = [
             _assign(seqv, seq_expr),
             _assign(iv, ast.Constant(value=0)),
             _assign(stopv, _helper("__pt_seq_len__", seqv)),
             _assign(stepv, ast.Constant(value=1)),
-            _assign(tgt_name, _helper("__pt_seq_first__", seqv)),
         ]
+        # pre-bind targets so they can join the loop state tuple — but
+        # NOT when already bound: python leaves the existing value
+        # untouched on an empty sequence
+        if tgt_name not in self.bound:
+            prologue.append(_assign(tgt_name, _helper("__pt_seq_first__", seqv)))
         test = ast.fix_missing_locations(ast.copy_location(
             _helper("__pt_range_cont__", iv, stopv, stepv), node))
         bind_v = _assign(tgt_name, _helper("__pt_seq_item__", seqv, iv))
         binds = [bind_v]
         if idx_name is not None:
             binds.append(_assign(idx_name, ast.Name(id=iv, ctx=ast.Load())))
-            prologue.append(_assign(idx_name, _helper("__pt_seq_fidx__", seqv)))
+            if idx_name not in self.bound:
+                prologue.append(_assign(idx_name, _helper("__pt_seq_fidx__", seqv)))
         incr = _assign(iv, ast.BinOp(
             left=ast.Name(id=iv, ctx=ast.Load()), op=ast.Add(),
             right=ast.Name(id=stepv, ctx=ast.Load())))

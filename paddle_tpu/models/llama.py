@@ -295,6 +295,93 @@ class LlamaForCausalLM(nn.Layer):
 
         return generate(self, input_ids, max_new_tokens=max_new_tokens, **kwargs)
 
+    @classmethod
+    def from_huggingface(cls, hf_model_or_state_dict, config: "LlamaConfig | None" = None):
+        """Build a LlamaForCausalLM from a HuggingFace transformers Llama
+        model (or its state_dict) — the interop door for users bringing
+        reference-ecosystem checkpoints (PaddleNLP's Llama loads the same
+        HF layout). Accepts the torch module itself or any mapping of
+        parameter name -> array-like; weights are converted with
+        ``convert_hf_llama_state_dict``."""
+        sd = hf_model_or_state_dict
+        if hasattr(sd, "state_dict"):
+            if config is None and hasattr(sd, "config"):
+                h = sd.config
+                scaling = getattr(h, "rope_scaling", None)
+                if scaling and scaling.get("rope_type", scaling.get("type")) \
+                        not in (None, "default"):
+                    raise NotImplementedError(
+                        f"rope_scaling={scaling!r} is not supported; only the "
+                        "default RoPE tables are derived from the config")
+                config = LlamaConfig(
+                    vocab_size=h.vocab_size, hidden_size=h.hidden_size,
+                    intermediate_size=h.intermediate_size,
+                    num_hidden_layers=h.num_hidden_layers,
+                    num_attention_heads=h.num_attention_heads,
+                    num_key_value_heads=getattr(h, "num_key_value_heads",
+                                                h.num_attention_heads),
+                    max_position_embeddings=h.max_position_embeddings,
+                    rms_norm_eps=h.rms_norm_eps,
+                    rope_theta=getattr(h, "rope_theta", 10000.0),
+                    tie_word_embeddings=getattr(h, "tie_word_embeddings", False))
+            sd = sd.state_dict()
+        if config is None:
+            raise ValueError("config is required when passing a bare state_dict")
+        if config.fuse_attention_qkv or config.fuse_mlp:
+            raise NotImplementedError(
+                "from_huggingface targets the unfused layout; load unfused, "
+                "then concatenate into a fused twin if needed")
+        model = cls(config)
+        converted = convert_hf_llama_state_dict(sd)
+        params = model.named_parameters_dict()
+        missing = set(params) - set(converted)
+        if missing:
+            raise ValueError(f"HF state_dict missing parameters: {sorted(missing)[:5]}")
+        # leftover HF weights we have no slot for (e.g. attention_bias /
+        # mlp_bias checkpoints) would be silently dropped — wrong logits
+        # with no error. The tied lm_head duplicate is the only benign one.
+        leftover = set(converted) - set(params)
+        if config.tie_word_embeddings:
+            leftover.discard("lm_head.weight")
+        if leftover:
+            raise ValueError(
+                f"HF state_dict has weights this model cannot consume "
+                f"(bias checkpoints are not supported): {sorted(leftover)[:5]}")
+        for name, p in params.items():
+            w = converted[name]
+            if tuple(w.shape) != tuple(p.shape):
+                raise ValueError(
+                    f"{name}: HF shape {tuple(w.shape)} vs model {tuple(p.shape)}")
+            p.set_value(Tensor(jnp.asarray(w, dtype=p._data.dtype)))
+        return model
+
+
+def convert_hf_llama_state_dict(sd) -> dict:
+    """HF Llama parameter layout -> ours: ``model.`` prefix becomes
+    ``llama.``, torch Linear weights [out, in] transpose to [in, out]
+    (embedding and norm weights keep their layout), lm_head [vocab, h]
+    transposes to [h, vocab]. Values are returned as numpy arrays."""
+    import numpy as np
+
+    def to_np(v):
+        if hasattr(v, "detach"):  # torch tensor
+            v = v.detach().cpu().numpy()
+        return np.asarray(v)
+
+    out = {}
+    for name, v in sd.items():
+        if name.endswith("rotary_emb.inv_freq"):
+            continue  # we derive RoPE tables from the config
+        arr = to_np(v)
+        new = name
+        if new.startswith("model."):
+            new = "llama." + new[len("model."):]
+        is_linear_w = new.endswith("_proj.weight") or new == "lm_head.weight"
+        if is_linear_w and arr.ndim == 2:
+            arr = arr.T
+        out[new] = arr
+    return out
+
 
 def llama_pretrain_loss(logits: Tensor, labels: Tensor) -> Tensor:
     """Shifted next-token cross entropy (labels may equal input_ids;

@@ -429,6 +429,22 @@ class TestForOverTensor:
         out = st(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(out.numpy(), [1.0, 1.0])  # keys 0+1
 
+    def test_empty_seq_keeps_prebound_target(self):
+        # python leaves a previously-bound loop variable untouched when
+        # the sequence is empty — the desugar must not clobber it
+        def h(x, seq):
+            row = 0
+            s = x * 0.0
+            for row in seq:
+                s = s + row
+            if row == 0:
+                s = s + 100.0
+            return s
+
+        st = paddle.jit.to_static(h)
+        out = st(paddle.to_tensor(np.ones(2, np.float32)), [])
+        np.testing.assert_allclose(out.numpy(), [100.0, 100.0])
+
     def test_empty_enumerate_idx_stays_unbound(self):
         # python leaves j unbound when the sequence is empty; the
         # transform must not silently bind it to 0

@@ -1,0 +1,114 @@
+"""HuggingFace checkpoint interop for Llama.
+
+Oracle: torch transformers' LlamaForCausalLM — the de-facto weight
+layout the reference ecosystem (PaddleNLP) also loads. A converted
+model must reproduce HF logits on CPU (model-level parity, beyond the
+per-op torch-oracle suite) and greedy-decode the same tokens.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _hf_pair(tie=False, kv_heads=2):
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kv_heads, max_position_embeddings=128,
+        tie_word_embeddings=tie, attn_implementation="eager")
+    hf = HFLlama(hf_cfg).eval()
+    ours = LlamaForCausalLM.from_huggingface(hf)
+    return hf, ours
+
+
+class TestHFInterop:
+    def test_logits_parity(self):
+        hf, ours = _hf_pair()
+        ids = np.random.RandomState(0).randint(0, 256, (2, 10)).astype("int64")
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_logits_parity_tied_embeddings(self):
+        hf, ours = _hf_pair(tie=True)
+        assert ours.lm_head is None  # tied: logits via embedding matmul
+        ids = np.random.RandomState(1).randint(0, 256, (1, 7)).astype("int64")
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_greedy_decode_matches_hf(self):
+        hf, ours = _hf_pair()
+        ids = np.random.RandomState(2).randint(0, 256, (2, 6)).astype("int64")
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                              do_sample=False).numpy()
+        got = ours.generate(paddle.to_tensor(ids.astype("int32")),
+                            max_new_tokens=8).numpy()
+        np.testing.assert_array_equal(got, ref)
+
+    def test_mha_config_no_gqa(self):
+        hf, ours = _hf_pair(kv_heads=4)
+        ids = np.random.RandomState(3).randint(0, 256, (1, 5)).astype("int64")
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_bare_state_dict_requires_config(self):
+        hf, _ = _hf_pair()
+        with pytest.raises(ValueError, match="config is required"):
+            LlamaForCausalLM.from_huggingface(hf.state_dict())
+
+    def test_bias_checkpoint_raises(self):
+        # attention_bias weights have no slot in our bias-free layers —
+        # must refuse, not silently drop them
+        from transformers import LlamaConfig as HFConfig
+        from transformers import LlamaForCausalLM as HFLlama
+
+        torch.manual_seed(0)
+        hf = HFLlama(HFConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+            max_position_embeddings=64, attention_bias=True)).eval()
+        with pytest.raises(ValueError, match="cannot consume"):
+            LlamaForCausalLM.from_huggingface(hf)
+
+    def test_rope_scaling_raises(self):
+        from transformers import LlamaConfig as HFConfig
+        from transformers import LlamaForCausalLM as HFLlama
+
+        torch.manual_seed(0)
+        hf = HFLlama(HFConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+            max_position_embeddings=64,
+            rope_scaling={"rope_type": "linear", "factor": 2.0})).eval()
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            LlamaForCausalLM.from_huggingface(hf)
+
+    def test_shape_mismatch_raises(self):
+        from paddle_tpu.models import LlamaConfig
+
+        hf, _ = _hf_pair()
+        wrong = LlamaConfig(
+            vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128)
+        with pytest.raises(ValueError, match="HF shape"):
+            LlamaForCausalLM.from_huggingface(hf.state_dict(), config=wrong)
