@@ -37,7 +37,8 @@ from .collective import (
 )
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized
 from .store import TCPStore, create_or_get_global_tcp_store
-from .mesh import Partial, Placement, ProcessMesh, Replicate, Shard
+from .mesh import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                   create_hybrid_mesh)
 from .api import (ShardDataloader, dtensor_from_fn, reshard, shard_dataloader,
                   shard_layer, shard_tensor, unshard_dtensor)
 from .auto_shard import auto_shard_layer, derive_placements

@@ -149,6 +149,57 @@ class ProcessMesh:
         return f"ProcessMesh(shape={list(self._shape)}, dim_names={list(self._dim_names)})"
 
 
+def create_hybrid_mesh(dim_names: Sequence[str], ici_shape: Sequence[int],
+                       dcn_shape: Sequence[int],
+                       process_is_granule: Optional[bool] = None) -> ProcessMesh:
+    """DCN-spanning ProcessMesh for multi-slice / multi-host pods.
+
+    Each named axis decomposes into an intra-slice (ICI) part and a
+    cross-slice (DCN) part: ``axis size = ici_shape[i] * dcn_shape[i]``.
+    Devices are arranged with jax mesh_utils.create_hybrid_device_mesh so
+    collectives on a dcn-decomposed axis cross DCN exactly once per hop
+    while ici-only axes never leave the slice — the device-assignment
+    form of the reference's multi-node topology (fleet/base/topology.py
+    CommunicateTopology nodes x devices; SURVEY §5.8 "DCN-spanning
+    meshes"). The canonical layout shards dp (and pp) over dcn and keeps
+    mp/sp inside a slice:
+
+        mesh = create_hybrid_mesh(["dp", "mp"], ici_shape=[1, 4],
+                                  dcn_shape=[2, 1])   # 2 slices x 4 chips
+
+    ``process_is_granule``: treat one PROCESS as the DCN granule instead
+    of one TPU slice — the layout rule for CPU pods and for GPU-style
+    one-process-per-host deployments. Default: auto — slice granules
+    when the backend reports more than one slice, process granules
+    otherwise (single-slice and CPU backends report slice_index 0
+    everywhere, so the process boundary is the only DCN boundary)."""
+    if len(dim_names) != len(ici_shape) or len(ici_shape) != len(dcn_shape):
+        raise ValueError(
+            f"dim_names/ici_shape/dcn_shape must align: "
+            f"{len(dim_names)}/{len(ici_shape)}/{len(dcn_shape)}")
+    from jax.experimental import mesh_utils
+
+    devices = jax.devices()
+    total = int(np.prod(ici_shape)) * int(np.prod(dcn_shape))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh wants {total} devices, backend has {len(devices)}")
+    if process_is_granule is None:
+        slices = {getattr(d, "slice_index", None) for d in devices}
+        process_is_granule = len(slices - {None}) <= 1
+    if int(np.prod(dcn_shape)) == 1:
+        # degenerate single-granule case: plain device mesh (the hybrid
+        # helper requires >=2 granules to infer the DCN dimension)
+        dev_arr = mesh_utils.create_device_mesh(
+            tuple(ici_shape), devices=devices)
+    else:
+        dev_arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=devices,
+            process_is_granule=process_is_granule)
+    ids = np.vectorize(lambda d: d.id)(dev_arr)
+    return ProcessMesh(ids, list(dim_names))
+
+
 def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh, ndim: int) -> PartitionSpec:
     """Translate a placement list (one entry per mesh dim, reference
     semantics) into a PartitionSpec over tensor dims."""

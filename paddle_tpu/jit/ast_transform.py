@@ -380,6 +380,17 @@ class _Rewriter:
                 out.extend(replaced)
         return out
 
+    def _maybe_bound(self, name: str, before_lineno: int) -> bool:
+        """Whether ``name`` is stored ANYWHERE in the function before
+        ``before_lineno`` — the may-bound complement of the
+        definitely-bound ``self.bound`` (branch-only bindings)."""
+        for node in ast.walk(self.func):
+            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store)
+                    and node.id == name
+                    and getattr(node, "lineno", 10**9) < before_lineno):
+                return True
+        return False
+
     def _state_vars(self, body_names: Set[str], test: ast.expr) -> List[str]:
         vars_ = body_names | (_loaded_names(test) & self.bound)
         return sorted(vars_)
@@ -662,7 +673,12 @@ class _Rewriter:
         ]
         # pre-bind targets so they can join the loop state tuple — but
         # NOT when already bound: python leaves the existing value
-        # untouched on an empty sequence
+        # untouched on an empty sequence. A name bound only on SOME paths
+        # (branch-bound) can't be decided statically: pre-binding would
+        # clobber it when the branch ran — decline, the loop stays eager.
+        for name in ((tgt_name,) if idx_name is None else (tgt_name, idx_name)):
+            if name not in self.bound and self._maybe_bound(name, node.lineno):
+                return None
         if tgt_name not in self.bound:
             prologue.append(_assign(tgt_name, _helper("__pt_seq_first__", seqv)))
         test = ast.fix_missing_locations(ast.copy_location(

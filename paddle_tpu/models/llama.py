@@ -305,14 +305,19 @@ class LlamaForCausalLM(nn.Layer):
         ``convert_hf_llama_state_dict``."""
         sd = hf_model_or_state_dict
         if hasattr(sd, "state_dict"):
-            if config is None and hasattr(sd, "config"):
-                h = sd.config
-                scaling = getattr(h, "rope_scaling", None)
+            # scaled-RoPE checkpoints (Llama-3.1 'llama3', 'linear', ...)
+            # would load silently with wrong tables — refuse regardless of
+            # whether the caller supplies a config. (A bare state_dict
+            # carries no config: the caller vouches for default RoPE.)
+            if hasattr(sd, "config"):
+                scaling = getattr(sd.config, "rope_scaling", None)
                 if scaling and scaling.get("rope_type", scaling.get("type")) \
                         not in (None, "default"):
                     raise NotImplementedError(
                         f"rope_scaling={scaling!r} is not supported; only the "
                         "default RoPE tables are derived from the config")
+            if config is None and hasattr(sd, "config"):
+                h = sd.config
                 config = LlamaConfig(
                     vocab_size=h.vocab_size, hidden_size=h.hidden_size,
                     intermediate_size=h.intermediate_size,

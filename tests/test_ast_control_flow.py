@@ -445,6 +445,21 @@ class TestForOverTensor:
         out = st(paddle.to_tensor(np.ones(2, np.float32)), [])
         np.testing.assert_allclose(out.numpy(), [100.0, 100.0])
 
+    def test_branch_bound_target_declines(self):
+        # y bound only on one branch: pre-binding would clobber it when
+        # the branch ran — the loop must stay eager and keep semantics
+        def f(c, x, seq):
+            if c:
+                y = x
+            for y in seq:
+                pass
+            return y
+
+        st = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        out = st(True, x, [])
+        np.testing.assert_allclose(out.numpy(), [1.0, 1.0])  # y == x
+
     def test_empty_enumerate_idx_stays_unbound(self):
         # python leaves j unbound when the sequence is empty; the
         # transform must not silently bind it to 0

@@ -125,6 +125,26 @@ class TestMeshSharding:
         assert mesh.get_dim_size("mp") == 4
         assert mesh.process_ids == list(range(8))
 
+    def test_create_hybrid_mesh_single_granule(self):
+        # degenerate dcn=1: equals a plain device mesh, train step runs
+        mesh = dist.create_hybrid_mesh(["dp", "mp"], ici_shape=[2, 4],
+                                       dcn_shape=[1, 1])
+        assert mesh.shape == [2, 4]
+        assert sorted(mesh.process_ids) == list(range(8))
+        x = a(8, 16)
+        st = dist.shard_tensor(paddle.to_tensor(x), mesh,
+                               [dist.Shard(0), dist.Shard(1)])
+        np.testing.assert_allclose(st.numpy(), x)
+
+    def test_create_hybrid_mesh_validation(self):
+        # the real 2-granule arrangement runs in the 2-process launch
+        # test (one process = one DCN granule); here: the error contract
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="align"):
+            dist.create_hybrid_mesh(["dp"], [2], [1, 1])
+        with _pytest.raises(ValueError, match="devices"):
+            dist.create_hybrid_mesh(["dp", "mp"], [1, 4], [4, 1])
+
     def test_shard_and_reshard_roundtrip(self):
         mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
         x = a(8, 16)

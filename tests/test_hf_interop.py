@@ -101,6 +101,14 @@ class TestHFInterop:
             rope_scaling={"rope_type": "linear", "factor": 2.0})).eval()
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             LlamaForCausalLM.from_huggingface(hf)
+        # the guard must hold when the caller supplies a config too
+        from paddle_tpu.models import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=1, num_attention_heads=2,
+                          num_key_value_heads=2, max_position_embeddings=64)
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            LlamaForCausalLM.from_huggingface(hf, config=cfg)
 
     def test_shape_mismatch_raises(self):
         from paddle_tpu.models import LlamaConfig
