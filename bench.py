@@ -203,6 +203,52 @@ def _run_resnet50(paddle):
     return out
 
 
+def _run_moe(paddle):
+    """MoE point: the 134M-class decoder with every MLP an 8-expert
+    GShard MoE (topk 2) — measures the routing + batched-expert-einsum
+    path (reference: incubate fused MoE kernels). MFU against ACTIVE
+    params (6N convention counts only the topk experts a token visits)."""
+    from paddle_tpu.distributed.engine import ShardedTrainStep
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   moe_pretrain_loss)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=768, intermediate_size=2048,
+        num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=12,
+        max_position_embeddings=2048, use_flash_attention=True,
+        moe_num_experts=8, moe_topk=2, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    _bf16_llama(model)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = ShardedTrainStep(model, moe_pretrain_loss(model), opt,
+                            ProcessMesh(np.arange(1), ["dp"]), dp_axis=None)
+    B, S = 16, 1024
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    dt, loss = _timed(lambda: step.step(ids, labels), 10, 2)
+    tps = B * S * 10 / dt
+    n_total = n_expert = 0
+    for name, p in model.named_parameters_dict().items():
+        n = int(np.prod(p.shape))
+        n_total += n
+        if ".experts." in name:
+            n_expert += n
+    n_active = n_total - n_expert + n_expert * cfg.moe_topk // cfg.moe_num_experts
+    fpt = 6 * n_active + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
+    return {
+        "tokens_per_sec_per_chip": round(tps, 2),
+        "params_m_total": round(n_total / 1e6, 1),
+        "params_m_active": round(n_active / 1e6, 1),
+        "mfu_active": round(tps * fpt / _v5e_peak_flops(), 4),
+        "final_loss": round(float(loss), 4),
+        "batch": B, "seq": S, "experts": cfg.moe_num_experts,
+        "topk": cfg.moe_topk,
+    }
+
+
 def _run_decode(paddle, cfg):
     """Serving-side point: autoregressive decode throughput with the
     static-KV-cache jitted step (generation.py; reference surface =
@@ -314,6 +360,12 @@ def main():
             detail["decode"] = _run_decode(paddle, cfg)
         except Exception as e:  # noqa: BLE001
             detail["decode_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # MoE point: 8-expert GShard decoder (routing + batched experts)
+        try:
+            detail["moe"] = _run_moe(paddle)
+        except Exception as e:  # noqa: BLE001
+            detail["moe_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # 16k capability assert: one fwd+bwd flash-attention step at seq
         # 16384 must execute (the documented single-chip ceiling,

@@ -328,6 +328,10 @@ def _definitely_bound(st: ast.stmt) -> Set[str]:
         f = set().union(*(_definitely_bound(s) for s in st.orelse)) \
             if st.orelse else set()
         return t & f if st.orelse else set()
+    if isinstance(st, (ast.Import, ast.ImportFrom)):
+        return {a.asname or a.name.split(".")[0] for a in st.names}
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return {st.name}
     # loops may run zero times; with/try have exceptional paths — nothing
     # is definitely bound by them
     return set()
@@ -381,13 +385,26 @@ class _Rewriter:
         return out
 
     def _maybe_bound(self, name: str, before_lineno: int) -> bool:
-        """Whether ``name`` is stored ANYWHERE in the function before
-        ``before_lineno`` — the may-bound complement of the
-        definitely-bound ``self.bound`` (branch-only bindings)."""
+        """Whether ``name`` MAY be bound anywhere in the function before
+        ``before_lineno`` — the complement of the definitely-bound
+        ``self.bound`` (branch-only bindings). Covers Name stores,
+        import aliases, def/class statements, and with/except aliases."""
         for node in ast.walk(self.func):
-            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store)
-                    and node.id == name
-                    and getattr(node, "lineno", 10**9) < before_lineno):
+            lineno = getattr(node, "lineno", 10**9)
+            if lineno >= before_lineno:
+                continue
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) \
+                    and node.id == name:
+                return True
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if bound == name:
+                        return True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node.name == name:
+                return True
+            if isinstance(node, ast.ExceptHandler) and node.name == name:
                 return True
         return False
 

@@ -7,6 +7,8 @@ from .llama import (
     LlamaModel,
     llama_pretrain_loss,
     llama_shard_fn,
+    moe_aux_loss,
+    moe_pretrain_loss,
 )
 from .gpt import GPTConfig, GPTForCausalLM
 from .bert import BertConfig, BertForPretraining, BertModel

@@ -53,6 +53,18 @@ class LlamaConfig:
     # for weight-layout parity with fused-checkpoint ecosystems
     fuse_attention_qkv: bool = False
     fuse_mlp: bool = False
+    # Mixtral-style MoE decoder: >0 replaces every MLP with a GShard MoE
+    # (distributed/moe.py MoELayer) — the in-model door to the reference's
+    # incubate MoE surface. Experts are built replicated here; shard them
+    # over an 'ep' axis with distributed.auto_shard (ExpertMLP pairing
+    # rule) or shard_tensor on experts.w*/b*, and set
+    # moe_dispatch_mode='einsum' so GSPMD turns dispatch/combine into
+    # all-to-alls (default None: MoELayer picks gather, the fast
+    # single-granule path)
+    moe_num_experts: int = 0
+    moe_topk: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_dispatch_mode: Optional[str] = None
     dtype: str = "float32"
 
     @staticmethod
@@ -215,7 +227,19 @@ class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        if config.moe_num_experts > 0:
+            from ..distributed.moe import MoELayer
+
+            self.mlp = MoELayer(
+                d_model=config.hidden_size,
+                d_hidden=config.intermediate_size,
+                num_experts=config.moe_num_experts,
+                topk=config.moe_topk,
+                capacity_factor=config.moe_capacity_factor,
+                activation="silu",
+                dispatch_mode=config.moe_dispatch_mode)
+        else:
+            self.mlp = LlamaMLP(config)
         self.input_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
@@ -386,6 +410,36 @@ def convert_hf_llama_state_dict(sd) -> dict:
             arr = arr.T
         out[new] = arr
     return out
+
+
+def moe_aux_loss(model) -> Optional[Tensor]:
+    """Sum of per-layer MoE load-balancing losses from the LAST forward
+    (each MoELayer stashes ``aux_loss`` — traced values inside a traced
+    step, so read this in the same loss closure; reference:
+    moe_layer.py gate.get_loss). None for dense models."""
+    total = None
+    for layer in model.sublayers(include_self=True):
+        aux = getattr(layer, "aux_loss", None)
+        if aux is not None:
+            total = aux if total is None else total + aux
+    if total is None:
+        return None
+    return total if isinstance(total, Tensor) else Tensor(total)
+
+
+def moe_pretrain_loss(model, aux_coeff: float = 0.01):
+    """loss_fn factory for ShardedTrainStep on an MoE Llama: next-token
+    CE + aux_coeff * load-balance loss (reference training recipes add
+    the gate loss the same way)."""
+
+    def loss_fn(logits, labels):
+        loss = llama_pretrain_loss(logits, labels)
+        aux = moe_aux_loss(model)
+        if aux is not None:
+            loss = loss + aux_coeff * aux
+        return loss
+
+    return loss_fn
 
 
 def llama_pretrain_loss(logits: Tensor, labels: Tensor) -> Tensor:
