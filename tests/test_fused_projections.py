@@ -89,6 +89,10 @@ class TestFusedProjections:
 
     def test_tp_shard_recipe_covers_fused(self):
         # llama_shard_fn column-shards the fused weights over mp
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh (CPU lane)")
         from paddle_tpu.distributed.mesh import ProcessMesh, Shard
         from paddle_tpu.models.llama import llama_shard_fn
 
