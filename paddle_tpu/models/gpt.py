@@ -101,3 +101,74 @@ class GPTForCausalLM(nn.Layer):
         from ..generation import generate_uncached
 
         return generate_uncached(self, input_ids, max_new_tokens=max_new_tokens, **kwargs)
+
+    @classmethod
+    def from_huggingface(cls, hf_model):
+        """Build a GPTForCausalLM from a transformers GPT2LMHeadModel —
+        the GPT-2 counterpart of the Llama interop door. HF GPT-2 stores
+        Conv1D weights in [in, out] (our nn.Linear layout — no
+        transpose); the fused c_attn [h, 3h] splits into q/k/v; lm_head
+        is tied to wte (we materialize the transpose into our untied
+        head)."""
+        h = hf_model.config
+        if getattr(h, "activation_function", "gelu_new") not in (
+                "gelu_new", "gelu_pytorch_tanh"):
+            raise NotImplementedError(
+                f"activation_function={h.activation_function!r}: this model "
+                "uses the tanh-approximate GELU only")
+        # attention-math knobs carry no weights, so the shape checks
+        # can't catch them — refuse rather than silently mis-load
+        if getattr(h, "scale_attn_by_inverse_layer_idx", False) \
+                or not getattr(h, "scale_attn_weights", True) \
+                or getattr(h, "add_cross_attention", False):
+            raise NotImplementedError(
+                "non-default attention scaling / cross-attention configs are "
+                "not reproduced by this model's fixed 1/sqrt(head_dim) SDPA")
+        config = GPTConfig(
+            vocab_size=h.vocab_size, hidden_size=h.n_embd,
+            num_hidden_layers=h.n_layer, num_attention_heads=h.n_head,
+            intermediate_size=h.n_inner or 4 * h.n_embd,
+            max_position_embeddings=h.n_positions,
+            layer_norm_eps=h.layer_norm_epsilon)
+        model = cls(config)
+
+        def to_np(v):
+            return v.detach().cpu().numpy()
+
+        sd = hf_model.state_dict()
+        out = {
+            "gpt.wte.weight": to_np(sd["transformer.wte.weight"]),
+            "gpt.wpe.weight": to_np(sd["transformer.wpe.weight"]),
+            "gpt.ln_f.weight": to_np(sd["transformer.ln_f.weight"]),
+            "gpt.ln_f.bias": to_np(sd["transformer.ln_f.bias"]),
+            "lm_head.weight": to_np(sd["transformer.wte.weight"]).T,  # tied
+        }
+        hs = config.hidden_size
+        for i in range(config.num_hidden_layers):
+            src, dst = f"transformer.h.{i}.", f"gpt.h.{i}."
+            for ln in ("ln_1", "ln_2"):
+                out[dst + ln + ".weight"] = to_np(sd[src + ln + ".weight"])
+                out[dst + ln + ".bias"] = to_np(sd[src + ln + ".bias"])
+            ca_w = to_np(sd[src + "attn.c_attn.weight"])  # [h, 3h]
+            ca_b = to_np(sd[src + "attn.c_attn.bias"])  # [3h]
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                out[dst + f"attn.{name}.weight"] = ca_w[:, j * hs:(j + 1) * hs]
+                out[dst + f"attn.{name}.bias"] = ca_b[j * hs:(j + 1) * hs]
+            out[dst + "attn.out_proj.weight"] = to_np(sd[src + "attn.c_proj.weight"])
+            out[dst + "attn.out_proj.bias"] = to_np(sd[src + "attn.c_proj.bias"])
+            out[dst + "fc_in.weight"] = to_np(sd[src + "mlp.c_fc.weight"])
+            out[dst + "fc_in.bias"] = to_np(sd[src + "mlp.c_fc.bias"])
+            out[dst + "fc_out.weight"] = to_np(sd[src + "mlp.c_proj.weight"])
+            out[dst + "fc_out.bias"] = to_np(sd[src + "mlp.c_proj.bias"])
+
+        params = model.named_parameters_dict()
+        missing = set(params) - set(out)
+        if missing:
+            raise ValueError(f"conversion missed parameters: {sorted(missing)[:5]}")
+        for name, p in params.items():
+            w = out[name]
+            if tuple(w.shape) != tuple(p.shape):
+                raise ValueError(
+                    f"{name}: HF shape {tuple(w.shape)} vs model {tuple(p.shape)}")
+            p.set_value(Tensor(jnp.asarray(w, dtype=p._data.dtype)))
+        return model

@@ -110,6 +110,52 @@ class TestHFInterop:
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             LlamaForCausalLM.from_huggingface(hf, config=cfg)
 
+    def test_gpt2_logits_parity(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from paddle_tpu.models import GPTForCausalLM
+
+        torch.manual_seed(0)
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4,
+            n_positions=64)).eval()
+        ours = GPTForCausalLM.from_huggingface(hf)
+        ids = np.random.RandomState(4).randint(0, 128, (2, 9)).astype("int64")
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_gpt2_nondefault_attn_scaling_raises(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from paddle_tpu.models import GPTForCausalLM
+
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=1, n_head=2, n_positions=32,
+            scale_attn_by_inverse_layer_idx=True)).eval()
+        with pytest.raises(NotImplementedError, match="attention scaling"):
+            GPTForCausalLM.from_huggingface(hf)
+
+    def test_gpt2_greedy_decode_matches_hf(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from paddle_tpu.models import GPTForCausalLM
+
+        torch.manual_seed(1)
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4,
+            n_positions=64)).eval()
+        ours = GPTForCausalLM.from_huggingface(hf)
+        ids = np.random.RandomState(5).randint(0, 128, (1, 6)).astype("int64")
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                              do_sample=False, pad_token_id=0).numpy()
+        got = ours.generate(paddle.to_tensor(ids.astype("int32")),
+                            max_new_tokens=6).numpy()
+        np.testing.assert_array_equal(got, ref)
+
     def test_shape_mismatch_raises(self):
         from paddle_tpu.models import LlamaConfig
 
