@@ -84,6 +84,75 @@ class BertModel(nn.Layer):
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
+    @classmethod
+    def from_huggingface(cls, hf_model):
+        """Build a BertModel from a transformers BertModel — the encoder
+        counterpart of the Llama/GPT-2 interop doors. HF BERT is post-LN
+        with exact (erf) GELU, matching nn.TransformerEncoderLayer's
+        defaults; torch Linear weights [out, in] transpose to our
+        [in, out]. Converts the BASE model (sequence + pooled outputs);
+        task heads differ structurally across ecosystems and are left to
+        the caller."""
+        h = hf_model.config
+        if getattr(h, "hidden_act", "gelu") != "gelu":
+            raise NotImplementedError(
+                f"hidden_act={h.hidden_act!r}: this encoder uses exact GELU")
+        if getattr(h, "position_embedding_type", "absolute") != "absolute":
+            raise NotImplementedError(
+                "only absolute position embeddings are supported")
+        if getattr(h, "is_decoder", False) or getattr(h, "add_cross_attention", False):
+            raise NotImplementedError(
+                "decoder-configured BERT (causal self-attention / cross-"
+                "attention) does not map onto this bidirectional encoder")
+        config = BertConfig(
+            vocab_size=h.vocab_size, hidden_size=h.hidden_size,
+            num_hidden_layers=h.num_hidden_layers,
+            num_attention_heads=h.num_attention_heads,
+            intermediate_size=h.intermediate_size,
+            max_position_embeddings=h.max_position_embeddings,
+            type_vocab_size=h.type_vocab_size,
+            layer_norm_eps=h.layer_norm_eps, dropout=0.0)
+        model = cls(config)
+
+        def lin(prefix):  # torch Linear -> (weight.T, bias)
+            return (to_np(sd[prefix + ".weight"]).T, to_np(sd[prefix + ".bias"]))
+
+        def to_np(v):
+            return v.detach().cpu().numpy()
+
+        sd = hf_model.state_dict()
+        emb = "embeddings."
+        out = {
+            "embeddings.word_embeddings.weight": to_np(sd[emb + "word_embeddings.weight"]),
+            "embeddings.position_embeddings.weight": to_np(sd[emb + "position_embeddings.weight"]),
+            "embeddings.token_type_embeddings.weight": to_np(sd[emb + "token_type_embeddings.weight"]),
+            "embeddings.layer_norm.weight": to_np(sd[emb + "LayerNorm.weight"]),
+            "embeddings.layer_norm.bias": to_np(sd[emb + "LayerNorm.bias"]),
+        }
+        out["pooler.weight"], out["pooler.bias"] = lin("pooler.dense")
+        for i in range(config.num_hidden_layers):
+            src, dst = f"encoder.layer.{i}.", f"encoder.layers.{i}."
+            for hf_name, our_name in (
+                    ("attention.self.query", "self_attn.q_proj"),
+                    ("attention.self.key", "self_attn.k_proj"),
+                    ("attention.self.value", "self_attn.v_proj"),
+                    ("attention.output.dense", "self_attn.out_proj"),
+                    ("intermediate.dense", "linear1"),
+                    ("output.dense", "linear2")):
+                w, bias = lin(src + hf_name)
+                out[dst + our_name + ".weight"] = w
+                out[dst + our_name + ".bias"] = bias
+            for hf_name, our_name in (("attention.output.LayerNorm", "norm1"),
+                                      ("output.LayerNorm", "norm2")):
+                out[dst + our_name + ".weight"] = to_np(sd[src + hf_name + ".weight"])
+                out[dst + our_name + ".bias"] = to_np(sd[src + hf_name + ".bias"])
+
+        from .interop import load_converted_state
+
+        load_converted_state(model, out)
+        model.eval()
+        return model
+
 
 class BertForPretraining(nn.Layer):
     def __init__(self, config: BertConfig):

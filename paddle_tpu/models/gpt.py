@@ -141,7 +141,9 @@ class GPTForCausalLM(nn.Layer):
             "gpt.wpe.weight": to_np(sd["transformer.wpe.weight"]),
             "gpt.ln_f.weight": to_np(sd["transformer.ln_f.weight"]),
             "gpt.ln_f.bias": to_np(sd["transformer.ln_f.bias"]),
-            "lm_head.weight": to_np(sd["transformer.wte.weight"]).T,  # tied
+            # present in the state_dict tied or untied; using it (not
+            # wte.T) keeps untied checkpoints correct
+            "lm_head.weight": to_np(sd["lm_head.weight"]).T,
         }
         hs = config.hidden_size
         for i in range(config.num_hidden_layers):
@@ -161,14 +163,6 @@ class GPTForCausalLM(nn.Layer):
             out[dst + "fc_out.weight"] = to_np(sd[src + "mlp.c_proj.weight"])
             out[dst + "fc_out.bias"] = to_np(sd[src + "mlp.c_proj.bias"])
 
-        params = model.named_parameters_dict()
-        missing = set(params) - set(out)
-        if missing:
-            raise ValueError(f"conversion missed parameters: {sorted(missing)[:5]}")
-        for name, p in params.items():
-            w = out[name]
-            if tuple(w.shape) != tuple(p.shape):
-                raise ValueError(
-                    f"{name}: HF shape {tuple(w.shape)} vs model {tuple(p.shape)}")
-            p.set_value(Tensor(jnp.asarray(w, dtype=p._data.dtype)))
-        return model
+        from .interop import load_converted_state
+
+        return load_converted_state(model, out)

@@ -1,0 +1,33 @@
+"""Shared checkpoint-conversion loader for the HF interop doors
+(llama/gpt/bert from_huggingface)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def load_converted_state(model, converted: dict, *, allow_leftover=()):
+    """Validate-and-load a converted state dict into ``model``.
+
+    Raises on missing parameters, on leftover weights the model cannot
+    consume (silent weight dropping = silently wrong outputs), and on
+    shape mismatches. ``allow_leftover``: names that are benign
+    duplicates (e.g. a tied lm_head)."""
+    params = model.named_parameters_dict()
+    missing = set(params) - set(converted)
+    if missing:
+        raise ValueError(f"HF state_dict missing parameters: {sorted(missing)[:5]}")
+    leftover = set(converted) - set(params) - set(allow_leftover)
+    if leftover:
+        raise ValueError(
+            f"HF state_dict has weights this model cannot consume: "
+            f"{sorted(leftover)[:5]}")
+    for name, p in params.items():
+        w = converted[name]
+        if tuple(w.shape) != tuple(p.shape):
+            raise ValueError(
+                f"{name}: HF shape {tuple(w.shape)} vs model {tuple(p.shape)}")
+        p.set_value(Tensor(jnp.asarray(w, dtype=p._data.dtype)))
+    return model

@@ -362,27 +362,15 @@ class LlamaForCausalLM(nn.Layer):
                 "then concatenate into a fused twin if needed")
         model = cls(config)
         converted = convert_hf_llama_state_dict(sd)
-        params = model.named_parameters_dict()
-        missing = set(params) - set(converted)
-        if missing:
-            raise ValueError(f"HF state_dict missing parameters: {sorted(missing)[:5]}")
-        # leftover HF weights we have no slot for (e.g. attention_bias /
-        # mlp_bias checkpoints) would be silently dropped — wrong logits
-        # with no error. The tied lm_head duplicate is the only benign one.
-        leftover = set(converted) - set(params)
-        if config.tie_word_embeddings:
-            leftover.discard("lm_head.weight")
-        if leftover:
-            raise ValueError(
-                f"HF state_dict has weights this model cannot consume "
-                f"(bias checkpoints are not supported): {sorted(leftover)[:5]}")
-        for name, p in params.items():
-            w = converted[name]
-            if tuple(w.shape) != tuple(p.shape):
-                raise ValueError(
-                    f"{name}: HF shape {tuple(w.shape)} vs model {tuple(p.shape)}")
-            p.set_value(Tensor(jnp.asarray(w, dtype=p._data.dtype)))
-        return model
+        from .interop import load_converted_state
+
+        # leftover weights (e.g. attention_bias / mlp_bias checkpoints)
+        # would be silently dropped — wrong logits with no error; the
+        # tied lm_head duplicate is the only benign one
+        return load_converted_state(
+            model, converted,
+            allow_leftover=("lm_head.weight",) if config.tie_word_embeddings
+            else ())
 
 
 def convert_hf_llama_state_dict(sd) -> dict:

@@ -70,6 +70,39 @@ class TestHFInterop:
             got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
+    def test_bert_outputs_parity(self):
+        from transformers import BertConfig as HFBertConfig
+        from transformers import BertModel as HFBert
+
+        from paddle_tpu.models import BertModel
+
+        torch.manual_seed(0)
+        hf = HFBert(HFBertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)).eval()
+        ours = BertModel.from_huggingface(hf)
+        rng = np.random.RandomState(6)
+        ids = rng.randint(0, 128, (2, 12)).astype("int64")
+        tt = rng.randint(0, 2, (2, 12)).astype("int64")
+        mask = np.ones((2, 12), "int64")
+        mask[:, 9:] = 0  # padded tail
+        with torch.no_grad():
+            o = hf(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                   token_type_ids=torch.tensor(tt))
+            ref_seq = o.last_hidden_state.numpy()
+            ref_pool = o.pooler_output.numpy()
+        with paddle.no_grad():
+            seq, pool = ours(paddle.to_tensor(ids.astype("int32")),
+                             token_type_ids=paddle.to_tensor(tt.astype("int32")),
+                             attention_mask=paddle.to_tensor(mask.astype("int32")))
+        # padded positions attend differently and are usually discarded;
+        # compare the unpadded region
+        np.testing.assert_allclose(seq.numpy()[:, :9], ref_seq[:, :9],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(pool.numpy(), ref_pool, rtol=1e-4, atol=1e-4)
+
     def test_bare_state_dict_requires_config(self):
         hf, _ = _hf_pair()
         with pytest.raises(ValueError, match="config is required"):
@@ -126,6 +159,42 @@ class TestHFInterop:
         with paddle.no_grad():
             got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_gpt2_untied_head_loads_real_head(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from paddle_tpu.models import GPTForCausalLM
+
+        torch.manual_seed(2)
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=1, n_head=4, n_positions=64,
+            tie_word_embeddings=False)).eval()
+        # make the head visibly different from wte
+        with torch.no_grad():
+            hf.lm_head.weight.add_(1.0)
+        ours = GPTForCausalLM.from_huggingface(hf)
+        np.testing.assert_allclose(
+            ours.lm_head.weight.numpy(),
+            hf.lm_head.weight.detach().numpy().T, rtol=1e-6)
+        ids = np.random.RandomState(7).randint(0, 128, (1, 5)).astype("int64")
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        with paddle.no_grad():
+            got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_bert_decoder_config_raises(self):
+        from transformers import BertConfig as HFBertConfig
+        from transformers import BertModel as HFBert
+
+        from paddle_tpu.models import BertModel
+
+        hf = HFBert(HFBertConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=32, is_decoder=True)).eval()
+        with pytest.raises(NotImplementedError, match="decoder"):
+            BertModel.from_huggingface(hf)
 
     def test_gpt2_nondefault_attn_scaling_raises(self):
         from transformers import GPT2Config, GPT2LMHeadModel
