@@ -24,7 +24,31 @@ from .core.autograd import no_grad
 from .core.tensor import Tensor
 from .utils.functional import functional_call
 
-__all__ = ["GenerationConfig", "generate", "generate_uncached"]
+__all__ = ["GenerationConfig", "generate", "generate_uncached",
+           "update_static_kv_cache"]
+
+
+def update_static_kv_cache(kv_cache: dict, k, v, position_offset):
+    """The static-cache protocol shared by the decoder models (llama/
+    gpt): write this step's k/v [b, s, h, d] into the pre-allocated
+    [b, max_len, h, d] buffers at ``position_offset`` and build the
+    additive causal mask that exposes only positions < offset + s.
+    Returns (k_full, v_full, new_cache, mask)."""
+    from .ops.dispatch import apply_op
+
+    def upd(buf, new):
+        return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype),
+                                            (0, position_offset, 0, 0))
+
+    ck = apply_op("kv_cache_update", upd, kv_cache["k"], k)
+    cv = apply_op("kv_cache_update", upd, kv_cache["v"], v)
+    s = k.shape[1]
+    max_len = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
+    kpos = jnp.arange(max_len)
+    qpos = position_offset + jnp.arange(s)
+    m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
+    mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+    return ck, cv, {"k": ck, "v": cv}, mask
 
 
 def _mask_after_eos(gen, eos_id):
@@ -67,9 +91,9 @@ def _select_token(logits, cfg: GenerationConfig, key):
 def generate_uncached(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
                       temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                       eos_token_id: Optional[int] = None, seed: int = 0) -> Tensor:
-    """Fallback decode for models without KV-cache plumbing (GPT/BERT
-    style): re-runs the full forward per token. Correct but O(n^2) — the
-    cached path in ``generate`` is the serving path."""
+    """Fallback decode for models without KV-cache plumbing: re-runs the
+    full forward per token. Correct but O(n^2) — the cached path in
+    ``generate`` is the serving path (llama and gpt both plumb it)."""
     cfg = GenerationConfig(max_new_tokens, do_sample, temperature, top_k, top_p,
                            eos_token_id, seed)
     ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
@@ -119,8 +143,9 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     if max_len > config.max_position_embeddings:
         raise ValueError(
             f"prompt ({S}) + max_new_tokens ({cfg.max_new_tokens}) exceeds "
-            f"max_position_embeddings ({config.max_position_embeddings}); RoPE has "
-            "no table entries past that position")
+            f"max_position_embeddings ({config.max_position_embeddings}); the "
+            "position table (RoPE / learned embeddings) has no entries past "
+            "that position")
     n_kv = config.num_key_value_heads
     head_dim = config.hidden_size // config.num_attention_heads
     dtype = next(iter(model.parameters()))._data.dtype

@@ -104,6 +104,10 @@ class BertModel(nn.Layer):
             raise NotImplementedError(
                 "decoder-configured BERT (causal self-attention / cross-"
                 "attention) does not map onto this bidirectional encoder")
+        if "pooler.dense.weight" not in hf_model.state_dict():
+            raise NotImplementedError(
+                "checkpoint has no pooler (add_pooling_layer=False); this "
+                "model always carries one — load a pooled variant")
         config = BertConfig(
             vocab_size=h.vocab_size, hidden_size=h.hidden_size,
             num_hidden_layers=h.num_hidden_layers,

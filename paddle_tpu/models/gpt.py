@@ -26,6 +26,11 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     dropout: float = 0.0
 
+    @property
+    def num_key_value_heads(self):
+        # no GQA in the GPT family; generation.py sizes KV caches off this
+        return self.num_attention_heads
+
     @staticmethod
     def gpt3_1p3b(**overrides):
         cfg = GPTConfig(hidden_size=2048, num_hidden_layers=24, num_attention_heads=16,
@@ -53,7 +58,7 @@ class GPTBlock(nn.Layer):
         self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size)
         self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, kv_cache=None, position_offset=0):
         h = self.ln_1(x)
         b, s, _ = h.shape
         nh = self.attn.num_heads
@@ -61,9 +66,27 @@ class GPTBlock(nn.Layer):
         q = self.attn.q_proj(h).reshape([b, s, nh, hd])
         k = self.attn.k_proj(h).reshape([b, s, nh, hd])
         v = self.attn.v_proj(h).reshape([b, s, nh, hd])
-        a = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+        new_cache = None
+        if isinstance(kv_cache, dict):
+            # pre-allocated [b, max_len, h, d] buffers updated in place
+            # (the generation.py static-cache protocol, as in llama.py)
+            from ..generation import update_static_kv_cache
+
+            k, v, new_cache, mask = update_static_kv_cache(
+                kv_cache, k, v, position_offset)
+            if attn_mask is None:
+                attn_mask = mask
+        elif kv_cache is not None:
+            raise TypeError(
+                f"GPT kv_cache must be the generation.py static-cache dict, "
+                f"got {type(kv_cache).__name__}")
+        a = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            is_causal=attn_mask is None and kv_cache is None)
         x = x + self.attn.out_proj(a.reshape([b, s, nh * hd]))
         x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+        if kv_cache is not None:
+            return x, new_cache
         return x
 
 
@@ -76,12 +99,18 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
-        from ..ops.creation import arange
-
+    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
         b, s = input_ids.shape
-        pos = arange(0, s, dtype="int32")
-        x = self.wte(input_ids) + self.wpe(pos)
+        # position_offset may be traced (jitted decode step): index wpe
+        # with a dynamic starting position
+        pos = position_offset + jnp.arange(s, dtype=jnp.int32)
+        x = self.wte(input_ids) + self.wpe(Tensor(pos))
+        if kv_caches is not None:
+            new_caches = []
+            for block, cache in zip(self.h, kv_caches):
+                x, nc = block(x, attn_mask, cache, position_offset)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         for block in self.h:
             x = block(x, attn_mask)
         return self.ln_f(x)
@@ -94,13 +123,16 @@ class GPTForCausalLM(nn.Layer):
         self.gpt = GPTModel(config)
         self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
+        if kv_caches is not None:
+            h, new_caches = self.gpt(input_ids, attn_mask, kv_caches, position_offset)
+            return self.lm_head(h), new_caches
         return self.lm_head(self.gpt(input_ids, attn_mask))
 
     def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
-        from ..generation import generate_uncached
+        from ..generation import generate
 
-        return generate_uncached(self, input_ids, max_new_tokens=max_new_tokens, **kwargs)
+        return generate(self, input_ids, max_new_tokens=max_new_tokens, **kwargs)
 
     @classmethod
     def from_huggingface(cls, hf_model):

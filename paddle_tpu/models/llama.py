@@ -156,22 +156,12 @@ class LlamaAttention(nn.Layer):
             # pre-allocated [b, max_len, h, d] buffers updated in place at
             # position_offset (jit-friendly decode path; the reference's
             # cache_kv semantics with TPU-native dynamic_update_slice)
-            def upd(buf, new):
-                return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype),
-                                                    (0, position_offset, 0, 0))
+            from ..generation import update_static_kv_cache
 
-            ck = apply_op("kv_cache_update", upd, kv_cache["k"], k)
-            cv = apply_op("kv_cache_update", upd, kv_cache["v"], v)
-            new_cache = {"k": ck, "v": cv}
-            k, v = ck, cv
-            # attention may only see positions <= position_offset + s - 1
-            max_len = int(ck.shape[1])
+            k, v, new_cache, mask = update_static_kv_cache(
+                kv_cache, k, v, position_offset)
             if attn_mask is None:
-                kpos = jnp.arange(max_len)
-                limit = position_offset + s  # python or traced scalar
-                qpos = position_offset + jnp.arange(s)
-                m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < limit)
-                attn_mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+                attn_mask = mask
         elif kv_cache is not None:
             pk, pv = kv_cache
             from ..ops.manipulation import concat

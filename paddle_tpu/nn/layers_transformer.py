@@ -8,6 +8,7 @@ Pallas flash kernel through nn.functional.
 
 from __future__ import annotations
 
+import collections
 from typing import Optional
 
 from . import functional as F
@@ -17,6 +18,13 @@ from .layers_conv_norm import LayerNorm
 
 
 class MultiHeadAttention(Layer):
+    # incremental-decode caches (reference transformer.py:131
+    # MultiHeadAttention.Cache/StaticCache + gen_cache): Cache grows k/v
+    # with each call (self-attention decoding); StaticCache holds the
+    # fixed encoder k/v (cross-attention decoding)
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -32,18 +40,59 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    def _kv(self, key, value, b):
+        sk = key.shape[1]
+        k = self.k_proj(key).reshape([b, sk, self.num_heads, self.head_dim])
+        v = self.v_proj(value).reshape([b, sk, self.num_heads, self.head_dim])
+        return k, v
+
+    def gen_cache(self, key, value=None, type=None):
+        """Reference API (transformer.py gen_cache): build a decode cache.
+        ``type=MultiHeadAttention.StaticCache`` precomputes k/v from the
+        given key/value (encoder output, cross-attention); otherwise an
+        empty growable Cache batched like ``key``."""
+        if type is self.StaticCache:
+            k, v = self._kv(key, key if value is None else value, key.shape[0])
+            return self.StaticCache(k, v)
+        from ..ops.creation import zeros
+
+        b = key.shape[0]
+        shape = [b, 0, self.num_heads, self.head_dim]
+        return self.Cache(zeros(shape, dtype=key.dtype),
+                          zeros(shape, dtype=key.dtype))
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        """With ``cache=Cache(k, v)``: appends this call's k/v and returns
+        ``(out, Cache)`` — incremental self-attention decoding. With
+        ``cache=StaticCache(k, v)``: attends the precomputed k/v
+        (cross-attention) and returns ``(out, cache)`` unchanged."""
         key = query if key is None else key
         value = query if value is None else value
         b, sq = query.shape[0], query.shape[1]
-        sk = key.shape[1]
         q = self.q_proj(query).reshape([b, sq, self.num_heads, self.head_dim])
-        k = self.k_proj(key).reshape([b, sk, self.num_heads, self.head_dim])
-        v = self.v_proj(value).reshape([b, sk, self.num_heads, self.head_dim])
+        if cache is not None and not isinstance(cache, (self.Cache,
+                                                        self.StaticCache)):
+            raise TypeError(
+                f"cache must be MultiHeadAttention.Cache or .StaticCache "
+                f"(see gen_cache), got {type(cache).__name__}")
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+            new_cache = cache
+        else:
+            k, v = self._kv(key, value, b)
+            if isinstance(cache, self.Cache):
+                from ..ops.manipulation import concat
+
+                k = concat([cache.k, k], axis=1)
+                v = concat([cache.v, v], axis=1)
+                new_cache = self.Cache(k, v)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              dropout_p=self.dropout, training=self.training)
         out = out.reshape([b, sq, self.embed_dim])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
 
 
 class TransformerEncoderLayer(Layer):
@@ -63,11 +112,22 @@ class TransformerEncoderLayer(Layer):
         self.act_dropout = Dropout(act_dropout if act_dropout is not None else dropout)
         self.activation = getattr(F, activation)
 
+    def gen_cache(self, src):
+        """Reference API: an incremental Cache for this layer's
+        self-attention."""
+        return self.self_attn.gen_cache(src)
+
     def forward(self, src, src_mask=None, cache=None):
+        """With ``cache`` (a MultiHeadAttention.Cache): incremental
+        decoding — k/v append across calls; returns (out, new_cache)."""
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, src, src, attn_mask=src_mask)
+        if cache is None:
+            src = self.self_attn(src, src, src, attn_mask=src_mask)
+        else:
+            src, new_cache = self.self_attn(src, src, src, attn_mask=src_mask,
+                                            cache=cache)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -78,6 +138,8 @@ class TransformerEncoderLayer(Layer):
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
+        if cache is not None:
+            return src, new_cache
         return src
 
 
@@ -92,8 +154,21 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
     def forward(self, src, src_mask=None, cache=None):
+        """``cache``: list of per-layer caches (gen_cache) for
+        incremental decoding; returns (out, new_caches) when given."""
         out = src
+        if cache is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, cache):
+                out, nc = layer(out, src_mask=src_mask, cache=c)
+                new_caches.append(nc)
+            if self.norm is not None:
+                out = self.norm(out)
+            return out, new_caches
         for layer in self.layers:
             out = layer(out, src_mask=src_mask)
         if self.norm is not None:
@@ -121,18 +196,35 @@ class TransformerDecoderLayer(Layer):
         self.dropout3 = Dropout(dropout)
         self.activation = getattr(F, activation)
 
+    def gen_cache(self, memory):
+        """Reference API: (incremental self-attn Cache, static cross-attn
+        cache precomputed from the encoder ``memory``)."""
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(memory,
+                                          type=MultiHeadAttention.StaticCache))
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        """``cache``: (self_attn Cache, cross_attn StaticCache) from
+        gen_cache; returns (out, new_cache) when given."""
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask)
+        else:
+            tgt, new_incr = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask,
+                                           cache=cache[0])
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        else:
+            tgt, _ = self.cross_attn(tgt, memory, memory,
+                                     attn_mask=memory_mask, cache=cache[1])
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -143,6 +235,8 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
+        if cache is not None:
+            return tgt, (new_incr, cache[1])
         return tgt
 
 
@@ -157,8 +251,22 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
+    def gen_cache(self, memory):
+        return [layer.gen_cache(memory) for layer in self.layers]
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        """``cache``: list of per-layer (Cache, StaticCache) tuples from
+        gen_cache; returns (out, new_caches) when given."""
         out = tgt
+        if cache is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, cache):
+                out, nc = layer(out, memory, tgt_mask=tgt_mask,
+                                memory_mask=memory_mask, cache=c)
+                new_caches.append(nc)
+            if self.norm is not None:
+                out = self.norm(out)
+            return out, new_caches
         for layer in self.layers:
             out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
         if self.norm is not None:

@@ -134,9 +134,6 @@ ALLOWED = {
     "nn.layer.to.device": "one logical device under PJRT; placement is "
     "sharding's job",
     "nn.layer.to.blocking": _ASYNC,
-    "nn.layers_transformer.forward.cache": "decode cache lives in "
-    "models/*.py kv-cache path; transformer-layer cache is "
-    "train-surface only here",
     # -- amp / optimizer / jit / misc ------------------------------------
     "amp.debugging.compare_accuracy.dump_all_tensors": "reference marks "
     "it reserved/unused as well",
