@@ -249,16 +249,22 @@ def _run_moe(paddle):
     }
 
 
-def _run_decode(paddle, cfg):
+def _run_decode(paddle, cfg, *, weight_only_int8=False):
     """Serving-side point: autoregressive decode throughput with the
     static-KV-cache jitted step (generation.py; reference surface =
     inference predictor + PaddleNLP generation loop). Whole second
-    generate() call timed — compiled prefill + N-1 donated decode steps."""
+    generate() call timed — compiled prefill + N-1 donated decode steps.
+    ``weight_only_int8``: nn.quant weight-only serving path (half the
+    weight bytes on the bandwidth-bound decode)."""
     from paddle_tpu.models import LlamaForCausalLM
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     _bf16_llama(model)
+    if weight_only_int8:
+        from paddle_tpu.nn.quant import quantize_for_inference
+
+        quantize_for_inference(model)
     B, S, N = 16, 128, 256
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
@@ -360,6 +366,14 @@ def main():
             detail["decode"] = _run_decode(paddle, cfg)
         except Exception as e:  # noqa: BLE001
             detail["decode_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # weight-only int8 serving point (nn.quant): same decode, half
+        # the weight bytes
+        try:
+            detail["decode_int8"] = _run_decode(paddle, cfg,
+                                                weight_only_int8=True)
+        except Exception as e:  # noqa: BLE001
+            detail["decode_int8_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # MoE point: 8-expert GShard decoder (routing + batched experts)
         try:

@@ -28,12 +28,14 @@ __all__ = ["GenerationConfig", "generate", "generate_uncached",
            "update_static_kv_cache"]
 
 
-def update_static_kv_cache(kv_cache: dict, k, v, position_offset):
+def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
+                           build_mask: bool = True):
     """The static-cache protocol shared by the decoder models (llama/
     gpt): write this step's k/v [b, s, h, d] into the pre-allocated
-    [b, max_len, h, d] buffers at ``position_offset`` and build the
-    additive causal mask that exposes only positions < offset + s.
-    Returns (k_full, v_full, new_cache, mask)."""
+    [b, max_len, h, d] buffers at ``position_offset`` and (unless the
+    caller brings its own attn_mask — ``build_mask=False``) build the
+    additive causal mask exposing only positions < offset + s.
+    Returns (k_full, v_full, new_cache, mask_or_None)."""
     from .ops.dispatch import apply_op
 
     def upd(buf, new):
@@ -42,12 +44,14 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset):
 
     ck = apply_op("kv_cache_update", upd, kv_cache["k"], k)
     cv = apply_op("kv_cache_update", upd, kv_cache["v"], v)
-    s = k.shape[1]
-    max_len = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
-    kpos = jnp.arange(max_len)
-    qpos = position_offset + jnp.arange(s)
-    m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
-    mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+    mask = None
+    if build_mask:
+        s = k.shape[1]
+        max_len = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
+        kpos = jnp.arange(max_len)
+        qpos = position_offset + jnp.arange(s)
+        m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
+        mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
     return ck, cv, {"k": ck, "v": cv}, mask
 
 

@@ -73,7 +73,8 @@ class GPTBlock(nn.Layer):
             from ..generation import update_static_kv_cache
 
             k, v, new_cache, mask = update_static_kv_cache(
-                kv_cache, k, v, position_offset)
+                kv_cache, k, v, position_offset,
+                build_mask=attn_mask is None)
             if attn_mask is None:
                 attn_mask = mask
         elif kv_cache is not None:
@@ -107,7 +108,7 @@ class GPTModel(nn.Layer):
         x = self.wte(input_ids) + self.wpe(Tensor(pos))
         if kv_caches is not None:
             new_caches = []
-            for block, cache in zip(self.h, kv_caches):
+            for block, cache in zip(self.h, kv_caches, strict=True):
                 x, nc = block(x, attn_mask, cache, position_offset)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches

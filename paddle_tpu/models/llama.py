@@ -159,7 +159,8 @@ class LlamaAttention(nn.Layer):
             from ..generation import update_static_kv_cache
 
             k, v, new_cache, mask = update_static_kv_cache(
-                kv_cache, k, v, position_offset)
+                kv_cache, k, v, position_offset,
+                build_mask=attn_mask is None)
             if attn_mask is None:
                 attn_mask = mask
         elif kv_cache is not None:
@@ -270,7 +271,7 @@ class LlamaModel(nn.Layer):
         cos_tab, sin_tab = self.rope_cos._data, self.rope_sin._data
         if kv_caches is not None:
             new_caches = []
-            for layer, cache in zip(self.layers, kv_caches):
+            for layer, cache in zip(self.layers, kv_caches, strict=True):
                 h, nc = layer(h, cos_tab, sin_tab, attn_mask, cache, position_offset)
                 new_caches.append(nc)
             return self.norm(h), new_caches

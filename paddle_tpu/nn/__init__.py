@@ -35,3 +35,4 @@ from .layers_rnn import (
     SimpleRNNCell,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from . import quant  # noqa: F401  (paddle.nn.quant subpackage parity)

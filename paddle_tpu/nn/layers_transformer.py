@@ -163,7 +163,7 @@ class TransformerEncoder(Layer):
         out = src
         if cache is not None:
             new_caches = []
-            for layer, c in zip(self.layers, cache):
+            for layer, c in zip(self.layers, cache, strict=True):
                 out, nc = layer(out, src_mask=src_mask, cache=c)
                 new_caches.append(nc)
             if self.norm is not None:
@@ -260,7 +260,7 @@ class TransformerDecoder(Layer):
         out = tgt
         if cache is not None:
             new_caches = []
-            for layer, c in zip(self.layers, cache):
+            for layer, c in zip(self.layers, cache, strict=True):
                 out, nc = layer(out, memory, tgt_mask=tgt_mask,
                                 memory_mask=memory_mask, cache=c)
                 new_caches.append(nc)

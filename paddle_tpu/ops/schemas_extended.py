@@ -1670,6 +1670,39 @@ _S("box_coder", _box_coder_ref, [((3, 4), "boxes"), ((3, 4), "boxes")],
    dtypes=("float32",))
 
 # ---------------------------------------------------------------------------
+# weight-only quantization (nn/quant.py; reference
+# python/paddle/nn/quant/quantized_linear.py)
+# ---------------------------------------------------------------------------
+
+_DOMAINS["int8w"] = lambda rng, sh: rng.randint(-127, 128, sh).astype(np.int8)
+
+
+def _weight_quantize_ref(w):
+    wt = w.astype(np.float32).T
+    scale = np.abs(wt).max(axis=1) / 127.0
+    q = np.clip(np.round(wt / np.maximum(scale, 1e-10)[:, None]),
+                -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _weight_dequantize_ref(q, s):
+    return (q.astype(np.float32) * s[:, None]).T
+
+
+def _weight_only_linear_ref(x, q, b, s):
+    return x @ (q.astype(np.float32) * s[:, None]).T + b
+
+
+_S("weight_quantize", _weight_quantize_ref, [((8, 6), "any")],
+   api="nn.quant.weight_quantize", grad=False, dtypes=("float32",))
+_S("weight_dequantize", _weight_dequantize_ref,
+   [((6, 8), "int8w"), ((6,), "pos")], api="nn.quant.weight_dequantize",
+   kwargs={"out_dtype": "float32"}, grad=False, dtypes=("float32",))
+_S("weight_only_linear", _weight_only_linear_ref,
+   [((2, 8), "any"), ((6, 8), "int8w"), ((6,), "any"), ((6,), "pos")],
+   api="nn.quant.weight_only_linear", grad=False, dtypes=("float32",))
+
+# ---------------------------------------------------------------------------
 # Enforcement registries (tests/test_schema_enforcement.py).
 #
 # NO_SCHEMA_WHITE_LIST: ops that dispatch through apply_op but carry no

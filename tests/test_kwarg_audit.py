@@ -131,6 +131,10 @@ ALLOWED = {
     "default True is the only behavior",
     "nn.layer.set_state_dict.use_structured_name": "structured names are "
     "the only key form",
+    "nn.quant.weight_quantize.arch": "no SM architectures on TPU; "
+    "accepted so reference call sites run unchanged",
+    "nn.quant.weight_only_linear.arch": "no SM architectures on TPU; "
+    "accepted so reference call sites run unchanged",
     "nn.layer.to.device": "one logical device under PJRT; placement is "
     "sharding's job",
     "nn.layer.to.blocking": _ASYNC,

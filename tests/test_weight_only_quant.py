@@ -1,0 +1,99 @@
+"""Weight-only int8 quantization (paddle.nn.quant parity — reference
+python/paddle/nn/quant/quantized_linear.py).
+
+Oracles: the symmetric per-channel roundtrip error bound (half a
+quantization step), float-linear proximity, and an end-to-end quantized
+Llama that still decodes through the cached generate path.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import (WeightOnlyLinear, quantize_for_inference,
+                                 weight_dequantize, weight_only_linear,
+                                 weight_quantize)
+
+RNG = np.random.RandomState(0)
+
+
+class TestQuantFunctions:
+    def test_quantize_shapes_and_roundtrip_bound(self):
+        w = paddle.to_tensor(RNG.randn(64, 32).astype(np.float32))
+        q, s = weight_quantize(w)
+        assert tuple(q.shape) == (32, 64) and str(q.dtype).endswith("int8")
+        assert tuple(s.shape) == (32,)
+        wd = weight_dequantize(q, s, out_dtype="float32").numpy()
+        # error <= half a step per out-channel
+        step = np.abs(w.numpy()).max(axis=0) / 127.0
+        assert (np.abs(wd - w.numpy()) <= step[None, :] * 0.5 + 1e-7).all()
+
+    def test_weight_only_linear_matches_float(self):
+        w = paddle.to_tensor(RNG.randn(64, 32).astype(np.float32))
+        b = paddle.to_tensor(RNG.randn(32).astype(np.float32))
+        q, s = weight_quantize(w)
+        x = paddle.to_tensor(RNG.randn(4, 64).astype(np.float32))
+        got = weight_only_linear(x, q, b, s).numpy()
+        ref = (x.matmul(w) + b).numpy()
+        assert np.abs(got - ref).max() / np.abs(ref).max() < 0.02
+
+    def test_unsupported_algos_raise(self):
+        w = paddle.to_tensor(RNG.randn(8, 4).astype(np.float32))
+        with pytest.raises(NotImplementedError, match="weight_only_int8"):
+            weight_quantize(w, algo="weight_only_int4")
+        with pytest.raises(NotImplementedError, match="group_size"):
+            weight_quantize(w, group_size=64)
+        q, s = weight_quantize(w)
+        with pytest.raises(NotImplementedError, match="int8"):
+            weight_only_linear(paddle.to_tensor(RNG.randn(2, 8).astype(np.float32)),
+                               q, None, s, weight_dtype="int4")
+        with pytest.raises(ValueError, match="weight_scale"):
+            weight_only_linear(paddle.to_tensor(RNG.randn(2, 8).astype(np.float32)),
+                               q, None, None)
+
+    def test_weight_only_layer_from_linear(self):
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        lin = nn.Linear(16, 8)
+        wol = WeightOnlyLinear.from_linear(lin)
+        x = paddle.to_tensor(RNG.randn(3, 16).astype(np.float32))
+        ref = lin(x).numpy()
+        got = wol(x).numpy()
+        assert np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6) < 0.02
+        # buffers, not parameters: a serving artifact
+        assert not list(wol.parameters())
+        assert {n for n, _ in wol.named_buffers_dict().items()} >= {"qweight", "scale"}
+
+
+class TestQuantizedModel:
+    def test_llama_quantized_decode(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.nn.quant import WeightOnlyLinear as WOL
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            RNG.randint(0, cfg.vocab_size, (2, 6)).astype("int32"))
+        with paddle.no_grad():
+            ref = m(ids).numpy()
+        quantize_for_inference(m)
+        n_q = sum(1 for s in m.sublayers() if isinstance(s, WOL))
+        assert n_q == 4 * cfg.num_hidden_layers + 3 * cfg.num_hidden_layers + 1
+        with paddle.no_grad():
+            got = m(ids).numpy()
+        assert np.abs(got - ref).max() / np.abs(ref).max() < 0.05
+        out = m.generate(ids, max_new_tokens=4).numpy()
+        assert out.shape == (2, 10)
+
+    def test_include_filter(self):
+        from paddle_tpu import nn
+        from paddle_tpu.nn.quant import WeightOnlyLinear as WOL
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        quantize_for_inference(m, include=lambda name, layer: layer.weight.shape[1] == 4)
+        kinds = [type(s).__name__ for s in m.sublayers()]
+        assert kinds.count("WeightOnlyLinear") == 1
+        assert kinds.count("Linear") == 1
