@@ -3,9 +3,42 @@
 
 from __future__ import annotations
 
+import json
+import os
+
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+
+
+def load_hf_state_dict(path: str) -> dict:
+    """Read a HuggingFace checkpoint DIRECTORY into a flat name->array
+    dict without torch: single or sharded ``*.safetensors`` (index json
+    honored). The interop doors accept the result as their bare
+    state_dict input — so converting a downloaded checkpoint needs no
+    torch and no model instantiation."""
+    from safetensors import safe_open
+
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        idx = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(idx):
+            with open(idx) as f:
+                weight_map = json.load(f)["weight_map"]
+            files = sorted({os.path.join(path, v) for v in weight_map.values()})
+        else:
+            single = os.path.join(path, "model.safetensors")
+            if not os.path.exists(single):
+                raise FileNotFoundError(
+                    f"no model.safetensors[.index.json] under {path!r}")
+            files = [single]
+    out = {}
+    for f in files:
+        with safe_open(f, framework="np") as sf:
+            for name in sf.keys():
+                out[name] = sf.get_tensor(name)
+    return out
 
 
 def load_converted_state(model, converted: dict, *, allow_leftover=()):

@@ -17,8 +17,6 @@ Contract (matches the reference):
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -27,10 +25,6 @@ from .layer import Layer
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
            "WeightOnlyLinear", "quantize_for_inference"]
-
-
-def _data(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
@@ -106,19 +100,27 @@ class WeightOnlyLinear(Layer):
 
     def __init__(self, qweight, scale, bias=None):
         super().__init__()
-        self.register_buffer("qweight", qweight if isinstance(qweight, Tensor)
-                             else Tensor(qweight), persistable=True)
-        self.register_buffer("scale", scale if isinstance(scale, Tensor)
-                             else Tensor(scale), persistable=True)
+
+        def _buf(x):
+            # detach: a serving buffer must not drag the quantization
+            # tape (and through it the original full-precision weight)
+            # along, nor record vjp residuals per decode step
+            data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            return Tensor(data, stop_gradient=True)
+
+        self.register_buffer("qweight", _buf(qweight), persistable=True)
+        self.register_buffer("scale", _buf(scale), persistable=True)
         if bias is not None:
-            self.register_buffer("bias", bias if isinstance(bias, Tensor)
-                                 else Tensor(bias), persistable=True)
+            self.register_buffer("bias", _buf(bias), persistable=True)
         else:
             self.bias = None
 
     @classmethod
     def from_linear(cls, linear):
-        q, scale = weight_quantize(linear.weight)
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            q, scale = weight_quantize(linear.weight)
         return cls(q, scale, linear.bias)
 
     def forward(self, x):

@@ -49,6 +49,7 @@ TPU_LANE = [
     ("test_generation.py", 600, {}),  # decode loops: many remote compiles
     ("test_offload.py", 420, {}),
     ("test_fused_projections.py", 420, {}),  # fused-vs-unfused on TPU numerics
+    ("test_weight_only_quant.py", 420, {}),  # int8 dequant-fusion numerics
     ("test_op_schema_sweep.py", 600, {"PADDLE_TPU_SWEEP_STRIDE": "16"}),
 ]
 

@@ -70,6 +70,47 @@ class TestHFInterop:
             got = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
+    def test_safetensors_checkpoint_dir_roundtrip(self, tmp_path):
+        # torch-free checkpoint ingestion: save an HF llama as sharded
+        # safetensors, read it back with load_hf_state_dict, convert via
+        # the bare-state-dict door — logits must match the live model
+        from safetensors.numpy import save_file
+
+        from paddle_tpu.models import LlamaConfig
+        from paddle_tpu.models.interop import load_hf_state_dict
+
+        hf, ours_ref = _hf_pair()
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        names = sorted(sd)
+        half = len(names) // 2
+        save_file({k: sd[k] for k in names[:half]},
+                  str(tmp_path / "model-00001-of-00002.safetensors"))
+        save_file({k: sd[k] for k in names[half:]},
+                  str(tmp_path / "model-00002-of-00002.safetensors"))
+        index = {"weight_map": {
+            **{k: "model-00001-of-00002.safetensors" for k in names[:half]},
+            **{k: "model-00002-of-00002.safetensors" for k in names[half:]}}}
+        (tmp_path / "model.safetensors.index.json").write_text(
+            __import__("json").dumps(index))
+
+        loaded = load_hf_state_dict(str(tmp_path))
+        assert set(loaded) == set(sd)
+        h = hf.config
+        cfg = LlamaConfig(
+            vocab_size=h.vocab_size, hidden_size=h.hidden_size,
+            intermediate_size=h.intermediate_size,
+            num_hidden_layers=h.num_hidden_layers,
+            num_attention_heads=h.num_attention_heads,
+            num_key_value_heads=h.num_key_value_heads,
+            max_position_embeddings=h.max_position_embeddings,
+            rms_norm_eps=h.rms_norm_eps)
+        ours = LlamaForCausalLM.from_huggingface(loaded, config=cfg)
+        ids = np.random.RandomState(8).randint(0, 256, (1, 6)).astype("int64")
+        with paddle.no_grad():
+            a = ours(paddle.to_tensor(ids.astype("int32"))).numpy()
+            b = ours_ref(paddle.to_tensor(ids.astype("int32"))).numpy()
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
     def test_bert_outputs_parity(self):
         from transformers import BertConfig as HFBertConfig
         from transformers import BertModel as HFBert

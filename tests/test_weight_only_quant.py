@@ -64,6 +64,12 @@ class TestQuantFunctions:
         # buffers, not parameters: a serving artifact
         assert not list(wol.parameters())
         assert {n for n, _ in wol.named_buffers_dict().items()} >= {"qweight", "scale"}
+        # detached: no tape edge back to the fp weight, no per-step
+        # vjp recording during decode
+        assert wol.scale.stop_gradient and wol.qweight.stop_gradient
+        assert wol.bias is None or wol.bias.stop_gradient
+        y = wol(paddle.to_tensor(RNG.randn(2, 16).astype(np.float32)))
+        assert y.stop_gradient
 
 
 class TestQuantizedModel:
