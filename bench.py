@@ -270,10 +270,15 @@ def _run_decode(paddle, cfg, *, weight_only_int8=False):
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
     out = model.generate(ids, max_new_tokens=N)
     np.asarray(out.numpy())  # sync: compile + warmup execution fully drained
-    t0 = time.perf_counter()
-    out = model.generate(ids, max_new_tokens=N)
-    np.asarray(out.numpy())  # sync
-    dt = time.perf_counter() - t0
+    # best-of-3: a single ~0.3s generate is noise-prone over the remote
+    # PJRT transport (one RPC hiccup skews it ±15%)
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=N)
+        np.asarray(out.numpy())  # sync
+        dts.append(time.perf_counter() - t0)
+    dt = min(dts)
     return {
         "decode_tokens_per_sec": round(B * N / dt, 1),
         "ms_per_token": round(1e3 * dt / N, 3),
