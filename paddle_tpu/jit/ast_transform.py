@@ -182,11 +182,18 @@ def _pt_seq_fidx(seq):
     return 0 if _pt_seq_len(seq) else _PTUndefined()
 
 
-def _pt_seq_first(seq):
+def _pt_seq_min_len(*seqs):
+    """zip() iteration count: the shortest member."""
+    return min(_pt_seq_len(s) for s in seqs)
+
+
+def _pt_seq_first(seq, trip_count=None):
     """Pre-bind value for the loop target (lax carries need a concrete
-    aval before the loop): element 0, or the undefined sentinel for an
-    empty sequence."""
-    if _pt_seq_len(seq) == 0:
+    aval before the loop): element 0, or the undefined sentinel when the
+    loop will not run (``trip_count`` — for zip this is the SHORTEST
+    member's length, so a sibling's emptiness sentinels every target,
+    matching python's leave-unbound)."""
+    if (trip_count if trip_count is not None else _pt_seq_len(seq)) == 0:
         return _PTUndefined()
     v = _unwrap(seq)
     first = v[0] if getattr(v, "shape", None) is not None else seq[0]
@@ -646,9 +653,11 @@ class _Rewriter:
         return prologue + replaced
 
     def _try_for_seq(self, node: ast.For) -> Optional[List[ast.stmt]]:
-        """``for x in seq`` / ``for j, x in enumerate(seq)`` desugars to an
-        index while over ``__pt_seq_item__(seq, i)`` (reference
-        loop_transformer converts iterable For the same way). The
+        """``for x in seq`` / ``for j, x in enumerate(seq)`` /
+        ``for a, b in zip(s1, s2, ...)`` desugar to an index while over
+        ``__pt_seq_item__(seq_j, i)`` (reference loop_transformer
+        converts iterable For the same way; zip stops at the shortest
+        member). The
         iteration count is static (tensor shapes / len()), so the
         constant-trip loop unrolls at trace time — one program, same as
         constant-bound for-range. The payoff is JUMPS: a ``break``/
@@ -659,33 +668,47 @@ class _Rewriter:
         element 0 (lax carries need an aval; an empty sequence pre-binds
         an undefined-sentinel and the loop never enters lax)."""
         it = node.iter
-        enum = (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "enumerate" and not it.keywords
-                and len(it.args) == 1)
-        if enum:
-            seq_expr = it.args[0]
-            if not (isinstance(node.target, ast.Tuple)
-                    and len(node.target.elts) == 2
-                    and all(isinstance(e, ast.Name) for e in node.target.elts)):
+
+        def _tuple_names(target, n):
+            if not (isinstance(target, ast.Tuple) and len(target.elts) == n
+                    and all(isinstance(e, ast.Name) for e in target.elts)):
                 return None
-            idx_name = node.target.elts[0].id
-            tgt_name = node.target.elts[1].id
+            return [e.id for e in target.elts]
+
+        idx_name = None
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "enumerate" and not it.keywords \
+                and len(it.args) == 1:
+            names = _tuple_names(node.target, 2)
+            if names is None:
+                return None
+            idx_name = names[0]
+            pairs = [(names[1], it.args[0])]  # (bind name, seq expr)
+        elif isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "zip" and not it.keywords \
+                and len(it.args) >= 2 \
+                and not any(isinstance(a, ast.Starred) for a in it.args):
+            names = _tuple_names(node.target, len(it.args))
+            if names is None:
+                return None
+            pairs = list(zip(names, it.args))
+        elif isinstance(node.target, ast.Name):
+            pairs = [(node.target.id, it)]
         else:
-            if not isinstance(node.target, ast.Name):
-                return None
-            seq_expr, idx_name, tgt_name = it, None, node.target.id
+            return None
         if _has_returns(node.body):
             return None
         k = self.counter
-        seqv, iv, stopv, stepv = (f"__pt_fseq_{k}", f"__pt_fi_{k}",
-                                  f"__pt_fstop_{k}", f"__pt_fstep_{k}")
+        iv, stopv, stepv = f"__pt_fi_{k}", f"__pt_fstop_{k}", f"__pt_fstep_{k}"
+        seqvs = [f"__pt_fseq_{k}_{j}" for j in range(len(pairs))]
         _assign = functools.partial(_assign_stmt, node)
         _helper = _helper_call
 
-        prologue = [
-            _assign(seqv, seq_expr),
+        prologue = [_assign(sv, expr) for sv, (_, expr) in zip(seqvs, pairs)]
+        prologue += [
             _assign(iv, ast.Constant(value=0)),
-            _assign(stopv, _helper("__pt_seq_len__", seqv)),
+            # zip stops at the SHORTEST sequence
+            _assign(stopv, _helper("__pt_seq_min_len__", *seqvs)),
             _assign(stepv, ast.Constant(value=1)),
         ]
         # pre-bind targets so they can join the loop state tuple — but
@@ -693,19 +716,22 @@ class _Rewriter:
         # untouched on an empty sequence. A name bound only on SOME paths
         # (branch-bound) can't be decided statically: pre-binding would
         # clobber it when the branch ran — decline, the loop stays eager.
-        for name in ((tgt_name,) if idx_name is None else (tgt_name, idx_name)):
+        tgt_names = [n for n, _ in pairs] + ([idx_name] if idx_name else [])
+        for name in tgt_names:
             if name not in self.bound and self._maybe_bound(name, node.lineno):
                 return None
-        if tgt_name not in self.bound:
-            prologue.append(_assign(tgt_name, _helper("__pt_seq_first__", seqv)))
+        for (name, _), sv in zip(pairs, seqvs):
+            if name not in self.bound:
+                prologue.append(_assign(name, _helper("__pt_seq_first__", sv,
+                                                      stopv)))
         test = ast.fix_missing_locations(ast.copy_location(
             _helper("__pt_range_cont__", iv, stopv, stepv), node))
-        bind_v = _assign(tgt_name, _helper("__pt_seq_item__", seqv, iv))
-        binds = [bind_v]
+        binds = [_assign(name, _helper("__pt_seq_item__", sv, iv))
+                 for (name, _), sv in zip(pairs, seqvs)]
         if idx_name is not None:
             binds.append(_assign(idx_name, ast.Name(id=iv, ctx=ast.Load())))
             if idx_name not in self.bound:
-                prologue.append(_assign(idx_name, _helper("__pt_seq_fidx__", seqv)))
+                prologue.append(_assign(idx_name, _helper("__pt_seq_fidx__", seqvs[0])))
         incr = _assign(iv, ast.BinOp(
             left=ast.Name(id=iv, ctx=ast.Load()), op=ast.Add(),
             right=ast.Name(id=stepv, ctx=ast.Load())))
@@ -713,9 +739,7 @@ class _Rewriter:
         wl = ast.fix_missing_locations(ast.copy_location(ast.While(
             test=test, body=binds + [incr] + node.body, orelse=[]), node))
         saved = set(self.bound)
-        self.bound |= {seqv, iv, stopv, stepv, tgt_name}
-        if idx_name is not None:
-            self.bound.add(idx_name)
+        self.bound |= {iv, stopv, stepv, *seqvs, *tgt_names}
         replaced = self._try_while(wl)
         if replaced is None:
             self.bound = saved
@@ -795,7 +819,7 @@ def transform_control_flow(fn: Callable) -> Optional[Callable]:
                          "__pt_range_cont__": _pt_range_cont,
                          "__pt_and_not__": _pt_and_not,
                          "__pt_not_any__": _pt_not_any,
-                         "__pt_seq_len__": _pt_seq_len,
+                         "__pt_seq_min_len__": _pt_seq_min_len,
                          "__pt_seq_fidx__": _pt_seq_fidx,
                          "__pt_seq_first__": _pt_seq_first,
                          "__pt_seq_item__": _pt_seq_item})

@@ -17,6 +17,7 @@ Contract (matches the reference):
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -90,8 +91,6 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         # with the barrier on the decode point). The barrier pins the
         # convert+scale into the loop body where it fuses into the
         # matmul's weight read.
-        import jax
-
         q = jax.lax.optimization_barrier(q)
         w = q.astype(xx.dtype) * s[:, None].astype(xx.dtype)  # [out, in]
         out = xx @ w.T

@@ -416,6 +416,47 @@ class TestForOverTensor:
         out = st(paddle.to_tensor(np.ones(2, np.float32)), [])
         np.testing.assert_allclose(out.numpy(), [0.0, 0.0])
 
+    def test_zip_over_tensors(self):
+        def f(a, b):
+            acc = a[0] * 0.0
+            for x, y in zip(a, b):
+                acc = acc + x * y
+            return acc
+
+        st = paddle.jit.to_static(f)
+        assert st.uses_compiled_control_flow
+        rng = np.random.RandomState(9)
+        av = rng.randn(4, 3).astype(np.float32)
+        bv = rng.randn(4, 3).astype(np.float32)
+        out = st(paddle.to_tensor(av), paddle.to_tensor(bv))
+        np.testing.assert_allclose(out.numpy(), (av * bv).sum(0), rtol=1e-5)
+        assert st.sot_graph_count is None
+
+    def test_zip_stops_at_shortest(self):
+        def f(a, seq):
+            acc = a[0] * 0.0
+            for x, v in zip(a, seq):
+                acc = acc + x * v
+            return acc
+
+        st = paddle.jit.to_static(f)
+        av = np.arange(6, dtype=np.float32).reshape(3, 2)
+        out = st(paddle.to_tensor(av), [2.0, 3.0])  # only 2 of 3 rows
+        np.testing.assert_allclose(out.numpy(), av[0] * 2 + av[1] * 3)
+
+    def test_zip_with_empty_member_leaves_targets_unbound(self):
+        import pytest
+
+        def f(a, seq):
+            s = a[0] * 0.0
+            for x, v in zip(a, seq):
+                s = s + x * v
+            return s + x.sum()
+
+        st = paddle.jit.to_static(f)
+        with pytest.raises((UnboundLocalError, AttributeError)):
+            st(paddle.to_tensor(np.ones((2, 2), np.float32)), [])
+
     def test_dict_iteration_keeps_eager_semantics(self):
         # dict iterates KEYS but d[i] reads VALUES — the desugar must
         # decline (runtime TypeError -> fall back to the original fn)
