@@ -152,16 +152,29 @@ class LlamaAttention(nn.Layer):
         q, k = apply_rotary_pos_emb(q, k, cos_tab, sin_tab, position_offset)
 
         static_cache = isinstance(kv_cache, dict)
+        # flash prefill: at offset 0 causal attention over the prompt
+        # alone equals the masked-dense attention over the padded cache
+        # (positions >= s are masked out anyway) — keep the step k/v for
+        # the Pallas kernel and skip the [s, max_len] mask entirely.
+        # Long-prompt serving stays flash-fast; the per-token decode path
+        # (s == 1) is unchanged.
+        flash_prefill = (static_cache and self.config.use_flash_attention
+                         and attn_mask is None
+                         and isinstance(position_offset, int)
+                         and position_offset == 0 and s > 1)
         if static_cache:
             # pre-allocated [b, max_len, h, d] buffers updated in place at
             # position_offset (jit-friendly decode path; the reference's
             # cache_kv semantics with TPU-native dynamic_update_slice)
             from ..generation import update_static_kv_cache
 
+            step_k, step_v = k, v
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
-                build_mask=attn_mask is None)
-            if attn_mask is None:
+                build_mask=attn_mask is None and not flash_prefill)
+            if flash_prefill:
+                k, v = step_k, step_v
+            elif attn_mask is None:
                 attn_mask = mask
         elif kv_cache is not None:
             pk, pv = kv_cache
@@ -179,10 +192,19 @@ class LlamaAttention(nn.Layer):
             k = apply_op("repeat_kv", lambda x: jnp.repeat(x, rep, axis=2), k)
             v = apply_op("repeat_kv", lambda x: jnp.repeat(x, rep, axis=2), v)
 
-        if self.config.use_flash_attention and attn_mask is None:
+        if self.config.use_flash_attention and attn_mask is None \
+                and (not static_cache or flash_prefill):
             from ..pallas_kernels.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            if flash_prefill and s % 128:
+                # pad the prompt to the kernel's 128 grid: padded queries
+                # are sliced off below, and causal masking means no REAL
+                # query (row < s) ever attends a padded key (row >= s)
+                pad = ((0, 0), (0, 128 - s % 128), (0, 0), (0, 0))
+                qp, kp, vp = (Tensor(jnp.pad(t._data, pad)) for t in (q, k, v))
+                out = flash_attention(qp, kp, vp, causal=True)[:, :s]
+            else:
+                out = flash_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=attn_mask is None)

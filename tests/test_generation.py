@@ -83,6 +83,41 @@ class TestGeneration:
         model.generate(ids, max_new_tokens=3)
         assert len(store) == n  # same shapes/config: reused, not re-built
 
+    def test_flash_prefill_matches_dense_cache_path(self):
+        # 128-multiple prompt with flash on: prefill runs the Pallas
+        # kernel over the step k/v instead of masked-dense over the
+        # padded cache — tokens must match the full-forward oracle
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(max_position_embeddings=256,
+                               use_flash_attention=True)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(4)
+        ids = rng.randint(0, cfg.vocab_size, (2, 128)).astype("int32")
+        N = 4
+        out = model.generate(paddle.to_tensor(ids), max_new_tokens=N).numpy()
+        with paddle.no_grad():
+            full = ids.copy()
+            for _ in range(N):
+                logits = model(paddle.to_tensor(full)).numpy()
+                nxt = logits[:, -1].argmax(-1).astype("int32")
+                full = np.concatenate([full, nxt[:, None]], 1)
+        np.testing.assert_array_equal(out, full)
+
+    def test_flash_prefill_pads_odd_prompt_lengths(self):
+        # real prompts are rarely 128-multiples: the prefill pads to the
+        # kernel grid and slices; greedy tokens must still match the
+        # dense no-flash twin exactly
+        paddle.seed(0)
+        rng = np.random.RandomState(5)
+        cfgs = [LlamaConfig.tiny(max_position_embeddings=512,
+                                 use_flash_attention=f) for f in (True, False)]
+        models = [LlamaForCausalLM(c) for c in cfgs]
+        models[1].set_state_dict(models[0].state_dict())
+        ids = rng.randint(0, cfgs[0].vocab_size, (2, 200)).astype("int32")
+        outs = [m.generate(paddle.to_tensor(ids), max_new_tokens=3).numpy()
+                for m in models]
+        np.testing.assert_array_equal(outs[0], outs[1])
+
     def test_scan_and_python_loops_agree(self, tiny_model):
         # the one-program lax.scan decode must reproduce the per-token
         # jitted-step loop exactly, greedy and sampled
