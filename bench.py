@@ -291,8 +291,12 @@ def main():
     # compiles; repeat runs (and the driver's bench phase after a local
     # run) hit the disk cache instead. /tmp: per-machine, never committed.
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/paddle_tpu_xla_cache")
+        import os
+        import tempfile
+
+        cache_dir = os.path.join(tempfile.gettempdir(),
+                                 f"paddle_tpu_xla_cache_{os.getuid()}")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax without the knobs: compile as usual
