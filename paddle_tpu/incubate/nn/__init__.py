@@ -1,3 +1,9 @@
 from . import functional
+from .layers import (FusedBiasDropoutResidualLayerNorm, FusedFeedForward,
+                     FusedMultiHeadAttention, FusedTransformerEncoderLayer)
+
+__all__ = ["functional", "FusedBiasDropoutResidualLayerNorm",
+           "FusedFeedForward", "FusedMultiHeadAttention",
+           "FusedTransformerEncoderLayer"]
 
 __all__ = ["functional"]

@@ -25,6 +25,7 @@ __all__ = [
     "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
     "fused_multi_head_attention", "fused_feedforward", "swiglu",
     "fused_bias_act", "fused_linear", "fused_linear_activation",
+    "fused_bias_dropout_residual_layer_norm",
 ]
 
 
@@ -48,14 +49,27 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
     return apply_op("fused_rms_norm", fn, *args)
 
 
-def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5, **kwargs):
-    def fn(x, w, b):
+def fused_layer_norm(x, norm_weight=None, norm_bias=None,
+                     epsilon: float = 1e-5, **kwargs):
+    """norm_weight/norm_bias None: identity scale / zero shift (the
+    reference kernels treat them as optional)."""
+    has_w, has_b = norm_weight is not None, norm_bias is not None
+
+    def fn(x, *wb):
         xf = x.astype(jnp.float32)
         mu = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.var(xf, axis=-1, keepdims=True)
-        return (((xf - mu) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)) * w + b
+        out = ((xf - mu) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
 
-    return apply_op("fused_layer_norm", fn, x, norm_weight, norm_bias)
+    args = [x] + [a for a in (norm_weight, norm_bias) if a is not None]
+    return apply_op("fused_layer_norm", fn, *args)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -154,6 +168,37 @@ def fused_linear_activation(x, y, bias, trans_x: bool = False, trans_y: bool = F
     return apply_op("fused_linear_activation", fn, x, y, bias)
 
 
+def _inverted_dropout(key, rate, x):
+    """Shared inverted-dropout step for the fused blocks."""
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate: float = 0.5, ln_epsilon: float = 1e-5,
+        training: bool = True, **kwargs):
+    """out = layer_norm(residual + dropout(x + bias)) — the epilogue the
+    fused attention/ffn kernels share (reference incubate
+    fused_bias_dropout_residual_layer_norm)."""
+    drop = training and dropout_rate > 0
+    if drop:
+        from ...ops.random import split_key
+
+        key = split_key()
+
+    def fn(x, residual, *rest):
+        b = rest[0] if bias is not None else None
+        h = x if b is None else x + b
+        if drop:
+            h = _inverted_dropout(key, dropout_rate, h)
+        return residual + h
+
+    args = [x, residual] + ([bias] if bias is not None else [])
+    out = apply_op("fused_bias_dropout_residual_ln", fn, *args)
+    return fused_layer_norm(out, ln_scale, ln_bias, ln_epsilon)
+
+
 def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm: bool = False,
                                pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
                                pre_ln_epsilon: float = 1e-5, qkv_bias=None, linear_bias=None,
@@ -206,18 +251,13 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm: boo
             logits = logits + mask
         probs = jax.nn.softmax(logits, axis=-1)
         if drop and attn_dropout_rate > 0:
-            keep = jax.random.bernoulli(dk1, 1.0 - attn_dropout_rate,
-                                        probs.shape)
-            probs = jnp.where(keep, probs / (1.0 - attn_dropout_rate),
-                              0.0).astype(probs.dtype)
+            probs = _inverted_dropout(dk1, attn_dropout_rate, probs)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, n_heads * head_dim)
         out = ctx @ lw
         if lb is not None:
             out = out + lb
         if drop and dropout_rate > 0:
-            keep = jax.random.bernoulli(dk2, 1.0 - dropout_rate, out.shape)
-            out = jnp.where(keep, out / (1.0 - dropout_rate),
-                            0.0).astype(out.dtype)
+            out = _inverted_dropout(dk2, dropout_rate, out)
         return out
 
     args = [h, qkv_weight, linear_weight]
@@ -243,7 +283,12 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None, line
     h = x
     if pre_layer_norm:
         h = fused_layer_norm(h, ln1_scale, ln1_bias, ln1_epsilon)
-    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu, "silu": jax.nn.silu}[activation]
+    # paddle's gelu defaults to the exact erf form (reference
+    # fused_feedforward passes act_method through to the phi kernel's
+    # erf gelu); jax.nn.gelu defaults to tanh-approximate
+    act = {"relu": jax.nn.relu,
+           "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+           "silu": jax.nn.silu}[activation]
     drop = training and (dropout1_rate > 0 or dropout2_rate > 0)
     if drop:
         from ...ops.random import split_key
@@ -261,14 +306,12 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None, line
             u = u + b1
         u = act(u)
         if drop and dropout1_rate > 0:
-            keep = jax.random.bernoulli(k1, 1.0 - dropout1_rate, u.shape)
-            u = jnp.where(keep, u / (1.0 - dropout1_rate), 0.0).astype(u.dtype)
+            u = _inverted_dropout(k1, dropout1_rate, u)
         v = u @ w2
         if b2 is not None:
             v = v + b2
         if drop and dropout2_rate > 0:
-            keep = jax.random.bernoulli(k2, 1.0 - dropout2_rate, v.shape)
-            v = jnp.where(keep, v / (1.0 - dropout2_rate), 0.0).astype(v.dtype)
+            v = _inverted_dropout(k2, dropout2_rate, v)
         return v
 
     args = [h, linear1_weight, linear2_weight]

@@ -1,0 +1,218 @@
+"""Fused transformer Layer classes (reference
+python/paddle/incubate/nn/layer/fused_transformer.py:94,213,534,750).
+
+On TPU "fused" means XLA-fused: the classes carry the REFERENCE weight
+layouts (qkv_weight [3, h, d, e] etc., so fused checkpoints load
+unchanged) and forward through incubate.nn.functional, whose jnp chains
+XLA fuses the way the reference's hand-written CUDA kernels do.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer import Layer
+from . import functional as FF
+
+__all__ = ["FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """out = layer_norm(residual + dropout(x + bias)); reference :94."""
+
+    def __init__(self, embed_dim, dropout_rate: float = 0.5,
+                 weight_attr=None, bias_attr=None, epsilon: float = 1e-5,
+                 name=None):
+        super().__init__()
+        assert embed_dim > 0
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                                 is_bias=True)
+        from ...nn.initializer import Constant
+
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                             is_bias=True)
+
+    def forward(self, x, residual):
+        return FF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Fused self-attention block with residual + layer norm; reference
+    :213. Weight layouts match the reference kernels: qkv_weight
+    [3, num_heads, head_dim, embed_dim], qkv_bias [3, num_heads,
+    head_dim], linear_weight [num_heads*head_dim, embed_dim]."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate: float = 0.5,
+                 attn_dropout_rate: float = 0.5, kdim=None, vdim=None,
+                 normalize_before: bool = False, need_weights: bool = False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon: float = 1e-5, nranks: int = 1, ring_id: int = -1,
+                 transpose_qkv_wb: bool = False, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0
+        assert embed_dim % num_heads == 0, "embed_dim must divide num_heads"
+        assert need_weights is False, "Only need_weights=False is supported"
+        if transpose_qkv_wb:
+            raise NotImplementedError(
+                "transpose_qkv_wb is a CUDA kernel-layout knob; use the "
+                "default [3, h, d, e] layout")
+        if kdim not in (None, embed_dim) or vdim not in (None, embed_dim):
+            raise NotImplementedError(
+                "the fused kernel is self-attention only (kdim/vdim must "
+                "equal embed_dim)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        h, d = num_heads, self.head_dim
+        self.qkv_weight = self.create_parameter([3, h, d, embed_dim],
+                                                attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter([3, h, d], attr=qkv_bias_attr,
+                                              is_bias=True)
+        self.linear_weight = self.create_parameter([h * d, embed_dim],
+                                                   attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter([embed_dim],
+                                                 attr=linear_bias_attr,
+                                                 is_bias=True)
+        from ...nn.initializer import Constant
+
+        if normalize_before:
+            self.pre_ln_scale = self.create_parameter(
+                [embed_dim], attr=pre_ln_scale_attr,
+                default_initializer=Constant(1.0))
+            self.pre_ln_bias = self.create_parameter(
+                [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+            self.ln_scale = self.ln_bias = None
+        else:
+            self.pre_ln_scale = self.pre_ln_bias = None
+            self.ln_scale = self.create_parameter(
+                [embed_dim], attr=ln_scale_attr,
+                default_initializer=Constant(1.0))
+            self.ln_bias = self.create_parameter([embed_dim],
+                                                 attr=ln_bias_attr,
+                                                 is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
+            raise NotImplementedError(
+                "self-attention only (key/value must be None or the query)")
+        return FF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """Fused FFN block with residual + layer norm; reference :534."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate: float = 0.1,
+                 epsilon: float = 1e-5, activation: str = "relu",
+                 act_dropout_rate=None, normalize_before: bool = False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None,
+                 ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        assert d_model > 0 and dim_feedforward > 0
+        self._d_model = d_model
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._act_method = activation
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter([d_model],
+                                                  attr=linear2_bias_attr,
+                                                  is_bias=True)
+        from ...nn.initializer import Constant
+
+        if normalize_before:
+            self._ln1_scale = self.create_parameter(
+                [d_model], attr=ln1_scale_attr,
+                default_initializer=Constant(1.0))
+            self._ln1_bias = self.create_parameter([d_model],
+                                                   attr=ln1_bias_attr,
+                                                   is_bias=True)
+            self._ln2_scale = self._ln2_bias = None
+        else:
+            self._ln1_scale = self._ln1_bias = None
+            self._ln2_scale = self.create_parameter(
+                [d_model], attr=ln2_scale_attr,
+                default_initializer=Constant(1.0))
+            self._ln2_bias = self.create_parameter([d_model],
+                                                   attr=ln2_bias_attr,
+                                                   is_bias=True)
+
+    def forward(self, src, cache=None):
+        return FF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self._ln1_scale, ln1_bias=self._ln1_bias,
+            ln2_scale=self._ln2_scale, ln2_bias=self._ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._act_method, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """FusedMultiHeadAttention + FusedFeedForward; reference :750."""
+
+    def __init__(self, d_model, nhead, dim_feedforward,
+                 dropout_rate: float = 0.1, activation: str = "relu",
+                 attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        assert d_model > 0 and nhead > 0 and dim_feedforward > 0
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)

@@ -1669,6 +1669,18 @@ _S("box_coder", _box_coder_ref, [((3, 4), "boxes"), ((3, 4), "boxes")],
    api="vision.ops.box_coder", wrap=_box_coder_wrap, grad=False,
    dtypes=("float32",))
 
+def _fused_bias_dropout_residual_ln_ref(x, res, b, g, beta):
+    h = res + x + b
+    mu = h.mean(-1, keepdims=True)
+    var = h.var(-1, keepdims=True)
+    return (h - mu) / np.sqrt(var + 1e-5) * g + beta
+
+
+_S("fused_bias_dropout_residual_ln", _fused_bias_dropout_residual_ln_ref,
+   [(_SH, "any"), (_SH, "any"), ((4,), "any"), ((4,), "pos"), ((4,), "any")],
+   api="incubate.nn.functional.fused_bias_dropout_residual_layer_norm",
+   kwargs={"dropout_rate": 0.0}, dtypes=("float32",))
+
 # ---------------------------------------------------------------------------
 # weight-only quantization (nn/quant.py; reference
 # python/paddle/nn/quant/quantized_linear.py)

@@ -1,0 +1,158 @@
+"""incubate.nn fused transformer Layer classes (reference
+incubate/nn/layer/fused_transformer.py).
+
+Oracle: with weights copied across, the fused blocks must reproduce an
+unfused composition of this framework's own layers (post-LN and pre-LN),
+in eval mode (dropout off) to tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.nn import (FusedBiasDropoutResidualLayerNorm,
+                                    FusedFeedForward, FusedMultiHeadAttention,
+                                    FusedTransformerEncoderLayer)
+
+RNG = np.random.RandomState(0)
+E, H, FFN = 16, 4, 32
+D = E // H
+
+
+def _set(p, arr):
+    p.set_value(paddle.to_tensor(arr.astype(np.float32)))
+
+
+def _wire_attn(fused, mha, ln):
+    """Copy q/k/v/out Linear + LayerNorm weights into the fused layout."""
+    qkv = np.stack([
+        np.asarray(getattr(mha, f"{n}_proj").weight.numpy()).T.reshape(H, D, E)
+        for n in ("q", "k", "v")])
+    _set(fused.qkv_weight, qkv)
+    qkv_b = np.stack([np.asarray(getattr(mha, f"{n}_proj").bias.numpy())
+                      .reshape(H, D) for n in ("q", "k", "v")])
+    _set(fused.qkv_bias, qkv_b)
+    _set(fused.linear_weight, mha.out_proj.weight.numpy())
+    _set(fused.linear_bias, mha.out_proj.bias.numpy())
+    tgt_scale = fused.pre_ln_scale if fused.normalize_before else fused.ln_scale
+    tgt_bias = fused.pre_ln_bias if fused.normalize_before else fused.ln_bias
+    _set(tgt_scale, ln.weight.numpy())
+    _set(tgt_bias, ln.bias.numpy())
+
+
+class TestFusedBiasDropoutResidualLN:
+    def test_matches_manual_composition(self):
+        paddle.seed(0)
+        layer = FusedBiasDropoutResidualLayerNorm(E, dropout_rate=0.0)
+        layer.eval()
+        _set(layer.linear_bias, RNG.randn(E))
+        _set(layer.ln_scale, RNG.rand(E) + 0.5)
+        _set(layer.ln_bias, RNG.randn(E))
+        x = paddle.to_tensor(RNG.randn(2, 5, E).astype(np.float32))
+        r = paddle.to_tensor(RNG.randn(2, 5, E).astype(np.float32))
+        got = layer(x, r).numpy()
+        ref = nn.functional.layer_norm(
+            r + x + layer.linear_bias, [E], weight=layer.ln_scale,
+            bias=layer.ln_bias).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedMultiHeadAttention:
+    @pytest.mark.parametrize("pre_ln", [False, True])
+    def test_matches_unfused_block(self, pre_ln):
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(E, H)
+        ln = nn.LayerNorm(E)
+        _set(ln.weight, RNG.rand(E) + 0.5)
+        _set(ln.bias, RNG.randn(E))
+        fused = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0,
+                                        normalize_before=pre_ln)
+        fused.eval()
+        _wire_attn(fused, mha, ln)
+        mha.eval()
+        x = paddle.to_tensor(RNG.randn(2, 6, E).astype(np.float32))
+        got = fused(x).numpy()
+        with paddle.no_grad():
+            if pre_ln:
+                ref = (x + mha(ln(x), ln(x), ln(x))).numpy()
+            else:
+                ref = nn.functional.layer_norm(
+                    x + mha(x, x, x), [E], weight=ln.weight,
+                    bias=ln.bias).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_guards(self):
+        with pytest.raises(NotImplementedError, match="transpose_qkv_wb"):
+            FusedMultiHeadAttention(E, H, transpose_qkv_wb=True)
+        with pytest.raises(NotImplementedError, match="self-attention"):
+            FusedMultiHeadAttention(E, H, kdim=8)
+        layer = FusedMultiHeadAttention(E, H)
+        x = paddle.to_tensor(RNG.randn(1, 3, E).astype(np.float32))
+        other = paddle.to_tensor(RNG.randn(1, 3, E).astype(np.float32))
+        with pytest.raises(NotImplementedError, match="self-attention"):
+            layer(x, value=other)
+
+    def test_functional_defaults_no_ln_params(self):
+        # reference treats ln scale/bias as optional (scale 1, shift 0)
+        from paddle_tpu.incubate.nn.functional import \
+            fused_bias_dropout_residual_layer_norm
+
+        x = paddle.to_tensor(RNG.randn(2, 4, E).astype(np.float32))
+        r = paddle.to_tensor(RNG.randn(2, 4, E).astype(np.float32))
+        got = fused_bias_dropout_residual_layer_norm(
+            x, r, dropout_rate=0.0, training=False).numpy()
+        ref = nn.functional.layer_norm(x + r, [E]).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedFeedForward:
+    @pytest.mark.parametrize("pre_ln", [False, True])
+    def test_matches_unfused_block(self, pre_ln):
+        paddle.seed(1)
+        fused = FusedFeedForward(E, FFN, dropout_rate=0.0, activation="gelu",
+                                 normalize_before=pre_ln)
+        fused.eval()
+        w1, b1 = RNG.randn(E, FFN), RNG.randn(FFN)
+        w2, b2 = RNG.randn(FFN, E), RNG.randn(E)
+        g, b = RNG.rand(E) + 0.5, RNG.randn(E)
+        _set(fused.linear1_weight, w1)
+        _set(fused.linear1_bias, b1)
+        _set(fused.linear2_weight, w2)
+        _set(fused.linear2_bias, b2)
+        scale = fused._ln1_scale if pre_ln else fused._ln2_scale
+        bias = fused._ln1_bias if pre_ln else fused._ln2_bias
+        _set(scale, g)
+        _set(bias, b)
+        x = paddle.to_tensor(RNG.randn(2, 5, E).astype(np.float32))
+        got = fused(x).numpy()
+
+        def ffn(h):
+            return nn.functional.gelu(h.matmul(
+                paddle.to_tensor(w1.astype(np.float32)))
+                + paddle.to_tensor(b1.astype(np.float32))).matmul(
+                paddle.to_tensor(w2.astype(np.float32))) \
+                + paddle.to_tensor(b2.astype(np.float32))
+
+        with paddle.no_grad():
+            if pre_ln:
+                ref = (x + ffn(nn.functional.layer_norm(
+                    x, [E], weight=scale, bias=bias))).numpy()
+            else:
+                ref = nn.functional.layer_norm(
+                    x + ffn(x), [E], weight=scale, bias=bias).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedEncoderLayer:
+    def test_trains(self):
+        paddle.seed(2)
+        layer = FusedTransformerEncoderLayer(E, H, FFN, dropout_rate=0.0)
+        x = paddle.to_tensor(RNG.randn(2, 6, E).astype(np.float32))
+        out = layer(x)
+        assert tuple(out.shape) == (2, 6, E)
+        loss = (out ** 2).mean()
+        loss.backward()
+        assert layer.fused_attn.qkv_weight.grad is not None
+        assert layer.ffn.linear1_weight.grad is not None
