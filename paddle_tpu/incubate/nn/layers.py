@@ -173,6 +173,10 @@ class FusedFeedForward(Layer):
                                                    is_bias=True)
 
     def forward(self, src, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedFeedForward has no cache state; decode caches live in "
+                "the attention layers")
         return FF.fused_feedforward(
             src, self.linear1_weight, self.linear2_weight,
             linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
