@@ -1,0 +1,43 @@
+"""Summarize an xplane trace: top HLO ops by self time + category totals.
+
+Usage: python benchmarks/xprof_top.py /tmp/trace_dir [N]
+"""
+import glob
+import json
+import sys
+from collections import defaultdict
+
+from xprof.convert import raw_to_tool_data as rtd
+
+
+def load(trace_dir):
+    f = glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb")
+    data, _ = rtd.xspace_to_tool_data(f, "hlo_stats", {})
+    d = json.loads(data)
+    cols = [c["id"] for c in d["cols"]]
+    rows = [dict(zip(cols, [c["v"] for c in r["c"]])) for r in d["rows"]]
+    return rows
+
+
+def main():
+    trace_dir = sys.argv[1]
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    rows = load(trace_dir)
+    total = sum(r["total_self_time"] for r in rows)
+    cats = defaultdict(float)
+    for r in rows:
+        cats[r["category"]] += r["total_self_time"]
+    print(f"total device self time: {total/1e3:.2f} ms")
+    print("\n-- by category --")
+    for c, t in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"{c:<32}{t/1e3:>10.2f} ms {100*t/total:>6.1f}%")
+    print("\n-- top ops by self time --")
+    rows.sort(key=lambda r: -r["total_self_time"])
+    for r in rows[:n]:
+        expr = r["hlo_op_expression"][:110].replace("\n", " ")
+        print(f"{r['total_self_time']/1e3:>9.2f} ms {100*r['total_self_time']/total:>5.1f}%"
+              f" x{r['occurrences']:<4} {r['category']:<22} {expr}")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,5 +5,3 @@ from .layers import (FusedBiasDropoutResidualLayerNorm, FusedFeedForward,
 __all__ = ["functional", "FusedBiasDropoutResidualLayerNorm",
            "FusedFeedForward", "FusedMultiHeadAttention",
            "FusedTransformerEncoderLayer"]
-
-__all__ = ["functional"]

@@ -35,4 +35,5 @@ from .layers_rnn import (
     SimpleRNNCell,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .layout import to_channels_last
 from . import quant  # noqa: F401  (paddle.nn.quant subpackage parity)

@@ -9,6 +9,7 @@ else is fusable elementwise HLO.
 from __future__ import annotations
 
 import builtins
+import functools
 import math as pymath
 from typing import Optional, Sequence
 
@@ -34,6 +35,10 @@ def _act(opname, jfn):
     return op
 
 
+# A/B'd on-chip vs an output-mask custom vjp (save relu OUTPUT for the
+# backward mask instead of the input): neutral — XLA already avoids a
+# second activation round trip by rematerializing the mask in the fused
+# backward, so the plain rule stays.
 relu = _act("relu", jax.nn.relu)
 relu6 = _act("relu6", jax.nn.relu6)
 sigmoid = _act("sigmoid", jax.nn.sigmoid)
@@ -710,6 +715,63 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None) -> Tensor:
     return apply_op("rms_norm", _f, *tensors)
 
 
+def _bn_train_fwd(a, w, b, axes, epsilon):
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        # single-pass E[x^2]-E[x]^2 stats (reference GPU BN kernels'
+        # form): both channel reductions read ``a`` once in fp32 — on a
+        # bandwidth-bound TPU conv step this halves the stat-pass HBM
+        # traffic. Half-precision inputs can't carry means large enough
+        # for the cancellation to matter beyond their own resolution.
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        ex2 = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
+        v = jnp.maximum(ex2 - jnp.square(m), 0.0)
+    else:
+        # fp32/fp64: two-pass mean/var in the input dtype — E[x^2]-E[x]^2
+        # cancels catastrophically for large-mean fp32 inputs
+        af = a
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.var(af, axis=axes, keepdims=True)
+    r = jax.lax.rsqrt(v + epsilon)
+    cdt = af.dtype
+    g = r if w is None else r * w.astype(cdt)
+    shift = -m * g if b is None else b.astype(cdt) - m * g
+    y = (af * g + shift).astype(a.dtype)
+    return y, (a, m, r, w, b)
+
+
+def _bn_train_bwd(axes, epsilon, res, dy):
+    # Standard fused BN backward (dx in one elementwise pass + two
+    # reductions that share one read of (dy, x)). Residuals are (x, m, r)
+    # — x-hat is recomputed here rather than materialized in the forward,
+    # which saves a full activation-tensor round trip to HBM; on a
+    # bandwidth-bound ResNet step that is the difference between the
+    # autodiff BN and this rule.
+    a, m, r, w, b = res
+    cdt = m.dtype  # fp32 for half inputs, the input dtype otherwise
+    af = a.astype(cdt)
+    dyf = dy.astype(cdt)
+    xhat = (af - m) * r
+    s1 = jnp.mean(dyf, axis=axes, keepdims=True)
+    s2 = jnp.mean(dyf * xhat, axis=axes, keepdims=True)
+    g = r if w is None else r * w.astype(cdt)
+    dx = (g * (dyf - s1 - xhat * s2)).astype(a.dtype)
+    n = 1
+    for i in axes:
+        n *= a.shape[i]
+    dw = None if w is None else (s2 * n).astype(w.dtype)
+    db = None if b is None else (s1 * n).astype(b.dtype)
+    return dx, dw, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(a, w, b, axes, epsilon):
+    return _bn_train_fwd(a, w, b, axes, epsilon)[0]
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
                momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None) -> Tensor:
     x = ensure_tensor(x)
@@ -735,17 +797,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
         rm._data = momentum * rm._data + (1 - momentum) * batch_mean.astype(rm._data.dtype)
         rv._data = momentum * rv._data + (1 - momentum) * batch_var.astype(rv._data.dtype)
 
+        import os as _os
+        _custom = _os.environ.get("PADDLE_TPU_BN_CUSTOM_VJP", "0") == "1"
+
         def _f(a, *wb):
-            m = jnp.mean(a, axis=axes, keepdims=True)
-            v = jnp.var(a, axis=axes, keepdims=True)
-            out = (a - m) * jax.lax.rsqrt(v + epsilon)
             i = 0
+            w_v = wb[i].reshape(bshape) if has_w else None
             if has_w:
-                out = out * wb[i].reshape(bshape)
                 i += 1
-            if has_b:
-                out = out + wb[i].reshape(bshape)
-            return out
+            b_v = wb[i].reshape(bshape) if has_b else None
+            if _custom:
+                return _bn_train(a, w_v, b_v, axes, float(epsilon))
+            y, _ = _bn_train_fwd(a, w_v, b_v, axes, float(epsilon))
+            return y
 
         return apply_op("batch_norm", _f, *tensors)
 

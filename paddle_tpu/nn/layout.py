@@ -1,0 +1,163 @@
+"""Channels-last (NHWC) model conversion for TPU conv performance.
+
+TPU convolutions want the channel dimension minor (the 128-wide lane
+axis): on a ResNet bottleneck stack (benchmarks/layout_probe.py) NHWC
+activations run ~1.4x faster than NCHW fwd+bwd on a v5e chip. The
+reference reaches the same end dynamically via layout autotuning
+(paddle/fluid/imperative/layout_autotune.cc,
+python/paddle/incubate/autotune.py set_config(layout)); here the
+conversion is a one-shot explicit model transform — XLA traces the whole
+step once, so fixing the layout before tracing beats per-dispatch
+rewriting.
+
+``to_channels_last(model)`` flips every layout-carrying sublayer
+(``data_format`` "NCHW" -> "NHWC") in place and wraps ``model.forward``
+so the public contract stays NCHW: 4-D tensor inputs are transposed to
+NHWC on entry and 4-D tensor outputs transposed back on exit.
+Parameters are untouched (conv weights stay OIHW — XLA folds the weight
+relayout into the conv), so ``state_dict`` round-trips bit-for-bit with
+the NCHW form of the same model.
+"""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from .layer import Layer
+
+__all__ = ["to_channels_last", "space_to_depth_stem"]
+
+# attribute names under which layers store their layout
+_FORMAT_ATTRS = ("data_format", "_data_format")
+
+
+def _flip_layer(layer: Layer, unsupported: list) -> bool:
+    hit = False
+    for attr in _FORMAT_ATTRS:
+        fmt = getattr(layer, attr, None)
+        if fmt is None:
+            continue
+        if fmt in ("NCHW", "NHWC"):
+            setattr(layer, attr, "NHWC")
+            hit = True
+        else:
+            # NCL/NCDHW etc.: 1-D/3-D layers have no channels-last path here
+            unsupported.append(f"{type(layer).__name__}({attr}={fmt!r})")
+    return hit
+
+
+def to_channels_last(model: Layer) -> Layer:
+    """Convert ``model`` to run internally in NHWC. Mutates in place and
+    returns the model. Raises ValueError if the model contains a
+    layout-carrying layer this conversion cannot express (non-2D
+    data_format values)."""
+    if getattr(model, "_channels_last", False):
+        return model
+
+    unsupported: list = []
+    flipped = 0
+    for layer in model.sublayers(include_self=True):
+        if _flip_layer(layer, unsupported):
+            flipped += 1
+    if unsupported:
+        raise ValueError(
+            "to_channels_last: model contains layers with non-NCHW/NHWC "
+            f"layouts that have no channels-last form: {unsupported}")
+    if not flipped:
+        raise ValueError(
+            "to_channels_last: no layout-carrying layer found — nothing "
+            "to convert (model already layout-free?)")
+
+    from ..ops.manipulation import transpose
+
+    inner_forward = model.forward
+
+    def _to_nhwc(a):
+        return transpose(a, [0, 2, 3, 1]) if (
+            isinstance(a, Tensor) and a.ndim == 4) else a
+
+    def _to_nchw(a):
+        return transpose(a, [0, 3, 1, 2]) if (
+            isinstance(a, Tensor) and a.ndim == 4) else a
+
+    def forward(*args, **kwargs):
+        args = tuple(_to_nhwc(a) for a in args)
+        kwargs = {k: _to_nhwc(v) for k, v in kwargs.items()}
+        out = inner_forward(*args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return type(out)(_to_nchw(o) for o in out)
+        return _to_nchw(out)
+
+    model.forward = forward
+    model._channels_last = True
+    return model
+
+
+def space_to_depth_stem(model: Layer, conv_attr: str = "conv1") -> Layer:
+    """Rewrite the stem conv (7x7 stride-2 pad-3 on 3 input channels —
+    the classic ResNet ``conv1``) as a 2x2 space-to-depth reshape
+    followed by an exactly-equivalent 4x4 stride-1 conv on 12 channels.
+
+    A 3-channel conv leaves 125 of the MXU's 128 input lanes idle; the
+    packed form is the standard TPU fix (MLPerf ResNet submissions; the
+    reference's analogue is its conv-algo autotuning picking an implicit
+    1x1-style lowering, paddle/phi/kernels/gpu/conv_kernel.cu).
+
+    Identity mapping: y[i,j] = sum_{u,v} w[u,v] x[2i+u-3, 2j+v-3]. With
+    u = 2a+p-1 (a in 0..3, p in 0..1) and X[m,n,(p,q,c)] = x[2m+p,2n+q,c]
+    this is a 4x4 conv over X with explicit padding (2,1) and kernel
+    W4[o,(p,q,c),a,b] = w_padded[o,c,2a+p,2b+q], where w_padded pads one
+    zero row/col at the top/left (the unused u = -1 tap). Same weights
+    tensor is read each step, so state_dict is untouched.
+
+    Requires ``to_channels_last`` first (NHWC activations). Mutates the
+    conv layer's ``forward`` in place; returns the model.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    conv = getattr(model, conv_attr)
+    if (tuple(conv._kernel_size) != (7, 7) or tuple(conv._stride) != (2, 2)
+            or conv._padding != 3 or conv.weight.shape[1] != 3
+            or conv._groups != 1 or tuple(conv._dilation) != (1, 1)
+            or conv._data_format != "NHWC"):
+        raise ValueError(
+            "space_to_depth_stem expects a channels-last 7x7 stride-2 "
+            "pad-3 dense undilated conv on 3 input channels; got "
+            f"kernel={conv._kernel_size} stride={conv._stride} "
+            f"padding={conv._padding} in_ch={conv.weight.shape[1]} "
+            f"groups={conv._groups} dilation={conv._dilation} "
+            f"data_format={conv._data_format!r}")
+
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    bias = conv.bias
+
+    def stem_forward(x):
+        x = ensure_tensor(x)
+        tensors = [x, conv.weight] + ([bias] if bias is not None else [])
+
+        def _f(a, w, *b):
+            n, h, wd, c = a.shape
+            if h % 2 or wd % 2:
+                raise ValueError(
+                    "space_to_depth_stem requires even spatial input "
+                    f"dims (got {h}x{wd}); call the untransformed model "
+                    "for odd sizes")
+            xp = a.reshape(n, h // 2, 2, wd // 2, 2, c)
+            xp = xp.transpose(0, 1, 3, 2, 4, 5).reshape(
+                n, h // 2, wd // 2, 4 * c)
+            wp = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+            w4 = wp.reshape(w.shape[0], c, 4, 2, 4, 2)
+            w4 = w4.transpose(0, 3, 5, 1, 2, 4).reshape(w.shape[0], 4 * c, 4, 4)
+            out = jax.lax.conv_general_dilated(
+                xp, w4, (1, 1), ((2, 1), (2, 1)),
+                dimension_numbers=("NHWC", "OIHW", "NHWC"))
+            if b:
+                out = out + b[0].reshape(1, 1, 1, -1)
+            return out
+
+        return apply_op("conv2d", _f, *tensors)
+
+    conv.forward = stem_forward
+    model._space_to_depth_stem = True
+    return model

@@ -1,0 +1,98 @@
+"""Channels-last conversion + space-to-depth stem equivalence.
+
+Parity role: the reference's layout-autotune correctness contract
+(paddle/fluid/imperative/layout_autotune.cc — transformed programs must
+be numerically equivalent); here the transforms are explicit
+(nn/layout.py) and these tests pin the equivalence.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import to_channels_last
+from paddle_tpu.nn.layout import space_to_depth_stem
+
+
+def _pair_models():
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(7)
+    m1 = resnet18(num_classes=10)
+    m2 = resnet18(num_classes=10)
+    m2.set_state_dict(m1.state_dict())
+    return m1, m2
+
+
+def test_channels_last_eval_equivalence():
+    m1, m2 = _pair_models()
+    to_channels_last(m2)
+    assert m2._channels_last
+    assert m2.conv1._data_format == "NHWC"
+    assert m2.bn1._data_format == "NHWC"
+    assert m2.maxpool.data_format == "NHWC"
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32))
+    m1.eval(), m2.eval()
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_channels_last_train_loss_and_grads_match():
+    m1, m2 = _pair_models()
+    to_channels_last(m2)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 3, 64, 64).astype(np.float32))
+    m1.train(), m2.train()
+    l1, l2 = m1(x).mean(), m2(x).mean()
+    np.testing.assert_allclose(float(l1), float(l2), atol=2e-3, rtol=2e-3)
+    l1.backward(), l2.backward()
+    g1 = m1.conv1.weight.grad.numpy()
+    g2 = m2.conv1.weight.grad.numpy()
+    scale = np.abs(g1).max() + 1e-6
+    assert np.abs(g1 - g2).max() / scale < 2e-2
+
+
+def test_state_dict_roundtrip_between_layouts():
+    # weights stay OIHW in both layouts: NHWC state loads into NCHW model
+    m1, m2 = _pair_models()
+    to_channels_last(m2)
+    sd = m2.state_dict()
+    m1.set_state_dict(sd)
+    for k, v in m1.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.numpy()),
+                                      np.asarray(sd[k].numpy()))
+
+
+def test_space_to_depth_stem_exact_on_stem_output():
+    m1, m2 = _pair_models()
+    to_channels_last(m2)
+    space_to_depth_stem(m2)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(2, 3, 224, 224).astype(np.float32))
+    m1.eval(), m2.eval()
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(),
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_space_to_depth_requires_channels_last():
+    from paddle_tpu.vision.models import resnet18
+
+    m = resnet18(num_classes=10)
+    with pytest.raises(ValueError):
+        space_to_depth_stem(m)
+
+
+def test_channels_last_rejects_1d_layers():
+    import paddle_tpu.nn as nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c = nn.Conv1D(3, 4, 3)
+
+        def forward(self, x):
+            return self.c(x)
+
+    with pytest.raises(ValueError):
+        to_channels_last(M())
