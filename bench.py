@@ -257,7 +257,7 @@ def _run_moe(paddle):
     }
 
 
-def _run_decode(paddle, cfg, *, weight_only_int8=False):
+def _run_decode(paddle, cfg, *, weight_only_int8=False, batch=16):
     """Serving-side point: autoregressive decode throughput with the
     static-KV-cache jitted step (generation.py; reference surface =
     inference predictor + PaddleNLP generation loop). Whole second
@@ -273,7 +273,7 @@ def _run_decode(paddle, cfg, *, weight_only_int8=False):
         from paddle_tpu.nn.quant import quantize_for_inference
 
         quantize_for_inference(model)
-    B, S, N = 16, 128, 256
+    B, S, N = batch, 128, 256
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
     out = model.generate(ids, max_new_tokens=N)
@@ -395,12 +395,39 @@ def main():
             detail["decode_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # weight-only int8 serving point (nn.quant): same decode, half
-        # the weight bytes
+        # the weight bytes. At 134M params / batch 16 the decode is NOT
+        # weight-bound, so int8 runs at parity here — the honest win is
+        # the serving_big point below.
         try:
             detail["decode_int8"] = _run_decode(paddle, cfg,
                                                 weight_only_int8=True)
         except Exception as e:  # noqa: BLE001
             detail["decode_int8_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # bandwidth-bound serving: 1.34B params at batch 4 — decode time
+        # is dominated by the weight read, so weight-only int8 should
+        # (and does) win; this is where the reference's weight_only_linear
+        # serving path earns its keep (quantized_linear.py:183)
+        try:
+            big_cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=24, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+                use_flash_attention=True, dtype="bfloat16")
+            sb = _run_decode(paddle, big_cfg, batch=4)
+            sb_i8 = _run_decode(paddle, big_cfg, batch=4,
+                                weight_only_int8=True)
+            n_params = (2 * 32000 * 2048
+                        + 24 * (4 * 2048**2 + 3 * 2048 * 5504 + 2 * 2048)
+                        + 2048) / 1e6
+            detail["serving_big"] = {
+                "params_m": round(n_params, 1), "bf16": sb, "int8": sb_i8,
+                "int8_speedup": round(
+                    sb_i8["decode_tokens_per_sec"]
+                    / sb["decode_tokens_per_sec"], 3),
+            }
+        except Exception as e:  # noqa: BLE001
+            detail["serving_big_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # MoE point: 8-expert GShard decoder (routing + batched experts)
         try:

@@ -4,9 +4,12 @@ Reference: python/paddle/nn/quant/quantized_linear.py:56 weight_quantize,
 :123 weight_dequantize, :183 weight_only_linear — there CUDA SM-gated
 kernels; here the dequant is a jnp convert+scale that XLA fuses into the
 matmul's weight read, so an int8 weight costs half the HBM traffic of
-bf16. Decode is bandwidth-bound: the fused int8 path measured 2.3x on a
-decode-shaped [16,768]x[768,32000] matmul on v5e, and the bench's
-decode_int8 point runs the whole Llama serving path with it.
+bf16. That only pays when decode is weight-bound: measured on v5e
+(bench.py serving_big), a 1.34B Llama at batch 4 decodes 1.7x faster
+with int8 (2.59 vs 4.44 ms/token), while the 134M/batch-16 decode point
+is NOT weight-bound and int8 runs at parity there (BENCH decode vs
+decode_int8). Rule of thumb: int8 wins once weight bytes dominate the
+per-token working set — roughly params >= 0.5B at batch <= 8.
 
 Contract (matches the reference):
 - ``weight_quantize(w [in, out]) -> (q [out, in] int8, scale [out] f32)``

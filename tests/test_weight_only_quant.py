@@ -103,3 +103,40 @@ class TestQuantizedModel:
         kinds = [type(s).__name__ for s in m.sublayers()]
         assert kinds.count("WeightOnlyLinear") == 1
         assert kinds.count("Linear") == 1
+
+
+class TestDequantFusion:
+    def test_dequant_fuses_into_matmul_weight_read(self):
+        """The int8->bf16 dequant must NOT materialize the full float
+        weight: the compiled program's temp allocation stays well under
+        the dequantized weight size (this is the whole premise of the
+        serving_big bench point — half the weight HBM traffic).
+
+        TPU-lane only: XLA:CPU materializes the dequant (measured 45MB
+        temp for this shape), XLA:TPU fuses it to 0 temp bytes — the
+        claim under test is about the serving chip."""
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() == "cpu":
+            pytest.skip("dequant fusion is a TPU backend property; "
+                        "XLA:CPU materializes the weight")
+
+        IN, OUT = 2048, 5504
+        q = jnp.asarray(RNG.randint(-127, 128, (OUT, IN)), jnp.int8)
+        s = jnp.asarray(RNG.rand(OUT).astype(np.float32) + 0.5)
+        x = jnp.asarray(RNG.randn(4, IN), jnp.bfloat16)
+
+        def f(x, q, s):
+            wd = (q.astype(jnp.bfloat16)
+                  * s[:, None].astype(jnp.bfloat16)).T
+            return x @ wd
+
+        compiled = jax.jit(f).lower(x, q, s).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            pytest.skip("memory_analysis unavailable on this backend")
+        dequant_bytes = IN * OUT * 2
+        assert ma.temp_size_in_bytes < dequant_bytes // 2, (
+            f"temp {ma.temp_size_in_bytes}B suggests the dequantized "
+            f"weight ({dequant_bytes}B) is materialized — fusion lost")
