@@ -462,11 +462,6 @@ class PipelineParallel:
         assert jax.process_count() == S, (
             f"lockstep pp needs one process per stage ({S}), have "
             f"{jax.process_count()}")
-        if self._layers.shared_groups():
-            raise NotImplementedError(
-                "cross-stage tied weights over the lockstep multi-process "
-                "path need an eager shared-grad allreduce; use the "
-                "single-controller engine or the compiled GSPMD pipeline")
         rank = jax.process_index()
         inner = getattr(optimizer, "_inner_opt", optimizer)
         owned = list(range(rank, V, S))  # virtual stages of this process
@@ -486,16 +481,49 @@ class PipelineParallel:
 
                 return jax.jit(_bwd)
 
+            def _make_bwd_b(_f):
+                # dX only — the zero-bubble critical path (reference
+                # pipeline_zero_bubble.py:38 backward_b)
+                def _bwd_b(params, xx, gy):
+                    _, vjp = jax.vjp(lambda x2: _f(params, x2), xx)
+                    return vjp(gy)[0]
+
+                return jax.jit(_bwd_b)
+
+            def _make_bwd_w(_f):
+                # dW only — fills bubbles (pipeline_zero_bubble.py:62)
+                def _bwd_w(params, xx, gy):
+                    _, vjp = jax.vjp(lambda pp: _f(pp, xx), params)
+                    return vjp(gy)[0]
+
+                return jax.jit(_bwd_w)
+
             fopt = from_eager(inner)
             self._mp = {
                 "fns": fns, "all_params": params_list,
                 "params": {vs: params_list[vs] for vs in owned},
                 "fwd": {vs: jax.jit(fns[vs]) for vs in owned},
                 "bwd": {vs: _make_bwd(fns[vs]) for vs in owned},
+                "bwd_b": {vs: _make_bwd_b(fns[vs]) for vs in owned},
+                "bwd_w": {vs: _make_bwd_w(fns[vs]) for vs in owned},
                 "loss_seed": jax.jit(lambda y, l: jax.value_and_grad(loss_fn)(y, l)),
                 "opt": fopt,
                 "opt_state": {vs: fopt.init(params_list[vs]) for vs in owned},
             }
+            # tied-weight sync at build (reference pp_layers.py:454
+            # _synchronize_shared_weights): every occurrence adopts the
+            # owner stage's value via a broadcast from the owning process.
+            # All ranks enter the broadcasts in the same global order.
+            for group in self._layers.shared_groups():
+                src_vs, src_key = group[0]
+                aval = self._mp["all_params"][src_vs][src_key]
+                payload = (self._mp["params"][src_vs][src_key]
+                           if src_vs in owned
+                           else jnp.zeros(aval.shape, aval.dtype))
+                synced = eager_broadcast(payload, src=src_vs % S)
+                for vs, key in group:
+                    if vs in owned:
+                        self._mp["params"][vs][key] = synced
             self._engine = self._mp  # marks built
             self._engine_opt_id = id(inner)
 
@@ -508,7 +536,11 @@ class PipelineParallel:
             aval = jax.eval_shape(fns[vs], mp["all_params"][vs], aval)
             bshapes.append(aval)
 
-        if C > 1 or self._schedule in ("1f1b", "vpp"):
+        if self._schedule == "zb":
+            assert C == 1, "zero-bubble runs with one chunk per rank"
+            grad_total, losses = self._lockstep_zb(
+                x_micro, y_micro, mp, bshapes, rank, S, M)
+        elif C > 1 or self._schedule in ("1f1b", "vpp"):
             # one clocked engine: _timetable_vpp(S, M, 1) is byte-identical
             # to the plain 1F1B timetable, and a C==1 'VPP' config is just
             # 1F1B (the reference treats them the same way)
@@ -519,8 +551,29 @@ class PipelineParallel:
                 x_micro, y_micro, mp, bshapes, rank, S, M)
         else:
             raise NotImplementedError(
-                f"cross-process schedule {self._schedule!r}: FThenB, 1F1B "
-                "and VPP run over processes; ZBH1 is single-controller only")
+                f"cross-process schedule {self._schedule!r}: FThenB, 1F1B, "
+                "VPP and ZBH1 run over processes")
+        # shared-grad reduction across processes (reference
+        # pp_layers.py:481 allreduce over the shared comm group): each
+        # rank contributes the sum of its occurrences' grads (zeros if it
+        # holds none — the allreduce spans the whole pp world), then every
+        # occurrence adopts the total. Identical start values + identical
+        # summed grads + identical optimizer state keep the copies in
+        # lockstep without ever moving the weight itself.
+        from ..eager_collectives import eager_all_reduce
+
+        for group in self._layers.shared_groups():
+            vs0, key0 = group[0]
+            aval = mp["all_params"][vs0][key0]
+            local = jnp.zeros(aval.shape, aval.dtype)
+            for vs, key in group:
+                if vs in owned and grad_total.get(vs) is not None:
+                    local = local + grad_total[vs][key]
+            total = eager_all_reduce(local)
+            for vs, key in group:
+                if vs in owned and grad_total.get(vs) is not None:
+                    grad_total[vs][key] = total
+
         lr = jnp.asarray(float(inner.get_lr()) if hasattr(inner, "get_lr") else 0.1,
                          jnp.float32)
         for vs in owned:
@@ -679,5 +732,111 @@ class PipelineParallel:
                     bshapes[v - 1].shape, bshapes[v - 1].dtype)
                 r_ = eager_shift(payload, shift)
                 if rank == dst:
+                    gys[(v - 1, bwd_sent[v])] = r_
+        return grad_total, losses
+
+    @staticmethod
+    def _timetable_zb(S: int, M: int):
+        """Clocked ZB-H1 (reference pipeline_zero_bubble.py): backward is
+        split into B (dX — critical path) and W (dW — fills what would be
+        bubbles). Per tick each rank runs one job, priority B > F > W,
+        forwards bounded by the 1F1B in-flight cap. Deterministic pure-int
+        simulation — identical on every process, so all ranks enter the
+        same edge collectives in the same order."""
+        next_f = [0] * S
+        next_b = [0] * S
+        next_w = [0] * S
+        in_flight = [0] * S
+        cap = [min(S - r, M) for r in range(S)]
+        act_avail = [set() for _ in range(S)]  # arrived stage inputs
+        gy_avail = [set() for _ in range(S)]   # arrived/seeded out-grads
+        ticks = []
+        while any(next_w[r] < M for r in range(S)):
+            jobs = [None] * S
+            fwd_sent = {}
+            bwd_sent = {}
+            for r in range(S):
+                m_b = next_b[r]
+                if m_b < M and m_b < next_f[r] and m_b in gy_avail[r]:
+                    jobs[r] = ("B", r, m_b)
+                    next_b[r] += 1
+                    in_flight[r] -= 1
+                    if r > 0:
+                        bwd_sent[r] = m_b
+                    continue
+                m_f = next_f[r]
+                if (m_f < M and in_flight[r] < cap[r]
+                        and (r == 0 or m_f in act_avail[r])):
+                    jobs[r] = ("F", r, m_f)
+                    next_f[r] += 1
+                    in_flight[r] += 1
+                    if r < S - 1:
+                        fwd_sent[r] = m_f
+                    else:
+                        gy_avail[r].add(m_f)  # loss seed, usable next tick
+                    continue
+                if next_w[r] < next_b[r]:
+                    jobs[r] = ("W", r, next_w[r])
+                    next_w[r] += 1
+            for r_, m in fwd_sent.items():
+                act_avail[r_ + 1].add(m)
+            for r_, m in bwd_sent.items():
+                gy_avail[r_ - 1].add(m)
+            ticks.append((jobs, fwd_sent, bwd_sent))
+            assert len(ticks) < 6 * M + 8 * S + 16, "zb timetable diverged"
+        return ticks
+
+    def _lockstep_zb(self, x_micro, y_micro, mp, bshapes, rank, S, M):
+        """ZB-H1 across processes: same clocked engine as
+        ``_lockstep_vpp`` but each backward runs as a B job (dX via
+        ``bwd_b``, sent downstream immediately) and a later W job (dW via
+        ``bwd_w`` from the saved (x, gy)) — the reference's rank-local
+        dX/dW split jobs (pipeline_zero_bubble.py:38,62,151) driven over
+        real process boundaries."""
+        import jax
+
+        from ..eager_collectives import eager_shift
+
+        acts = {}      # (r, micro) -> stage input (until B)
+        saved_w = {}   # (r, micro) -> (x, gy) between B and W
+        recv_act = {}
+        gys = {}
+        grad_total = {rank: None}
+        losses = []
+
+        for jobs, fwd_sent, bwd_sent in self._timetable_zb(S, M):
+            job = jobs[rank]
+            out = gx = None
+            if job is not None:
+                kind, r, m = job
+                if kind == "F":
+                    inp = x_micro[m] if r == 0 else recv_act.pop((r, m))
+                    out = mp["fwd"][r](mp["params"][r], inp)
+                    acts[(r, m)] = inp
+                    if r == S - 1:
+                        l, gy = mp["loss_seed"](out, y_micro[m])
+                        losses.append(float(l))
+                        gys[(r, m)] = jax.tree.map(lambda g: g / M, gy)
+                elif kind == "B":
+                    x = acts.pop((r, m))
+                    gy = gys.pop((r, m))
+                    gx = mp["bwd_b"][r](mp["params"][r], x, gy)
+                    saved_w[(r, m)] = (x, gy)
+                else:  # W
+                    x, gy = saved_w.pop((r, m))
+                    gp = mp["bwd_w"][r](mp["params"][r], x, gy)
+                    grad_total[r] = gp if grad_total[r] is None else \
+                        jax.tree.map(jnp.add, grad_total[r], gp)
+            for v in sorted(fwd_sent):
+                payload = out if rank == v else jnp.zeros(
+                    bshapes[v].shape, bshapes[v].dtype)
+                r_ = eager_shift(payload, 1)
+                if rank == v + 1:
+                    recv_act[(v + 1, fwd_sent[v])] = r_
+            for v in sorted(bwd_sent):
+                payload = gx if rank == v else jnp.zeros(
+                    bshapes[v - 1].shape, bshapes[v - 1].dtype)
+                r_ = eager_shift(payload, -1)
+                if rank == v - 1:
                     gys[(v - 1, bwd_sent[v])] = r_
         return grad_total, losses
