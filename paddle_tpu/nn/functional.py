@@ -706,6 +706,8 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None) -> Tensor:
         tensors.append(ensure_tensor(weight))
 
     def _f(a, *w):
+        # (an einsum mean-square was A/B'd here like the flash delta fix
+        # and measured neutral-to-slower — XLA already fuses this chain)
         ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
         out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
         if w:
