@@ -327,7 +327,7 @@ def main():
             vocab_size=32000, hidden_size=768, intermediate_size=2048,
             num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=12,
             max_position_embeddings=2048, use_flash_attention=True, dtype="bfloat16")
-        primary = _run_config(paddle, cfg, batch=16, seq=1024, steps=20, warmup=3)
+        primary = _run_config(paddle, cfg, batch=16, seq=1024, steps=30, warmup=3)
     else:  # CI smoke path
         primary = _run_config(paddle, LlamaConfig.tiny(), batch=4, seq=64,
                               steps=5, warmup=2)
@@ -370,7 +370,7 @@ def main():
                 num_key_value_heads=12, max_position_embeddings=4096,
                 use_flash_attention=True, dtype="bfloat16")
             detail["seq4096"] = _run_config(
-                paddle, long_cfg, batch=4, seq=4096, steps=10, warmup=2)
+                paddle, long_cfg, batch=4, seq=4096, steps=15, warmup=2)
         except Exception as e:  # noqa: BLE001
             detail["seq4096_error"] = f"{type(e).__name__}: {e}"[:200]
 
@@ -385,9 +385,24 @@ def main():
                 num_key_value_heads=12, max_position_embeddings=8192,
                 use_flash_attention=True, dtype="bfloat16")
             detail["seq8192"] = _run_config(
-                paddle, cfg8k, batch=2, seq=8192, steps=6, warmup=2)
+                paddle, cfg8k, batch=2, seq=8192, steps=15, warmup=2)
         except Exception as e:  # noqa: BLE001
             detail["seq8192_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # seq 16384 measured (round-5: was a capability assert only):
+        # single-chip ceiling documented in flash_attention.py — no remat
+        # (A/B'd: dots_with_no_batch_dims_saveable costs 23% here and
+        # batch 2 fits without it)
+        try:
+            cfg16k = LlamaConfig(
+                vocab_size=32000, hidden_size=768, intermediate_size=2048,
+                num_hidden_layers=12, num_attention_heads=12,
+                num_key_value_heads=12, max_position_embeddings=16384,
+                use_flash_attention=True, dtype="bfloat16")
+            detail["seq16384"] = _run_config(
+                paddle, cfg16k, batch=2, seq=16384, steps=10, warmup=2)
+        except Exception as e:  # noqa: BLE001
+            detail["seq16384_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # vision point: ResNet-50 train step (BASELINE's second metric)
         try:
@@ -442,23 +457,8 @@ def main():
         except Exception as e:  # noqa: BLE001
             detail["moe_error"] = f"{type(e).__name__}: {e}"[:200]
 
-        # 16k capability assert: one fwd+bwd flash-attention step at seq
-        # 16384 must execute (the documented single-chip ceiling,
-        # flash_attention.py docstring)
-        try:
-            from paddle_tpu.pallas_kernels.flash_attention import _flash
-            rng16 = np.random.RandomState(0)
-            import jax.numpy as jnp
-            import math as _math
-            qkv = [jnp.asarray(rng16.randn(4, 16384, 64), jnp.bfloat16)
-                   for _ in range(3)]
-            f16 = jax.jit(jax.grad(lambda q, k, v: _flash(
-                q, k, v, None, True, 1.0 / _math.sqrt(64), 512, 512)
-                .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-            jax.block_until_ready(f16(*qkv))
-            detail["seq16384_fwd_bwd"] = "ok"
-        except Exception as e:  # noqa: BLE001
-            detail["seq16384_fwd_bwd"] = f"{type(e).__name__}: {e}"[:160]
+        # (the old seq16384 fwd+bwd capability assert is superseded by
+        # the measured detail["seq16384"] train-step point above)
 
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",

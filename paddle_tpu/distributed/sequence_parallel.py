@@ -199,39 +199,59 @@ def ring_attention(q: Tensor, k: Tensor, v: Tensor, group: Group, causal: bool =
     axis = group.axis_name
 
     def _f(qa, ka, va):
+        from ..pallas_kernels.flash_attention import _flash_lse
+
         b, s_loc, h, d = qa.shape
         scale = 1.0 / math.sqrt(d)
-        qt = jnp.moveaxis(qa, 2, 1).astype(jnp.float32) * scale  # [b,h,sl,d]
-        my = jax.lax.axis_index(axis)
+        # Flash-per-hop formulation: each resident KV block is consumed
+        # by the Pallas flash kernel (no [s_loc, s_loc] score tensor is
+        # ever materialized — the einsum form was HBM-bound at 23 TF/s
+        # on the per-hop microbench, benchmarks/bench_ring_attention.py),
+        # and the hops' NORMALIZED partials merge exactly through their
+        # log-sum-exps: out = sum_i out_i * exp(lse_i - lse_total).
+        bq = bk = min(1024, s_loc)
 
+        def to_bh(x):
+            return jnp.moveaxis(x, 2, 1).reshape(b * h, s_loc, d)
+
+        qm = to_bh(qa)
+        my = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def block(carry, step):
-            kv, m, l, acc = carry
-            kb, vb = kv
-            kt = jnp.moveaxis(kb, 2, 1).astype(jnp.float32)
-            vt = jnp.moveaxis(vb, 2, 1).astype(jnp.float32)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+            (kb, vb), o, lse = carry
+            km, vm = to_bh(kb), to_bh(vb)
             if causal:
                 src = (my - step) % n  # rank whose KV we now hold
-                qpos = my * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
-                kpos = src * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
-                s = jnp.where((qpos >= kpos)[None, None], s, -1e30)
-            m_new = jnp.maximum(m, s.max(-1))
-            p = jnp.exp(s - m_new[..., None])
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + p.sum(-1)
-            acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vt)
-            kv_next = (jax.lax.ppermute(kb, axis, perm), jax.lax.ppermute(vb, axis, perm))
-            return (kv_next, m_new, l_new, acc_new), None
 
-        m0 = jnp.full((b, h, s_loc), -1e30, jnp.float32)
-        l0 = jnp.zeros((b, h, s_loc), jnp.float32)
-        acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-        (kv, m, l, acc), _ = jax.lax.scan(block, ((ka, va), m0, l0, acc0),
-                                          jnp.arange(n), length=n)
-        out = acc / jnp.maximum(l, 1e-30)[..., None]
-        return jnp.moveaxis(out, 1, 2).astype(qa.dtype)
+                def diag(_):
+                    return _flash_lse(qm, km, vm, None, True, scale, bq, bk)
+
+                def full(_):
+                    return _flash_lse(qm, km, vm, None, False, scale, bq, bk)
+
+                def skip(_):
+                    # KV strictly in this rank's future: contributes 0
+                    return (jnp.zeros_like(qm),
+                            jnp.full((b * h, s_loc), -jnp.inf, jnp.float32))
+
+                branch = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+                o_i, lse_i = jax.lax.switch(branch, [diag, full, skip], None)
+            else:
+                o_i, lse_i = _flash_lse(qm, km, vm, None, False, scale, bq, bk)
+            lse_new = jnp.logaddexp(lse, lse_i)
+            o = (o * jnp.exp(lse - lse_new)[..., None]
+                 + o_i.astype(jnp.float32) * jnp.exp(lse_i - lse_new)[..., None])
+            kv_next = (jax.lax.ppermute(kb, axis, perm),
+                       jax.lax.ppermute(vb, axis, perm))
+            return (kv_next, o, lse_new), None
+
+        o0 = jnp.zeros((b * h, s_loc, d), jnp.float32)
+        lse0 = jnp.full((b * h, s_loc), -jnp.inf, jnp.float32)
+        (kv, o, lse), _ = jax.lax.scan(block, ((ka, va), o0, lse0),
+                                       jnp.arange(n), length=n)
+        out = jnp.moveaxis(o.reshape(b, h, s_loc, d), 1, 2)
+        return out.astype(qa.dtype)
 
     return apply_op("ring_attention", _f, ensure_tensor(q), ensure_tensor(k), ensure_tensor(v))
 

@@ -317,7 +317,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, seg, out, lse, do, *, causal: bool, sm_scale: float,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, dlse=None):
     bh, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -328,6 +328,9 @@ def _flash_bwd(q, k, v, seg, out, lse, do, *, causal: bool, sm_scale: float,
     # ~4 ms/step on the 12-layer bench points)
     delta = jnp.einsum("bsd,bsd->bs", do, out,
                        preferred_element_type=jnp.float32)[..., None]
+    if dlse is not None:
+        # lse cotangent (flash-with-lse path): ds = p*(dp - delta + dlse)
+        delta = delta - dlse.astype(jnp.float32)[..., None]
     lse = lse[..., None]  # [bh, s, 1] — TPU-tileable stat columns
 
     # dK/dV pass
@@ -436,6 +439,37 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_lse(q, k, v, seg, causal, sm_scale, block_q, block_k):
+    """Flash attention that also RETURNS the log-sum-exp rows.
+
+    For block-parallel formulations (ring attention) that merge several
+    kernels' normalized partials: out = sum_i out_i * exp(lse_i - lse).
+    The lse output is differentiable: d lse_r / d s_rk = p_rk, so its
+    cotangent folds into the standard backward as delta_r - dlse_r
+    (delta = rowsum(dO*O)) — same kernels, one extra subtraction."""
+    return _flash_fwd(q, k, v, seg, causal=causal, sm_scale=sm_scale,
+                      block_q=block_q, block_k=block_k)
+
+
+def _flash_lse_vjp_fwd(q, k, v, seg, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, seg, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k)
+    return (out, lse), (q, k, v, seg, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, res, cts):
+    do, dlse = cts
+    q, k, v, seg, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, seg, out, lse, do, causal=causal,
+                            sm_scale=sm_scale, block_q=block_q,
+                            block_k=block_k, dlse=dlse)
+    return dq, dk, dv, None
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
 def _pick_block(s: int, want: int) -> int:
     b = min(want, s)
     while s % b and b > 1:
@@ -444,17 +478,20 @@ def _pick_block(s: int, want: int) -> int:
 
 
 def flash_attention(q, k, v, causal: bool = True, sm_scale=None,
-                    block_q: int = 512, block_k: int = 512, segment_ids=None):
+                    block_q: int = 1024, block_k: int = 1024, segment_ids=None):
     """Flash attention on [b, s, h, d] Tensors or arrays. Returns same layout.
 
-    Default 512x512 blocks: chip-swept optimum on v5e — vs 256x256 the
-    end-to-end train step gains +16% at seq 1024 and +39% at seq 4096
-    (fewer grid launches, better MXU occupancy per block; VMEM still
-    fits at head_dim <= 128). Blocks are clamped to the sequence length.
+    Default 1024x1024 blocks: round-5 chip re-sweep on v5e — vs the
+    512x512 round-3 optimum, the end-to-end train step gains +2.1% at
+    seq 1024, +1.6% at 4096, and +4.0% at 8192 (fewer grid launches,
+    better MXU occupancy per block; VMEM still fits at head_dim <= 128).
+    Blocks are clamped to the sequence length.
     Sequences to at least 16384 train on one chip (the raised Mosaic VMEM
-    cap covers the backward's full-sequence refs; measured 35.9k tok/s at
-    16k); beyond that, shard the sequence across chips with ring
-    attention / Ulysses (distributed/sequence_parallel.py).
+    cap covers the backward's full-sequence refs; measured 42.2k tok/s at
+    16k, batch 2, no remat — the bench's seq16384 point); beyond that,
+    shard the sequence across chips with ring attention / Ulysses
+    (distributed/sequence_parallel.py — ring runs THIS kernel per hop
+    via _flash_lse and merges partials by log-sum-exp).
 
     segment_ids: optional [b, s] int32 — packed-sequence (varlen) masking;
     attention only within equal segment ids.
