@@ -77,11 +77,7 @@ class MelSpectrogram(Layer):
 
     def forward(self, x: Tensor) -> Tensor:
         spec = self.spectrogram(x)
-
-        def fn(spec, fb):
-            return jnp.einsum("mf,...ft->...mt", fb, spec)
-
-        return apply_op("mel_projection", fn, spec, self.fbank_matrix)
+        return AF.mel_projection(spec, self.fbank_matrix)
 
 
 class LogMelSpectrogram(Layer):
@@ -93,18 +89,8 @@ class LogMelSpectrogram(Layer):
 
     def forward(self, x: Tensor) -> Tensor:
         mel = self.mel(x)
-        ref, amin, top_db = self.ref_value, self.amin, self.top_db
-
-        def fn(m):
-            import math as _m
-
-            log_spec = 10.0 * jnp.log10(jnp.maximum(m, amin))
-            log_spec = log_spec - 10.0 * _m.log10(max(ref, amin))
-            if top_db is not None:
-                log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
-            return log_spec
-
-        return apply_op("power_to_db", fn, mel)
+        return AF.power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                              top_db=self.top_db)
 
 
 class MFCC(Layer):
@@ -117,8 +103,4 @@ class MFCC(Layer):
 
     def forward(self, x: Tensor) -> Tensor:
         logmel = self.log_mel(x)
-
-        def fn(lm, dct):
-            return jnp.einsum("mk,...mt->...kt", dct, lm)
-
-        return apply_op("mfcc_dct", fn, logmel, self.dct_matrix)
+        return AF.mfcc_dct(logmel, self.dct_matrix)

@@ -16,7 +16,8 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
-           "compute_fbank_matrix", "create_dct", "get_window", "power_to_db"]
+           "compute_fbank_matrix", "create_dct", "get_window", "power_to_db",
+           "mel_projection", "mfcc_dct"]
 
 
 def hz_to_mel(freq, htk: bool = False):
@@ -118,9 +119,42 @@ def get_window(window: str, win_length: int, fftbins: bool = True):
 
 def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
                 top_db: Optional[float] = 80.0):
-    d = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
-    log_spec = 10.0 * jnp.log10(jnp.maximum(d, amin))
-    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
-    if top_db is not None:
-        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
-    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec
+    """Power spectrogram -> dB (reference paddle.audio.functional
+    power_to_db). Dispatches as an op so the schema sweep covers it."""
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    is_t = isinstance(spect, Tensor)
+
+    def fn(d):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(d, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    out = apply_op("power_to_db", fn, ensure_tensor(spect))
+    return out if is_t else out._data
+
+
+def mel_projection(spec, fbank_matrix):
+    """[..., freq, time] power spectrogram x [n_mels, freq] filter bank
+    -> [..., n_mels, time] (the projection stage of MelSpectrogram)."""
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    def fn(s, fb):
+        return jnp.einsum("mf,...ft->...mt", fb, s)
+
+    return apply_op("mel_projection", fn, ensure_tensor(spec),
+                    ensure_tensor(fbank_matrix))
+
+
+def mfcc_dct(logmel, dct_matrix):
+    """[..., n_mels, time] log-mel x [n_mels, n_mfcc] DCT basis ->
+    [..., n_mfcc, time] (the DCT stage of MFCC)."""
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    def fn(lm, dct):
+        return jnp.einsum("mk,...mt->...kt", dct, lm)
+
+    return apply_op("mfcc_dct", fn, ensure_tensor(logmel),
+                    ensure_tensor(dct_matrix))

@@ -75,9 +75,40 @@ def _compiled(kind: str, shape, dtype, extra):
         red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
                "prod": jnp.prod, "avg": jnp.mean}[kind]
         return jax.jit(lambda g: red(g, axis=0), out_shardings=repl)
+    if kind in ("sum_block", "avg_block", "sum_strided", "avg_strided"):
+        # grouped reductions for dp x pp process grids (round 5): the
+        # world reshapes to (W//S, S). "block" groups are S consecutive
+        # ranks (one pipeline replica's stages — tied-weight sums);
+        # "strided" groups share rank % S (the same stage across data
+        # replicas — dp grad sync). Every process enters the ONE
+        # compiled program, so the lockstep deadlock-freedom argument is
+        # unchanged; GSPMD lowers the segment reduction to collectives.
+        S = extra
+        red = jnp.mean if kind.startswith("avg") else jnp.sum
+
+        def f(g):
+            r = g.reshape((W // S, S) + tuple(shape))
+            if kind.endswith("block"):
+                out = jnp.repeat(red(r, axis=1, keepdims=True), S, axis=1)
+            else:
+                out = jnp.tile(red(r, axis=0, keepdims=True),
+                               (W // S,) + (1,) * (r.ndim - 1))
+            return out.reshape((W,) + tuple(shape))
+
+        return jax.jit(f, out_shardings=sharded)
     if kind == "broadcast":
         src = extra
         return jax.jit(lambda g: g[src], out_shardings=repl)
+    if kind == "broadcast_block":
+        # rank r receives the row of (its block start + src_off)
+        src_off, S = extra
+
+        def f(g):
+            r = g.reshape((W // S, S) + tuple(shape))
+            out = jnp.repeat(r[:, src_off:src_off + 1], S, axis=1)
+            return out.reshape((W,) + tuple(shape))
+
+        return jax.jit(f, out_shardings=sharded)
     if kind == "all_gather":
         return jax.jit(lambda g: g, out_shardings=repl)
     if kind == "reduce_scatter":
@@ -112,10 +143,12 @@ def _compiled(kind: str, shape, dtype, extra):
         # (the eager send/recv of the reference's ProcessGroup,
         # process_group.h send:129/recv:139 / pp_utils
         # p2p_communication.py:576 _p2p_helper).
-        shift = extra
+        shift, block = extra if isinstance(extra, tuple) else (extra, None)
         from jax.experimental.shard_map import shard_map
 
-        perm = [(i, i + shift) for i in range(W) if 0 <= i + shift < W]
+        perm = [(i, i + shift) for i in range(W)
+                if 0 <= i + shift < W
+                and (block is None or i // block == (i + shift) // block)]
 
         def body(local):  # [1, *shape] — this process's row
             return jax.lax.ppermute(local, "world", perm)
@@ -168,10 +201,30 @@ def eager_scatter(arr, src: int = 0, axis: int = 0):
     return _run("scatter", arr, (src, axis))
 
 
-def eager_shift(arr, shift: int = 1):
+def eager_shift(arr, shift: int = 1, block: int = None):
     """Every process sends ``arr`` to rank+shift and receives from
-    rank-shift (zeros past the edges). The pipeline p2p primitive."""
-    out = _run("shift", arr, shift)
+    rank-shift (zeros past the edges). The pipeline p2p primitive.
+    ``block``: edges stay within consecutive blocks of that size (one
+    pipeline replica in a dp x pp grid)."""
+    out = _run("shift", arr, (shift, block))
+    return out[0] if out.ndim == arr.ndim + 1 else out
+
+
+def eager_all_reduce_grouped(arr, group_size: int, mode: str = "block",
+                             op: str = "sum"):
+    """Reduce within process groups of a dp x pp grid. mode='block':
+    groups are ``group_size`` consecutive ranks (a pipeline replica);
+    mode='strided': groups share rank %% group_size (a stage's data
+    replicas)."""
+    assert mode in ("block", "strided") and op in ("sum", "avg")
+    out = _run(f"{op}_{mode}", arr, group_size)
+    return out[0] if out.ndim == arr.ndim + 1 else out
+
+
+def eager_broadcast_block(arr, src_off: int, group_size: int):
+    """Broadcast from the ``src_off``-th rank of each consecutive
+    ``group_size`` block to its block peers."""
+    out = _run("broadcast_block", arr, (src_off, group_size))
     return out[0] if out.ndim == arr.ndim + 1 else out
 
 

@@ -444,25 +444,47 @@ class PipelineParallel:
 
     # -- cross-process (multi-controller) pipeline --------------------------
     def _train_batch_lockstep(self, x_micro, y_micro, optimizer) -> float:
-        """FThenB over real processes: process p owns stage p; every
-        inter-stage edge is one compiled shift collective all processes
-        enter in the same global order — deadlock-free send/recv over
-        Gloo/DCN (reference p2p: fleet/meta_parallel/pp_utils/
-        p2p_communication.py). Correctness path for DCN-spanning pp; the
-        single-controller engine and the compiled GSPMD pipeline
-        (distributed/pipeline.py) are the throughput paths."""
+        """Pipeline schedules over real processes: every inter-stage edge
+        is one compiled shift collective all processes enter in the same
+        global order — deadlock-free send/recv over Gloo/DCN (reference
+        p2p: fleet/meta_parallel/pp_utils/p2p_communication.py).
+
+        dp x pp process grids (round 5): with world = dp * S, pp-minor
+        blocks of S consecutive processes form pipeline replicas (stage
+        = rank %% S, replica = rank // S — the reference topology order,
+        fleet/topology.py CommunicateTopology). Each replica runs its
+        own micro-batch slice; edges shift WITHIN the block; stage grads
+        average across replicas (strided groups) before the update.
+        Correctness path for DCN-spanning pp; the single-controller
+        engine and the compiled GSPMD pipeline (distributed/pipeline.py)
+        are the throughput paths."""
         import jax
 
         from ...optimizer.functional import from_eager
-        from ..eager_collectives import eager_broadcast, eager_shift
+        from ..eager_collectives import (eager_all_reduce,
+                                         eager_all_reduce_grouped,
+                                         eager_broadcast_block, eager_shift)
 
         S, M = self.num_stages, self.accumulate_steps
         C = self._layers.get_num_virtual_stages()
         V = S * C
-        assert jax.process_count() == S, (
-            f"lockstep pp needs one process per stage ({S}), have "
-            f"{jax.process_count()}")
-        rank = jax.process_index()
+        W = jax.process_count()
+        assert W % S == 0, (
+            f"lockstep pp needs a multiple of {S} processes (dp x pp "
+            f"grid), have {W}")
+        dp = W // S
+        proc = jax.process_index()
+        rank = proc % S          # pp stage within this replica's block
+        replica = proc // S
+        if dp > 1:
+            # this replica's batch slice (global batch split along axis 1
+            # of [M, B, ...] — the reference's per-rank data feed)
+            B = x_micro.shape[1]
+            assert B % dp == 0, (
+                f"global batch {B} not divisible by dp degree {dp}")
+            Bd = B // dp
+            x_micro = x_micro[:, replica * Bd:(replica + 1) * Bd]
+            y_micro = y_micro[:, replica * Bd:(replica + 1) * Bd]
         inner = getattr(optimizer, "_inner_opt", optimizer)
         owned = list(range(rank, V, S))  # virtual stages of this process
 
@@ -520,7 +542,7 @@ class PipelineParallel:
                 payload = (self._mp["params"][src_vs][src_key]
                            if src_vs in owned
                            else jnp.zeros(aval.shape, aval.dtype))
-                synced = eager_broadcast(payload, src=src_vs % S)
+                synced = eager_broadcast_block(payload, src_vs % S, S)
                 for vs, key in group:
                     if vs in owned:
                         self._mp["params"][vs][key] = synced
@@ -553,15 +575,28 @@ class PipelineParallel:
             raise NotImplementedError(
                 f"cross-process schedule {self._schedule!r}: FThenB, 1F1B, "
                 "VPP and ZBH1 run over processes")
-        # shared-grad reduction across processes (reference
-        # pp_layers.py:481 allreduce over the shared comm group): each
-        # rank contributes the sum of its occurrences' grads (zeros if it
-        # holds none — the allreduce spans the whole pp world), then every
-        # occurrence adopts the total. Identical start values + identical
-        # summed grads + identical optimizer state keep the copies in
-        # lockstep without ever moving the weight itself.
-        from ..eager_collectives import eager_all_reduce
+        # dp gradient sync (reference: DP allreduce over the data-parallel
+        # comm group): each stage's grads average across the replicas
+        # holding the same stage — strided groups of the dp x pp grid.
+        # Order is deterministic (owned ascending, sorted keys), so every
+        # process enters the same collectives.
+        if dp > 1:
+            for vs in owned:
+                if grad_total.get(vs) is None:
+                    continue
+                grad_total[vs] = {
+                    k: eager_all_reduce_grouped(grad_total[vs][k], S,
+                                                mode="strided", op="avg")
+                    for k in sorted(grad_total[vs])}
 
+        # shared-grad reduction (reference pp_layers.py:481 allreduce over
+        # the shared comm group): each rank contributes the sum of its
+        # occurrences' grads (zeros if it holds none), summed over the
+        # replica's BLOCK of stages, and every occurrence adopts the
+        # total. Identical start values + identical summed grads +
+        # identical optimizer state keep the copies in lockstep without
+        # ever moving the weight itself. (Runs AFTER the dp average, so
+        # replicas stay bit-identical.)
         for group in self._layers.shared_groups():
             vs0, key0 = group[0]
             aval = mp["all_params"][vs0][key0]
@@ -569,7 +604,7 @@ class PipelineParallel:
             for vs, key in group:
                 if vs in owned and grad_total.get(vs) is not None:
                     local = local + grad_total[vs][key]
-            total = eager_all_reduce(local)
+            total = eager_all_reduce_grouped(local, S, mode="block")
             for vs, key in group:
                 if vs in owned and grad_total.get(vs) is not None:
                     grad_total[vs][key] = total
@@ -584,8 +619,12 @@ class PipelineParallel:
                 seg_state[name]._data = arr
         if hasattr(inner, "_step_count"):
             inner._step_count += 1
+        # per-replica mean loss from the last stage, then mean over
+        # replicas (each replica's value appears S times — the world
+        # average IS the replica average)
         mean_loss = jnp.asarray(sum(losses) / M if losses else 0.0, jnp.float32)
-        return float(eager_broadcast(mean_loss, src=(V - 1) % S))
+        mean_loss = eager_broadcast_block(mean_loss, (V - 1) % S, S)
+        return float(eager_all_reduce(mean_loss, "avg"))
 
     @staticmethod
     def _lockstep_fthenb(x_micro, y_micro, mp, bshapes, rank, S, M):
@@ -608,7 +647,7 @@ class PipelineParallel:
                 if s < S - 1:
                     payload = out if rank == s else jnp.zeros(
                         bshapes[s].shape, bshapes[s].dtype)
-                    r = eager_shift(payload, 1)
+                    r = eager_shift(payload, 1, block=S)
                     if rank == s + 1:
                         inp = r
             if rank == S - 1:
@@ -626,7 +665,7 @@ class PipelineParallel:
                 if s > 0:
                     payload = gx if rank == s else jnp.zeros(
                         bshapes[s - 1].shape, bshapes[s - 1].dtype)
-                    r = eager_shift(payload, -1)
+                    r = eager_shift(payload, -1, block=S)
                     if rank == s - 1:
                         gy = r
         return {rank: grad_total}, losses
@@ -722,7 +761,7 @@ class PipelineParallel:
                 shift = dst - src  # +1, or -(S-1) at a chunk boundary
                 payload = out if rank == src else jnp.zeros(
                     bshapes[v].shape, bshapes[v].dtype)
-                r_ = eager_shift(payload, shift)
+                r_ = eager_shift(payload, shift, block=S)
                 if rank == dst:
                     recv_act[(v + 1, fwd_sent[v])] = r_
             for v in sorted(bwd_sent):
@@ -730,7 +769,7 @@ class PipelineParallel:
                 shift = dst - src  # -1, or +(S-1) at a chunk boundary
                 payload = gx if rank == src else jnp.zeros(
                     bshapes[v - 1].shape, bshapes[v - 1].dtype)
-                r_ = eager_shift(payload, shift)
+                r_ = eager_shift(payload, shift, block=S)
                 if rank == dst:
                     gys[(v - 1, bwd_sent[v])] = r_
         return grad_total, losses
@@ -830,13 +869,13 @@ class PipelineParallel:
             for v in sorted(fwd_sent):
                 payload = out if rank == v else jnp.zeros(
                     bshapes[v].shape, bshapes[v].dtype)
-                r_ = eager_shift(payload, 1)
+                r_ = eager_shift(payload, 1, block=S)
                 if rank == v + 1:
                     recv_act[(v + 1, fwd_sent[v])] = r_
             for v in sorted(bwd_sent):
                 payload = gx if rank == v else jnp.zeros(
                     bshapes[v - 1].shape, bshapes[v - 1].dtype)
-                r_ = eager_shift(payload, -1)
+                r_ = eager_shift(payload, -1, block=S)
                 if rank == v - 1:
                     gys[(v - 1, bwd_sent[v])] = r_
         return grad_total, losses

@@ -293,6 +293,26 @@ def _moe_combine_perm_bwd(res, dy):
 moe_combine_perm.defvjp(_moe_combine_perm_fwd, _moe_combine_perm_bwd)
 
 
+def dispatch_tokens(flat, token_idx, inv_idx):
+    """Tensor-level functional form of the permutation dispatch (the op
+    MoELayer's gather path runs; schema-swept)."""
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    return apply_op("moe_dispatch", moe_dispatch_perm, ensure_tensor(flat),
+                    ensure_tensor(token_idx), ensure_tensor(inv_idx))
+
+
+def combine_tokens(expert_out, gate_t, token_idx, gate_w, inv_idx):
+    """Tensor-level functional form of the permutation combine (the op
+    MoELayer's gather path runs; schema-swept)."""
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    return apply_op("moe_combine", moe_combine_perm,
+                    ensure_tensor(expert_out), ensure_tensor(gate_t),
+                    ensure_tensor(token_idx), ensure_tensor(gate_w),
+                    ensure_tensor(inv_idx))
+
+
 class ExpertMLP(Layer):
     """Stacked-expert SwiGLU/ReLU MLP: weights [E, ...] so expert compute is
     one batched einsum (the fused-MoE analogue; E shards over 'ep')."""

@@ -28,6 +28,21 @@ __all__ = ["GenerationConfig", "generate", "generate_uncached",
            "update_static_kv_cache"]
 
 
+def kv_cache_write(buf, new, position_offset):
+    """Write a step's [b, s, h, d] block into a pre-allocated
+    [b, max_len, h, d] cache buffer at ``position_offset`` (the
+    TPU-native dynamic_update_slice form of the reference's cache_kv
+    write; one of the two halves of ``update_static_kv_cache``)."""
+    from .ops.dispatch import apply_op, ensure_tensor
+
+    def upd(b, n):
+        return jax.lax.dynamic_update_slice(
+            b, n.astype(b.dtype), (0, position_offset, 0, 0))
+
+    return apply_op("kv_cache_update", upd, ensure_tensor(buf),
+                    ensure_tensor(new))
+
+
 def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
                            build_mask: bool = True):
     """The static-cache protocol shared by the decoder models (llama/
@@ -36,14 +51,8 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
     caller brings its own attn_mask — ``build_mask=False``) build the
     additive causal mask exposing only positions < offset + s.
     Returns (k_full, v_full, new_cache, mask_or_None)."""
-    from .ops.dispatch import apply_op
-
-    def upd(buf, new):
-        return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype),
-                                            (0, position_offset, 0, 0))
-
-    ck = apply_op("kv_cache_update", upd, kv_cache["k"], k)
-    cv = apply_op("kv_cache_update", upd, kv_cache["v"], v)
+    ck = kv_cache_write(kv_cache["k"], k, position_offset)
+    cv = kv_cache_write(kv_cache["v"], v, position_offset)
     mask = None
     if build_mask:
         s = k.shape[1]

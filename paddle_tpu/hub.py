@@ -1,0 +1,102 @@
+"""Model hub: list / help / load entrypoints from a hubconf.py repo.
+
+Parity: python/paddle/hub.py (re-export of python/paddle/hapi/hub.py —
+list:171, help:224, load:274; hubconf protocol: a ``hubconf.py`` at the
+repo root whose public callables are the entrypoints and whose optional
+``dependencies`` list names required import-checkable packages,
+hapi/hub.py:149 _load_entry_from_hubconf).
+
+TPU-runtime scope: ``source='local'`` is fully supported. The
+github/gitee sources download an archive over the network
+(hapi/hub.py:94 _get_cache_or_reload); this runtime has no egress, so
+those sources raise with guidance to clone the repo and use local.
+"""
+
+from __future__ import annotations
+
+import builtins
+import importlib.util
+import os
+import sys
+import uuid
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _check_module_exists(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def _import_module(name: str, repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} found in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.modules.pop(name, None)
+    return module
+
+
+def _load_hubconf(repo_dir: str, source: str, force_reload: bool):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            '"gitee" | "local".')
+    if source in ("github", "gitee"):
+        raise NotImplementedError(
+            f"source={source!r} downloads a repo archive over the network; "
+            "this runtime has no egress — clone the repo locally and call "
+            "with source='local' (reference download path: "
+            "python/paddle/hapi/hub.py:94 _get_cache_or_reload)")
+    repo_dir = os.path.expanduser(repo_dir)
+    m = _import_module(f"_paddle_tpu_hubconf_{uuid.uuid4().hex}", repo_dir)
+    deps = getattr(m, "dependencies", None) or []
+    missing = [d for d in deps if not _check_module_exists(d)]
+    if missing:
+        raise RuntimeError(
+            f"hubconf dependencies not installed: {missing}")
+    return m
+
+
+def _entrypoints(m):
+    return sorted(
+        name for name, obj in vars(m).items()
+        if callable(obj) and not name.startswith("_"))
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """All entrypoint names exported by the repo's hubconf.py."""
+    return builtins.list(_entrypoints(_load_hubconf(repo_dir, source,
+                                                    force_reload)))
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """The docstring of entrypoint ``model``."""
+    m = _load_hubconf(repo_dir, source, force_reload)
+    entry = _load_entry(m, model)
+    return entry.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Call entrypoint ``model`` with ``kwargs`` and return the result
+    (typically a constructed, optionally weight-loaded Layer)."""
+    m = _load_hubconf(repo_dir, source, force_reload)
+    entry = _load_entry(m, model)
+    return entry(**kwargs)
+
+
+def _load_entry(m, name: str):
+    entry = getattr(m, name, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(
+            f"Cannot find callable entrypoint {name!r} in hubconf; "
+            f"available: {_entrypoints(m)}")
+    return entry

@@ -157,21 +157,56 @@ class _PTUndefined:
         return "<undefined loop variable (sequence was empty)>"
 
 
+def _pt_seq_norm(seq):
+    """Normalize an iterable to positional indexing BEFORE the index
+    desugar (round 5; reference loop transformer covers dict iteration,
+    dy2static/transformers/loop_transformer.py:111):
+
+    - dicts and their views iterate in insertion order, so ``list(...)``
+      reproduces python's semantics exactly (``for k in d`` yields keys;
+      .values()/.items() likewise);
+    - a uniform numeric list/tuple stacks into an array and a uniform
+      same-shape Tensor list stacks into a Tensor — rows then read
+      through dynamic_index_in_dim, so a TRACED loop index (a tensor
+      break/continue mid-loop) stays compilable where a python list
+      would need int(tracer). A body that truly needs python scalars
+      fails at trace time and to_static retries the original function.
+
+    Sets stay undesugared (arbitrary iteration order is not worth
+    freezing into a program) — _pt_seq_len declines them."""
+    if isinstance(seq, dict):
+        seq = list(seq.keys())
+    elif isinstance(seq, (type({}.keys()), type({}.values()),
+                          type({}.items()))):
+        seq = list(seq)
+    if isinstance(seq, (list, tuple)) and seq:
+        if all(isinstance(e, (int, float)) and not isinstance(e, bool)
+               for e in seq):
+            import numpy as _np
+
+            return jnp.asarray(_np.asarray(seq))
+        if (all(isinstance(e, Tensor) for e in seq)
+                and len({(tuple(e.shape), str(e.dtype)) for e in seq}) == 1):
+            return Tensor(jnp.stack([e._data for e in seq]),
+                          stop_gradient=True)
+    return seq
+
+
 def _pt_seq_len(seq):
     """Static iteration count of a ``for x in seq`` iterable: leading-dim
     size for tensors/arrays (a python int — shapes are static under
-    trace), len() for positional sequences. Anything whose iteration
-    order is not positional indexing (dict: iterates KEYS but d[i] reads
-    VALUES; sets/generators) must NOT be desugared — raise so to_static
-    falls back to the original function."""
+    trace), len() for positional sequences (dicts/views were normalized
+    to key/value lists by _pt_seq_norm). Anything whose iteration order
+    is not positional indexing (sets/generators) must NOT be desugared —
+    raise so to_static falls back to the original function."""
     v = _unwrap(seq)
     shape = getattr(v, "shape", None)
     if shape is not None and getattr(v, "ndim", 1) >= 1:
         return int(shape[0])
     if not isinstance(seq, (list, tuple, str)):
         raise TypeError(
-            f"for-seq transform supports tensors/arrays and list/tuple/str, "
-            f"not {type(seq).__name__}")
+            f"for-seq transform supports tensors/arrays, list/tuple/str "
+            f"and dict/dict-views, not {type(seq).__name__}")
     return len(seq)
 
 
@@ -265,9 +300,22 @@ def _assigned_names(stmts: List[ast.stmt]) -> Optional[Set[str]]:
                 return None
             if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
                 names.add(node.id)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Store) and \
+                    isinstance(node.value, ast.Name):
+                # round 5: ``name[i] = v`` on a local — treat as binding
+                # ``name``: Tensor __setitem__ rebinds the value
+                # functionally (ops/__init__ _setitem -> _replace_), so
+                # carrying the name through the loop/branch state machine
+                # reproduces the mutation; python containers mutate in
+                # place and ride the state tuple by identity. If the
+                # state cannot be expressed as a lax carry, the generated
+                # function fails at trace time and to_static retries the
+                # original (api.py's graceful-decline path).
+                names.add(node.value.id)
             elif isinstance(node, (ast.Attribute, ast.Subscript)) and \
                     isinstance(node.ctx, ast.Store):
-                return None  # mutation of containers: state unclear
+                return None  # attribute / nested-container mutation
     return names
 
 
@@ -704,7 +752,10 @@ class _Rewriter:
         _assign = functools.partial(_assign_stmt, node)
         _helper = _helper_call
 
-        prologue = [_assign(sv, expr) for sv, (_, expr) in zip(seqvs, pairs)]
+        prologue = [_assign(sv, ast.Call(
+            func=ast.Name(id="__pt_seq_norm__", ctx=ast.Load()),
+            args=[expr], keywords=[]))
+            for sv, (_, expr) in zip(seqvs, pairs)]
         prologue += [
             _assign(iv, ast.Constant(value=0)),
             # zip stops at the SHORTEST sequence
@@ -822,7 +873,8 @@ def transform_control_flow(fn: Callable) -> Optional[Callable]:
                          "__pt_seq_min_len__": _pt_seq_min_len,
                          "__pt_seq_fidx__": _pt_seq_fidx,
                          "__pt_seq_first__": _pt_seq_first,
-                         "__pt_seq_item__": _pt_seq_item})
+                         "__pt_seq_item__": _pt_seq_item,
+                         "__pt_seq_norm__": _pt_seq_norm})
     loc: dict = {}
     exec(code, glb, loc)
     new_fn = loc[func.name]

@@ -122,6 +122,16 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos_tab, sin_tab, position_offset
     return qo, ko
 
 
+def repeat_kv(x, rep: int):
+    """GQA head expansion: [b, s, kv_heads, d] -> [b, s, kv_heads*rep, d]
+    (reference PaddleNLP repeat_kv; each kv head serves ``rep`` query
+    heads)."""
+    from ..ops.dispatch import apply_op, ensure_tensor
+
+    return apply_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2),
+                    ensure_tensor(x))
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -194,8 +204,8 @@ class LlamaAttention(nn.Layer):
         # GQA: repeat kv heads
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
-            k = apply_op("repeat_kv", lambda x: jnp.repeat(x, rep, axis=2), k)
-            v = apply_op("repeat_kv", lambda x: jnp.repeat(x, rep, axis=2), v)
+            k = repeat_kv(k, rep)
+            v = repeat_kv(v, rep)
 
         if self.config.use_flash_attention and attn_mask is None \
                 and (not static_cache or flash_prefill):

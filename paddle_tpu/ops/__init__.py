@@ -68,6 +68,20 @@ def _setitem(self: Tensor, idx, value):
     return self
 
 
+def getitem(x, idx):
+    """Functional ``x[idx]`` (the __getitem__ kernel; schema-swept)."""
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return _getitem(t, idx)
+
+
+def setitem(x, idx, value):
+    """Functional out-of-place ``x[idx] = value`` -> new Tensor (the
+    __setitem__ kernel; schema-swept). ``x`` is left untouched."""
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    y = Tensor(t._data, stop_gradient=t.stop_gradient)
+    return _setitem(y, idx, value)
+
+
 def _iter(self: Tensor):
     for i in range(len(self)):
         yield self[i]

@@ -85,11 +85,17 @@ class OpSchema:
         return [_DOMAINS[dom](rng, sh) for sh, dom in self.inputs]
 
     def resolve(self):
+        import importlib
+
         import paddle_tpu as root
 
         obj = root
         for part in self.api.split("."):
-            obj = getattr(obj, part)
+            try:
+                obj = getattr(obj, part)
+            except AttributeError:
+                # lazily-loaded submodule (e.g. paddle_tpu.models)
+                obj = importlib.import_module(f"{obj.__name__}.{part}")
         return obj
 
 

@@ -75,6 +75,15 @@ def _compiler_kwargs():
 NEG_INF = -1e30
 
 
+def _dot_prec(dt):
+    """Kernel dot precision: f32 operands inherit the global setting
+    (the TPU test lane forces 'highest' for oracle comparisons), while
+    half-precision operands pin DEFAULT — Mosaic rejects an fp32-precision
+    contraction on bf16 vectors ("Bad lhs type"), and bf16-operand/
+    f32-accumulate IS this kernel's contract."""
+    return None if dt == jnp.float32 else jax.lax.Precision.DEFAULT
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -108,7 +117,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segc_ref, segr_ref, o_ref, lse_ref, *,
         m, l, acc = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype)) * sm_scale
         mask = None
         if causal:
             qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -125,7 +135,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segc_ref, segr_ref, o_ref, lse_ref, *,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
@@ -237,7 +248,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]      # (bq, 1)
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]  # (bq, 1)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype)) * sm_scale
         mask = None
         if causal:
             qpos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
@@ -251,11 +263,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # normalized probabilities
         dv = dv + jnp.dot(p.astype(do.dtype).T, do,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))
         ds = p * (dp - delta) * sm_scale
         dk = dk + jnp.dot(ds.astype(q.dtype).T, q,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
@@ -293,7 +308,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(kb, dq):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype)) * sm_scale
         mask = None
         if causal:
             qpos = qi * q_block + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -306,10 +322,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))
         ds = p * (dp - delta) * sm_scale
         dq = dq + jnp.dot(ds.astype(k.dtype), k,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))
         return dq
 
     dq = jax.lax.fori_loop(0, last_kb, body, dq)
@@ -327,7 +345,8 @@ def _flash_bwd(q, k, v, seg, out, lse, do, *, causal: bool, sm_scale: float,
     # astype form emitted two [bh,s,d] f32 converts + layout copies,
     # ~4 ms/step on the 12-layer bench points)
     delta = jnp.einsum("bsd,bsd->bs", do, out,
-                       preferred_element_type=jnp.float32)[..., None]
+                       preferred_element_type=jnp.float32,
+        precision=_dot_prec(q.dtype))[..., None]
     if dlse is not None:
         # lse cotangent (flash-with-lse path): ds = p*(dp - delta + dlse)
         delta = delta - dlse.astype(jnp.float32)[..., None]

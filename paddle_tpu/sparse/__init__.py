@@ -467,6 +467,15 @@ Tensor.to_sparse_csr = _tensor_to_sparse_csr
 
 # ---------------------------------------------------------------- sparse.nn
 
+def linear_bias_add(x, b):
+    """Bias add of sparse.nn.Linear's dense output (its own op name so
+    the dispatch surface stays enumerable; schema-swept)."""
+    from ..ops.dispatch import ensure_tensor
+
+    return apply_op("sparse_linear_bias", jnp.add, ensure_tensor(x),
+                    ensure_tensor(b))
+
+
 class nn:
     """paddle.sparse.nn (parity: python/paddle/sparse/nn — activations,
     sparse softmax, BatchNorm over values, conv via dense lowering with
@@ -609,7 +618,7 @@ class nn:
         def __call__(self, x):
             out = matmul(x, self._lin.weight)
             if getattr(self._lin, "bias", None) is not None:
-                out = apply_op("sparse_linear_bias", jnp.add, out, self._lin.bias)
+                out = linear_bias_add(out, self._lin.bias)
             return out
 
         @property

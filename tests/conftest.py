@@ -27,6 +27,19 @@ if os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu") == "cpu":
         pass  # older jax: XLA_FLAGS above covers it
 else:
     jax.config.update("jax_default_matmul_precision", "highest")
+    # persistent compile cache: the full on-chip schema sweep pays one
+    # XLA compile per case; repeat lane runs hit the disk cache instead
+    # (same knob bench.py uses)
+    try:
+        import tempfile
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(tempfile.gettempdir(),
+                         f"paddle_tpu_xla_cache_{os.getuid()}"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------------

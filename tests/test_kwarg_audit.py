@@ -27,6 +27,18 @@ _SPARSE_GRAD = ("sparse gradients are a CUDA memory optimization; XLA "
                 "gradients are dense by design")
 
 ALLOWED = {
+    # -- custom-vjp aux index inputs: consumed by the BACKWARD rule, so
+    # the forward body never reads them (moe permutation formulation)
+    "distributed.moe.moe_dispatch_perm.inv_idx":
+        "vjp-only input: the backward gathers via the inverse map",
+    "distributed.moe.moe_combine_perm.token_idx":
+        "vjp-only input: the backward gathers d_eo via the slot map",
+    "distributed.moe.moe_combine_perm.gate_w":
+        "vjp-only input: slot-side gate weights for the backward",
+    # lax.switch branch thunks take one ignored operand by contract
+    "distributed.sequence_parallel.diag._": "lax.switch branch operand",
+    "distributed.sequence_parallel.full._": "lax.switch branch operand",
+    "distributed.sequence_parallel.skip._": "lax.switch branch operand",
     # -- distributed collectives ------------------------------------------
     "distributed.collective.all_gather.sync_op": _ASYNC,
     "distributed.collective.all_gather.axis": "reference ignores it too "

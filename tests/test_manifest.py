@@ -28,7 +28,8 @@ def test_manifest_complete():
 def test_manifest_fields_sane():
     for r in load_manifest():
         assert r["run_type"] in ("parallel", "serial"), r
-        assert 30 <= r["timeout"] <= 900, r
+        # 1200 ceiling: the full 466-schema sweep measured ~960s (round 5)
+        assert 30 <= r["timeout"] <= 1200, r
 
 
 def test_partition_balances_and_covers():
