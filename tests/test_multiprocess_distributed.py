@@ -833,14 +833,19 @@ open(os.path.join(os.getcwd(), f"grouped_ok_{rank}"), "w").write("ok")
         assert (tmp_path / f"grouped_ok_{r}").exists()
 
 
-def test_multiprocess_pipeline_dp_x_pp_grid(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("schedule", ["1F1B", "ZBH1"])
+def test_multiprocess_pipeline_dp_x_pp_grid(tmp_path, schedule):
     """Round-5: dp x pp PROCESS GRID — 4 processes as 2 pipeline
     replicas of 2 stages (pp-minor blocks, reference
     fleet/topology.py CommunicateTopology order). Each replica runs its
-    batch slice through 1F1B; stage grads average across replicas
-    (strided groups); edges shift within blocks. Asserts loss parity vs
-    the single-controller engine on the SAME global batch, and that the
-    two replicas' stage-0 parameters stay bit-identical."""
+    batch slice through the schedule (1F1B and the ZB-H1 dX/dW split);
+    stage grads average across replicas (strided groups); edges shift
+    within blocks. Asserts loss parity vs the single-controller engine
+    on the SAME global batch, and that the two replicas' stage-0
+    parameters stay bit-identical."""
     body = """
 from paddle_tpu import nn
 from paddle_tpu.distributed import fleet
@@ -855,7 +860,7 @@ pl = PipelineLayer(make_descs(), num_stages=2, loss_fn=nn.CrossEntropyLoss())
 
 s = fleet.DistributedStrategy()
 s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 2}
-s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
+s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "__SCHEDULE__"}
 fleet.init(is_collective=True, strategy=s)
 model = fleet.distributed_model(pl)
 opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
@@ -875,7 +880,7 @@ if rank == 0:
     open(os.path.join(os.getcwd(), "dpxpp_losses.json"), "w").write(
         json.dumps(losses))
 """
-    _launch(tmp_path, body, nproc=4)
+    _launch(tmp_path, body.replace("__SCHEDULE__", schedule), nproc=4)
     got = json.loads((tmp_path / "dpxpp_losses.json").read_text())
 
     # the two replicas' stage-0 weights must match bit-for-bit
