@@ -195,10 +195,15 @@ def _run_resnet50(paddle):
     steps, warmup = 30, 3
     dt, loss = _timed(lambda: step.step(x, y), steps, warmup)
     images_per_sec = B * steps / dt
+    from paddle_tpu.nn.layers_conv_norm import fused_conv_enabled
+
     out = {
         "images_per_sec": round(images_per_sec, 1),
         "batch": B,
         "final_loss": round(float(loss), 4),
+        # Pallas conv+BN+ReLU fusion (pallas_kernels/fused_conv.py):
+        # default-on for TPU backends, PADDLE_TPU_FUSED_CONV=0 disables
+        "fused_conv": fused_conv_enabled(),
     }
     try:
         ca = step.cost_analysis(x, y)

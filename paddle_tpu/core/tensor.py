@@ -42,6 +42,17 @@ class Tensor:
         "placements",
         "process_mesh",
         "_prov",  # auto-shard dataflow provenance (distributed/auto_shard.py)
+        # conv+BN+ReLU fusion peephole tags (nn/layers_conv_norm.py):
+        # a qualifying Conv2D output carries (input, layer) so the next
+        # BatchNorm can route the pair to the Pallas fused kernel; a
+        # frozen-stats fused output carries a relu re-dispatch closure
+        "_fused_conv_src",
+        "_fused_relu_rerun",
+        # training-mode chain fusion: a fused conv+BN output carries
+        # (raw_conv_out, mean, var, gamma, beta, eps, relu_applied) so
+        # the NEXT qualifying conv can run the normalize(+relu) as its
+        # kernel prologue and read the raw tensor instead
+        "_fused_bn_pending",
         "__weakref__",
     )
 

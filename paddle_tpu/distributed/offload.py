@@ -31,18 +31,50 @@ from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.memory import Space
+try:
+    from jax.memory import Space
+except ImportError:  # older jax: no jax.memory module. The in-jit
+    # device_put targets below accept TransferToMemoryKind with the same
+    # semantics ("device" / "pinned_host" memory kinds); expose it under
+    # the Space.Device/Space.Host names the code uses. The seed pinned
+    # the new alias, which broke `import offload` (and test_offload
+    # collection) on the baked-in jax 0.4.37.
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    class Space:  # noqa: N801 - mirrors jax.memory.Space's attribute API
+        Device = TransferToMemoryKind("device")
+        Host = TransferToMemoryKind("pinned_host")
 from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
 
 __all__ = ["HostOffloadAdamW", "host_sharding", "supports_inline_transfers"]
+
+
+def _host_memory_kind() -> str:
+    """"pinned_host" where the backend exposes it (TPU; newer CPU jax),
+    else the device's host-most kind (older XLA:CPU only advertises
+    "unpinned_host" — functionally the same host residency for tests)."""
+    kinds = [m.kind for m in jax.devices()[0].addressable_memories()]
+    if "pinned_host" in kinds:
+        return "pinned_host"
+    for k in kinds:
+        if "host" in k:
+            return k
+    return jax.devices()[0].default_memory().kind
+
+
+def _device_memory_kind() -> str:
+    """"device" where the backend has distinct device memory (TPU);
+    older XLA:CPU has a single host memory — use its default kind."""
+    kinds = [m.kind for m in jax.devices()[0].addressable_memories()]
+    return "device" if "device" in kinds else jax.devices()[0].default_memory().kind
 
 
 def host_sharding(sharding=None):
     """The pinned-host twin of a (device) sharding."""
     if sharding is None:
         return SingleDeviceSharding(jax.devices()[0],
-                                    memory_kind="pinned_host")
-    return sharding.with_memory_kind("pinned_host")
+                                    memory_kind=_host_memory_kind())
+    return sharding.with_memory_kind(_host_memory_kind())
 
 
 def supports_inline_transfers() -> bool:
@@ -97,8 +129,13 @@ def make_streamed_update(body, n_host: int, n_rest: int, host_sh, dev_sh,
                        out_shardings=out_shardings,
                        donate_argnums=donate)
 
-    body_jit = jax.jit(body, donate_argnums=donate)
-    dev_stage = host_sh.with_memory_kind("device")
+    # single-memory backends (older XLA:CPU): the host->device staging
+    # device_put is an alias, so donating the staged buffer would delete
+    # the caller's live array — skip donation there (tests only; TPU has
+    # distinct memories and keeps the donate path)
+    same_memory = _device_memory_kind() == _host_memory_kind()
+    body_jit = jax.jit(body, donate_argnums=() if same_memory else donate)
+    dev_stage = host_sh.with_memory_kind(_device_memory_kind())
 
     def upd_eager(*args):
         staged = [jax.device_put(a, dev_stage) for a in args[:n_host]]

@@ -199,7 +199,7 @@ def ring_attention(q: Tensor, k: Tensor, v: Tensor, group: Group, causal: bool =
     axis = group.axis_name
 
     def _f(qa, ka, va):
-        from ..pallas_kernels.flash_attention import _flash_lse
+        from ..pallas_kernels.flash_attention import _flash_lse, _pick_block
 
         b, s_loc, h, d = qa.shape
         scale = 1.0 / math.sqrt(d)
@@ -209,7 +209,12 @@ def ring_attention(q: Tensor, k: Tensor, v: Tensor, group: Group, causal: bool =
         # on the per-hop microbench, benchmarks/bench_ring_attention.py),
         # and the hops' NORMALIZED partials merge exactly through their
         # log-sum-exps: out = sum_i out_i * exp(lse_i - lse_total).
-        bq = bk = min(1024, s_loc)
+        # _pick_block (same fix-up flash_attention() applies): the flash
+        # grids floor-divide by the block size, so a non-multiple s_loc
+        # (e.g. 1536 = 6144 over 4 ranks) with a raw min(1024, s_loc)
+        # block silently dropped tail rows/columns — wrong attention,
+        # no error (tests/test_sequence_parallel.py pins the regression).
+        bq = bk = _pick_block(s_loc, 1024)
 
         def to_bh(x):
             return jnp.moveaxis(x, 2, 1).reshape(b * h, s_loc, d)

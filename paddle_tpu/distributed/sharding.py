@@ -103,9 +103,12 @@ def _wrap_adamw_offload(optimizer, mesh: ProcessMesh, n: int):
     inner_acc = optimizer._acc
 
     def _host_sharding(shape):
+        from .offload import _host_memory_kind
+
         spec = (_shard_spec_for(shape, n, axis) if n > 1 else None) \
             or PartitionSpec()
-        return NamedSharding(mesh.jax_mesh, spec, memory_kind="pinned_host")
+        return NamedSharding(mesh.jax_mesh, spec,
+                             memory_kind=_host_memory_kind())
 
     def offloaded_acc(name, p, init=jnp.zeros_like):
         created = id(p) not in optimizer._accumulators.get(name, {})

@@ -39,7 +39,28 @@ def _act(opname, jfn):
 # backward mask instead of the input): neutral — XLA already avoids a
 # second activation round trip by rematerializing the mask in the fused
 # backward, so the plain rule stays.
-relu = _act("relu", jax.nn.relu)
+_relu_plain = _act("relu", jax.nn.relu)
+
+
+def relu(x, name=None):
+    # peephole: a frozen-stats fused conv+BN output (see fused_conv_bn)
+    # carries a re-dispatch closure that puts THIS relu inside the Pallas
+    # epilogue; under jit the relu-less fused call is dead code, so the
+    # whole Conv2D->BatchNorm->ReLU block becomes one kernel.
+    rerun = getattr(x, "_fused_relu_rerun", None)
+    if rerun is not None:
+        return rerun()
+    out = _relu_plain(x)
+    pending = getattr(x, "_fused_bn_pending", None)
+    if pending is not None and not pending[-1]:
+        # training-mode chain fusion: record that a ReLU sits between the
+        # fused BN and its consumer, so the next fused conv's prologue
+        # applies it in VMEM (this materialized relu is then dead code)
+        out._fused_bn_pending = pending[:-1] + (True,)
+    return out
+
+
+relu.__name__ = "relu"
 relu6 = _act("relu6", jax.nn.relu6)
 sigmoid = _act("sigmoid", jax.nn.sigmoid)
 tanh = _act("tanh", jnp.tanh)
@@ -724,6 +745,10 @@ def _bn_train_fwd(a, w, b, axes, epsilon):
         # bandwidth-bound TPU conv step this halves the stat-pass HBM
         # traffic. Half-precision inputs can't carry means large enough
         # for the cancellation to matter beyond their own resolution.
+        # Accepted variance tolerance vs the two-pass form is DOCUMENTED
+        # and pinned in tests/test_nn.py::TestNorms::
+        # test_batch_norm_bf16_single_pass_stats_tolerance (5e-4 at
+        # mean/std=10, 6e-2 at 100).
         af = a.astype(jnp.float32)
         m = jnp.mean(af, axis=axes, keepdims=True)
         ex2 = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
@@ -829,6 +854,75 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
         return out
 
     return apply_op("batch_norm", _f2, *tensors)
+
+
+def fused_conv_bn(x, conv_weight, running_mean, running_var, weight, bias,
+                  training=False, momentum=0.9, epsilon=1e-05,
+                  use_global_stats=None, relu=False, name=None) -> Tensor:
+    """Conv2D+BatchNorm(+ReLU) through the Pallas fused kernels
+    (pallas_kernels/fused_conv.py). NHWC only; the conv must be a dense
+    stride-1 3x3(pad 1) or 1x1(pad 0) with no bias — callers (the
+    BatchNorm dispatch hook in layers_conv_norm.py) qualify shapes
+    first. Semantics match batch_norm applied to conv2d's output,
+    including the host-side running-stat update in training mode."""
+    from ..pallas_kernels import fused_conv as fc
+
+    x, wconv = ensure_tensor(x), ensure_tensor(conv_weight)
+    rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
+    g, b = ensure_tensor(weight), ensure_tensor(bias)
+    if x.ndim != 4 or wconv._data.shape[2] not in (1, 3):
+        raise ValueError("fused_conv_bn: NHWC 4-D input with a 3x3 or 1x1 "
+                         f"OIHW weight required, got x.ndim={x.ndim} "
+                         f"w={tuple(wconv._data.shape)}")
+
+    if training and not use_global_stats:
+        eps = float(epsilon)
+        pending = getattr(x, "_fused_bn_pending", None)
+        if pending is not None:
+            # CHAIN fusion: the input is itself a fused conv+BN(+ReLU)
+            # output — consume the upstream conv's RAW output and run its
+            # BN normalize(+ReLU) as the kernel's VMEM prologue. The
+            # normalized tensor the model passed in is then dead code
+            # under jit (nothing else reads it), so it never hits HBM.
+            co_p, m_p, v_p, gp, bp, eps_p, relu_in = pending
+
+            def _f(cp, mp, vp, gpp, bpp, wc, gg, bb):
+                co, bm, bv = fc.conv_stats_pre(cp, mp, vp, gpp, bpp, wc,
+                                               relu_in, eps_p)
+                return fc.bn_apply(co, bm, bv, gg, bb, eps), co, bm, bv
+
+            y, co_t, bm, bv = apply_op("fused_conv_bn_train", _f, co_p, m_p,
+                                       v_p, gp, bp, wconv, g, b, nouts=4)
+        else:
+            def _f(a, wc, gg, bb):
+                co, bm, bv = fc.conv_stats(a, wc)
+                return fc.bn_apply(co, bm, bv, gg, bb, eps), co, bm, bv
+
+            y, co_t, bm, bv = apply_op("fused_conv_bn_train", _f, x, wconv,
+                                       g, b, nouts=4)
+        rm._data = momentum * rm._data + (1 - momentum) * bm._data.astype(rm._data.dtype)
+        rv._data = momentum * rv._data + (1 - momentum) * bv._data.astype(rv._data.dtype)
+        # offer THIS unit's raw output + stats to the next qualifying conv
+        y._fused_bn_pending = (co_t, bm, bv, g, b, eps, False)
+        return y
+
+    mconst = rm._data.astype(jnp.float32)
+    vconst = rv._data.astype(jnp.float32)
+
+    def _f2(a, wc, gg, bb, _relu=relu):
+        scale = gg.astype(jnp.float32) * jax.lax.rsqrt(vconst + epsilon)
+        shift = bb.astype(jnp.float32) - mconst * scale
+        return fc.fused_conv_bn_eval(a, wc, scale, shift, _relu)
+
+    out = apply_op("fused_conv_bn_eval", _f2, x, wconv, g, b)
+    if not relu:
+        # let a following F.relu re-dispatch with the relu INSIDE the
+        # epilogue (the relu-less call becomes dead code under jit)
+        out._fused_relu_rerun = lambda: apply_op(
+            "fused_conv_bn_eval",
+            lambda a, wc, gg, bb: _f2(a, wc, gg, bb, True),
+            x, wconv, g, b)
+    return out
 
 
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05, data_format="NCHW", name=None) -> Tensor:

@@ -20,6 +20,47 @@ def _pair(v, n=2):
     return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
 
 
+# ---------------------------------------------------------------------------
+# Conv2D -> BatchNorm -> ReLU fusion dispatch (pallas_kernels/fused_conv.py)
+#
+# A qualifying Conv2D tags its output with (input, layer); the consuming
+# BatchNorm sees the tag and dispatches the PAIR to the fused Pallas
+# kernel from the conv's INPUT — under jit the untagged conv output is
+# dead code, so XLA drops it and the block runs as one kernel (eval) or
+# conv+stats-in-epilogue (training). Anything that doesn't qualify never
+# gets tagged and takes the normal XLA path — automatic fallback.
+# Reference analogue: the conv+BN+act fusion passes feeding
+# phi/kernels/fusion/.
+# ---------------------------------------------------------------------------
+
+_FUSED_CONV_ENV = "PADDLE_TPU_FUSED_CONV"
+
+
+def fused_conv_enabled() -> bool:
+    """Env-gated: PADDLE_TPU_FUSED_CONV=1/0 forces it; default on for
+    TPU backends (where the kernel is compiled) and off on CPU (where
+    Pallas runs in the slow interpreter — tests opt in explicitly)."""
+    import os
+
+    v = os.environ.get(_FUSED_CONV_ENV)
+    if v is not None:
+        return v != "0"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _conv_tag_eligible(conv: "Conv2D", x) -> bool:
+    from ..pallas_kernels.fused_conv import conv_qualifies
+
+    return (conv._data_format == "NHWC" and conv.bias is None
+            and getattr(x, "ndim", 0) == 4
+            and str(x.dtype) in ("float32", "bfloat16")
+            and conv_qualifies(conv._kernel_size, conv._stride,
+                               _pair(conv._padding), conv._dilation,
+                               conv._groups))
+
+
 class _ConvNd(Layer):
     def __init__(self, in_channels, out_channels, kernel_size, nd, stride=1, padding=0,
                  dilation=1, groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None,
@@ -58,8 +99,11 @@ class Conv2D(_ConvNd):
                          groups, padding_mode, weight_attr, bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding, self._dilation,
-                        self._groups, self._data_format)
+        out = F.conv2d(x, self.weight, self.bias, self._stride, self._padding, self._dilation,
+                       self._groups, self._data_format)
+        if fused_conv_enabled() and _conv_tag_eligible(self, x):
+            out._fused_conv_src = (x, self)  # BatchNorm fusion peephole
+        return out
 
 
 class Conv1D(_ConvNd):
@@ -181,6 +225,17 @@ class _BatchNormBase(Layer):
         self.register_buffer("_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
 
     def forward(self, x):
+        src = getattr(x, "_fused_conv_src", None)
+        if (src is not None and self._data_format == "NHWC"
+                and self.weight is not None and self.bias is not None
+                and src[1]._out_channels == self._num_features):
+            conv_in, conv = src
+            return F.fused_conv_bn(conv_in, conv.weight, self._mean,
+                                   self._variance, self.weight, self.bias,
+                                   training=self.training,
+                                   momentum=self._momentum,
+                                   epsilon=self._epsilon,
+                                   use_global_stats=self._use_global_stats)
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
                             training=self.training, momentum=self._momentum, epsilon=self._epsilon,
                             data_format=self._data_format, use_global_stats=self._use_global_stats)

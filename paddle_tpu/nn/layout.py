@@ -115,10 +115,16 @@ def space_to_depth_stem(model: Layer, conv_attr: str = "conv1") -> Layer:
     import jax
     import jax.numpy as jnp
 
+    from .layers_conv_norm import _pair
+
     conv = getattr(model, conv_attr)
-    if (tuple(conv._kernel_size) != (7, 7) or tuple(conv._stride) != (2, 2)
-            or conv._padding != 3 or conv.weight.shape[1] != 3
-            or conv._groups != 1 or tuple(conv._dilation) != (1, 1)
+    # _ConvNd stores kernel/stride/dilation normalized but padding RAW
+    # (int or tuple) — normalize everything with _pair so the equivalent
+    # Conv2D(padding=(3, 3)) (or list forms) is accepted, not rejected
+    # against the int spelling (tests/test_layout.py pins the tuple form)
+    if (_pair(conv._kernel_size) != (7, 7) or _pair(conv._stride) != (2, 2)
+            or _pair(conv._padding) != (3, 3) or conv.weight.shape[1] != 3
+            or conv._groups != 1 or _pair(conv._dilation) != (1, 1)
             or conv._data_format != "NHWC"):
         raise ValueError(
             "space_to_depth_stem expects a channels-last 7x7 stride-2 "

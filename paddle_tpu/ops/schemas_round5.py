@@ -430,3 +430,55 @@ _S("moe_combine", _moe_combine_ref,
    [((2, 3, 4), "any"), ((6, 2), "prob"), ((2, 3), "int"), ((2, 3), "prob"),
     ((6, 2), "int")],
    api="distributed.moe.combine_tokens", grad=False, dtypes=("float32",))
+
+
+# ---------------------------------------------------------------------------
+# fused conv+BN (pallas_kernels/fused_conv.py). grad=False: the custom
+# VJPs reuse _bn_train_bwd + XLA conv vjps and are pinned exactly against
+# the unfused composition in tests/test_fused_conv.py; FD through the
+# interpret-mode Pallas conv is quadratic in tensor size.
+# ---------------------------------------------------------------------------
+
+
+def _np_conv_nhwc(x, w):
+    k, c, kh, kw = w.shape
+    pad = (kh - 1) // 2
+    xp = np.pad(x.astype(np.float64), ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    n, h, wd, _ = x.shape
+    out = np.zeros((n, h, wd, k), np.float64)
+    for di in range(kh):
+        for dj in range(kw):
+            out += xp[:, di:di + h, dj:dj + wd, :] @ w[:, :, di, dj].T.astype(np.float64)
+    return out
+
+
+def _fused_conv_bn_train_ref(x, wc, rm, rv, g, b):
+    co = _np_conv_nhwc(x, wc)
+    m = co.mean((0, 1, 2))
+    v = co.var((0, 1, 2))
+    y = (co - m) / np.sqrt(v + 1e-5) * g + b
+    return y.astype(np.float32)
+
+
+def _fused_conv_bn_eval_ref(x, wc, rm, rv, g, b):
+    y = (_np_conv_nhwc(x, wc) - rm) / np.sqrt(rv + 1e-5) * g + b
+    return y.astype(np.float32)
+
+
+_FUSED_CONV_TOL = {"float32": (5e-4, 5e-4), "bfloat16": (8e-2, 8e-2)}
+
+_S("fused_conv_bn_train", _fused_conv_bn_train_ref,
+   [((2, 4, 4, 8), "any"), ((8, 8, 3, 3), "small"), ((8,), "any"),
+    ((8,), "pos"), ((8,), "any"), ((8,), "any")],
+   api="nn.functional.fused_conv_bn", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FUSED_CONV_TOL,
+   wrap=lambda api: lambda x, wc, rm, rv, g, b: api(
+       x, wc, rm, rv, g, b, training=True))
+
+_S("fused_conv_bn_eval", _fused_conv_bn_eval_ref,
+   [((2, 4, 4, 8), "any"), ((8, 8, 1, 1), "small"), ((8,), "any"),
+    ((8,), "pos"), ((8,), "any"), ((8,), "any")],
+   api="nn.functional.fused_conv_bn", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FUSED_CONV_TOL,
+   wrap=lambda api: lambda x, wc, rm, rv, g, b: api(
+       x, wc, rm, rv, g, b, training=False))

@@ -6,3 +6,4 @@ CUDA kernels) and the dynloaded flash-attention library
 """
 
 from .flash_attention import flash_attention
+from .fused_conv import fused_conv_bn_eval, fused_conv_bn_train

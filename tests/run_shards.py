@@ -32,6 +32,7 @@ import csv
 import os
 import subprocess
 import sys
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 MANIFEST = os.path.join(HERE, "testslist.csv")
@@ -55,11 +56,36 @@ TPU_LANE = [
     # reference op_test.py:2925 per-place discipline; ~345 s/shard cold,
     # fast on the persistent compile cache). Grad FD checks are sampled
     # (see the grad-policy note in test_op_schema_sweep.py).
+    ("test_fused_conv.py", 420, {}),  # Pallas conv+BN on-chip numerics
     *[(f"test_op_schema_sweep.py", 600,
        {"PADDLE_TPU_SWEEP_SHARD": f"{i}/8"}) for i in range(8)],
     # sampled FD-grad lane (every 16th schema incl. grads): ~2 s/op of
     # tunnel sync per FD evaluation — generous budget
     ("test_op_schema_sweep.py", 900, {"PADDLE_TPU_SWEEP_STRIDE": "16"}),
+]
+
+# Documented CPU-vs-TPU tolerance deltas the on-chip lane runs under.
+# Written into benchmarks/tpu_lane_results.json with every lane run so
+# the "full sweep on the real chip" claim is auditable (per-shard rc +
+# wall time) instead of builder-attested.
+TPU_TOLERANCE_DELTAS = [
+    {"where": "flash_attention / flash_attn_varlen",
+     "delta": "bf16-only on chip (fp32 operands fail Mosaic compilation — "
+              "the MXU path is half-precision operands with f32 "
+              "accumulation); CPU lane sweeps fp32 in interpret mode",
+     "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
+    {"where": "fused_conv_bn_train / fused_conv_bn_eval",
+     "delta": "bf16-only on chip, same MXU contract as flash attention",
+     "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
+    {"where": "power_to_db",
+     "delta": "5e-4 vs the CPU 1e-5 oracle tolerance (TPU log/pow "
+              "transcendental rounding)",
+     "source": "COVERAGE.md round-5 notes"},
+    {"where": "fp32 matmul ops (whole sweep)",
+     "delta": "run with jax_default_matmul_precision=highest — TPU fp32 "
+              "dots otherwise default to a bf16-class mode (~1e-2 error) "
+              "that would void the 1e-5 oracle comparisons",
+     "source": "tests/conftest.py"},
 ]
 
 
@@ -135,11 +161,36 @@ def run_pytest(files, budget, label, extra_env=None):
 
 
 def run_tpu_lane(slack: float) -> int:
+    """Run the on-chip lane and write benchmarks/tpu_lane_results.json
+    (per-shard rc, wall time, and the documented tolerance-delta list)
+    so the on-chip sweep claim is auditable, not builder-attested."""
+    import datetime
+    import json
+
     rc = 0
+    shards = []
     for f, timeout, extra in TPU_LANE:
-        rc |= run_pytest([f], int(timeout * slack), f"tpu-lane {f}",
-                         extra_env={"PADDLE_TPU_TEST_PLATFORM": "tpu",
-                                    **extra})
+        t0 = time.monotonic()
+        shard_rc = run_pytest([f], int(timeout * slack), f"tpu-lane {f}",
+                              extra_env={"PADDLE_TPU_TEST_PLATFORM": "tpu",
+                                         **extra})
+        shards.append({"file": f, "extra_env": extra, "rc": shard_rc,
+                       "wall_s": round(time.monotonic() - t0, 1),
+                       "budget_s": int(timeout * slack)})
+        rc |= shard_rc
+    out = {
+        "platform": "tpu",
+        "finished": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "overall_rc": rc,
+        "shards": shards,
+        "tolerance_deltas": TPU_TOLERANCE_DELTAS,
+    }
+    path = os.path.join(os.path.dirname(HERE), "benchmarks",
+                        "tpu_lane_results.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"[run_shards] tpu lane results -> {path} (rc={rc})", flush=True)
     return rc
 
 

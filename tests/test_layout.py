@@ -75,6 +75,25 @@ def test_space_to_depth_stem_exact_on_stem_output():
                                atol=1e-3, rtol=1e-3)
 
 
+def test_space_to_depth_stem_accepts_tuple_hyperparams():
+    """Regression (round-5 ADVICE): _ConvNd stores padding RAW, so an
+    equivalent Conv2D built with padding=(3, 3) (or list kernel/stride
+    forms) was rejected against the int spelling. The validation must
+    normalize with _pair and the transformed model must stay exact."""
+    m1, m2 = _pair_models()
+    to_channels_last(m2)
+    # same conv, tuple/list spellings of the same hyperparameters
+    m2.conv1._padding = (3, 3)
+    m2.conv1._stride = [2, 2]
+    m2.conv1._kernel_size = [7, 7]
+    space_to_depth_stem(m2)  # pre-fix: ValueError
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 3, 64, 64).astype(np.float32))
+    m1.eval(), m2.eval()
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(),
+                               atol=1e-3, rtol=1e-3)
+
+
 def test_space_to_depth_requires_channels_last():
     from paddle_tpu.vision.models import resnet18
 

@@ -56,7 +56,10 @@ if _os.environ.get("PADDLE_TPU_TEST_PLATFORM") == "tpu":
 # operands with f32 accumulation; production only ever feeds bf16). The
 # CPU lane sweeps fp32 against the oracle in interpret mode; the TPU
 # lane runs the bf16 case only — documented TPU-tolerance delta.
-_TPU_HALF_ONLY = {"flash_attention", "flash_attn_varlen"}
+_TPU_HALF_ONLY = {"flash_attention", "flash_attn_varlen",
+                  # same MXU contract as flash: bf16 operands / f32
+                  # accumulate (production dtype); fp32 swept on CPU
+                  "fused_conv_bn_train", "fused_conv_bn_eval"}
 
 
 def test_registry_is_populated():
