@@ -37,21 +37,47 @@ def _bf16_llama(model):
     model.llama.rope_sin._data = model.llama.rope_sin._data.astype(np.float32)
 
 
-def _timed(step_fn, steps, warmup):
+def _timed(step_fn, steps, warmup, *, entry="bench", items_per_step=None):
     """Warmup-skip timing window (reference profiler/timer.py ips
     semantics): run ``warmup`` steps, sync, time ``steps`` steps, sync.
-    Returns (elapsed_seconds, last_loss). The float() on the loss is the
-    synchronization point that bounds the measured window."""
+    Returns (elapsed_seconds, last_loss, step_records).
+
+    The timed window is driven through the profiler ips timer with an
+    observability.StepTelemetry attached, so every bench point emits the
+    per-step telemetry stream (step time, items/s, memory watermarks,
+    compile-count deltas) the BENCH artifact is derived from —
+    ``PADDLE_TPU_TELEMETRY_JSONL=path`` additionally lands one JSONL
+    line per step. The elapsed seconds are integrated from that stream;
+    the float() on the loss is the synchronization point that bounds the
+    measured window (executed INSIDE the last step so the stream total
+    covers the same window)."""
+    import os
+
+    from paddle_tpu import observability, profiler
+
     loss = None
     for _ in range(warmup):
         loss = step_fn()
     if loss is not None:
         _ = float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step_fn()
-    _ = float(loss)
-    return time.perf_counter() - t0, loss
+    st = observability.StepTelemetry(
+        entry=entry, jsonl_path=os.environ.get("PADDLE_TPU_TELEMETRY_JSONL"))
+    bm = profiler.benchmark()
+    wall0 = time.time()
+    bm.begin()
+    st.attach_benchmark()
+    try:
+        for i in range(steps):
+            loss = step_fn()
+            if i == steps - 1:
+                _ = float(loss)  # sync: the last record absorbs the drain
+            bm.step(items_per_step)
+    finally:
+        bm.end()
+        st.close()
+    recs = [r for r in st.records() if r["ts"] >= wall0]
+    dt = sum(r["step_time_s"] for r in recs) or 1e-9
+    return dt, loss, recs
 
 
 def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
@@ -77,7 +103,9 @@ def _run_config(paddle, cfg, batch, seq, steps, warmup, *, remat=False,
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
 
-    dt, loss = _timed(lambda: step.step(ids, labels), steps, warmup)
+    dt, loss, _recs = _timed(
+        lambda: step.step(ids, labels), steps, warmup,
+        entry=f"llama_h{cfg.hidden_size}_s{seq}", items_per_step=batch * seq)
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens_per_sec = batch * seq * steps / dt
@@ -140,7 +168,8 @@ def _run_offload_config(paddle):
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
     # warmup = one full accumulation cycle: compiles accum + per-shape updates
-    dt, loss = _timed(lambda: step.step(ids, labels), ACC, ACC)
+    dt, loss, _recs = _timed(lambda: step.step(ids, labels), ACC, ACC,
+                             entry="llama2b_offload", items_per_step=B * S)
     tps = B * S * ACC / dt
     fpt = 6 * n_params + 12 * cfg.num_hidden_layers * S * cfg.hidden_size
     return {
@@ -193,7 +222,8 @@ def _run_resnet50(paddle):
     # 30 timed steps: the tunnel's ~90ms result-fetch round trip is paid
     # once per window, so a short window understates device throughput
     steps, warmup = 30, 3
-    dt, loss = _timed(lambda: step.step(x, y), steps, warmup)
+    dt, loss, _recs = _timed(lambda: step.step(x, y), steps, warmup,
+                             entry="resnet50", items_per_step=B)
     images_per_sec = B * steps / dt
     from paddle_tpu.nn.layers_conv_norm import fused_conv_enabled
 
@@ -248,7 +278,8 @@ def _run_moe(paddle):
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
     # 60-step window: the tunnel's ~90 ms fetch is per-window; a short
     # window would understate device throughput by ~2%
-    dt, loss = _timed(lambda: step.step(ids, labels), 60, 4)
+    dt, loss, _recs = _timed(lambda: step.step(ids, labels), 60, 4,
+                             entry="moe", items_per_step=B * S)
     tps = B * S * 60 / dt
     n_total = n_expert = 0
     for name, p in model.named_parameters_dict().items():
@@ -303,6 +334,33 @@ def _run_decode(paddle, cfg, *, weight_only_int8=False, batch=16):
         "decode_tokens_per_sec": round(B * N / dt, 1),
         "ms_per_token": round(1e3 * dt / N, 3),
         "batch": B, "prompt": S, "new_tokens": N,
+    }
+
+
+def _telemetry_summary():
+    """Aggregates from the observability stream for the bench artifact:
+    compile counts/seconds, retraces, fused-conv dispatch outcomes —
+    the numbers BENCH_r*.json used to reconstruct by hand."""
+    from paddle_tpu import observability as obs
+
+    snap = obs.snapshot()
+    fams = snap["metrics"]
+
+    def series(name):
+        fam = fams.get(name)
+        return fam["samples"] if fam else []
+
+    return {
+        "compiles_total": int(sum(
+            s["value"] for s in series("paddle_tpu_compiles_total"))),
+        "compile_seconds_total": round(sum(
+            s.get("sum", 0.0) for s in series("paddle_tpu_compile_seconds")), 2),
+        "retraces_total": int(sum(
+            s["value"] for s in series("paddle_tpu_retraces_total"))),
+        "fused_conv_dispatch": {
+            "/".join(s["labels"].values()): int(s["value"])
+            for s in series("paddle_tpu_fused_conv_dispatch_total")},
+        "steps_recorded": len(snap["steps"]),
     }
 
 
@@ -471,6 +529,11 @@ def main():
 
         # (the old seq16384 fwd+bwd capability assert is superseded by
         # the measured detail["seq16384"] train-step point above)
+
+    try:
+        detail["telemetry"] = _telemetry_summary()
+    except Exception as e:  # noqa: BLE001 — the bench must still print
+        detail["telemetry_error"] = f"{type(e).__name__}: {e}"[:200]
 
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",

@@ -1,17 +1,41 @@
 """Summarize an xplane trace: top HLO ops by self time + category totals.
 
-Usage: python benchmarks/xprof_top.py /tmp/trace_dir [N]
+Usage: python benchmarks/xprof_top.py /tmp/trace_dir [N] [--json]
+
+``--json`` prints one machine-readable JSON object (category totals +
+top ops) so CI can diff category totals between runs instead of parsing
+the human table.
 """
+import argparse
 import glob
 import json
 import sys
 from collections import defaultdict
 
-from xprof.convert import raw_to_tool_data as rtd
+
+def _die(msg: str) -> "NoReturn":
+    print(f"xprof_top: {msg}", file=sys.stderr)
+    raise SystemExit(2)
 
 
 def load(trace_dir):
-    f = glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb")
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError:
+        _die("the 'xprof' package is not installed in this environment.\n"
+             "  It ships with tensorboard-plugin-profile / the TPU "
+             "tooling image;\n"
+             "  install it (pip install xprof) or run this script where "
+             "the profile\n  tooling is available. The raw trace itself "
+             "is readable in TensorBoard.")
+    pattern = f"{trace_dir}/plugins/profile/*/*.xplane.pb"
+    f = glob.glob(pattern)
+    if not f:
+        _die(f"no xplane trace found under {pattern!r}.\n"
+             "  Expected the directory passed to "
+             "Profiler.start_device_trace(log_dir)\n"
+             "  (or jax.profiler.start_trace) AFTER a stop_device_trace/"
+             "stop_trace —\n  the .xplane.pb file is written on stop.")
     data, _ = rtd.xspace_to_tool_data(f, "hlo_stats", {})
     d = json.loads(data)
     cols = [c["id"] for c in d["cols"]]
@@ -19,24 +43,57 @@ def load(trace_dir):
     return rows
 
 
-def main():
-    trace_dir = sys.argv[1]
-    n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
-    rows = load(trace_dir)
+def summarize(rows, n):
     total = sum(r["total_self_time"] for r in rows)
     cats = defaultdict(float)
     for r in rows:
         cats[r["category"]] += r["total_self_time"]
-    print(f"total device self time: {total/1e3:.2f} ms")
+    rows = sorted(rows, key=lambda r: -r["total_self_time"])
+    return {
+        "total_self_time_ms": round(total / 1e3, 3),
+        "categories": {c: round(t / 1e3, 3)
+                       for c, t in sorted(cats.items(), key=lambda kv: -kv[1])},
+        "top_ops": [
+            {"self_time_ms": round(r["total_self_time"] / 1e3, 3),
+             "pct": round(100 * r["total_self_time"] / total, 1) if total else 0.0,
+             "occurrences": r["occurrences"],
+             "category": r["category"],
+             "expression": r["hlo_op_expression"][:110].replace("\n", " ")}
+            for r in rows[:n]
+        ],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Top HLO ops / category totals from an xplane trace")
+    ap.add_argument("trace_dir")
+    ap.add_argument("n", nargs="?", type=int, default=25,
+                    help="how many top ops to show (default 25)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON object (CI-diffable) instead of "
+                         "the table")
+    args = ap.parse_args()
+
+    rows = load(args.trace_dir)
+    if not rows:
+        _die("the trace parsed but contains no HLO rows (empty capture? "
+             "profile a window that executes device computations)")
+    s = summarize(rows, args.n)
+
+    if args.json:
+        print(json.dumps(s, indent=1))
+        return
+
+    total = s["total_self_time_ms"]
+    print(f"total device self time: {total:.2f} ms")
     print("\n-- by category --")
-    for c, t in sorted(cats.items(), key=lambda kv: -kv[1]):
-        print(f"{c:<32}{t/1e3:>10.2f} ms {100*t/total:>6.1f}%")
+    for c, t in s["categories"].items():
+        print(f"{c:<32}{t:>10.2f} ms {100*t/total if total else 0:>6.1f}%")
     print("\n-- top ops by self time --")
-    rows.sort(key=lambda r: -r["total_self_time"])
-    for r in rows[:n]:
-        expr = r["hlo_op_expression"][:110].replace("\n", " ")
-        print(f"{r['total_self_time']/1e3:>9.2f} ms {100*r['total_self_time']/total:>5.1f}%"
-              f" x{r['occurrences']:<4} {r['category']:<22} {expr}")
+    for r in s["top_ops"]:
+        print(f"{r['self_time_ms']:>9.2f} ms {r['pct']:>5.1f}%"
+              f" x{r['occurrences']:<4} {r['category']:<22} {r['expression']}")
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ from .core import device
 from .core.device import CPUPlace, CUDAPlace, TPUPlace, get_device, is_compiled_with_cuda, set_device
 
 from . import amp, autograd, distribution, fft, hub, io, jit, linalg, metric, nn, optimizer, profiler, vision
+from . import observability
 from . import hapi
 from .hapi import Model, callbacks, summary
 from .core import memory

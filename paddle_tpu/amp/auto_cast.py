@@ -17,7 +17,17 @@ from typing import Optional
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
+from ..observability.metrics import _ENABLED as _obs_on
+from ..observability.metrics import counter as _obs_counter
 from ..ops import dispatch as _dispatch
+
+# Ops routed through autocast while enabled, by list decision — the
+# fleet counter that shows whether AMP is actually biting (a model whose
+# matmuls all land in "black"/"promote" is silently running fp32).
+_amp_ops = _obs_counter(
+    "paddle_tpu_amp_autocast_ops_total",
+    "op dispatches seen by the AMP autocast hook while enabled, by "
+    "list decision", ("list",))
 
 # O1 lists (subset of reference amp_lists.py FP16_WHITE_LIST / BLACK_LIST).
 white_list = {
@@ -52,13 +62,19 @@ def _amp_hook(op_name: str, datas):
     wl = (white_list | st.custom_white) - st.custom_black
     bl = (black_list | st.custom_black) - st.custom_white
     if op_name in wl:
+        if _obs_on[0]:
+            _amp_ops.labels("white").inc()
         return [d.astype(st.dtype) if d.dtype in (jnp.float32, jnp.float16, jnp.bfloat16) and d.dtype != st.dtype else d
                 for d in datas]
     if op_name in bl:
+        if _obs_on[0]:
+            _amp_ops.labels("black").inc()
         return [d.astype(jnp.float32) if d.dtype in (jnp.float16, jnp.bfloat16) else d for d in datas]
     # gray zone: promote to widest float among inputs
     fdts = [d.dtype for d in datas if d.dtype in (jnp.float16, jnp.bfloat16, jnp.float32)]
     if fdts and any(dt == jnp.float32 for dt in fdts) and any(dt != jnp.float32 for dt in fdts):
+        if _obs_on[0]:
+            _amp_ops.labels("promote").inc()
         return [d.astype(jnp.float32) if d.dtype in (jnp.float16, jnp.bfloat16) else d for d in datas]
     return datas
 

@@ -24,6 +24,19 @@ from typing import Callable, Dict, List, Optional
 
 __all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager", "watch_async"]
 
+from ..observability.metrics import _ENABLED as _obs_on
+from ..observability.metrics import counter as _obs_counter
+
+# Watchdog expiries/aborts are THE fleet hang signal (reference:
+# CommTaskManager's async trace dump is file-only) — counted per
+# collective name so a rank-skew pattern is visible in one scrape.
+_wd_timeouts = _obs_counter(
+    "paddle_tpu_watchdog_timeouts_total",
+    "collectives that exceeded their watchdog deadline", ("name",))
+_wd_aborts = _obs_counter(
+    "paddle_tpu_watchdog_aborts_total",
+    "abort hooks invoked after a collective timeout", ("name",))
+
 
 @dataclass
 class CommTask:
@@ -125,7 +138,11 @@ class CommTaskManager:
                     t.timed_out = True
                 t.error = self._dump_state(t)
                 self.timeout_history.append(t)
+                if _obs_on[0]:
+                    _wd_timeouts.labels(t.name).inc()
                 for hook in self._abort_hooks:
+                    if _obs_on[0]:
+                        _wd_aborts.labels(t.name).inc()
                     try:
                         hook(t)
                     except Exception:

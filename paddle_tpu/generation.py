@@ -22,6 +22,7 @@ import numpy as np
 
 from .core.autograd import no_grad
 from .core.tensor import Tensor
+from .observability.recompile import entrypoint as _entrypoint
 from .utils.functional import functional_call
 
 __all__ = ["GenerationConfig", "generate", "generate_uncached",
@@ -244,22 +245,26 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     pb = {**params, **buffers}
     key = jax.random.PRNGKey(cfg.seed)
 
-    if loop_mode == "scan" and cfg.max_new_tokens > 1:
-        return Tensor(generate_program(pb, ids, key))
+    # recompile-monitor attribution: prefill/step/whole-program compiles
+    # charge to this entry; a compile after the first completed generate
+    # (new B/S/N or config) is surfaced as a retrace
+    with _entrypoint("generation.generate"):
+        if loop_mode == "scan" and cfg.max_new_tokens > 1:
+            return Tensor(generate_program(pb, ids, key))
 
-    caches = make_caches()
-    last_logits, caches = prefill(pb, ids, caches)
-    key, sub = jax.random.split(key)
-    token = _select_token(last_logits, cfg, sub)
-
-    out = [token]
-    for i in range(1, cfg.max_new_tokens):
+        caches = make_caches()
+        last_logits, caches = prefill(pb, ids, caches)
         key, sub = jax.random.split(key)
-        # pos as a traced scalar: one compiled step executable for all tokens
-        token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub)
-        out.append(token)
-    gen = jnp.stack(out, axis=1)  # [B, N]
+        token = _select_token(last_logits, cfg, sub)
 
-    if cfg.eos_token_id is not None:
-        gen = _mask_after_eos(gen, cfg.eos_token_id)
-    return Tensor(jnp.concatenate([ids, gen], axis=1))
+        out = [token]
+        for i in range(1, cfg.max_new_tokens):
+            key, sub = jax.random.split(key)
+            # pos as a traced scalar: one compiled step executable for all tokens
+            token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub)
+            out.append(token)
+        gen = jnp.stack(out, axis=1)  # [B, N]
+
+        if cfg.eos_token_id is not None:
+            gen = _mask_after_eos(gen, cfg.eos_token_id)
+        return Tensor(jnp.concatenate([ids, gen], axis=1))

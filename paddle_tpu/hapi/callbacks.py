@@ -167,6 +167,54 @@ class EarlyStopping(Callback):
                       f"{self.patience + 1} evals (best {self.best_value:.5f})")
 
 
+class TelemetryCallback(Callback):
+    """Per-step training telemetry into ``paddle_tpu.observability``:
+    one StepTelemetry record per train batch (step wall time, ips from
+    the batch size, device-memory watermarks, compile-count delta) —
+    surfaced by ``observability.snapshot()["steps"]`` and, when a JSONL
+    path is given (argument or ``PADDLE_TPU_TELEMETRY_JSONL``), appended
+    one line per step. Added by default in ``config_callbacks`` (cost:
+    a clock read + a memory_stats call per batch)."""
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 entry: str = "hapi.fit", record_memory: bool = True):
+        super().__init__()
+        self.jsonl_path = jsonl_path or os.environ.get(
+            "PADDLE_TPU_TELEMETRY_JSONL") or None
+        self.entry = entry
+        self.record_memory = record_memory
+        self._st = None
+
+    def on_train_begin(self, logs=None):
+        from ..observability import StepTelemetry
+
+        self._st = StepTelemetry(entry=self.entry,
+                                 jsonl_path=self.jsonl_path,
+                                 record_memory=self.record_memory)
+        self._st.mark()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self._st is not None:
+            self._st.mark()  # exclude between-epoch work (eval, ckpt)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._st is None:
+            return
+        extra = {}
+        loss = (logs or {}).get("loss")
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        if isinstance(loss, numbers.Number):
+            extra["loss"] = float(loss)
+        self._st.step(num_samples=self.params.get("batch_size"),
+                      extra=extra or None)
+
+    def on_train_end(self, logs=None):
+        if self._st is not None:
+            self._st.close()
+            self._st = None
+
+
 class LRScheduler(Callback):
     """Steps the optimizer's LRScheduler (reference hapi LRScheduler)."""
 
@@ -203,6 +251,8 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks = cbks + [LRScheduler()]
+    if mode == "train" and not any(isinstance(c, TelemetryCallback) for c in cbks):
+        cbks = cbks + [TelemetryCallback()]
     if not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
     lst = CallbackList(cbks)

@@ -19,6 +19,7 @@ from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..metric import Metric
 from ..nn.layer import Layer
+from ..observability.recompile import entrypoint as _entrypoint
 from ..ops.dispatch import ensure_tensor
 from .callbacks import config_callbacks
 
@@ -54,27 +55,32 @@ class Model:
     # -- single-batch ops (reference train_batch:713) ----------------------
     def train_batch(self, inputs, labels=None, update: bool = True):
         self.network.train()
-        inputs = [ensure_tensor(x) for x in _to_list(inputs)]
-        labels = [ensure_tensor(y) for y in _to_list(labels)]
-        outputs = self.network(*inputs)
-        outs = _to_list(outputs)
-        losses = self._compute_loss(outs, labels)
-        total = losses[0]
-        for l in losses[1:]:
-            total = total + l
-        total.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
-        metrics = self._update_metrics(outs, labels)
-        loss_vals = [float(l.numpy()) for l in losses]
+        # recompile-monitor attribution: the step's op compiles (or the
+        # jitted step program, if the network is to_static) charge here;
+        # compiles after the first completed batch — e.g. a drop_last=False
+        # partial final batch — are surfaced as retraces
+        with _entrypoint("hapi.Model.train_batch"):
+            inputs = [ensure_tensor(x) for x in _to_list(inputs)]
+            labels = [ensure_tensor(y) for y in _to_list(labels)]
+            outputs = self.network(*inputs)
+            outs = _to_list(outputs)
+            losses = self._compute_loss(outs, labels)
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            total.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            metrics = self._update_metrics(outs, labels)
+            loss_vals = [float(l.numpy()) for l in losses]
         return (loss_vals, metrics) if metrics else loss_vals
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         import paddle_tpu as paddle
 
-        with paddle.no_grad():
+        with paddle.no_grad(), _entrypoint("hapi.Model.eval_batch"):
             inputs = [ensure_tensor(x) for x in _to_list(inputs)]
             labels = [ensure_tensor(y) for y in _to_list(labels)]
             outs = _to_list(self.network(*inputs))

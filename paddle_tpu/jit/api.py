@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
+from ..observability.recompile import entrypoint as _entrypoint
 
 _tls = threading.local()
 
@@ -57,6 +58,11 @@ class StaticFunction:
     def __init__(self, fn: Callable, build_strategy=None, backend=None, donate_argnums=()):
         self._fn = fn
         self._sot = None  # set on first graph break (SOT-lite fallback)
+        # recompile-monitor attribution: compiles triggered while this
+        # entry dispatches are charged to it; a compile AFTER the first
+        # completed call is flagged as a retrace (shape/dtype churn)
+        self._entry_name = "to_static:" + getattr(
+            fn, "__qualname__", getattr(fn, "__name__", "fn"))
         functools.update_wrapper(self, fn, updated=[])
 
         # compiled control flow (reference: dy2static AST transformers):
@@ -87,6 +93,10 @@ class StaticFunction:
         return jax.jit(runner, donate_argnums=self._donate_argnums)
 
     def __call__(self, *args, **kwargs):
+        with _entrypoint(self._entry_name):
+            return self._call_impl(*args, **kwargs)
+
+    def _call_impl(self, *args, **kwargs):
         datas = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x, args,
                              is_leaf=lambda x: isinstance(x, Tensor))
         kw = jax.tree.map(lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
@@ -115,7 +125,7 @@ class StaticFunction:
                 # SOT-lite as before
                 self.uses_compiled_control_flow = False
                 self._jitted = self._build_jitted(self._fn)
-                return self(*args, **kwargs)
+                return self._call_impl(*args, **kwargs)
         out = self._sot(*datas, **kw)
         return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
 
@@ -153,6 +163,7 @@ class _LayerStaticWrapper:
 
     def __init__(self, layer):
         self._layer = layer
+        self._entry_name = "to_static:" + type(layer).__name__
 
         def runner(params, buffers, *datas, **kw):
             with _TraceScope(), no_grad():
@@ -172,7 +183,8 @@ class _LayerStaticWrapper:
         buffers = {k: v._data for k, v in self._layer.named_buffers_dict().items()}
         datas = [a._data if isinstance(a, Tensor) else a for a in args]
         kw = {k: (v._data if isinstance(v, Tensor) else v) for k, v in kwargs.items()}
-        out = self._jitted(params, buffers, *datas, **kw)
+        with _entrypoint(self._entry_name):
+            out = self._jitted(params, buffers, *datas, **kw)
         return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
 
 

@@ -165,12 +165,21 @@ def _pt_seq_norm(seq):
     - dicts and their views iterate in insertion order, so ``list(...)``
       reproduces python's semantics exactly (``for k in d`` yields keys;
       .values()/.items() likewise);
-    - a uniform numeric list/tuple stacks into an array and a uniform
-      same-shape Tensor list stacks into a Tensor — rows then read
-      through dynamic_index_in_dim, so a TRACED loop index (a tensor
-      break/continue mid-loop) stays compilable where a python list
-      would need int(tracer). A body that truly needs python scalars
-      fails at trace time and to_static retries the original function.
+    - a uniform same-shape Tensor list stacks into a Tensor — rows then
+      read through dynamic_index_in_dim, so a TRACED loop index (a
+      tensor break/continue mid-loop) stays compilable where a python
+      list would need int(tracer).
+
+    Numeric lists/tuples stay python sequences (ADVICE round-5 fix):
+    eagerly stacking them into a traced array turned every loop element
+    into a tracer, so a body using the element as a python int
+    (``range(n)``, list indexing, shape arithmetic) failed its trace
+    and dragged the WHOLE function onto the retry/fallback path. On the
+    positional-indexing path the elements stay python scalars; a loop
+    that develops a TRACED index (tensor break/continue switching to
+    lax) still reads numeric elements — _pt_seq_item lifts the sequence
+    to an array lazily at that point, scoping the cost to the loops
+    that need it.
 
     Sets stay undesugared (arbitrary iteration order is not worth
     freezing into a program) — _pt_seq_len declines them."""
@@ -180,11 +189,6 @@ def _pt_seq_norm(seq):
                           type({}.items()))):
         seq = list(seq)
     if isinstance(seq, (list, tuple)) and seq:
-        if all(isinstance(e, (int, float)) and not isinstance(e, bool)
-               for e in seq):
-            import numpy as _np
-
-            return jnp.asarray(_np.asarray(seq))
         if (all(isinstance(e, Tensor) for e in seq)
                 and len({(tuple(e.shape), str(e.dtype)) for e in seq}) == 1):
             return Tensor(jnp.stack([e._data for e in seq]),
@@ -237,12 +241,26 @@ def _pt_seq_first(seq, trip_count=None):
 
 def _pt_seq_item(seq, i):
     """seq[i] with a possibly-traced index: dynamic_index_in_dim for
-    tensors/arrays, plain indexing (concrete i) for python sequences."""
+    tensors/arrays, plain indexing (concrete i) for python sequences.
+
+    A python numeric sequence indexed by a TRACED i (a tensor
+    break/continue switched the loop to lax mid-stream) lifts to an
+    array at that point — the lazy form of the old eager numeric
+    stacking, paid only by loops that actually develop a traced index;
+    everyone else keeps python-int elements."""
     v = _unwrap(seq)
     if getattr(v, "shape", None) is not None and getattr(v, "ndim", None):
         out = jax.lax.dynamic_index_in_dim(v, jnp.asarray(i, jnp.int32), 0,
                                            keepdims=False)
         return Tensor(out, stop_gradient=True) if isinstance(seq, Tensor) else out
+    if (_is_traced(_unwrap(i)) and isinstance(seq, (list, tuple)) and seq
+            and all(isinstance(e, (int, float)) and not isinstance(e, bool)
+                    for e in seq)):
+        import numpy as _np
+
+        return jax.lax.dynamic_index_in_dim(
+            jnp.asarray(_np.asarray(seq)), jnp.asarray(i, jnp.int32), 0,
+            keepdims=False)
     return seq[int(i)]
 
 

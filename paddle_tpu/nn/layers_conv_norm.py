@@ -35,6 +35,22 @@ def _pair(v, n=2):
 
 _FUSED_CONV_ENV = "PADDLE_TPU_FUSED_CONV"
 
+# Fusion-peephole outcome counters (observability): the PR-1 dispatch is
+# a silent tag-and-DCE rewrite with automatic XLA fallback, so a shape
+# regression that disables the kernel family would otherwise be
+# invisible. hit = the consuming BatchNorm dispatched the fused Pallas
+# kernel (reason carries train/eval); fallback = the pair ran on the
+# plain XLA path (reason: disabled | ineligible | bn_mismatch). Under
+# jit these fire once per TRACE (the peephole is python-side); in eager
+# they fire per call.
+from ..observability.metrics import _ENABLED as _obs_on
+from ..observability.metrics import counter as _obs_counter
+
+_fc_dispatch = _obs_counter(
+    "paddle_tpu_fused_conv_dispatch_total",
+    "Conv2D->BatchNorm(->ReLU) fusion peephole outcomes",
+    ("result", "reason"))
+
 
 def fused_conv_enabled() -> bool:
     """Env-gated: PADDLE_TPU_FUSED_CONV=1/0 forces it; default on for
@@ -101,8 +117,13 @@ class Conv2D(_ConvNd):
     def forward(self, x):
         out = F.conv2d(x, self.weight, self.bias, self._stride, self._padding, self._dilation,
                        self._groups, self._data_format)
-        if fused_conv_enabled() and _conv_tag_eligible(self, x):
-            out._fused_conv_src = (x, self)  # BatchNorm fusion peephole
+        if fused_conv_enabled():
+            if _conv_tag_eligible(self, x):
+                out._fused_conv_src = (x, self)  # BatchNorm fusion peephole
+            elif _obs_on[0]:
+                _fc_dispatch.labels("fallback", "ineligible").inc()
+        elif _obs_on[0]:
+            _fc_dispatch.labels("fallback", "disabled").inc()
         return out
 
 
@@ -226,16 +247,22 @@ class _BatchNormBase(Layer):
 
     def forward(self, x):
         src = getattr(x, "_fused_conv_src", None)
-        if (src is not None and self._data_format == "NHWC"
-                and self.weight is not None and self.bias is not None
-                and src[1]._out_channels == self._num_features):
-            conv_in, conv = src
-            return F.fused_conv_bn(conv_in, conv.weight, self._mean,
-                                   self._variance, self.weight, self.bias,
-                                   training=self.training,
-                                   momentum=self._momentum,
-                                   epsilon=self._epsilon,
-                                   use_global_stats=self._use_global_stats)
+        if src is not None:
+            if (self._data_format == "NHWC"
+                    and self.weight is not None and self.bias is not None
+                    and src[1]._out_channels == self._num_features):
+                conv_in, conv = src
+                if _obs_on[0]:
+                    _fc_dispatch.labels(
+                        "hit", "train" if self.training else "eval").inc()
+                return F.fused_conv_bn(conv_in, conv.weight, self._mean,
+                                       self._variance, self.weight, self.bias,
+                                       training=self.training,
+                                       momentum=self._momentum,
+                                       epsilon=self._epsilon,
+                                       use_global_stats=self._use_global_stats)
+            if _obs_on[0]:
+                _fc_dispatch.labels("fallback", "bn_mismatch").inc()
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
                             training=self.training, momentum=self._momentum, epsilon=self._epsilon,
                             data_format=self._data_format, use_global_stats=self._use_global_stats)

@@ -1,0 +1,88 @@
+"""paddle_tpu.observability — the runtime's *metrics* half.
+
+The profiler (``paddle_tpu.profiler``) answers "where did this step's
+time go" with spans; this package answers the fleet questions — how
+often the fused-conv Pallas path fired vs. fell back to XLA, how many
+times each jitted entry point recompiled and for how long, what the
+per-step tokens/s and device-memory watermarks were — as cheap
+always-on counters with Prometheus/JSONL export.
+
+Layout:
+- ``metrics``:    thread-safe Counter/Gauge/Histogram registry (lock-free
+                  writer hot path — a deque append, no lock per op).
+- ``exporters``:  Prometheus text exposition, JSONL snapshots, opt-in
+                  stdlib http scrape endpoint (``start_http_server``).
+- ``recompile``:  jax.monitoring compile listeners + ``entrypoint``
+                  attribution + retrace warnings.
+- ``telemetry``:  ``StepTelemetry`` per-step records (step time, ips,
+                  memory watermarks, compile deltas) feeding the hapi
+                  callback and ``bench.py``.
+
+``snapshot()`` is the one-call view of all of it.
+
+Importing this package installs the jax.monitoring listeners (a list
+append inside jax; per-event cost is one callback). ``disable()``
+reduces every instrumentation site to a single list-index check.
+"""
+
+from __future__ import annotations
+
+import time
+
+from . import exporters, metrics, recompile, telemetry
+from .exporters import (parse_prometheus_text, prometheus_text,
+                        start_http_server, stop_http_server,
+                        write_jsonl_snapshot)
+from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                      MetricsRegistry, counter, gauge, get_registry,
+                      histogram)
+from .metrics import _ENABLED
+from .recompile import compile_events, current_entry, entry_stats, entrypoint
+from .telemetry import StepTelemetry, memory_watermarks, step_records
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "counter", "gauge", "histogram", "get_registry",
+    "prometheus_text", "parse_prometheus_text", "write_jsonl_snapshot",
+    "start_http_server", "stop_http_server",
+    "entrypoint", "current_entry", "compile_events", "entry_stats",
+    "StepTelemetry", "memory_watermarks", "step_records",
+    "snapshot", "enable", "disable", "enabled",
+]
+
+# Recompile monitoring is the subsystem's reason to exist; subscribe as
+# soon as the package is imported so no compile goes unattributed.
+recompile.install()
+
+
+def enable():
+    _ENABLED[0] = True
+
+
+def disable():
+    """Kill switch: instrumentation sites reduce to one flag check."""
+    _ENABLED[0] = False
+
+
+def enabled() -> bool:
+    return _ENABLED[0]
+
+
+def snapshot() -> dict:
+    """Full observability state as one JSON-ready dict:
+
+    - ``metrics``: every registered metric's samples (counters, gauges,
+      histograms with bucket counts),
+    - ``compile_events``: the recent-compile flight recorder
+      (entry, duration_s, ts),
+    - ``entries``: per-entry-point call/compile/retrace totals,
+    - ``steps``: the per-step telemetry ring (step time, ips, memory
+      watermarks, compile deltas).
+    """
+    return {
+        "ts": time.time(),
+        "metrics": get_registry().collect(),
+        "compile_events": compile_events(),
+        "entries": entry_stats(),
+        "steps": step_records(),
+    }

@@ -1,0 +1,224 @@
+"""Metric exporters: Prometheus text exposition, JSONL snapshots, and an
+opt-in stdlib ``http.server`` scrape endpoint.
+
+The Prometheus text format follows the exposition spec (``# HELP`` /
+``# TYPE`` headers, escaped label values, cumulative histogram buckets
+with an explicit ``+Inf`` le plus ``_sum``/``_count`` series).
+``parse_prometheus_text`` is the matching reader — used by the
+round-trip test and by anyone scraping the JSONL lane without a real
+Prometheus.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = [
+    "prometheus_text", "parse_prometheus_text", "write_jsonl_snapshot",
+    "start_http_server", "stop_http_server",
+]
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                     for k, v in sorted(items.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f.is_integer() and abs(f) < 2 ** 53 else repr(f)
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text exposition format."""
+    reg = registry or get_registry()
+    lines: List[str] = []
+    for m in sorted(reg.metrics(), key=lambda m: m.name):
+        lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for sample in m.collect():
+            labels = sample["labels"]
+            if m.kind == "histogram":
+                cum = 0
+                for le, c in zip(sample["buckets"], sample["counts"]):
+                    cum += c
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(labels, {'le': _fmt_value(le)})}"
+                        f" {cum}")
+                cum += sample["counts"][-1]
+                lines.append(f"{m.name}_bucket"
+                             f"{_fmt_labels(labels, {'le': '+Inf'})} {cum}")
+                lines.append(f"{m.name}_sum{_fmt_labels(labels)}"
+                             f" {_fmt_value(sample['sum'])}")
+                lines.append(f"{m.name}_count{_fmt_labels(labels)}"
+                             f" {sample['count']}")
+            else:
+                lines.append(f"{m.name}{_fmt_labels(labels)}"
+                             f" {_fmt_value(sample['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def _parse_labels(s: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    i = 0
+    while i < len(s):
+        eq = s.index("=", i)
+        name = s[i:eq].strip().lstrip(",").strip()
+        assert s[eq + 1] == '"', f"malformed label set: {s!r}"
+        j = eq + 2
+        buf = []
+        while s[j] != '"':
+            if s[j] == "\\":
+                nxt = s[j + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+            else:
+                buf.append(s[j])
+                j += 1
+        out[name] = "".join(buf)
+        i = j + 1
+    return out
+
+
+def parse_prometheus_text(text: str) -> Dict[str, dict]:
+    """Parse the exposition format back into
+    {name: {type, help, samples: [{labels, value}]}} — sample names keep
+    their ``_bucket``/``_sum``/``_count`` suffixes (series-level view),
+    grouped under the declared family name."""
+    families: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            families.setdefault(name, {"type": "untyped", "help": help_text,
+                                       "samples": []})
+            families[name]["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            families.setdefault(name, {"type": kind, "help": "",
+                                       "samples": []})
+            families[name]["type"] = kind
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        if "{" in line:
+            name = line[:line.index("{")]
+            rest = line[line.index("{") + 1:]
+            labels_s, _, value_s = rest.rpartition("} ")
+            labels = _parse_labels(labels_s)
+        else:
+            name, _, value_s = line.rpartition(" ")
+            labels = {}
+        value = float(value_s)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+                break
+        families.setdefault(family, {"type": "untyped", "help": "",
+                                     "samples": []})
+        families[family]["samples"].append(
+            {"series": name, "labels": labels, "value": value})
+    return families
+
+
+def write_jsonl_snapshot(path: str, registry: Optional[MetricsRegistry] = None,
+                         extra: Optional[dict] = None):
+    """Append ONE JSON line holding the full registry state (plus any
+    ``extra`` fields) — the flight-recorder export: a file of these lines
+    is a coarse time series a fleet log pipeline can ingest directly."""
+    reg = registry or get_registry()
+    rec = {"ts": time.time(), "metrics": reg.collect()}
+    if extra:
+        rec.update(extra)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Opt-in scrape endpoint (stdlib http.server; no third-party deps)
+# ---------------------------------------------------------------------------
+
+_server = None
+_server_thread = None
+_server_lock = threading.Lock()
+
+
+def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
+    """Serve ``/metrics`` (Prometheus text) and ``/snapshot`` (JSON) on a
+    daemon thread. Returns the bound port (``port=0`` picks a free one).
+    Opt-in only: nothing in the runtime starts this implicitly."""
+    global _server, _server_thread
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] == "/metrics":
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/snapshot":
+                from . import snapshot
+
+                body = json.dumps(snapshot()).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # no per-scrape stderr chatter
+            pass
+
+    with _server_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        _server = ThreadingHTTPServer((addr, port), _Handler)
+        _server_thread = threading.Thread(target=_server.serve_forever,
+                                          name="paddle-tpu-metrics",
+                                          daemon=True)
+        _server_thread.start()
+        return _server.server_address[1]
+
+
+def stop_http_server():
+    global _server, _server_thread
+    with _server_lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+            _server_thread = None

@@ -1,0 +1,185 @@
+"""Recompile monitor: attribute XLA compiles to jitted entry points.
+
+jax 0.4.x emits ``jax.monitoring`` events around every trace/compile —
+``/jax/core/compile/backend_compile_duration`` fires once per XLA
+compilation with its wall seconds, and the compilation-cache events
+(``/jax/compilation_cache/...``) mark cache traffic. This module
+subscribes listeners once and attributes each compile to the *runtime
+entry point* that triggered it: ``jit/api.py`` StaticFunction calls,
+``generation.generate``, and the hapi ``Model`` train/eval steps wrap
+their dispatch in ``entrypoint(name)``, which pushes the name onto a
+thread-local stack the listener reads (compiles happen synchronously on
+the dispatching thread).
+
+Retrace detection (reference pain point: silent per-shape program
+explosions): an entry point that compiles AFTER it has already completed
+a call is retracing — new input shapes/dtypes or an unstable cache key.
+Each such event increments ``paddle_tpu_retraces_total`` and logs a
+one-line warning (per entry, first occurrence) so a shape regression in
+a training loop is visible without a profiler run.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import metrics as _m
+
+__all__ = ["install", "installed", "entrypoint", "current_entry",
+           "compile_events", "total_compiles", "entry_stats", "reset_entries"]
+
+logger = logging.getLogger("paddle_tpu.observability")
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_UNATTRIBUTED = "<unattributed>"
+
+_tls = threading.local()
+_installed = [False]
+_install_lock = threading.Lock()
+
+# Bounded flight recorder of compile events (entry, event, duration_s, ts)
+_events: deque = deque(maxlen=512)
+# Per-entry call/compile bookkeeping for retrace detection
+_entries: Dict[str, dict] = {}
+_entries_lock = threading.Lock()
+
+_compiles = _m.counter(
+    "paddle_tpu_compiles_total",
+    "XLA backend compilations attributed to the triggering entry point",
+    ("entry",))
+_compile_seconds = _m.histogram(
+    "paddle_tpu_compile_seconds",
+    "XLA backend compile wall time per entry point", ("entry",))
+_retraces = _m.counter(
+    "paddle_tpu_retraces_total",
+    "compilations that happened AFTER an entry point had already "
+    "completed a call (unexpected retrace: shape/dtype churn)", ("entry",))
+_jax_events = _m.counter(
+    "paddle_tpu_jax_monitoring_events_total",
+    "raw jax.monitoring counter events (compilation cache traffic etc.)",
+    ("event",))
+
+
+def current_entry() -> str:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else _UNATTRIBUTED
+
+
+class entrypoint:
+    """Context manager marking the currently-dispatching entry point so
+    compile events attribute to it. Re-entrant; nesting attributes to the
+    innermost entry. Completing the ``with`` block counts one call —
+    the retrace detector's notion of "this entry is past warmup"."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+        if exc[0] is None:
+            st = _entry_state(self.name)
+            st["calls"] += 1
+        return False
+
+
+def _entry_state(name: str) -> dict:
+    st = _entries.get(name)
+    if st is None:
+        with _entries_lock:
+            st = _entries.setdefault(
+                name, {"calls": 0, "compiles": 0, "retraces": 0,
+                       "compile_seconds": 0.0, "warned": False})
+    return st
+
+
+def _on_duration(name: str, duration: float, **kwargs):
+    if not _m._ENABLED[0] or name != _COMPILE_EVENT:
+        return
+    try:
+        entry = current_entry()
+        _compiles.labels(entry).inc()
+        _compile_seconds.labels(entry).observe(duration)
+        _events.append({"entry": entry, "event": "backend_compile",
+                        "duration_s": duration, "ts": time.time()})
+        st = _entry_state(entry)
+        st["compiles"] += 1
+        st["compile_seconds"] += duration
+        if st["calls"] >= 1:
+            st["retraces"] += 1
+            _retraces.labels(entry).inc()
+            if not st["warned"]:
+                st["warned"] = True
+                logger.warning(
+                    "unexpected retrace: entry %r recompiled (%.3fs) after "
+                    "%d completed call(s) — input shapes/dtypes changed or "
+                    "the jit cache key is unstable (compile #%d)",
+                    entry, duration, st["calls"], st["compiles"])
+    except Exception:  # a metrics bug must never break a compile
+        logger.debug("recompile monitor listener failed", exc_info=True)
+
+
+def _on_event(name: str, **kwargs):
+    if not _m._ENABLED[0] or not name.startswith("/jax/"):
+        return
+    try:
+        _jax_events.labels(name).inc()
+    except Exception:
+        pass
+
+
+def install() -> bool:
+    """Register the jax.monitoring listeners (idempotent). Returns True
+    when running with a jax that exposes the monitoring API."""
+    if _installed[0]:
+        return True
+    with _install_lock:
+        if _installed[0]:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _installed[0] = True
+        return True
+
+
+def installed() -> bool:
+    return _installed[0]
+
+
+def compile_events() -> List[dict]:
+    """The bounded flight recorder: most recent compiles, oldest first."""
+    return list(_events)
+
+
+def total_compiles() -> int:
+    """Process-wide compile count (all entries) — cheap enough for the
+    per-step telemetry delta."""
+    return sum(st["compiles"] for st in list(_entries.values()))
+
+
+def entry_stats() -> Dict[str, dict]:
+    with _entries_lock:
+        return {k: dict(v) for k, v in _entries.items()}
+
+
+def reset_entries():
+    """Clear attribution state + the event recorder (tests)."""
+    with _entries_lock:
+        _entries.clear()
+    _events.clear()

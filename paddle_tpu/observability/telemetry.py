@@ -1,0 +1,199 @@
+"""Per-step training telemetry: one record per optimizer step.
+
+``StepTelemetry`` fuses three existing signals into a single stream:
+the wall step time (its own clock, or fed by the profiler's
+``_Benchmark`` ips timer via ``attach_benchmark``), the PJRT device
+memory watermarks (``memory_stats()`` live/peak bytes — absent on some
+CPU transports, recorded as null), and the recompile monitor's compile
+count (per-step delta, so a mid-training retrace shows up on exactly the
+step that paid for it). Each record lands in a bounded in-process ring
+(surfaced by ``observability.snapshot()``) and, when a path is given,
+as one JSONL line per step — the stream ``bench.py`` and the hapi
+``TelemetryCallback`` emit so BENCH numbers come from telemetry instead
+of ad-hoc prints.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Optional, Tuple
+
+from . import metrics as _m
+from . import recompile as _rc
+
+__all__ = ["StepTelemetry", "memory_watermarks", "record_memory_gauges",
+           "step_records", "clear_step_records"]
+
+# Process-wide ring of step records from every StepTelemetry instance;
+# snapshot() exposes it, run_shards merges it across shard processes.
+_STEP_RECORDS: deque = deque(maxlen=2048)
+
+_step_seconds = _m.histogram(
+    "paddle_tpu_step_seconds", "training/eval step wall time", ("entry",),
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 10.0, 30.0, 60.0))
+_ips_gauge = _m.gauge(
+    "paddle_tpu_ips", "items (samples/tokens) per second, latest step",
+    ("entry",))
+_live_bytes = _m.gauge(
+    "paddle_tpu_device_live_bytes",
+    "device bytes in use at the last recorded step")
+_peak_bytes = _m.gauge(
+    "paddle_tpu_device_peak_bytes",
+    "device peak bytes in use (process high-water mark)")
+_steps_total = _m.counter(
+    "paddle_tpu_steps_total", "telemetry-recorded steps", ("entry",))
+
+
+def memory_watermarks() -> Tuple[Optional[int], Optional[int]]:
+    """(live_bytes, peak_bytes) summed over devices via PJRT
+    ``memory_stats()``; (None, None) where the transport doesn't report
+    (CPU PJRT commonly returns nothing)."""
+    try:
+        import jax
+
+        live = peak = None
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                continue
+            if "bytes_in_use" in stats:
+                live = (live or 0) + int(stats["bytes_in_use"])
+            if "peak_bytes_in_use" in stats:
+                peak = (peak or 0) + int(stats["peak_bytes_in_use"])
+        return live, peak
+    except Exception:
+        return None, None
+
+
+def record_memory_gauges() -> Tuple[Optional[int], Optional[int]]:
+    """Read the watermarks AND publish them to the device-memory gauges
+    (the Profiler's profile_memory hook and StepTelemetry both use
+    this)."""
+    live, peak = memory_watermarks()
+    if live is not None:
+        _live_bytes.set(live)
+    if peak is not None:
+        _peak_bytes.set(peak)
+    return live, peak
+
+
+class StepTelemetry:
+    """Per-step recorder.
+
+    st = StepTelemetry(entry="train", jsonl_path="steps.jsonl")
+    loop: work; st.step(num_samples=batch)      # or tokens=batch*seq
+    st.close()
+
+    ``step()`` cost when idle-configured: a perf_counter read, a
+    memory_stats call, and a handful of deque appends — safe to leave on
+    in production loops (the reference ips timer already pays the clock
+    read)."""
+
+    def __init__(self, entry: str = "train", jsonl_path: Optional[str] = None,
+                 record_memory: bool = True):
+        self.entry = entry
+        self.jsonl_path = jsonl_path
+        self.record_memory = record_memory
+        self._fh = None
+        self._idx = 0
+        self._last = time.perf_counter()
+        self._compiles_seen = _rc.total_compiles()
+        self._bench = None
+
+    # -- feeding ------------------------------------------------------------
+    def step(self, num_samples: Optional[int] = None,
+             tokens: Optional[int] = None,
+             step_time: Optional[float] = None,
+             extra: Optional[dict] = None) -> dict:
+        """Record one step. ``step_time`` overrides the internal clock
+        (used when fed by the profiler benchmark timer)."""
+        now = time.perf_counter()
+        dt = step_time if step_time is not None else now - self._last
+        self._last = now
+        n = tokens if tokens is not None else num_samples
+        ips = (n / dt) if (n and dt > 0) else ((1.0 / dt) if dt > 0 else None)
+        compiles = _rc.total_compiles()
+        rec = {
+            "entry": self.entry, "step": self._idx, "ts": time.time(),
+            "step_time_s": dt,
+            "ips": ips,
+            "unit": "tokens" if tokens is not None else "samples",
+            "compile_count_delta": compiles - self._compiles_seen,
+        }
+        if num_samples is not None or tokens is not None:
+            rec["num_items"] = n
+        if self.record_memory:
+            live, peak = record_memory_gauges()
+            rec["live_bytes"] = live
+            rec["peak_bytes"] = peak
+        if extra:
+            rec.update(extra)
+        self._compiles_seen = compiles
+        self._idx += 1
+
+        _steps_total.labels(self.entry).inc()
+        _step_seconds.labels(self.entry).observe(dt)
+        if ips is not None:
+            _ips_gauge.labels(self.entry).set(ips)
+        _STEP_RECORDS.append(rec)
+        if self.jsonl_path:
+            if self._fh is None:
+                self._fh = open(self.jsonl_path, "a")
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        return rec
+
+    def mark(self):
+        """Reset the step clock without recording (start of a timed
+        window: excludes setup/warmup from the first step's time)."""
+        self._last = time.perf_counter()
+        self._compiles_seen = _rc.total_compiles()
+
+    # -- profiler benchmark-timer integration --------------------------------
+    def attach_benchmark(self):
+        """Feed this recorder from the existing profiler ips timer
+        (``profiler._Benchmark``): every ``benchmark().step(n)`` forwards
+        its measured step time + sample count here, so a loop already
+        instrumented with the reference-shaped timer gets telemetry for
+        free. Detach with ``detach_benchmark``."""
+        from .. import profiler as _prof
+
+        _prof._telemetry_sink[0] = self
+        self._bench = _prof
+        self.mark()
+        return self
+
+    def detach_benchmark(self):
+        if self._bench is not None:
+            self._bench._telemetry_sink[0] = None
+            self._bench = None
+
+    # -- results -------------------------------------------------------------
+    def records(self):
+        return [r for r in list(_STEP_RECORDS) if r["entry"] == self.entry]
+
+    def close(self):
+        self.detach_benchmark()
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        self.mark()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def step_records():
+    return list(_STEP_RECORDS)
+
+
+def clear_step_records():
+    _STEP_RECORDS.clear()

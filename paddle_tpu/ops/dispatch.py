@@ -30,6 +30,16 @@ from ..core.autograd import Edge, GradNode, is_grad_enabled
 from ..core.flags import flag
 from ..core.tensor import Tensor
 from ..profiler import _recording as _prof_recording  # shared mutable flag; zero-cost check
+from ..observability.metrics import _ENABLED as _obs_on  # same zero-cost pattern
+from ..observability.metrics import counter as _obs_counter
+
+# NaN/Inf-check trips (FLAGS_check_nan_inf parity): every detection is a
+# fleet-visible counter, not just a print/raise. Incremented only on the
+# (rare) trip path — never on the per-op hot path.
+_nan_trips = _obs_counter(
+    "paddle_tpu_nan_check_trips_total",
+    "ops whose output tripped the NaN/Inf finite check "
+    "(FLAGS_check_nan_inf)", ("op",))
 
 # Set by paddle_tpu.amp at import; signature: (op_name, [jax arrays]) -> [jax arrays]
 _amp_cast_hook: Optional[Callable] = None
@@ -208,6 +218,8 @@ def _check_finite(name: str, arrays):
     for a in arrays:
         if dtypes.is_floating_point(a.dtype):
             if not bool(jnp.isfinite(a).all()):
+                if _obs_on[0]:
+                    _nan_trips.labels(name).inc()
                 if flag("check_nan_inf_level") >= 1:
                     print(f"[check_nan_inf] WARNING: op {name} produced NaN/Inf")
                 else:

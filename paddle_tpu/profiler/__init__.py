@@ -65,6 +65,11 @@ _py_events: List[tuple] = []  # fallback when no native tracer
 _py_events_lock = threading.Lock()
 _recording = [False]  # single source of truth; dispatch.py imports this list
 
+# observability.StepTelemetry installs itself here (attach_benchmark) so
+# the ips timer's per-step measurements feed the telemetry stream; the
+# None check is the whole cost when nothing is attached.
+_telemetry_sink = [None]
+
 
 class RecordEvent:
     """Span context manager/decorator (reference event_tracing.h RecordEvent).
@@ -237,12 +242,24 @@ class Profiler:
             self.scheduler = scheduler
         self.on_trace_ready = on_trace_ready
         self.timer_only = timer_only
+        self.profile_memory = profile_memory
         self._ring_capacity = ring_capacity
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._events: List[Dict] = []
+        self._mem_records: List[Dict] = []
         self._device_trace_dir: Optional[str] = None
         self._timer = benchmark()
+
+    def _record_memory(self):
+        """profile_memory=True: device live/peak bytes at this step, into
+        the observability watermark gauges + a per-step record that
+        summary() renders."""
+        from ..observability.telemetry import record_memory_gauges
+
+        live, peak = record_memory_gauges()
+        self._mem_records.append(
+            {"step": self.step_num, "live_bytes": live, "peak_bytes": peak})
 
     # -- state machine -----------------------------------------------------
     def start(self):
@@ -278,6 +295,8 @@ class Profiler:
 
     def step(self, num_samples: Optional[int] = None):
         self._timer.step(num_samples)
+        if self.profile_memory:
+            self._record_memory()
         if self.timer_only:
             return
         if self.current_state == ProfilerState.RECORD_AND_RETURN:
@@ -333,7 +352,27 @@ class Profiler:
         for r in rows:
             lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>14.1f}{r[3]:>12.1f}"
                          f"{r[4]:>12.1f}{r[5]:>12.1f}")
+        if self._mem_records:
+            mb = 1.0 / 2 ** 20
+            known = [r for r in self._mem_records
+                     if r["peak_bytes"] is not None]
+            lines.append("")
+            lines.append(f"{'Device memory (profile_memory=True)':<40}"
+                         f"{'Steps':>8}{'Peak(MB)':>14}{'LastLive(MB)':>14}")
+            if known:
+                peak = max(r["peak_bytes"] for r in known)
+                live = next((r["live_bytes"] for r in reversed(known)
+                             if r["live_bytes"] is not None), 0) or 0
+                lines.append(f"{'':<40}{len(self._mem_records):>8}"
+                             f"{peak * mb:>14.1f}{live * mb:>14.1f}")
+            else:
+                lines.append(f"{'':<40}{len(self._mem_records):>8}"
+                             f"{'n/a (PJRT memory_stats unsupported)':>28}")
         return "\n".join(lines)
+
+    def memory_records(self) -> List[Dict]:
+        """Per-step device-memory watermarks (profile_memory=True)."""
+        return list(self._mem_records)
 
     # -- device (XLA/PJRT) traces -------------------------------------------
     def start_device_trace(self, log_dir: str):
@@ -376,9 +415,13 @@ class _Benchmark:
         if not self._running:
             return
         now = time.perf_counter()
-        self._step_times.append(now - self._last)
+        dt = now - self._last
+        self._step_times.append(dt)
         self._samples.append(num_samples)
         self._last = now
+        sink = _telemetry_sink[0]
+        if sink is not None:
+            sink.step(num_samples=num_samples, step_time=dt)
 
     def end(self):
         self._running = False

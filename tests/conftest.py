@@ -69,6 +69,17 @@ def pytest_sessionfinish(session, exitstatus):
     if dump:
         with open(f"{dump}.{os.getpid()}", "w") as fh:
             fh.write("\n".join(sorted(_RECORDED_NAMES)))
+    # observability snapshot per shard process: run_shards merges these
+    # into benchmarks/telemetry_lane.json (fused-conv hit rates, compile
+    # counts) next to tpu_lane_results.json
+    tdump = os.environ.get("PADDLE_TPU_TELEMETRY_DUMP")
+    if tdump:
+        import json
+
+        from paddle_tpu import observability
+
+        with open(f"{tdump}.{os.getpid()}.json", "w") as fh:
+            json.dump(observability.snapshot(), fh)
     strays = {
         n for n in _RECORDED_NAMES
         if n not in SCHEMAS and n not in NO_SCHEMA_WHITE_LIST

@@ -143,6 +143,97 @@ def merge_dispatch_records(dump_prefix):
     return 0
 
 
+def setup_telemetry_dump() -> str:
+    """Point every shard process's conftest at a per-pid observability
+    snapshot dump; stale dumps from an interrupted run are cleared so
+    they can't leak into this run's merge."""
+    import glob
+
+    prefix = os.path.join(HERE, ".telemetry_snap")
+    os.environ["PADDLE_TPU_TELEMETRY_DUMP"] = prefix
+    for stale in glob.glob(prefix + ".*.json"):
+        os.remove(stale)
+    return prefix
+
+
+def _summarize_snapshot(snap: dict) -> dict:
+    """Reduce one shard's observability snapshot to the lane-relevant
+    aggregates (fused-conv dispatch outcomes, compile counts/seconds,
+    retraces, step records)."""
+    fams = snap.get("metrics", {})
+
+    def series(name):
+        return fams.get(name, {}).get("samples", [])
+
+    return {
+        "fused_conv_dispatch": {
+            "/".join(s["labels"].values()): int(s["value"])
+            for s in series("paddle_tpu_fused_conv_dispatch_total")},
+        "compiles_total": int(sum(
+            s["value"] for s in series("paddle_tpu_compiles_total"))),
+        "compile_seconds_total": round(sum(
+            s.get("sum", 0.0)
+            for s in series("paddle_tpu_compile_seconds")), 2),
+        "retraces_total": int(sum(
+            s["value"] for s in series("paddle_tpu_retraces_total"))),
+        "nan_check_trips": int(sum(
+            s["value"] for s in series("paddle_tpu_nan_check_trips_total"))),
+        "steps_recorded": len(snap.get("steps", [])),
+    }
+
+
+def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
+    """Merge the per-shard snapshots into benchmarks/telemetry_lane.json
+    (next to tpu_lane_results.json): per-shard summaries plus summed
+    totals, so the chip lane's fused-conv hit rate and compile counts
+    are auditable without re-running anything."""
+    import datetime
+    import glob
+    import json
+
+    shards = []
+    totals: dict = {"fused_conv_dispatch": {}, "compiles_total": 0,
+                    "compile_seconds_total": 0.0, "retraces_total": 0,
+                    "nan_check_trips": 0, "steps_recorded": 0}
+    for path in sorted(glob.glob(dump_prefix + ".*.json")):
+        try:
+            with open(path) as fh:
+                snap = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        summary = _summarize_snapshot(snap)
+        summary["pid"] = path.rsplit(".", 2)[-2]
+        shards.append(summary)
+        for k, v in summary["fused_conv_dispatch"].items():
+            totals["fused_conv_dispatch"][k] = (
+                totals["fused_conv_dispatch"].get(k, 0) + v)
+        for k in ("compiles_total", "compile_seconds_total",
+                  "retraces_total", "nan_check_trips", "steps_recorded"):
+            totals[k] += summary[k]
+        os.remove(path)
+    totals["compile_seconds_total"] = round(totals["compile_seconds_total"], 2)
+    hits = sum(v for k, v in totals["fused_conv_dispatch"].items()
+               if k.startswith("hit/"))
+    falls = sum(v for k, v in totals["fused_conv_dispatch"].items()
+                if k.startswith("fallback/"))
+    totals["fused_conv_hit_rate"] = (
+        round(hits / (hits + falls), 4) if hits + falls else None)
+    out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
+                            "telemetry_lane.json")
+    with open(out_path, "w") as fh:
+        json.dump({
+            "platform": platform,
+            "finished": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "totals": totals,
+            "shards": shards,
+        }, fh, indent=1)
+    print(f"[run_shards] telemetry lane -> {out_path} "
+          f"(compiles {totals['compiles_total']}, fused-conv hit rate "
+          f"{totals['fused_conv_hit_rate']})", flush=True)
+    return out_path
+
+
 def run_pytest(files, budget, label, extra_env=None):
     cmd = [sys.executable, "-m", "pytest", "-q", "--no-header",
            *(os.path.join(HERE, f) for f in files)]
@@ -167,6 +258,7 @@ def run_tpu_lane(slack: float) -> int:
     import datetime
     import json
 
+    tdump = setup_telemetry_dump()
     rc = 0
     shards = []
     for f, timeout, extra in TPU_LANE:
@@ -191,6 +283,7 @@ def run_tpu_lane(slack: float) -> int:
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(f"[run_shards] tpu lane results -> {path} (rc={rc})", flush=True)
+    merge_telemetry_snapshots(tdump, "tpu")
     return rc
 
 
@@ -224,6 +317,7 @@ def main(argv=None):
         for stale in glob.glob(os.environ["PADDLE_TPU_DISPATCH_DUMP"] + ".*"):
             os.remove(stale)
 
+    tdump = setup_telemetry_dump()
     rows = load_manifest()
     par = [r for r in rows if r["run_type"] == "parallel"]
     ser = [r for r in rows if r["run_type"] == "serial"]
@@ -251,6 +345,7 @@ def main(argv=None):
                              f"serial {r['file']}")
     if args.enforce_dispatch:
         rc |= merge_dispatch_records(os.environ["PADDLE_TPU_DISPATCH_DUMP"])
+    merge_telemetry_snapshots(tdump, "cpu")
     return rc
 
 

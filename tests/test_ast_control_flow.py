@@ -528,3 +528,51 @@ class TestForOverTensor:
               for i in (1, 2, 3)]
         out = st(*xs)
         np.testing.assert_allclose(out.numpy(), [6.0, 6.0])
+
+
+class TestNumericListStaysPython:
+    """ADVICE round-5 regression: _pt_seq_norm used to stack uniform
+    numeric lists into traced arrays, so the loop elements became
+    tracers and any body using them as python ints (range(n), slicing)
+    failed its trace and dragged the WHOLE function onto the fallback
+    path. Numeric lists now stay on the positional-indexing path."""
+
+    def test_numeric_list_element_usable_as_python_int(self):
+        def f(x):
+            s = x * 0.0
+            for n in [1, 2, 3]:
+                for _ in range(n):  # range(tracer) would raise
+                    s = s + x
+            return s
+
+        st = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        out = st(x)
+        np.testing.assert_allclose(out.numpy(), [6.0, 6.0])
+        # the payoff: the compiled-control-flow program survives — no
+        # whole-function trace-failure fallback, no SOT graph break
+        assert st.uses_compiled_control_flow
+        assert st.sot_graph_count is None
+
+    def test_numeric_list_static_slice_bound(self):
+        def g(x):
+            s = x[:1] * 0.0
+            for n in [1, 2, 3]:
+                s = s + x[:n].sum()  # static slice needs a python int
+            return s
+
+        st = paddle.jit.to_static(g)
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        out = st(x)
+        np.testing.assert_allclose(out.numpy(), [6.0])
+        assert st.sot_graph_count is None
+
+    def test_seq_norm_still_stacks_tensor_lists(self):
+        from paddle_tpu.jit.ast_transform import _pt_seq_norm
+
+        assert isinstance(_pt_seq_norm([1, 2, 3]), list)
+        assert isinstance(_pt_seq_norm((1.5, 2.5)), tuple)
+        ts = [paddle.to_tensor(np.ones(2, np.float32)) for _ in range(3)]
+        stacked = _pt_seq_norm(ts)
+        from paddle_tpu import Tensor
+        assert isinstance(stacked, Tensor) and tuple(stacked.shape) == (3, 2)

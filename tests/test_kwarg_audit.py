@@ -174,7 +174,8 @@ ALLOWED = {
     "directly (documented in hapi docstring)",
     "hapi.model_summary.hook.ins": _INTERFACE,
     "hapi.model_summary.make_hook.layer": _INTERFACE,
-    "hapi.callbacks.config_callbacks.mode": _INTERFACE,
+    # config_callbacks.mode left the allowlist in round 6: it now gates
+    # the default TelemetryCallback (train mode only)
     "inference.__init__.enable_use_gpu.device_id": _PJRT,
     "inference.__init__.enable_use_gpu.memory_pool_init_size_mb": _PJRT,
     "inference.__init__.reshape.shape": "predictor re-traces on new "

@@ -1,0 +1,458 @@
+"""Observability subsystem tests: metrics registry semantics under
+threads, Prometheus/JSONL export round trips, the recompile monitor's
+compile attribution + retrace detection, fused-conv dispatch counters
+through real Conv2D->BatchNorm->ReLU blocks, per-step training
+telemetry through the hapi fit loop, and the run_shards telemetry-lane
+merge.
+
+Counter deltas (not absolutes) are asserted throughout — the registry
+is process-global and other tests in the same pytest process increment
+the same families.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+
+RNG = np.random.RandomState(0)
+
+
+def _sample_value(metric, **labels):
+    fam = obs.get_registry().get(metric)
+    if fam is None:
+        return 0.0
+    for s in fam.collect():
+        if s["labels"] == {k: str(v) for k, v in labels.items()}:
+            return s.get("value", s.get("count", 0.0))
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsRegistry:
+    def test_counter_exact_under_threads(self):
+        c = obs.counter("t_obs_threads_total", "x", ("who",))
+        child = c.labels("w")
+        before = child.value()
+
+        def worker():
+            for _ in range(5000):
+                child.inc()
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert child.value() - before == 8 * 5000
+
+    def test_histogram_exact_under_threads(self):
+        h = obs.histogram("t_obs_thread_hist", "x", buckets=(0.5, 1.5))
+        b0, s0, n0 = h._d().snapshot()
+
+        def worker():
+            for _ in range(2000):
+                h.observe(1.0)
+
+        ts = [threading.Thread(target=worker) for _ in range(6)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        counts, total, n = h._d().snapshot()
+        assert n - n0 == 12000
+        assert total - s0 == pytest.approx(12000.0)
+        # 1.0 lands in the le=1.5 bucket (second), nothing past it
+        assert counts[1] - b0[1] == 12000
+        assert counts[2] == b0[2]
+
+    def test_gauge_set_inc_dec(self):
+        g = obs.gauge("t_obs_gauge", "x")
+        g.set(10)
+        g.inc(5)
+        g.dec(2)
+        assert g.value() == 13
+
+    def test_registry_idempotent_and_type_conflict(self):
+        a = obs.counter("t_obs_same", "x", ("l",))
+        b = obs.counter("t_obs_same", "x", ("l",))
+        assert a is b
+        with pytest.raises(ValueError):
+            obs.gauge("t_obs_same")
+        with pytest.raises(ValueError):
+            obs.counter("t_obs_same", labelnames=("other",))
+
+    def test_labels_by_name_and_validation(self):
+        c = obs.counter("t_obs_lbl", "x", ("alpha", "beta"))
+        c.labels(alpha="1", beta="2").inc()
+        assert _sample_value("t_obs_lbl", alpha="1", beta="2") == 1
+        with pytest.raises(ValueError):
+            c.labels("only-one")
+        with pytest.raises(ValueError):
+            c.inc()  # labeled metric needs .labels()
+
+    def test_disable_is_a_flag_check(self):
+        # instrumentation sites guard on the shared flag; disabled means
+        # no increments land
+        conv = nn.Conv2D(4, 4, 3, padding=1, data_format="NCHW")
+        x = paddle.to_tensor(RNG.randn(1, 4, 5, 5).astype(np.float32))
+        before = _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                               result="fallback", reason="disabled")
+        obs.disable()
+        try:
+            conv(x)
+            assert _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                                 result="fallback",
+                                 reason="disabled") == before
+        finally:
+            obs.enable()
+        conv(x)
+        assert _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                             result="fallback",
+                             reason="disabled") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+class TestExporters:
+    def test_prometheus_text_round_trip(self):
+        c = obs.counter("t_exp_total", "reqs", ("path",))
+        c.labels('with"quote\nand\\slash').inc(3)
+        g = obs.gauge("t_exp_gauge", "val")
+        g.set(2.5)
+        h = obs.histogram("t_exp_hist", "lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+
+        text = obs.prometheus_text()
+        parsed = obs.parse_prometheus_text(text)
+
+        assert parsed["t_exp_total"]["type"] == "counter"
+        (sample,) = parsed["t_exp_total"]["samples"]
+        assert sample["labels"]["path"] == 'with"quote\nand\\slash'
+        assert sample["value"] == 3
+
+        assert parsed["t_exp_gauge"]["samples"][0]["value"] == 2.5
+
+        hist = parsed["t_exp_hist"]
+        assert hist["type"] == "histogram"
+        series = {(s["series"], s["labels"].get("le")): s["value"]
+                  for s in hist["samples"]}
+        assert series[("t_exp_hist_bucket", "0.1")] == 1
+        assert series[("t_exp_hist_bucket", "1")] == 2   # cumulative
+        assert series[("t_exp_hist_bucket", "+Inf")] == 3
+        assert series[("t_exp_hist_sum", None)] == pytest.approx(5.55)
+        assert series[("t_exp_hist_count", None)] == 3
+
+    def test_jsonl_snapshot_appends_one_line(self, tmp_path):
+        obs.counter("t_exp_jsonl_total").inc()
+        path = tmp_path / "metrics.jsonl"
+        obs.write_jsonl_snapshot(str(path), extra={"shard": 7})
+        obs.write_jsonl_snapshot(str(path))
+        lines = path.read_text().splitlines()
+        assert len(lines) == 2
+        rec = json.loads(lines[0])
+        assert rec["shard"] == 7
+        assert rec["metrics"]["t_exp_jsonl_total"]["samples"][0]["value"] >= 1
+
+    def test_http_scrape_endpoint(self):
+        import urllib.request
+
+        obs.counter("t_exp_http_total").inc()
+        port = obs.start_http_server(0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert "t_exp_http_total" in body
+            assert obs.parse_prometheus_text(body)  # well-formed
+            snap = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/snapshot", timeout=5).read())
+            assert "metrics" in snap
+        finally:
+            obs.stop_http_server()
+
+
+# ---------------------------------------------------------------------------
+# recompile monitor
+# ---------------------------------------------------------------------------
+
+
+class TestRecompileMonitor:
+    def test_one_compile_then_retrace_on_shape_change(self):
+        @paddle.jit.to_static
+        def _obs_probe_fn(x):
+            return x * 2.0 + 1.0
+
+        entry = _obs_probe_fn._entry_name
+        base = _sample_value("paddle_tpu_compiles_total", entry=entry)
+        base_rt = _sample_value("paddle_tpu_retraces_total", entry=entry)
+
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        _obs_probe_fn(x)
+        after_first = _sample_value("paddle_tpu_compiles_total", entry=entry)
+        assert after_first - base == 1  # exactly one XLA compile
+
+        _obs_probe_fn(x)
+        _obs_probe_fn(x)  # same shape: served from the executable cache
+        assert _sample_value("paddle_tpu_compiles_total",
+                             entry=entry) == after_first
+        assert _sample_value("paddle_tpu_retraces_total",
+                             entry=entry) == base_rt
+
+        y = paddle.to_tensor(np.ones((8, 2), np.float32))
+        _obs_probe_fn(y)  # shape change AFTER completed calls: retrace
+        assert _sample_value("paddle_tpu_compiles_total",
+                             entry=entry) == after_first + 1
+        assert _sample_value("paddle_tpu_retraces_total",
+                             entry=entry) == base_rt + 1
+
+        st = obs.entry_stats()[entry]
+        assert st["retraces"] >= 1 and st["compile_seconds"] > 0
+
+    def test_compile_events_have_duration_and_entry(self):
+        @paddle.jit.to_static
+        def _obs_probe_fn2(x):
+            return x - 3.0
+
+        _obs_probe_fn2(paddle.to_tensor(np.ones((3,), np.float32)))
+        evs = [e for e in obs.compile_events()
+               if e["entry"] == _obs_probe_fn2._entry_name]
+        assert evs and evs[-1]["duration_s"] > 0
+
+    def test_entrypoint_nesting(self):
+        with obs.entrypoint("outer"):
+            assert obs.current_entry() == "outer"
+            with obs.entrypoint("inner"):
+                assert obs.current_entry() == "inner"
+            assert obs.current_entry() == "outer"
+
+
+# ---------------------------------------------------------------------------
+# fused-conv dispatch counters (real Conv2D -> BatchNorm -> ReLU blocks)
+# ---------------------------------------------------------------------------
+
+
+class TestFusedConvCounters:
+    def _block(self):
+        paddle.seed(0)
+        conv = nn.Conv2D(8, 8, 3, padding=1, bias_attr=False,
+                         data_format="NHWC")
+        bn = nn.BatchNorm2D(8, data_format="NHWC")
+        relu = nn.ReLU()
+        return lambda x: relu(bn(conv(x)))
+
+    def test_hit_counter_with_fusion_enabled(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FUSED_CONV", "1")
+        block = self._block()
+        x = paddle.to_tensor(RNG.randn(2, 6, 6, 8).astype(np.float32))
+        before = _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                               result="hit", reason="train")
+        block(x)
+        assert _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                             result="hit", reason="train") == before + 1
+
+    def test_fallback_counter_with_fusion_disabled(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FUSED_CONV", "0")
+        block = self._block()
+        x = paddle.to_tensor(RNG.randn(2, 6, 6, 8).astype(np.float32))
+        before = _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                               result="fallback", reason="disabled")
+        block(x)
+        assert _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                             result="fallback", reason="disabled") == before + 1
+
+    def test_fallback_counter_ineligible_conv(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FUSED_CONV", "1")
+        paddle.seed(0)
+        conv = nn.Conv2D(8, 8, 3, stride=2, padding=1, bias_attr=False,
+                         data_format="NHWC")  # strided: never fused
+        x = paddle.to_tensor(RNG.randn(2, 6, 6, 8).astype(np.float32))
+        before = _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                               result="fallback", reason="ineligible")
+        conv(x)
+        assert _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                             result="fallback",
+                             reason="ineligible") == before + 1
+
+    def test_bn_mismatch_counter(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FUSED_CONV", "1")
+        paddle.seed(0)
+        conv = nn.Conv2D(8, 8, 3, padding=1, bias_attr=False,
+                         data_format="NHWC")
+        bn = nn.BatchNorm2D(8, data_format="NHWC", weight_attr=False)
+        x = paddle.to_tensor(RNG.randn(2, 6, 6, 8).astype(np.float32))
+        before = _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                               result="fallback", reason="bn_mismatch")
+        bn(conv(x))  # tagged, but the affine-less BN declines the kernel
+        assert _sample_value("paddle_tpu_fused_conv_dispatch_total",
+                             result="fallback",
+                             reason="bn_mismatch") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# per-step telemetry + the hapi acceptance path
+# ---------------------------------------------------------------------------
+
+
+class TestStepTelemetry:
+    def test_jsonl_records(self, tmp_path):
+        path = tmp_path / "steps.jsonl"
+        st = obs.StepTelemetry(entry="t_unit", jsonl_path=str(path))
+        for _ in range(3):
+            st.step(num_samples=16)
+        st.close()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(lines) == 3
+        assert [l["step"] for l in lines] == [0, 1, 2]
+        for l in lines:
+            assert l["step_time_s"] > 0
+            assert l["ips"] > 0
+            assert "compile_count_delta" in l
+        assert [r["step"] for r in st.records()][-3:] == [0, 1, 2]
+
+    def test_tokens_unit(self):
+        st = obs.StepTelemetry(entry="t_tok", record_memory=False)
+        rec = st.step(tokens=1024)
+        assert rec["unit"] == "tokens" and rec["num_items"] == 1024
+
+    def test_hapi_fit_snapshot_acceptance(self, tmp_path):
+        """Acceptance criterion: after a 3-step jitted hapi fit on CPU,
+        snapshot() has >=1 compile event with nonzero duration, per-step
+        records with step time and ips, and nonzero fused-conv fallback
+        counters (CPU defaults to the XLA path)."""
+        os.environ.pop("PADDLE_TPU_FUSED_CONV", None)
+        paddle.seed(0)
+        net = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1, bias_attr=False,
+                      data_format="NCHW"),
+            nn.BatchNorm2D(8),
+            nn.ReLU(),
+            nn.Flatten(),
+            nn.Linear(8 * 8 * 8, 4),
+        )
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        X = RNG.rand(12, 3, 8, 8).astype(np.float32)
+        Y = RNG.randint(0, 4, (12, 1)).astype(np.int64)
+        jsonl = tmp_path / "fit.jsonl"
+        from paddle_tpu.hapi.callbacks import TelemetryCallback
+
+        model.fit([(X[i], Y[i]) for i in range(12)], batch_size=4,
+                  epochs=1, verbose=0,
+                  callbacks=[TelemetryCallback(jsonl_path=str(jsonl))])
+
+        snap = obs.snapshot()
+        assert any(e["duration_s"] > 0 for e in snap["compile_events"])
+        steps = [r for r in snap["steps"] if r["entry"] == "hapi.fit"]
+        assert len(steps) >= 3
+        assert all(r["step_time_s"] > 0 and r["ips"] > 0 for r in steps[-3:])
+        fc = snap["metrics"]["paddle_tpu_fused_conv_dispatch_total"]
+        fallbacks = sum(s["value"] for s in fc["samples"]
+                        if s["labels"]["result"] == "fallback")
+        assert fallbacks > 0
+        entries = snap["entries"]
+        assert entries["hapi.Model.train_batch"]["compiles"] >= 1
+        # the JSONL stream mirrors the in-memory records
+        lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+        assert len(lines) == 3 and all("ips" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-layer counters (AMP, NaN checks, watchdog)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchCounters:
+    def test_amp_autocast_counter(self):
+        before = _sample_value("paddle_tpu_amp_autocast_ops_total",
+                               list="white")
+        x = paddle.to_tensor(RNG.randn(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast():
+            paddle.matmul(x, x)
+        assert _sample_value("paddle_tpu_amp_autocast_ops_total",
+                             list="white") == before + 1
+
+    def test_nan_check_trip_counter(self):
+        before = _sample_value("paddle_tpu_nan_check_trips_total",
+                               op="log")
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError):
+                paddle.log(paddle.to_tensor(
+                    np.array([-1.0], np.float32)))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+        assert _sample_value("paddle_tpu_nan_check_trips_total",
+                             op="log") == before + 1
+
+    def test_watchdog_timeout_counter(self):
+        import time as _time
+
+        from paddle_tpu.distributed.watchdog import watch_async
+
+        before_t = _sample_value("paddle_tpu_watchdog_timeouts_total",
+                                 name="t_obs_hang")
+        with pytest.raises(TimeoutError):
+            watch_async("t_obs_hang", lambda: _time.sleep(2.0), timeout=0.2)
+        assert _sample_value("paddle_tpu_watchdog_timeouts_total",
+                             name="t_obs_hang") == before_t + 1
+
+
+# ---------------------------------------------------------------------------
+# run_shards telemetry-lane merge
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryLaneMerge:
+    def test_merge_snapshots(self, tmp_path, monkeypatch):
+        import run_shards
+
+        fake_tests = tmp_path / "tests"
+        fake_tests.mkdir()
+        (tmp_path / "benchmarks").mkdir()
+        monkeypatch.setattr(run_shards, "HERE", str(fake_tests))
+
+        def snap(hit, fb, compiles):
+            return {"metrics": {
+                "paddle_tpu_fused_conv_dispatch_total": {"samples": [
+                    {"labels": {"result": "hit", "reason": "train"},
+                     "value": hit},
+                    {"labels": {"result": "fallback", "reason": "disabled"},
+                     "value": fb}]},
+                "paddle_tpu_compiles_total": {"samples": [
+                    {"labels": {"entry": "e"}, "value": compiles}]},
+                "paddle_tpu_compile_seconds": {"samples": [
+                    {"labels": {"entry": "e"}, "sum": 1.5,
+                     "count": compiles, "buckets": [], "counts": []}]},
+            }, "steps": [{}, {}]}
+
+        prefix = str(fake_tests / ".telemetry_snap")
+        for pid, args in ((101, (3, 1, 4)), (102, (1, 3, 2))):
+            with open(f"{prefix}.{pid}.json", "w") as fh:
+                json.dump(snap(*args), fh)
+
+        out = run_shards.merge_telemetry_snapshots(prefix, "cpu")
+        data = json.loads(open(out).read())
+        assert data["platform"] == "cpu"
+        assert len(data["shards"]) == 2
+        t = data["totals"]
+        assert t["fused_conv_dispatch"] == {"hit/train": 4,
+                                            "fallback/disabled": 4}
+        assert t["fused_conv_hit_rate"] == 0.5
+        assert t["compiles_total"] == 6
+        assert t["compile_seconds_total"] == 3.0
+        assert t["steps_recorded"] == 4
+        # per-pid dumps are consumed by the merge
+        assert not list(fake_tests.glob(".telemetry_snap.*.json"))

@@ -101,3 +101,53 @@ def test_memory_stats_api():
     a = arena.alloc_array((1024,), np.float32)
     assert arena.allocated() >= 4096
     arena.free_array(a)
+
+
+def test_profile_memory_records_watermarks():
+    # profile_memory=True wires the device-memory watermark gauges:
+    # one record per step(); summary() renders the section. On CPU PJRT
+    # memory_stats may be unsupported -> recorded as None, never a crash.
+    prof = Profiler(profile_memory=True)
+    prof.start()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    for _ in range(3):
+        _ = paddle.matmul(x, x)
+        prof.step()
+    prof.stop()
+    recs = prof.memory_records()
+    assert len(recs) == 3
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert all(set(r) == {"step", "live_bytes", "peak_bytes"} for r in recs)
+    assert "Device memory (profile_memory=True)" in prof.summary()
+    # default stays off
+    prof2 = Profiler()
+    prof2.start(); prof2.step(); prof2.stop()
+    assert prof2.memory_records() == []
+
+
+def test_benchmark_timer_feeds_step_telemetry():
+    import time
+
+    from paddle_tpu import observability
+
+    st = observability.StepTelemetry(entry="t_prof_feed",
+                                     record_memory=False)
+    bm = profiler.benchmark()
+    st.attach_benchmark()
+    try:
+        bm.begin()
+        for _ in range(2):
+            time.sleep(0.005)
+            bm.step(num_samples=32)
+        bm.end()
+    finally:
+        st.close()
+    recs = st.records()
+    assert len(recs) == 2
+    # the telemetry record carries the TIMER's measurement, not its own
+    assert recs[-1]["step_time_s"] == pytest.approx(
+        bm._step_times[-1], rel=1e-9)
+    assert recs[-1]["num_items"] == 32
+    # detached: further timer steps do not record
+    bm.begin(); bm.step(); bm.end()
+    assert len(st.records()) == 2
