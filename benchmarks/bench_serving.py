@@ -1,0 +1,209 @@
+"""Serving throughput+latency lane: continuous batching vs sequential.
+
+The acceptance workload for paddle_tpu/serving/: 12 requests with
+STAGGERED arrivals (deterministic arrival schedule in engine steps),
+mixed prompt lengths across two prefill buckets and mixed greedy/sampled
+params, decoded two ways:
+
+- ``serving``:    one ``ServingEngine`` (slot pool, bucketed prefill,
+                  ONE jitted decode step for the whole pool) — requests
+                  are injected mid-flight per the arrival schedule.
+- ``sequential``: the same 12 requests as back-to-back
+                  ``generation.generate`` calls in arrival order (the
+                  pre-serving status quo: one request, one (1, S, N)
+                  program, whole-batch lockstep).
+
+Both lanes run the full workload once as WARMUP (all executables
+compile) and are measured on the second pass, so the comparison is
+steady-state throughput, not compile time. The bench asserts the
+engine's three acceptance properties while it measures:
+
+- per-request outputs match ``generate()`` with the same seed/params;
+- the recompile monitor records EXACTLY one ``serving.step`` compile
+  and zero retraces across the measured pass;
+- aggregate serving tok/s > sequential tok/s.
+
+Artifact: ``benchmarks/bench_serving.json`` — tok/s both lanes, speedup,
+mean/p95 TTFT + TPOT, mean slot occupancy, parity/compile verdicts.
+``tests/run_shards.py`` folds it into ``telemetry_lane.json`` as the
+``serving_bench`` block. CPU numbers here size the continuous-batching
+win on the dev box; the chip lane reruns this on TPU for real numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import generation, serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import recompile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# the staggered 12-request workload: (arrival_step, prompt_len, params).
+# arrival_step is in ENGINE ITERATIONS — request k is submitted once the
+# engine has run that many decode steps, so later requests land while
+# earlier ones are mid-decode (the continuous-batching case, not a
+# one-shot batch).
+WORKLOAD = [
+    (0, 5, dict(max_new_tokens=48)),
+    (0, 9, dict(max_new_tokens=40, do_sample=True, temperature=0.8,
+                top_k=8, seed=1)),
+    (0, 14, dict(max_new_tokens=56)),
+    # top-p WITHOUT top-k: the one request that exercises the sampler's
+    # exact full-sort fallback (see generation._NUCLEUS_BOUND)
+    (0, 26, dict(max_new_tokens=32, do_sample=True, top_p=0.9, seed=2)),
+    (2, 7, dict(max_new_tokens=48)),
+    (4, 11, dict(max_new_tokens=24, do_sample=True, temperature=1.1,
+                 top_k=12, seed=3)),
+    (6, 19, dict(max_new_tokens=40)),
+    (8, 4, dict(max_new_tokens=16)),
+    (10, 30, dict(max_new_tokens=48, do_sample=True, top_k=64, top_p=0.95,
+                  seed=4)),
+    (12, 6, dict(max_new_tokens=32)),
+    (14, 13, dict(max_new_tokens=24, do_sample=True, temperature=0.9,
+                  top_k=6, seed=5)),
+    (16, 8, dict(max_new_tokens=40)),
+]
+MAX_SLOTS = 6
+MAX_LEN = 96
+
+
+# Big enough that a decode step is weight-streaming-bound (the serving
+# regime: a B-row step streams the weights ONCE for B streams, which is
+# the whole continuous-batching win) — at toy widths the scan-mode
+# sequential program wins on pure dispatch amortization instead.
+MODEL_KW = dict(hidden_size=512, intermediate_size=1024,
+                num_hidden_layers=4, num_attention_heads=8,
+                num_key_value_heads=4, vocab_size=4096)
+
+
+def make_workload(cfg):
+    rng = np.random.RandomState(42)
+    return [(step, rng.randint(1, cfg.vocab_size, n).astype(np.int32), p)
+            for step, n, p in WORKLOAD]
+
+
+def run_serving(engine, workload):
+    """Drive the engine synchronously, injecting each request at its
+    scheduled iteration; returns (requests, wall_s)."""
+    pending = list(workload)
+    reqs = []
+    t0 = time.perf_counter()
+    steps = 0
+    while pending or engine.scheduler.depth or engine.busy_slots():
+        while pending and pending[0][0] <= steps:
+            _, prompt, params = pending.pop(0)
+            reqs.append(engine.submit(prompt, **params))
+        if not engine.step() and not pending:
+            break
+        steps += 1
+    return reqs, time.perf_counter() - t0
+
+
+def run_sequential(model, workload):
+    """The status quo: one generate() per request, arrival order.
+    Returns (outputs, wall_s)."""
+    outs = []
+    t0 = time.perf_counter()
+    for _, prompt, params in workload:
+        out = generation.generate(model, prompt[None], **params)
+        outs.append(np.asarray(out.numpy())[0, len(prompt):])
+    return outs, time.perf_counter() - t0
+
+
+def pct(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(**MODEL_KW)
+    model = LlamaForCausalLM(cfg)
+    workload = make_workload(cfg)
+    n_tokens = sum(p["max_new_tokens"] for _, _, p in WORKLOAD)
+
+    # -- warmup: compile every executable both lanes will use ------------
+    eng = serving.ServingEngine(model, max_slots=MAX_SLOTS, max_len=MAX_LEN,
+                                max_queue_depth=len(workload))
+    warm_reqs, _ = run_serving(eng, workload)
+    refs, _ = run_sequential(model, workload)  # also the parity oracle
+
+    parity = all(
+        np.array_equal(np.asarray(r.result(timeout=1.0)), ref[:len(r.output_tokens)])
+        and len(r.output_tokens) == len(ref)
+        for r, ref in zip(warm_reqs, refs))
+
+    # -- measured passes: 3 rounds per lane, ALTERNATING so an ambient
+    # slowdown (shared box) hits both lanes; keep each lane's best
+    step_before = recompile.entry_stats().get(
+        "serving.step", {"compiles": 0, "retraces": 0})
+    reqs, serving_wall = None, float("inf")
+    seq_wall = float("inf")
+    for _ in range(3):
+        r, w = run_serving(eng, workload)
+        if w < serving_wall:
+            reqs, serving_wall = r, w
+        _, w = run_sequential(model, workload)
+        seq_wall = min(seq_wall, w)
+    step_after = recompile.entry_stats().get(
+        "serving.step", {"compiles": 0, "retraces": 0})
+
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    tpots = [r.tpot_s for r in reqs if r.tpot_s is not None]
+    serving_tps = n_tokens / serving_wall
+    seq_tps = n_tokens / seq_wall
+    result = {
+        "bench": "serving_vs_sequential",
+        "platform": jax.default_backend(),
+        "model": {"family": "llama", **MODEL_KW},
+        "requests": len(workload),
+        "generated_tokens": n_tokens,
+        "max_slots": MAX_SLOTS,
+        "max_len": MAX_LEN,
+        "serving": {
+            "tok_s": round(serving_tps, 1),
+            "wall_s": round(serving_wall, 3),
+            "ttft_mean_s": round(float(np.mean(ttfts)), 4),
+            "ttft_p95_s": round(pct(ttfts, 95), 4),
+            "tpot_mean_s": round(float(np.mean(tpots)), 5),
+            "tpot_p95_s": round(pct(tpots, 95), 5),
+            "mean_occupancy": round(eng.mean_occupancy, 3),
+        },
+        "sequential": {
+            "tok_s": round(seq_tps, 1),
+            "wall_s": round(seq_wall, 3),
+        },
+        "speedup": round(serving_tps / seq_tps, 2),
+        "per_request_parity": bool(parity),
+        "step_compiles_measured_pass":
+            step_after["compiles"] - step_before["compiles"],
+        "step_retraces_measured_pass":
+            step_after["retraces"] - step_before["retraces"],
+    }
+
+    path = os.path.join(HERE, "bench_serving.json")
+    with open(path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result, indent=1))
+    print(f"[bench_serving] artifact -> {path}")
+
+    ok = (parity and result["speedup"] > 1.0
+          and result["step_compiles_measured_pass"] == 0
+          and result["step_retraces_measured_pass"] == 0)
+    if not ok:
+        print("[bench_serving] ACCEPTANCE FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

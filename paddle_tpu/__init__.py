@@ -61,6 +61,7 @@ from . import signal
 from . import onnx
 from . import regularizer
 from . import generation
+from . import serving
 
 # top-level aliases for reference __all__ parity
 # paddle.dtype is a TYPE in the reference (framework dtype class);

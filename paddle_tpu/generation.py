@@ -26,17 +26,34 @@ from .observability.recompile import entrypoint as _entrypoint
 from .utils.functional import functional_call
 
 __all__ = ["GenerationConfig", "generate", "generate_uncached",
-           "update_static_kv_cache"]
+           "update_static_kv_cache", "make_kv_caches", "make_cached_runner",
+           "select_tokens", "split_keys"]
+
+
+def _is_per_row(position_offset) -> bool:
+    """True when ``position_offset`` is a per-row [B] vector (the serving
+    engine's continuous-batching decode, where every slot sits at its own
+    sequence position) rather than a shared scalar."""
+    return getattr(position_offset, "ndim", 0) == 1
 
 
 def kv_cache_write(buf, new, position_offset):
     """Write a step's [b, s, h, d] block into a pre-allocated
     [b, max_len, h, d] cache buffer at ``position_offset`` (the
     TPU-native dynamic_update_slice form of the reference's cache_kv
-    write; one of the two halves of ``update_static_kv_cache``)."""
+    write; one of the two halves of ``update_static_kv_cache``).
+
+    ``position_offset`` may be a shared scalar (whole-batch decode) or a
+    per-row [b] vector (slot-batched serving decode) — the vector form
+    vmaps the update so each row lands at its own position."""
     from .ops.dispatch import apply_op, ensure_tensor
 
     def upd(b, n):
+        if _is_per_row(position_offset):
+            return jax.vmap(
+                lambda br, nr, off: jax.lax.dynamic_update_slice(
+                    br, nr.astype(br.dtype), (off, 0, 0))
+            )(b, n, position_offset)
         return jax.lax.dynamic_update_slice(
             b, n.astype(b.dtype), (0, position_offset, 0, 0))
 
@@ -51,7 +68,11 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
     [b, max_len, h, d] buffers at ``position_offset`` and (unless the
     caller brings its own attn_mask — ``build_mask=False``) build the
     additive causal mask exposing only positions < offset + s.
-    Returns (k_full, v_full, new_cache, mask_or_None)."""
+    Returns (k_full, v_full, new_cache, mask_or_None).
+
+    A per-row [b] ``position_offset`` vector produces per-row writes and
+    a per-row [b, 1, s, max_len] mask (slots at different positions in
+    one batch — the serving engine's decode step)."""
     ck = kv_cache_write(kv_cache["k"], k, position_offset)
     cv = kv_cache_write(kv_cache["v"], v, position_offset)
     mask = None
@@ -59,9 +80,16 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
         s = k.shape[1]
         max_len = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
         kpos = jnp.arange(max_len)
-        qpos = position_offset + jnp.arange(s)
-        m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
-        mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+        if _is_per_row(position_offset):
+            po = position_offset
+            qpos = po[:, None] + jnp.arange(s)          # [b, s]
+            m = (kpos[None, None, :] <= qpos[:, :, None]) \
+                & (kpos[None, None, :] < (po[:, None, None] + s))
+            mask = Tensor(jnp.where(m[:, None], 0.0, -1e30).astype(jnp.float32))
+        else:
+            qpos = position_offset + jnp.arange(s)
+            m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
+            mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
     return ck, cv, {"k": ck, "v": cv}, mask
 
 
@@ -102,6 +130,132 @@ def _select_token(logits, cfg: GenerationConfig, key):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def split_keys(keys):
+    """Per-row PRNG advance: [B, 2] keys -> (new_keys [B, 2], subkeys
+    [B, 2]), each row exactly ``jax.random.split(key)`` for that row —
+    so a slot's key chain inside a batched decode step reproduces the
+    ``key, sub = jax.random.split(key)`` chain ``generate`` drives for a
+    single request."""
+    pairs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+    return pairs[:, 0], pairs[:, 1]
+
+
+# Bounded-nucleus fast path for select_tokens: a full-vocab XLA sort is
+# by far the most expensive op in a decode step (CPU: ~8x a
+# lax.top_k(256) on a [4, 4096] batch), so rows whose top-k filter fits
+# this bound take a top_k-only path. The fallback keeps it EXACT — see
+# select_tokens.
+_NUCLEUS_BOUND = 256
+
+
+def select_tokens(logits, keys, do_sample, temperature, top_k, top_p):
+    """Per-row token selection with TRACED sampling params: [B, V]
+    logits -> [B] tokens, where each row carries its own ``do_sample`` /
+    ``temperature`` / ``top_k`` / ``top_p`` / PRNG key. Mixed greedy and
+    sampled requests therefore share ONE compiled step program (the
+    serving engine's requirement); row-wise the math is exactly
+    ``_select_token`` on that row alone, so a slot's tokens match a
+    standalone ``generate`` call with the same config and key chain.
+
+    ``top_k <= 0`` and ``top_p >= 1.0`` disable their filters per row
+    (same semantics as the static config path).
+
+    Bit-exactness of the fast path: when every sampled row has
+    ``0 < top_k <= _NUCLEUS_BOUND`` (and no tie straddles the bound),
+    the kept set lives entirely in the top-K values, so padding those
+    back to width V with -1e30 reproduces the EXACT masked-sorted array
+    the full-sort path builds — every downstream softmax/cumsum/cutoff
+    runs on an identical array and is bit-identical, whatever the
+    backend's reduction groupings. Any row outside that envelope
+    (top-p-only sampling, huge top_k, boundary ties) flips a runtime
+    ``lax.cond`` to the full sort."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    K = min(_NUCLEUS_BOUND, V)
+
+    def _filter(sorted_desc):
+        """Width-V filter math given the descending-sorted logits:
+        top-k threshold at the k-th largest, then the top-p nucleus
+        over the k-filtered distribution (the single-sort form: the
+        'sorted filtered' array is the sorted array with the < kth
+        suffix dropped to -1e30, since filtering keeps a prefix)."""
+        kth_idx = jnp.clip(jnp.minimum(top_k, V) - 1, 0, V - 1).astype(jnp.int32)
+        kth = jnp.take_along_axis(sorted_desc, kth_idx[:, None], axis=-1)
+        kfilt = (top_k > 0)[:, None]
+        out = jnp.where(kfilt & (lg < kth), -1e30, lg)
+        sd = jnp.where(kfilt & (sorted_desc < kth), -1e30, sorted_desc)
+        probs = jax.nn.softmax(sd, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        inside = cum - probs < top_p[:, None]
+        cutoff = jnp.min(jnp.where(inside, sd, jnp.inf), axis=-1,
+                         keepdims=True)
+        return jnp.where((top_p < 1.0)[:, None] & (out < cutoff), -1e30, out)
+
+    tops = jax.lax.top_k(lg, K)[0]  # [B, K], descending
+    padded = jnp.concatenate(
+        [tops, jnp.full((B, V - K), -1e30, lg.dtype)], axis=-1)
+    # Everything downstream reads ``padded`` through an optimization
+    # barrier, NEVER ``tops``: lax.top_k lowers to sort+slice, which
+    # XLA:CPU pattern-matches into a fast partial-sort TopK custom call
+    # — but slicing the result again gets algebraically pushed back
+    # into slice-of-sort, breaking the match and silently falling back
+    # to a full-vocab sort (~7x this op's cost). The barrier pins the
+    # concat as a materialization point so consumers can't sink
+    # through it.
+    padded = jax.lax.optimization_barrier(padded)
+    kth_idx = jnp.clip(jnp.minimum(top_k, K) - 1, 0, K - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(padded, kth_idx[:, None], axis=-1)
+    # strict: values beyond the bound are all < kth, so the kept set
+    # (lg >= kth) is fully inside the top-K — no tie straddles the edge
+    row_fast = (~do_sample) | ((top_k > 0) & (top_k <= K)
+                               & (padded[:, K - 1] < kth[:, 0]))
+    lg = jax.lax.cond(
+        jnp.all(row_fast),
+        lambda: _filter(padded),
+        lambda: _filter(jnp.sort(lg, axis=-1)[:, ::-1]))
+    # per-row categorical with that row's key: the flat random-bit draw
+    # for a [V] row equals the [1, V] draw generate makes at B=1
+    sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(
+        keys, lg).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)
+
+
+def make_kv_caches(config, batch_size: int, max_len: int, dtype):
+    """Pre-allocated per-layer static KV buffers: a list (one per
+    decoder layer) of {"k", "v"} jnp arrays shaped
+    [batch_size, max_len, num_key_value_heads, head_dim]."""
+    n_kv = config.num_key_value_heads
+    head_dim = config.hidden_size // config.num_attention_heads
+    return [{"k": jnp.zeros((batch_size, max_len, n_kv, head_dim), dtype),
+             "v": jnp.zeros((batch_size, max_len, n_kv, head_dim), dtype)}
+            for _ in range(config.num_hidden_layers)]
+
+
+def make_cached_runner(model):
+    """The jit-friendly functional cached forward shared by ``generate``
+    and the serving engine: ``run(pb, token_ids, caches, pos,
+    attn_mask=None)`` calls the model with parameters/buffers supplied
+    as the ``pb`` pytree and raw-jnp caches, returning
+    (logits_jnp, new_caches_jnp). ``pos`` may be a python int, a traced
+    scalar, or a per-row [B] vector (serving decode)."""
+
+    def run(pb, token_ids, caches, pos, attn_mask=None):
+        with no_grad():
+            caches_t = [{"k": Tensor(c["k"]), "v": Tensor(c["v"])}
+                        for c in caches]
+            am = None
+            if attn_mask is not None:
+                am = attn_mask if isinstance(attn_mask, Tensor) else Tensor(attn_mask)
+            logits, new_caches = functional_call(
+                model, pb, Tensor(token_ids), attn_mask=am,
+                kv_caches=caches_t, position_offset=pos)
+        return (logits._data,
+                [{"k": c["k"]._data, "v": c["v"]._data} for c in new_caches])
+
+    return run
+
+
 def generate_uncached(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
                       temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                       eos_token_id: Optional[int] = None, seed: int = 0) -> Tensor:
@@ -133,10 +287,52 @@ def generate_uncached(model, input_ids, max_new_tokens: int = 32, do_sample: boo
     return Tensor(ids)
 
 
+def _normalize_prompts(input_ids, pad_token_id):
+    """Normalize ``input_ids`` into (ids [B, S] int32, pad_lens or None).
+
+    Accepts a [B, S] Tensor/array (classic equal-length prompts) or a
+    ragged list/tuple of per-row token sequences. Ragged rows are
+    LEFT-padded with ``pad_token_id`` to the longest prompt, and
+    ``pad_lens`` [B] counts each row's leading pads so prefill/decode
+    can mask them out of attention. A rectangular input combined with an
+    explicit ``pad_token_id`` also enters ragged mode: leading
+    ``pad_token_id`` tokens per row are treated as padding."""
+    if isinstance(input_ids, (list, tuple)) and input_ids and \
+            isinstance(input_ids[0], (list, tuple, np.ndarray)):
+        rows = [np.asarray(r, dtype=np.int32).reshape(-1) for r in input_ids]
+        lens = [r.shape[0] for r in rows]
+        if any(l == 0 for l in lens):
+            raise ValueError("empty prompt in ragged batch")
+        S = max(lens)
+        if len(set(lens)) > 1 and pad_token_id is None:
+            raise ValueError(
+                "ragged prompts (lengths %s) require pad_token_id for "
+                "left-padding" % sorted(set(lens)))
+        ids = np.full((len(rows), S), pad_token_id if pad_token_id is not None
+                      else 0, np.int32)
+        for b, r in enumerate(rows):
+            ids[b, S - r.shape[0]:] = r
+        if pad_token_id is None:
+            return jnp.asarray(ids), None
+        pad_lens = np.asarray([S - l for l in lens], np.int32)
+        return jnp.asarray(ids), pad_lens
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    if pad_token_id is None:
+        return ids, None
+    arr = np.asarray(ids)
+    # leading-run-of-pads per row (a pad id INSIDE the prompt is content)
+    is_pad = arr == pad_token_id
+    pad_lens = (np.cumprod(is_pad, axis=1)).sum(axis=1).astype(np.int32)
+    pad_lens = np.minimum(pad_lens, arr.shape[1] - 1)  # never mask a whole row
+    return ids, pad_lens
+
+
 def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
-             loop_mode: str = "scan") -> Tensor:
+             loop_mode: str = "scan", pad_token_id: Optional[int] = None,
+             stream: bool = False):
     """Generate continuations for ``input_ids`` [B, S]; returns [B, S+N].
 
     Greedy by default; sampling with temperature/top-k/top-p when
@@ -146,11 +342,28 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     program (``lax.scan`` over the token index) — one dispatch for N
     tokens, which is what makes decode fast over a remote PJRT transport;
     ``"python"`` drives one jitted step per token (useful for streaming
-    consumers that want tokens as they land)."""
+    consumers that want tokens as they land). In python mode with an
+    ``eos_token_id`` the token loop exits as soon as every row has
+    emitted EOS (the result is padded back to [B, S+N] with EOS, so the
+    output contract is unchanged).
+
+    Ragged prompts: pass a list of per-row token sequences (or a
+    pre-padded [B, S] batch) together with ``pad_token_id`` — rows are
+    LEFT-padded and an attention mask hides the pads through prefill AND
+    every decode step. Pad positions keep their absolute cache/RoPE
+    indices: RoPE scores depend only on relative distance, so a
+    left-padded row decodes exactly like its unpadded twin (for learned
+    position embeddings the shift is absolute, like other left-padding
+    implementations).
+
+    ``stream=True`` (forces python mode) returns a generator that yields
+    one np.int32 [B] token vector per generated position as it lands
+    (EOS-masked rows keep yielding EOS) and stops early once every row
+    is done."""
     cfg = GenerationConfig(max_new_tokens, do_sample, temperature, top_k, top_p,
                            eos_token_id, seed)
-    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-    ids = ids.astype(jnp.int32)
+    ids, pad_lens = _normalize_prompts(input_ids, pad_token_id)
+    ragged = pad_lens is not None
     B, S = ids.shape
     max_len = S + cfg.max_new_tokens
     config = model.config
@@ -160,30 +373,38 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
             f"max_position_embeddings ({config.max_position_embeddings}); the "
             "position table (RoPE / learned embeddings) has no entries past "
             "that position")
-    n_kv = config.num_key_value_heads
-    head_dim = config.hidden_size // config.num_attention_heads
     dtype = next(iter(model.parameters()))._data.dtype
 
     params = {k: v._data for k, v in model.named_parameters_dict().items()}
     buffers = {k: v._data for k, v in model.named_buffers_dict().items()}
-    n_layers = config.num_hidden_layers
 
     def make_caches():
-        return [{"k": jnp.zeros((B, max_len, n_kv, head_dim), dtype),
-                 "v": jnp.zeros((B, max_len, n_kv, head_dim), dtype)}
-                for _ in range(n_layers)]
+        return make_kv_caches(config, B, max_len, dtype)
 
-    def run(pb, token_ids, caches, pos):
-        with no_grad():
-            caches_t = [{"k": Tensor(c["k"]), "v": Tensor(c["v"])} for c in caches]
-            logits, new_caches = functional_call(model, pb, Tensor(token_ids),
-                                                 kv_caches=caches_t, position_offset=pos)
-        return (logits._data,
-                [{"k": c["k"]._data, "v": c["v"]._data} for c in new_caches])
+    base_run = make_cached_runner(model)
 
+    def run(pb, token_ids, caches, pos, pads=None):
+        if pads is None:
+            return base_run(pb, token_ids, caches, pos)
+        # ragged: causal mask that ALSO hides each row's left pads, for
+        # prefill and for every decode step (pads live at cache positions
+        # 0..pad_len-1 forever, so the default causal mask would attend
+        # them)
+        s = token_ids.shape[1]
+        kpos = jnp.arange(max_len)
+        qpos = pos + jnp.arange(s)
+        m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < pos + s)
+        m = m[None] & (kpos[None, None, :] >= pads[:, None, None])
+        mask = jnp.where(m[:, None], 0.0, -1e30).astype(jnp.float32)
+        return base_run(pb, token_ids, caches, pos, attn_mask=mask)
+
+    if stream:
+        loop_mode = "python"
     if loop_mode not in ("scan", "python"):
         raise ValueError(f"loop_mode must be 'scan' or 'python', got {loop_mode!r}")
     if cfg.max_new_tokens <= 0:
+        if stream:
+            return iter(())
         return Tensor(ids)
 
     # jitted executables are cached on the model so repeat generate() calls
@@ -194,23 +415,24 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     # must not recompile per eos id
     gen_key = (B, S, cfg.max_new_tokens, cfg.do_sample, cfg.temperature,
                cfg.top_k, cfg.top_p,
-               cfg.eos_token_id if loop_mode == "scan" else None, loop_mode)
+               cfg.eos_token_id if loop_mode == "scan" else None, loop_mode,
+               ragged)
     cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
     if gen_key not in cache_store:
 
         @jax.jit
-        def prefill(pb, ids, caches):
-            logits, caches = run(pb, ids, caches, 0)
+        def prefill(pb, ids, caches, pads):
+            logits, caches = run(pb, ids, caches, 0, pads)
             return logits[:, -1], caches
 
         @functools.partial(jax.jit, donate_argnums=(2,))
-        def step(pb, token, caches, pos, key):
-            logits, caches = run(pb, token[:, None], caches, pos)
+        def step(pb, token, caches, pos, key, pads):
+            logits, caches = run(pb, token[:, None], caches, pos, pads)
             nxt = _select_token(logits[:, 0], cfg, key)
             return nxt, caches
 
         @jax.jit
-        def generate_program(pb, ids, key):
+        def generate_program(pb, ids, key, pads):
             """The WHOLE generate as ONE program: cache init + prefill +
             first-token select + (N-1)-step ``lax.scan`` decode + EOS
             masking + prompt concat. A single dispatch and a single
@@ -219,14 +441,14 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
             transport (measured 3.2s -> 0.5s for 16x256 tokens on the
             134M model over the axon tunnel)."""
             caches = make_caches()
-            logits, caches = run(pb, ids, caches, 0)
+            logits, caches = run(pb, ids, caches, 0, pads)
             key, sub = jax.random.split(key)
             token = _select_token(logits[:, -1], cfg, sub)
 
             def body(carry, i):
                 token, caches, key = carry
                 key, sub = jax.random.split(key)
-                logits, caches = run(pb, token[:, None], caches, S + i)
+                logits, caches = run(pb, token[:, None], caches, S + i, pads)
                 nxt = _select_token(logits[:, 0], cfg, sub)
                 return (nxt, caches, key), nxt
 
@@ -244,16 +466,60 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
 
     pb = {**params, **buffers}
     key = jax.random.PRNGKey(cfg.seed)
+    pads = jnp.asarray(pad_lens) if ragged else None
+
+    def python_token_iter():
+        """One jitted step per token; yields the np.int32 [B] token
+        vector per position, EOS-masked, exiting early once every row
+        has emitted EOS."""
+        with _entrypoint("generation.generate"):
+            caches = make_caches()
+            last_logits, caches = prefill(pb, ids, caches, pads)
+            k = key
+            k, sub = jax.random.split(k)
+            token = _select_token(last_logits, cfg, sub)
+            done = np.zeros(B, bool)
+            for i in range(cfg.max_new_tokens):
+                if i > 0:
+                    k, sub = jax.random.split(k)
+                    # pos as a traced scalar: one compiled step
+                    # executable for all tokens
+                    token, caches = step(pb, token, caches,
+                                         jnp.asarray(S + i - 1, jnp.int32),
+                                         sub, pads)
+                tok_np = np.asarray(token).astype(np.int32)
+                if cfg.eos_token_id is not None:
+                    tok_np = np.where(done, cfg.eos_token_id, tok_np)
+                    done |= tok_np == cfg.eos_token_id
+                yield tok_np
+                if cfg.eos_token_id is not None and done.all():
+                    return
 
     # recompile-monitor attribution: prefill/step/whole-program compiles
     # charge to this entry; a compile after the first completed generate
     # (new B/S/N or config) is surfaced as a retrace
+    if stream:
+        return python_token_iter()
+
     with _entrypoint("generation.generate"):
         if loop_mode == "scan" and cfg.max_new_tokens > 1:
-            return Tensor(generate_program(pb, ids, key))
+            return Tensor(generate_program(pb, ids, key, pads))
+
+        if cfg.eos_token_id is not None:
+            # early-exit python loop: host-syncs each token (the
+            # streaming path already pays that), stops once every row is
+            # done, pads the tail back to N with EOS
+            toks = list(python_token_iter())
+            gen = np.stack(toks, axis=1)
+            if gen.shape[1] < cfg.max_new_tokens:
+                pad = np.full((B, cfg.max_new_tokens - gen.shape[1]),
+                              cfg.eos_token_id, np.int32)
+                gen = np.concatenate([gen, pad], axis=1)
+            return Tensor(jnp.concatenate(
+                [ids, jnp.asarray(gen)], axis=1))
 
         caches = make_caches()
-        last_logits, caches = prefill(pb, ids, caches)
+        last_logits, caches = prefill(pb, ids, caches, pads)
         key, sub = jax.random.split(key)
         token = _select_token(last_logits, cfg, sub)
 
@@ -261,10 +527,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
         for i in range(1, cfg.max_new_tokens):
             key, sub = jax.random.split(key)
             # pos as a traced scalar: one compiled step executable for all tokens
-            token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub)
+            token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub, pads)
             out.append(token)
         gen = jnp.stack(out, axis=1)  # [B, N]
-
-        if cfg.eos_token_id is not None:
-            gen = _mask_after_eos(gen, cfg.eos_token_id)
         return Tensor(jnp.concatenate([ids, gen], axis=1))

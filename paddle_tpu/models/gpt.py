@@ -103,8 +103,12 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
         b, s = input_ids.shape
         # position_offset may be traced (jitted decode step): index wpe
-        # with a dynamic starting position
-        pos = position_offset + jnp.arange(s, dtype=jnp.int32)
+        # with a dynamic starting position; a per-row [b] vector (serving
+        # decode: each slot at its own position) gathers [b, s] rows
+        if getattr(position_offset, "ndim", 0) == 1:
+            pos = position_offset[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        else:
+            pos = position_offset + jnp.arange(s, dtype=jnp.int32)
         x = self.wte(input_ids) + self.wpe(Tensor(pos))
         if kv_caches is not None:
             new_caches = []

@@ -93,13 +93,21 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
 
 def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos_tab, sin_tab, position_offset: int = 0):
     """Rotary embedding on [b, s, h, d] tensors (reference:
-    incubate fused_rope / PaddleNLP rope; half-split convention)."""
+    incubate fused_rope / PaddleNLP rope; half-split convention).
+    ``position_offset`` may be a per-row [b] vector (serving decode:
+    every slot sits at its own position) — the tables are then gathered
+    per row instead of sliced once."""
 
     def _rope(x, cos, sin):
         s = x.shape[1]
         if isinstance(position_offset, int):
             c = cos[position_offset:position_offset + s]
             si = sin[position_offset:position_offset + s]
+        elif getattr(position_offset, "ndim", 0) == 1:
+            # per-row offsets [b]: gather [b, s] position rows
+            idx = position_offset[:, None] + jnp.arange(s)
+            c = cos[idx]   # [b, s, d/2]
+            si = sin[idx]
         else:  # traced offset (jitted decode step)
             c = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, 0)
             si = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, 0)
@@ -109,8 +117,12 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos_tab, sin_tab, position_offset
         # table first costs <=1 ulp while keeping the whole rope fwd AND
         # its transpose in bf16 — fp32 tables made XLA materialize fp32
         # [b,h,s,d] copies in the backward (~10 ms/step on the MoE bench)
-        c = c[None, :, None, :].astype(x.dtype)
-        si = si[None, :, None, :].astype(x.dtype)
+        if c.ndim == 3:  # per-row [b, s, d/2]
+            c = c[:, :, None, :].astype(x.dtype)
+            si = si[:, :, None, :].astype(x.dtype)
+        else:
+            c = c[None, :, None, :].astype(x.dtype)
+            si = si[None, :, None, :].astype(x.dtype)
         x1, x2 = jnp.split(x, 2, axis=-1)
         return jnp.concatenate([
             x1 * c - x2 * si,

@@ -190,6 +190,25 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
 
                 body = json.dumps(snapshot()).encode()
                 ctype = "application/json"
+            elif self.path.split("?")[0] == "/healthz":
+                # liveness + the serving gauges (queue depth, slot
+                # occupancy), so a probe sees serving state without
+                # pulling a full snapshot
+                reg = get_registry()
+
+                def _g(name):
+                    m = reg.get(name)
+                    return m.value() if m is not None else None
+
+                body = json.dumps({
+                    "status": "ok",
+                    "ts": time.time(),
+                    "serving_queue_depth": _g("paddle_tpu_serving_queue_depth"),
+                    "serving_slots_busy": _g("paddle_tpu_serving_slots_busy"),
+                    "serving_slot_occupancy": _g(
+                        "paddle_tpu_serving_slot_occupancy"),
+                }).encode()
+                ctype = "application/json"
             else:
                 self.send_response(404)
                 self.end_headers()

@@ -30,7 +30,8 @@ from typing import Dict, List, Optional
 from . import metrics as _m
 
 __all__ = ["install", "installed", "entrypoint", "current_entry",
-           "compile_events", "total_compiles", "entry_stats", "reset_entries"]
+           "compile_events", "total_compiles", "entry_stats", "reset_entries",
+           "reset_warmup"]
 
 logger = logging.getLogger("paddle_tpu.observability")
 
@@ -176,6 +177,20 @@ def total_compiles() -> int:
 def entry_stats() -> Dict[str, dict]:
     with _entries_lock:
         return {k: dict(v) for k, v in _entries.items()}
+
+
+def reset_warmup(*names: str):
+    """Restart retrace warmup for ``names``: the owner just built NEW
+    jitted executables for those entries (e.g. a fresh ServingEngine's
+    step/prefill closures), so their next compiles are expected warmup,
+    not retraces. Compile/retrace totals are kept — only the completed-
+    call count (the "past warmup" marker) and the warn latch clear."""
+    with _entries_lock:
+        for name in names:
+            st = _entries.get(name)
+            if st is not None:
+                st["calls"] = 0
+                st["warned"] = False
 
 
 def reset_entries():

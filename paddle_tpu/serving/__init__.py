@@ -1,0 +1,48 @@
+"""paddle_tpu.serving — continuous-batching inference engine over
+slot-based static KV caches.
+
+The north-star workload is "serve heavy traffic from millions of
+users"; ``generation.generate`` is one request at a time, whole-batch
+lockstep. This package is the request-level layer above the same
+static-shape decode substrate:
+
+- ``engine``:    ``ServingEngine`` — a fixed pool of decode slots over
+                 pre-allocated [B, max_len, h, d] KV buffers; bucketed
+                 padded prefill, ``dynamic_update_slice`` cache splice,
+                 ONE jitted decode step for the whole pool (per-slot
+                 positions/sampling params/PRNG keys as traced arrays),
+                 slots freed on EOS/max-tokens and refilled immediately.
+- ``scheduler``: FCFS admission, max-queue-depth backpressure
+                 (``QueueFullError``), deadlines, cancellation.
+- ``request``:   ``Request`` handles — blocking ``result()``, streaming
+                 ``stream()`` iterator, per-token callbacks.
+- ``metrics``:   requests/tokens counters, queue-depth + slot-occupancy
+                 gauges, TTFT/TPOT histograms in the shared
+                 observability registry (registered at import so
+                 scrapes always show serving state).
+- ``http``:      opt-in stdlib HTTP front end
+                 (``start_serving_http_server``).
+
+Quick start::
+
+    from paddle_tpu import serving
+    eng = serving.ServingEngine(model, max_slots=8, max_len=512)
+    eng.start()                      # background loop (or drive step())
+    req = eng.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    for tok in req.stream():         # tokens as the decode lands them
+        ...
+"""
+
+from __future__ import annotations
+
+from . import metrics  # registers the serving gauges at import
+from .engine import ServingConfig, ServingEngine
+from .http import start_serving_http_server, stop_serving_http_server
+from .request import Request, RequestStatus, SamplingParams
+from .scheduler import QueueFullError, Scheduler
+
+__all__ = [
+    "ServingConfig", "ServingEngine", "SamplingParams", "Request",
+    "RequestStatus", "Scheduler", "QueueFullError",
+    "start_serving_http_server", "stop_serving_http_server",
+]

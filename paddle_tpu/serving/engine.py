@@ -1,0 +1,498 @@
+"""Continuous-batching serving engine over slot-based static KV caches.
+
+The TPU-native translation of iteration-level scheduling (Orca) +
+paged/managed KV serving (vLLM), built on this repo's static-shape
+decode substrate instead of paging:
+
+- a fixed pool of ``max_slots`` decode SLOTS over pre-allocated
+  [B, max_len, h, d] KV buffers (one pytree for the whole pool);
+- admission prefills one request at a BUCKETED prompt length (a small
+  set of padded-prefill executables — right-padded, plain causal mask:
+  padded keys sit at positions the causal mask never exposes) and
+  splices the per-layer [1, Lb, h, d] prefill cache into the slot with
+  ``dynamic_update_slice``;
+- decode drives ONE jitted step for the whole slot pool every
+  iteration: per-slot positions ([B] vector — each slot at its own
+  sequence offset), per-slot sampling params and PRNG keys carried as
+  traced arrays so mixed greedy/sampled requests share the single step
+  program. The step executable compiles exactly once and then runs at
+  whatever occupancy admission sustains;
+- slots free on EOS / max-tokens / cancellation / deadline and are
+  refilled by the next iteration's admission pass.
+
+Per-request outputs are bit-identical to ``generation.generate`` with
+the same sampling seed/params: the slot key chain reproduces generate's
+``key, sub = split(key)`` walk and ``select_tokens`` row-wise equals the
+config-static ``_select_token`` (tests/test_serving.py holds this as an
+oracle).
+
+Observability: requests/tokens counters, queue-depth + slot-occupancy
+gauges, TTFT/TPOT histograms (serving/metrics.py), and every compile is
+attributed to the ``serving.step`` / ``serving.prefill[Lb]`` recompile-
+monitor entries — a retrace on ``serving.step`` after warmup is a bug
+and the monitor will flag it.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generation import (make_cached_runner, make_kv_caches, select_tokens,
+                          split_keys)
+from ..observability import recompile as _recompile
+from ..observability.recompile import entrypoint as _entrypoint
+from . import metrics as _sm
+from .request import Request, RequestStatus, SamplingParams
+from .scheduler import Scheduler
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+def _default_buckets(max_len: int) -> tuple:
+    """Powers of two from 16 up to (and always including) max_len."""
+    out = []
+    b = 16
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+@dataclass
+class ServingConfig:
+    """Engine knobs.
+
+    - ``max_slots``: the decode batch B — slots in flight at once.
+    - ``max_len``: per-slot KV capacity; every request needs
+      prompt_len + max_new_tokens <= max_len.
+    - ``prefill_buckets``: padded prompt lengths; each bucket costs one
+      prefill + one splice compile, so keep the set small. Defaults to
+      powers of two up to max_len.
+    - ``max_queue_depth``: admission backpressure bound
+      (``QueueFullError`` beyond it).
+    - ``pad_token_id``: right-pad filler for bucketed prefill — any
+      valid token id works (padded positions are causally invisible).
+    """
+
+    max_slots: int = 4
+    max_len: int = 256
+    prefill_buckets: Sequence[int] = ()
+    max_queue_depth: int = 64
+    pad_token_id: int = 0
+
+    def buckets(self) -> tuple:
+        bs = tuple(sorted({int(b) for b in self.prefill_buckets
+                           if int(b) <= self.max_len}))
+        if not bs:
+            return _default_buckets(self.max_len)
+        if bs[-1] != self.max_len:
+            bs = bs + (self.max_len,)
+        return bs
+
+
+class ServingEngine:
+    """Request-level serving over one decoder model (llama / gpt — any
+    model speaking the generation.py static-cache protocol).
+
+    Drive it synchronously (``submit`` + ``step``/``run_until_idle`` —
+    deterministic, what the tests do) or as a background thread
+    (``start``/``stop``; ``submit`` then wakes the loop and callers wait
+    on ``Request.result()`` / iterate ``Request.stream()``).
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None, **overrides):
+        if config is None:
+            config = ServingConfig(**overrides)
+        elif overrides:
+            raise ValueError("pass ServingConfig OR keyword overrides, not both")
+        self.config = config
+        self.model = model
+        mcfg = model.config
+        if config.max_len > mcfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len ({config.max_len}) exceeds the model's "
+                f"max_position_embeddings ({mcfg.max_position_embeddings})")
+        self._buckets = config.buckets()
+        # this engine's step/prefill closures are NEW executables — their
+        # first compiles are warmup, not retraces of a previous engine's
+        _recompile.reset_warmup(
+            "serving.step", *(f"serving.prefill[{b}]" for b in self._buckets))
+        B = int(config.max_slots)
+        self.scheduler = Scheduler(config.max_queue_depth)
+
+        self._dtype = next(iter(model.parameters()))._data.dtype
+        params = {k: v._data for k, v in model.named_parameters_dict().items()}
+        buffers = {k: v._data for k, v in model.named_buffers_dict().items()}
+        self._pb = {**params, **buffers}
+        self._mcfg = mcfg
+
+        # slot pool state. The KV pool AND the per-slot decode state
+        # (last token, position, PRNG chain, sampling params) live on
+        # DEVICE across steps — the decode loop transfers ONE [B] token
+        # vector per iteration and nothing else; admission updates a
+        # slot's state rows inside the (jitted) splice program.
+        self._caches = make_kv_caches(mcfg, B, config.max_len, self._dtype)
+        self._state = {
+            "tokens": jnp.zeros(B, jnp.int32),     # last token per slot
+            "pos": jnp.zeros(B, jnp.int32),        # next cache write index
+            "keys": jnp.zeros((B, 2), jnp.uint32),  # per-slot PRNG chain
+            "ds": jnp.zeros(B, bool),
+            "temp": jnp.ones(B, jnp.float32),
+            "tk": jnp.zeros(B, jnp.int32),
+            "tp": jnp.ones(B, jnp.float32),
+        }
+        self._slot_req: List[Optional[Request]] = [None] * B
+        self._slot_sampling = [False] * B  # host mirror for the step cond
+
+        self._steps = 0
+        self._occupancy_integral = 0
+        self._outcomes = {}
+        self._step_lock = threading.RLock()
+        self._wake = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        run = make_cached_runner(model)
+
+        @jax.jit
+        def _prefill(pb, ids, last_idx, key, do_sample, temp, top_k, top_p):
+            """Bucketed prefill: one forward over the right-padded
+            prompt into fresh [1, Lb] caches, then the FIRST token
+            select with generate's exact key chain
+            (key, sub = split(key); select(last_logits, sub))."""
+            Lb = ids.shape[1]
+            caches = make_kv_caches(mcfg, 1, Lb, self._dtype)
+            logits, caches = run(pb, ids, caches, 0)
+            last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1, axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            token = jax.lax.cond(
+                do_sample[0],
+                lambda: select_tokens(last, sub[None], do_sample, temp,
+                                      top_k, top_p),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            return token, key, caches
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _splice(caches, state, pcaches, slot, token, pos0, key,
+                    ds, temp, tk, tp):
+            """Admission: copy a prefilled [1, Lb, h, d] cache into slot
+            ``slot`` of the pool (rows [slot, 0:Lb]) via
+            ``dynamic_update_slice`` AND set that slot's rows of the
+            device-resident decode state — one dispatch, no recompile,
+            nothing round-trips through the host."""
+            out = []
+            for c, p in zip(caches, pcaches):
+                out.append({
+                    "k": jax.lax.dynamic_update_slice(
+                        c["k"], p["k"].astype(c["k"].dtype), (slot, 0, 0, 0)),
+                    "v": jax.lax.dynamic_update_slice(
+                        c["v"], p["v"].astype(c["v"].dtype), (slot, 0, 0, 0)),
+                })
+            state = dict(state)
+            state["tokens"] = state["tokens"].at[slot].set(token)
+            state["pos"] = state["pos"].at[slot].set(pos0)
+            state["keys"] = state["keys"].at[slot].set(key)
+            state["ds"] = state["ds"].at[slot].set(ds)
+            state["temp"] = state["temp"].at[slot].set(temp)
+            state["tk"] = state["tk"].at[slot].set(tk)
+            state["tp"] = state["tp"].at[slot].set(tp)
+            return out, state
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _step(pb, caches, state, any_sampling):
+            """ONE decode iteration for the whole slot pool: per-slot
+            positions (vector ``state["pos"]``) drive per-row RoPE/
+            cache-write/mask; per-slot params + keys drive the batched
+            sampler. Compiles once — every shape here is fixed by the
+            pool. When NO active slot samples (``any_sampling``, a
+            host-tracked traced scalar — stale params on freed slots
+            can't force the branch), a runtime ``lax.cond`` skips the
+            sampling branch (its full-vocab sort is the most expensive
+            op in the step) for a pure-argmax step — exact, since
+            ``select_tokens`` is row-wise greedy for ds=False rows.
+            Free slots keep decoding garbage rows; their tokens are
+            never delivered and admission resets their state."""
+            logits, caches = run(pb, state["tokens"][:, None], caches,
+                                 state["pos"])
+            last = logits[:, 0]
+            new_keys, subs = split_keys(state["keys"])
+            nxt = jax.lax.cond(
+                any_sampling,
+                lambda: select_tokens(last, subs, state["ds"], state["temp"],
+                                      state["tk"], state["tp"]),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            state = dict(state)
+            state["tokens"] = nxt
+            # free rows advance too — clamp so their cache writes stay
+            # in bounds (the clamped row is overwritten at admission)
+            state["pos"] = jnp.minimum(state["pos"] + 1,
+                                       jnp.int32(config.max_len - 1))
+            state["keys"] = new_keys
+            return nxt, caches, state
+
+        self._prefill_fn = _prefill
+        self._splice_fn = _splice
+        self._step_fn = _step
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt, deadline_s: Optional[float] = None,
+               on_token=None, params: Optional[SamplingParams] = None,
+               **sampling) -> Request:
+        """Enqueue one request; returns its handle immediately.
+
+        ``prompt`` is a 1-D sequence of token ids; ``sampling`` takes
+        the ``SamplingParams`` fields (``max_new_tokens``, ``do_sample``,
+        ``temperature``, ``top_k``, ``top_p``, ``eos_token_id``,
+        ``seed``), or pass a prebuilt ``params``. Raises ``ValueError``
+        for requests that cannot fit a slot and ``QueueFullError`` under
+        backpressure."""
+        if params is None:
+            params = SamplingParams(**sampling)
+        elif sampling:
+            raise ValueError("pass params OR sampling kwargs, not both")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        L = int(prompt.shape[0])
+        if L < 1:
+            raise ValueError("empty prompt")
+        if params.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if L + params.max_new_tokens > self.config.max_len:
+            raise ValueError(
+                f"prompt ({L}) + max_new_tokens ({params.max_new_tokens}) "
+                f"exceeds the slot KV capacity max_len="
+                f"{self.config.max_len}")
+        req = Request(prompt, params, deadline_s=deadline_s, on_token=on_token)
+        self.scheduler.submit(req)  # may raise QueueFullError
+        with self._wake:
+            self._wake.notify_all()
+        return req
+
+    def cancel(self, req: Request) -> bool:
+        return self.scheduler.cancel(req)
+
+    # -- slot bookkeeping ----------------------------------------------------
+    def _bucket(self, L: int) -> int:
+        for b in self._buckets:
+            if b >= L:
+                return b
+        raise ValueError(f"prompt length {L} exceeds max bucket "
+                         f"{self._buckets[-1]}")
+
+    def busy_slots(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    def _update_occupancy_gauges(self):
+        busy = self.busy_slots()
+        _sm.slots_busy.set(busy)
+        _sm.slot_occupancy.set(busy / max(1, self.config.max_slots))
+
+    def _free_slot(self, slot: int, status: str, outcome: str,
+                   error: Optional[str] = None):
+        req = self._slot_req[slot]
+        self._slot_req[slot] = None
+        self._slot_sampling[slot] = False
+        if req is not None:
+            req.finish(status, error=error)
+            _sm.requests_total.labels(outcome).inc()
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+        self._update_occupancy_gauges()
+
+    def _finish_or_keep(self, slot: int, req: Request, token: int,
+                        now: float) -> bool:
+        """Terminal checks after a delivered token; True when freed."""
+        p = req.params
+        if req.cancel_requested:
+            self._free_slot(slot, RequestStatus.CANCELLED, "cancelled")
+            return True
+        if req.deadline_ts is not None and now > req.deadline_ts:
+            self._free_slot(slot, RequestStatus.EXPIRED, "expired",
+                            error="deadline passed during decode")
+            return True
+        if p.eos_token_id is not None and token == p.eos_token_id:
+            self._free_slot(slot, RequestStatus.COMPLETED, "completed")
+            return True
+        if len(req.output_tokens) >= p.max_new_tokens:
+            self._free_slot(slot, RequestStatus.COMPLETED, "completed")
+            return True
+        return False
+
+    # -- admission / prefill -------------------------------------------------
+    def _prefill_into_slot(self, req: Request, slot: int):
+        p = req.params
+        L = int(req.prompt.shape[0])
+        Lb = self._bucket(L)
+        ids = np.full((1, Lb), self.config.pad_token_id, np.int32)
+        ids[0, :L] = req.prompt
+        t0 = time.perf_counter()
+        with _entrypoint(f"serving.prefill[{Lb}]"):
+            token, key, pcaches = self._prefill_fn(
+                self._pb, jnp.asarray(ids), jnp.asarray(L - 1, jnp.int32),
+                jax.random.PRNGKey(p.seed),
+                jnp.asarray([p.do_sample]),
+                jnp.asarray([p.temperature], jnp.float32),
+                jnp.asarray([p.top_k], jnp.int32),
+                jnp.asarray([p.top_p], jnp.float32))
+            # prefill outputs stay on device: the splice wires them into
+            # the pool caches + the slot's decode-state rows directly
+            self._caches, self._state = self._splice_fn(
+                self._caches, self._state, pcaches,
+                jnp.asarray(slot, jnp.int32), token[0],
+                jnp.asarray(L, jnp.int32), key,
+                jnp.asarray(p.do_sample),
+                jnp.asarray(p.temperature, jnp.float32),
+                jnp.asarray(p.top_k, jnp.int32),
+                jnp.asarray(p.top_p, jnp.float32))
+        tok0 = int(np.asarray(token)[0])
+        now = time.perf_counter()
+        _sm.prefill_seconds.observe(now - t0)
+        _sm.tokens_total.labels("prompt").inc(L)
+        _sm.tokens_total.labels("generated").inc()
+
+        self._slot_req[slot] = req
+        self._slot_sampling[slot] = bool(p.do_sample)
+        req.slot = slot
+        req.status = RequestStatus.RUNNING
+        req.prefill_done_ts = now
+
+        req.push_token(tok0, now)
+        _sm.ttft_seconds.observe(req.ttft_s)
+        self._finish_or_keep(slot, req, tok0, now)
+        self._update_occupancy_gauges()
+
+    def _admit(self):
+        """Fill every free slot FCFS from the queue (prefill + splice);
+        runs at the top of each iteration so a slot freed by EOS is
+        refilled before the next decode step."""
+        for slot in range(self.config.max_slots):
+            while self._slot_req[slot] is None:
+                req = self.scheduler.pop_ready()
+                if req is None:
+                    return
+                try:
+                    self._prefill_into_slot(req, slot)
+                except Exception as e:  # noqa: BLE001 — engine must survive
+                    self._slot_req[slot] = None
+                    req.finish(RequestStatus.FAILED, error=repr(e))
+                    _sm.requests_total.labels("failed").inc()
+                    self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
+
+    # -- the iteration -------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: admit into free slots, then (if any
+        slot is busy) run the single jitted decode step for the whole
+        pool and deliver/retire per-slot tokens. Returns True when any
+        work happened."""
+        with self._step_lock:
+            self._admit()
+            active = [i for i, r in enumerate(self._slot_req) if r is not None]
+            # cancellation between steps: drop flagged slots without
+            # paying another decode step for them
+            for i in list(active):
+                if self._slot_req[i].cancel_requested:
+                    self._free_slot(i, RequestStatus.CANCELLED, "cancelled")
+                    active.remove(i)
+            if not active:
+                self._update_occupancy_gauges()
+                return False
+
+            t0 = time.perf_counter()
+            any_sampling = any(self._slot_sampling[i] for i in active)
+            with _entrypoint("serving.step"):
+                toks, self._caches, self._state = self._step_fn(
+                    self._pb, self._caches, self._state,
+                    jnp.asarray(any_sampling))
+            toks_np = np.asarray(toks)  # the step's ONE device->host sync
+            now = time.perf_counter()
+            _sm.steps_total.inc()
+            _sm.step_seconds.observe(now - t0)
+            self._steps += 1
+            self._occupancy_integral += len(active)
+
+            for i in active:
+                req = self._slot_req[i]
+                t = int(toks_np[i])
+                prev = req.last_token_ts
+                req.push_token(t, now)
+                _sm.tokens_total.labels("generated").inc()
+                if prev is not None:
+                    _sm.tpot_seconds.observe(now - prev)
+                self._finish_or_keep(i, req, t, now)
+            return True
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> int:
+        """Drive ``step()`` until queue and slots are empty (the
+        synchronous serving loop); returns iterations executed."""
+        n = 0
+        while n < max_steps and (self.scheduler.depth or self.busy_slots()):
+            if not self.step():
+                break
+            n += 1
+        # admission may have drained the queue into terminal states
+        # without any decode work; one more pass clears stragglers
+        self._admit()
+        return n
+
+    # -- background loop -----------------------------------------------------
+    def start(self):
+        """Run the serving loop on a daemon thread (the HTTP front end
+        and ``Request.result()`` consumers use this mode)."""
+        with self._wake:
+            if self._running:
+                return self
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._serve_loop, name="paddle-tpu-serving", daemon=True)
+            self._thread.start()
+        return self
+
+    def _serve_loop(self):
+        while self._running:
+            if not self.step():
+                with self._wake:
+                    if self._running and not self.scheduler.depth \
+                            and not self.busy_slots():
+                        self._wake.wait(0.05)
+
+    def stop(self):
+        self._running = False
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def mean_occupancy(self) -> Optional[float]:
+        if not self._steps:
+            return None
+        return self._occupancy_integral / (self._steps * self.config.max_slots)
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.config.max_slots,
+            "slots_busy": self.busy_slots(),
+            "queue_depth": self.scheduler.depth,
+            "max_len": self.config.max_len,
+            "prefill_buckets": list(self._buckets),
+            "steps": self._steps,
+            "mean_occupancy": self.mean_occupancy,
+            "outcomes": dict(self._outcomes),
+            "running": self._running,
+        }

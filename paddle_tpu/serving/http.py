@@ -1,0 +1,159 @@
+"""Opt-in stdlib HTTP front end for the serving engine — the same
+zero-dependency ``http.server`` pattern as the observability scrape
+endpoint (``observability.exporters.start_http_server``).
+
+Token-level API (the framework has no tokenizer): prompts and
+completions are lists of token ids.
+
+- ``POST /generate`` body
+  ``{"prompt": [ids], "max_new_tokens": 16, "do_sample": false,
+     "temperature": 1.0, "top_k": 0, "top_p": 1.0, "eos_token_id": null,
+     "seed": 0, "deadline_s": null, "stream": false}``
+  -> ``{"request_id", "status", "prompt_len", "tokens", "ttft_s",
+        "tpot_s", "latency_s"}``; with ``"stream": true`` the response
+  is newline-delimited JSON, one ``{"token": id}`` line per token as it
+  lands, then a final ``{"done": true, "status": ...}`` line.
+- ``GET /healthz``  -> liveness + the serving gauges
+  (slots busy/total, queue depth) as JSON.
+- ``GET /stats``    -> ``engine.stats()``.
+
+Backpressure maps to ``429``, invalid requests to ``400``.
+Opt-in only: nothing starts this server implicitly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .scheduler import QueueFullError
+
+__all__ = ["start_serving_http_server", "stop_serving_http_server"]
+
+_server = None
+_server_thread = None
+_server_lock = threading.Lock()
+
+
+def _request_record(req) -> dict:
+    return {
+        "request_id": req.id,
+        "status": req.status,
+        "prompt_len": int(req.prompt.shape[0]),
+        "tokens": list(req.output_tokens),
+        "ttft_s": req.ttft_s,
+        "tpot_s": req.tpot_s,
+        "latency_s": (req.finish_ts - req.arrival_ts
+                      if req.finish_ts else None),
+        "error": req.error,
+    }
+
+
+def start_serving_http_server(engine, port: int = 0, addr: str = "127.0.0.1",
+                              request_timeout_s: float = 300.0) -> int:
+    """Serve the engine over HTTP on a daemon thread; returns the bound
+    port (``port=0`` picks a free one). Starts the engine's background
+    loop if it isn't running (handlers block on ``Request.result``)."""
+    global _server, _server_thread
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    engine.start()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                self._json(200, {
+                    "status": "ok",
+                    "ts": time.time(),
+                    "slots_busy": engine.busy_slots(),
+                    "slots_total": engine.config.max_slots,
+                    "queue_depth": engine.scheduler.depth,
+                })
+            elif path == "/stats":
+                self._json(200, engine.stats())
+            else:
+                self._json(404, {"error": f"no such path {path!r}"})
+
+        def do_POST(self):
+            if self.path.split("?")[0] != "/generate":
+                self._json(404, {"error": "POST /generate only"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = body.pop("prompt")
+                stream = bool(body.pop("stream", False))
+                deadline_s = body.pop("deadline_s", None)
+                if not isinstance(prompt, (list, tuple)) or not prompt:
+                    raise ValueError("prompt must be a non-empty list of "
+                                     "token ids")
+            except (ValueError, KeyError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                req = engine.submit(prompt, deadline_s=deadline_s, **body)
+            except QueueFullError as e:
+                self._json(429, {"error": str(e)})
+                return
+            except (TypeError, ValueError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            if not stream:
+                try:
+                    req.result(timeout=request_timeout_s)
+                except TimeoutError:
+                    req.cancel()
+                    req.result(timeout=10.0)
+                self._json(200, _request_record(req))
+                return
+            # streaming: newline-delimited JSON; no Content-Length, the
+            # connection close marks the end (HTTP/1.0 framing)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.end_headers()
+            try:
+                for tok in req.stream(timeout=request_timeout_s):
+                    self.wfile.write(
+                        (json.dumps({"token": int(tok)}) + "\n").encode())
+                    self.wfile.flush()
+            except (TimeoutError, BrokenPipeError, ConnectionResetError):
+                req.cancel()
+            done = dict(_request_record(req))
+            done["done"] = True
+            try:
+                self.wfile.write((json.dumps(done) + "\n").encode())
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def log_message(self, *args):  # no per-request stderr chatter
+            pass
+
+    with _server_lock:
+        if _server is not None:
+            return _server.server_address[1]
+        _server = ThreadingHTTPServer((addr, port), _Handler)
+        _server_thread = threading.Thread(target=_server.serve_forever,
+                                          name="paddle-tpu-serving-http",
+                                          daemon=True)
+        _server_thread.start()
+        return _server.server_address[1]
+
+
+def stop_serving_http_server():
+    global _server, _server_thread
+    with _server_lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+            _server_thread = None

@@ -1,0 +1,60 @@
+"""Serving metrics, registered at import so a scrape of the
+observability HTTP endpoint shows serving state (queue depth, slot
+occupancy, TTFT/TPOT) without anyone having to take a snapshot first.
+
+Names follow the ``paddle_tpu_serving_*`` prefix; all instruments live
+in the shared observability registry (lock-free writer hot path), so
+``observability.prometheus_text()`` / ``/metrics`` pick them up
+automatically.
+"""
+
+from __future__ import annotations
+
+from ..observability import metrics as _m
+
+__all__ = [
+    "requests_total", "tokens_total", "queue_depth", "slots_busy",
+    "slot_occupancy", "steps_total", "step_seconds", "prefill_seconds",
+    "ttft_seconds", "tpot_seconds",
+]
+
+requests_total = _m.counter(
+    "paddle_tpu_serving_requests_total",
+    "serving requests by terminal outcome", ("outcome",))
+tokens_total = _m.counter(
+    "paddle_tpu_serving_tokens_total",
+    "tokens through the serving engine (prompt = prefilled, "
+    "generated = decoded)", ("kind",))
+queue_depth = _m.gauge(
+    "paddle_tpu_serving_queue_depth",
+    "requests waiting for a decode slot")
+slots_busy = _m.gauge(
+    "paddle_tpu_serving_slots_busy",
+    "decode slots currently running a request")
+slot_occupancy = _m.gauge(
+    "paddle_tpu_serving_slot_occupancy",
+    "busy fraction of the decode slot pool (0..1)")
+steps_total = _m.counter(
+    "paddle_tpu_serving_steps_total",
+    "batched decode steps executed")
+step_seconds = _m.histogram(
+    "paddle_tpu_serving_step_seconds",
+    "wall time of one batched decode step",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0))
+prefill_seconds = _m.histogram(
+    "paddle_tpu_serving_prefill_seconds",
+    "wall time of one bucketed prefill (+ cache splice)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+ttft_seconds = _m.histogram(
+    "paddle_tpu_serving_ttft_seconds",
+    "time to first token (request arrival -> first token delivered)",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0))
+tpot_seconds = _m.histogram(
+    "paddle_tpu_serving_tpot_seconds",
+    "per-token decode latency (time between consecutive tokens of one "
+    "request)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5))

@@ -1,0 +1,93 @@
+"""Admission control for the serving engine: FCFS queue with bounded
+depth (backpressure), per-request deadlines, and cancellation.
+
+Iteration-level scheduling (Orca) splits serving into two loops: the
+ADMISSION decision (this module — which request gets the next free slot)
+and the ITERATION itself (engine.py — one decode step for every running
+slot). FCFS is deliberately the whole policy here: the TPU-side design
+makes admission cheap enough (bucketed prefill + cache splice, no
+recompile) that fancier policies are a drop-in swap of ``pop_ready``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import metrics as _sm
+from .request import Request, RequestStatus
+
+__all__ = ["Scheduler", "QueueFullError"]
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the admission queue is at max depth. Callers should
+    shed load or retry later — the engine NEVER buffers unboundedly."""
+
+
+class Scheduler:
+    def __init__(self, max_queue_depth: int = 64):
+        self.max_queue_depth = int(max_queue_depth)
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def submit(self, req: Request):
+        """FCFS enqueue. Raises ``QueueFullError`` (and marks the request
+        REJECTED) when the queue is at max depth — the clear-rejection
+        contract: the caller knows immediately, nothing is dropped
+        silently."""
+        with self._lock:
+            if len(self._q) >= self.max_queue_depth:
+                req.finish(RequestStatus.REJECTED,
+                           error=f"queue full (depth {self.max_queue_depth})")
+                _sm.requests_total.labels("rejected").inc()
+                raise QueueFullError(
+                    f"serving queue is full ({self.max_queue_depth} requests "
+                    f"waiting); retry later or raise max_queue_depth")
+            req.status = RequestStatus.QUEUED
+            self._q.append(req)
+            _sm.queue_depth.set(len(self._q))
+
+    def cancel(self, req: Request) -> bool:
+        """Cancel a request. Queued: removed immediately. Running: flag
+        it; the engine frees the slot at the next step boundary. Returns
+        True when the request was still live."""
+        req.cancel_requested = True
+        with self._lock:
+            if req in self._q:
+                self._q.remove(req)
+                _sm.queue_depth.set(len(self._q))
+                req.finish(RequestStatus.CANCELLED)
+                _sm.requests_total.labels("cancelled").inc()
+                return True
+        return req.status not in RequestStatus.FINAL
+
+    def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
+        """Next admissible request (FCFS), transparently finishing
+        cancelled/expired entries it skips over."""
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            while self._q:
+                req = self._q.popleft()
+                _sm.queue_depth.set(len(self._q))
+                if req.cancel_requested:
+                    req.finish(RequestStatus.CANCELLED)
+                    _sm.requests_total.labels("cancelled").inc()
+                    continue
+                if req.deadline_ts is not None and now > req.deadline_ts:
+                    req.finish(RequestStatus.EXPIRED,
+                               error="deadline passed while queued")
+                    _sm.requests_total.labels("expired").inc()
+                    continue
+                return req
+            return None

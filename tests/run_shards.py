@@ -218,6 +218,18 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
                 if k.startswith("fallback/"))
     totals["fused_conv_hit_rate"] = (
         round(hits / (hits + falls), 4) if hits + falls else None)
+    # fold the most recent serving bench artifact (if any) into the lane
+    # so one file carries the full telemetry story: compile counts,
+    # fused-conv hit rate, AND the continuous-batching numbers
+    serving_bench = None
+    sb_path = os.path.join(os.path.dirname(HERE), "benchmarks",
+                           "bench_serving.json")
+    if os.path.exists(sb_path):
+        try:
+            with open(sb_path) as fh:
+                serving_bench = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            serving_bench = None
     out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
                             "telemetry_lane.json")
     with open(out_path, "w") as fh:
@@ -227,6 +239,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
                 datetime.timezone.utc).isoformat(timespec="seconds"),
             "totals": totals,
             "shards": shards,
+            "serving_bench": serving_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

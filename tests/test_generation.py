@@ -151,3 +151,95 @@ class TestUncachedGeneration:
             logits = model(paddle.to_tensor(cur)).numpy()
             cur = np.concatenate([cur, logits[:, -1].argmax(-1).astype("int32")[:, None]], 1)
         np.testing.assert_array_equal(out, cur)
+
+
+class TestRaggedAndStreaming:
+    """PR-3 satellites on generate itself: ragged prompts (left-padding
+    + attention mask through prefill AND decode), python-loop early exit
+    on all-rows-EOS, and the stream generator."""
+
+    def test_ragged_prompts_match_per_row_generate(self, tiny_model):
+        """Each row of a ragged batch (left-padded, mask-hidden pads)
+        must decode to the same tokens as a standalone generate() of
+        that row alone (RoPE scores depend only on relative distance,
+        so the left shift is invisible to attention)."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(21)
+        prompts = [rng.randint(1, cfg.vocab_size, n).astype("int32")
+                   for n in (3, 6, 9)]
+        N = 6
+        out = paddle.generation.generate(
+            model, [list(p) for p in prompts], max_new_tokens=N,
+            pad_token_id=0).numpy()
+        S = max(len(p) for p in prompts)
+        assert out.shape == (3, S + N)
+        for b, p in enumerate(prompts):
+            ref = paddle.generation.generate(
+                model, p[None], max_new_tokens=N).numpy()[0, len(p):]
+            np.testing.assert_array_equal(out[b, S:], ref)
+            # the visible prompt sits right-aligned above the pads
+            np.testing.assert_array_equal(out[b, S - len(p):S], p)
+
+    def test_equal_length_list_needs_no_pad_id(self, tiny_model):
+        model, cfg = tiny_model
+        rng = np.random.RandomState(23)
+        rows = [rng.randint(1, cfg.vocab_size, 5).astype("int32")
+                for _ in range(2)]
+        a = paddle.generation.generate(model, [list(r) for r in rows],
+                                       max_new_tokens=4).numpy()
+        b = paddle.generation.generate(model, np.stack(rows),
+                                       max_new_tokens=4).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_ragged_requires_pad_token_id(self, tiny_model):
+        model, cfg = tiny_model
+        with pytest.raises(ValueError, match="pad_token_id"):
+            paddle.generation.generate(model, [[1, 2], [3, 4, 5]],
+                                       max_new_tokens=2)
+
+    def test_rectangular_batch_with_pad_id_masks_leading_pads(self, tiny_model):
+        """A pre-padded [B, S] batch + pad_token_id enters ragged mode:
+        leading pads are masked, interior pad ids stay content."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(25)
+        p = rng.randint(1, cfg.vocab_size, 4).astype("int32")
+        pre = np.concatenate([np.zeros(3, "int32"), p])[None]
+        out = paddle.generation.generate(model, pre, max_new_tokens=5,
+                                         pad_token_id=0).numpy()
+        ref = paddle.generation.generate(
+            model, [list(p)], max_new_tokens=5, pad_token_id=0).numpy()
+        np.testing.assert_array_equal(out[0, 7:], ref[0, 4:])
+
+    def test_python_loop_early_exit_matches_scan(self, tiny_model):
+        """python mode with an eos_token_id stops the token loop once
+        every row is done, pads the tail with EOS, and agrees with the
+        scan program's masked output exactly."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(27)
+        ids = rng.randint(1, cfg.vocab_size, (2, 5)).astype("int32")
+        probe = paddle.generation.generate(model, ids, max_new_tokens=12).numpy()
+        eos = int(probe[0, 5 + 2])  # row 0 emits this at step 3
+        a = paddle.generation.generate(model, ids, max_new_tokens=12,
+                                       eos_token_id=eos,
+                                       loop_mode="scan").numpy()
+        b = paddle.generation.generate(model, ids, max_new_tokens=12,
+                                       eos_token_id=eos,
+                                       loop_mode="python").numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_stream_yields_per_position_tokens_and_stops_early(self, tiny_model):
+        model, cfg = tiny_model
+        rng = np.random.RandomState(29)
+        ids = rng.randint(1, cfg.vocab_size, (2, 4)).astype("int32")
+        ref = paddle.generation.generate(model, ids, max_new_tokens=8).numpy()
+        chunks = list(paddle.generation.generate(model, ids, max_new_tokens=8,
+                                                 stream=True))
+        assert len(chunks) == 8 and all(c.shape == (2,) for c in chunks)
+        np.testing.assert_array_equal(np.stack(chunks, 1), ref[:, 4:])
+        # with an EOS every row hits, the stream ends before N positions
+        eos = int(ref[0, 4 + 1])
+        streamed = list(paddle.generation.generate(
+            model, ids[:1], max_new_tokens=12, stream=True,
+            eos_token_id=eos))
+        assert len(streamed) < 12
+        assert streamed[-1][0] == eos
