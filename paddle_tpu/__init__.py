@@ -62,6 +62,7 @@ from . import onnx
 from . import regularizer
 from . import generation
 from . import serving
+from . import fault_tolerance
 
 # top-level aliases for reference __all__ parity
 # paddle.dtype is a TYPE in the reference (framework dtype class);

@@ -47,7 +47,8 @@ from .parallel import DataParallel
 
 from . import fleet
 from . import checkpoint
-from .checkpoint import load_state_dict, save_state_dict
+from .checkpoint import (CheckpointCorruptError, latest_checkpoint,
+                         load_state_dict, read_state_dict, save_state_dict)
 from . import auto_tuner
 from . import elastic
 from . import rpc

@@ -15,13 +15,19 @@ NamedSharding (the runtime moves bytes over ICI/DCN — the reference's
 metadata+P2P resharding collapses into one device_put).
 """
 
+from .atomic import (CheckpointCorruptError, atomic_write, cleanup_stale_tmp,
+                     commit_dir, is_committed, latest_checkpoint,
+                     verify_checkpoint)
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
-from .save_state_dict import save_state_dict
-from .load_state_dict import load_state_dict
+from .save_state_dict import save_state_dict, write_state_dict_files
+from .load_state_dict import checkpoint_meta, load_state_dict, read_state_dict
 from .utils import flatten_state_dict, unflatten_state_dict
 
 __all__ = [
-    "save_state_dict", "load_state_dict", "Metadata",
+    "save_state_dict", "load_state_dict", "read_state_dict", "Metadata",
     "LocalTensorMetadata", "LocalTensorIndex",
     "flatten_state_dict", "unflatten_state_dict",
+    "CheckpointCorruptError", "atomic_write", "commit_dir", "is_committed",
+    "verify_checkpoint", "latest_checkpoint", "cleanup_stale_tmp",
+    "checkpoint_meta", "write_state_dict_files",
 ]

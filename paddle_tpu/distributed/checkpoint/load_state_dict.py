@@ -1,4 +1,4 @@
-"""Sharded checkpoint load with reshard-on-load.
+"""Sharded checkpoint load with reshard-on-load and corruption guards.
 
 Parity: python/paddle/distributed/checkpoint/load_state_dict.py — reads
 the union of metadata files, plans which saved pieces cover each target
@@ -11,6 +11,14 @@ sharding), then distributed with the target's NamedSharding — via
 ``jax.make_array_from_callback`` so each process materialises only its
 addressable shards (multi-controller safe); XLA's transfer engine does
 what the reference's metadata-driven P2P reshard does.
+
+v2 (fault tolerance): loading REFUSES uncommitted directories, verifies
+every file against the ``COMMITTED`` sha256 digests before unpickling,
+and surfaces truncation/corruption as ``CheckpointCorruptError`` naming
+the offending file plus the ``latest_checkpoint`` recovery hint — never
+a raw ``EOFError`` from pickle. A ``manifest.pkl`` whose
+``process_count`` doesn't match the metadata files on disk is a hard
+error instead of a silent merge of stale shards.
 """
 
 from __future__ import annotations
@@ -24,25 +32,65 @@ import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from .atomic import CheckpointCorruptError, read_marker, verify_checkpoint
 from .metadata import LocalTensorIndex, Metadata
-from .utils import flatten_state_dict
+from .utils import flatten_state_dict, unflatten_state_dict
+
+_CORRUPT_HINT = ("the checkpoint is truncated or corrupt — recover with "
+                 "latest_checkpoint(parent_dir) to resume from the newest "
+                 "committed save")
+
+
+def _read_pickle(path: str, fname: str):
+    fp = os.path.join(path, fname)
+    try:
+        with open(fp, "rb") as f:
+            return pickle.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is missing {fname!r}; {_CORRUPT_HINT}")
+    except (EOFError, pickle.UnpicklingError, ValueError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint file {fp!r} cannot be unpickled ({type(e).__name__}: "
+            f"{e}); {_CORRUPT_HINT}") from e
 
 
 def _read_metadata(path: str) -> Metadata:
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint directory at {path}")
+    # commit gate: refuse dirs the atomic protocol never finished, and
+    # re-hash committed files so flipped bits fail loudly up front.
+    verify_checkpoint(path, deep=True)
+
     merged = Metadata()
     manifest = os.path.join(path, "manifest.pkl")
     if os.path.exists(manifest):
-        with open(manifest, "rb") as f:
-            count = pickle.load(f)["process_count"]
-        files = [os.path.join(path, f"{i}.metadata") for i in range(count)
-                 if os.path.exists(os.path.join(path, f"{i}.metadata"))]
+        count = _read_pickle(path, "manifest.pkl")["process_count"]
+        files, missing = [], []
+        for i in range(count):
+            fn = os.path.join(path, f"{i}.metadata")
+            (files if os.path.exists(fn) else missing).append(fn)
+        if missing:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r}: manifest pins process_count={count} "
+                f"but metadata for rank(s) "
+                f"{[os.path.basename(m).split('.')[0] for m in missing]} "
+                f"is missing — refusing to merge a partial shard set; "
+                f"{_CORRUPT_HINT}")
+        stale = [f for f in glob.glob(os.path.join(path, "*.metadata"))
+                 if f not in files]
+        if stale:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r}: manifest pins process_count={count} "
+                f"but extra metadata files {sorted(os.path.basename(s) for s in stale)} "
+                f"exist (stale shards from a different save) — refusing to "
+                f"merge; {_CORRUPT_HINT}")
     else:
         files = sorted(glob.glob(os.path.join(path, "*.metadata")))
     if not files:
         raise FileNotFoundError(f"no checkpoint metadata under {path}")
     for fn in files:
-        with open(fn, "rb") as f:
-            m: Metadata = pickle.load(f)
+        m: Metadata = _read_pickle(path, os.path.basename(fn))
         for k, shards in m.state_dict_metadata.items():
             merged.state_dict_metadata.setdefault(k, []).extend(shards)
         merged.storage_metadata.update(m.storage_metadata)
@@ -57,9 +105,14 @@ class _StorageCache:
 
     def get(self, data_file: str, storage_key: str):
         if data_file not in self._files:
-            with open(os.path.join(self.path, data_file), "rb") as f:
-                self._files[data_file] = pickle.load(f)
-        return self._files[data_file][storage_key]
+            self._files[data_file] = _read_pickle(self.path, data_file)
+        try:
+            return self._files[data_file][storage_key]
+        except KeyError:
+            raise CheckpointCorruptError(
+                f"checkpoint file {data_file!r} in {self.path!r} has no "
+                f"storage key {storage_key!r} its metadata promised; "
+                f"{_CORRUPT_HINT}")
 
 
 def _assemble_global(key: str, meta: Metadata, cache: _StorageCache) -> np.ndarray:
@@ -101,7 +154,10 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, process_group=None) -
     """In-place load into ``state_dict``'s tensors, resharding saved data
     onto each target tensor's current sharding. Plain numpy targets are
     filled in place; python-object entries (step counters, …) are restored
-    into their parent containers."""
+    into their parent containers.
+
+    Refuses uncommitted/corrupt checkpoints with
+    ``CheckpointCorruptError`` (see module docstring)."""
     meta = _read_metadata(path)
     cache = _StorageCache(path)
     flat, mapping = flatten_state_dict(state_dict)
@@ -135,6 +191,29 @@ def load_state_dict(state_dict: Dict[str, Any], path: str, process_group=None) -
         warnings.warn(
             f"load_state_dict: {len(missing)} state_dict key(s) not found in "
             f"checkpoint (kept initial values): {missing[:8]}")
+
+
+def read_state_dict(path: str) -> Dict[str, Any]:
+    """Read a committed checkpoint WITHOUT a target template: every
+    tensor entry is assembled into its full (host numpy) global array,
+    python-object entries come back as-is, and the original nesting is
+    reconstructed. This is the restore path for states whose structure
+    only the checkpoint knows (optimizer accumulators, train meta)."""
+    meta = _read_metadata(path)
+    cache = _StorageCache(path)
+    flat: Dict[str, Any] = {}
+    for key, shards in meta.state_dict_metadata.items():
+        if shards and shards[0].dtype == "object":
+            flat[key] = cache.get(
+                *meta.storage_metadata[LocalTensorIndex(key, ())])
+        else:
+            flat[key] = _assemble_global(key, meta, cache)
+    return unflatten_state_dict(flat, meta.flat_mapping)
+
+
+def checkpoint_meta(path: str) -> dict:
+    """The checkpoint's COMMITTED marker (step, ts, file digests)."""
+    return read_marker(path)
 
 
 def _set_by_path(state_dict, path, value) -> None:

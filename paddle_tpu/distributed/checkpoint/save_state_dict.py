@@ -1,41 +1,46 @@
-"""Sharded checkpoint save.
+"""Sharded checkpoint save with atomic commit.
 
 Parity: python/paddle/distributed/checkpoint/save_state_dict.py:145 —
 each process writes exactly the shards it owns into
 ``{path}/{proc}_0.distcp`` plus a ``{proc}.metadata`` file; replicated
 shards are written once (dedup). The union of metadata files is the global
 checkpoint Metadata the loader plans against.
+
+v2 (fault tolerance): nothing is ever written into ``path`` directly.
+Files land in a scratch dir, get fsynced and digest-recorded in a
+``COMMITTED`` marker, and the scratch dir is atomically renamed into
+place (atomic.py) — a preemption at any byte of the save leaves the
+previous checkpoint untouched and only a ``.tmp-*`` orphan behind.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import ml_dtypes  # noqa: F401  (ensures bf16/fp8 numpy dtypes exist)
 import numpy as np
 
+from .atomic import atomic_write, commit_dir
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .utils import flatten_state_dict, local_shards
 
 
-def save_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
-                    coordinator_rank: int = 0) -> None:
-    """Save a (possibly nested) state dict of (possibly sharded) tensors.
-
-    Every process calls this with the same keys; each writes only the
-    shards it owns. Safe to call single-process (saves everything).
-    """
-    os.makedirs(path, exist_ok=True)
+def write_state_dict_files(state_dict: Dict[str, Any], dirpath: str,
+                           coordinator_rank: int = 0) -> None:
+    """Write this process's shard/metadata/manifest files into
+    ``dirpath`` (no commit semantics — callers wrap this in the atomic
+    protocol, optionally alongside extra files of their own)."""
+    os.makedirs(dirpath, exist_ok=True)
     flat, mapping = flatten_state_dict(state_dict)
     proc = jax.process_index()
 
     # Manifest pins the file set for this save so a later load never merges
     # stale metadata/data from a previous save with more processes.
     if proc == coordinator_rank:
-        with open(os.path.join(path, "manifest.pkl"), "wb") as f:
+        with open(os.path.join(dirpath, "manifest.pkl"), "wb") as f:
             pickle.dump({"process_count": jax.process_count()}, f, protocol=4)
 
     data_file = f"{proc}_0.distcp"
@@ -63,7 +68,34 @@ def save_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
                 LocalTensorMetadata(offset, tuple(arr.shape), arr.dtype.name))
             meta.storage_metadata[idx] = (data_file, storage_key)
 
-    with open(os.path.join(path, data_file), "wb") as f:
+    with open(os.path.join(dirpath, data_file), "wb") as f:
         pickle.dump(datas, f, protocol=4)
-    with open(os.path.join(path, f"{proc}.metadata"), "wb") as f:
+    with open(os.path.join(dirpath, f"{proc}.metadata"), "wb") as f:
         pickle.dump(meta, f, protocol=4)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str, process_group=None,
+                    coordinator_rank: int = 0,
+                    extra_marker: Optional[dict] = None) -> None:
+    """Save a (possibly nested) state dict of (possibly sharded) tensors
+    atomically: ``path`` either keeps its previous committed content or
+    appears complete with a digest ``COMMITTED`` marker — never partial.
+
+    Every process calls this with the same keys; each writes only the
+    shards it owns. Safe to call single-process (saves everything).
+    """
+    if jax.process_count() > 1:
+        # every rank writes into the same deterministic scratch dir; a
+        # host barrier delimits the write phase; the coordinator hashes
+        # and performs the single atomic rename.
+        from ..collective import barrier
+
+        with atomic_write(path, shared_tmp=True) as tmp:
+            write_state_dict_files(state_dict, tmp, coordinator_rank)
+        barrier()
+        if jax.process_index() == coordinator_rank:
+            commit_dir(tmp, os.path.abspath(path), extra_marker)
+        barrier()
+        return
+    with atomic_write(path, extra_marker=extra_marker) as tmp:
+        write_state_dict_files(state_dict, tmp, coordinator_rank)

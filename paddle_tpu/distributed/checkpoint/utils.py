@@ -74,6 +74,13 @@ def local_shards(array) -> List[Tuple[Tuple[int, ...], np.ndarray]]:
     dedup_tensor:117 semantics)."""
     if isinstance(array, Tensor):
         array = array._data
+    if isinstance(array, np.ndarray):
+        # host snapshot (async checkpointer) / plain numpy state: save the
+        # bytes directly — round-tripping through jax would re-upload the
+        # array to device just to read it back.
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return []
+        return [(tuple(0 for _ in array.shape), array)]
     arr = jax.numpy.asarray(array) if not isinstance(array, jax.Array) else array
     if jax.process_count() > 1 and arr.is_fully_addressable and jax.process_index() != 0:
         return []

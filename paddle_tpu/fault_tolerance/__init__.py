@@ -1,0 +1,47 @@
+"""paddle_tpu.fault_tolerance — survive preemptions, corrupt saves and
+loss blow-ups on long training runs.
+
+Built on the v2 atomic checkpoint protocol
+(``distributed/checkpoint/atomic.py``: scratch-dir write -> fsync ->
+digest ``COMMITTED`` marker -> atomic rename), this package adds the
+training-loop half:
+
+- ``AsyncCheckpointer``: millisecond device->host snapshot on the train
+  thread, serialize/write/commit on a background thread with a bounded
+  queue, retention GC (``max_to_keep`` / ``keep_every_n_steps``).
+- ``FaultTolerantCheckpoint``: the hapi callback — periodic async
+  train-state saves (params, optimizer, step, RNG), one final sync save
+  on SIGTERM/SIGINT, and the checkpoints ``Model.fit(resume_from=...)``
+  restores bit-identically from.
+- ``LossSpikeSentinel``: robust (median/MAD) loss watch; NaN/Inf or
+  >k-sigma steps get their update skipped, persistent divergence rolls
+  back to the last committed checkpoint.
+- preemption handler: SIGTERM/SIGINT -> "save at the next step
+  boundary, then stop" (``install_preemption_handler`` /
+  ``preemption_requested``).
+
+All of it is metered (``paddle_tpu_checkpoint_*``,
+``paddle_tpu_loss_spike_*``, ``paddle_tpu_preemptions_total``) through
+the observability registry.
+"""
+
+from . import metrics
+from .checkpointer import (AsyncCheckpointer, latest_checkpoint,
+                           load_train_state, restore_train_state,
+                           save_train_state, snapshot_state_dict)
+from .callback import (FaultTolerantCheckpoint, capture_rng_state,
+                       restore_rng_state)
+from .preemption import (PreemptionHandler, clear_preemption,
+                         install_preemption_handler, preemption_requested,
+                         request_preemption, uninstall_preemption_handler)
+from .sentinel import LossSpikeSentinel
+
+__all__ = [
+    "AsyncCheckpointer", "FaultTolerantCheckpoint", "LossSpikeSentinel",
+    "PreemptionHandler", "install_preemption_handler",
+    "uninstall_preemption_handler", "preemption_requested",
+    "request_preemption", "clear_preemption",
+    "latest_checkpoint", "save_train_state", "load_train_state",
+    "restore_train_state", "snapshot_state_dict",
+    "capture_rng_state", "restore_rng_state", "metrics",
+]

@@ -1,0 +1,160 @@
+"""FaultTolerantCheckpoint — the hapi callback tying the layer together.
+
+Every ``save_freq_steps`` train steps it asynchronously checkpoints the
+full train state (model params, optimizer accumulators + step + LR
+schedule, global/epoch/step counters, numpy + jax RNG states) through
+the atomic protocol; on a preemption request it runs one final
+SYNCHRONOUS save at the step boundary and stops training cleanly.
+
+``Model.fit(resume_from=...)`` consumes these checkpoints: weights and
+optimizer state are restored before the loop, and the loop fast-forwards
+to the saved position — re-drawing the epoch's shuffle permutation from
+the saved epoch-begin RNG state, skipping the already-trained batches,
+then restoring the exact step-boundary RNG states — so a killed-and-
+resumed run is step-for-step bit-identical to an uninterrupted one
+(asserted in tests/test_fault_tolerance.py). The LR schedule needs no
+arithmetic fast-forward: its state rides in the optimizer state dict.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+import numpy as np
+
+from ..hapi.callbacks import Callback
+from .checkpointer import AsyncCheckpointer
+from . import preemption as _pre
+
+__all__ = ["FaultTolerantCheckpoint", "capture_rng_state",
+           "restore_rng_state"]
+
+
+def capture_rng_state() -> dict:
+    """Both host RNG streams a training loop consumes: numpy's global
+    generator (data shuffling) and the framework's jax key chain
+    (dropout/init via ``paddle.seed``)."""
+    from ..ops.random import get_rng_state
+
+    return {"np": np.random.get_state(),
+            "jax": np.asarray(get_rng_state()[0])}
+
+
+def restore_rng_state(state: Optional[dict]):
+    from ..ops.random import set_rng_state
+
+    if not state:
+        return
+    if state.get("np") is not None:
+        np.random.set_state(state["np"])
+    if state.get("jax") is not None:
+        set_rng_state(np.asarray(state["jax"]))
+
+
+class FaultTolerantCheckpoint(Callback):
+    """Periodic async train-state checkpointing + preemption save.
+
+    Args:
+        dir: checkpoint root; saves land in ``{dir}/step_{n:08d}/``.
+        save_freq_steps: checkpoint every N global train steps
+            (None: only the preemption/final save).
+        async_save: snapshot on the train thread, commit in the
+            background (False: every save is synchronous).
+        max_to_keep / keep_every_n_steps: retention GC
+            (``AsyncCheckpointer``).
+        install_signal_handlers: route SIGTERM/SIGINT into the
+            checkpoint-then-stop path.
+        exit_on_preemption: after the final save and clean callback
+            teardown, exit the process with code 0 (what a preemptible
+            worker wants; leave False for in-process use/tests).
+        save_on_train_end: also checkpoint when fit finishes normally.
+    """
+
+    def __init__(self, dir: str, save_freq_steps: Optional[int] = 100,
+                 async_save: bool = True, max_to_keep: Optional[int] = None,
+                 keep_every_n_steps: Optional[int] = None,
+                 install_signal_handlers: bool = True,
+                 exit_on_preemption: bool = False,
+                 save_on_train_end: bool = True):
+        super().__init__()
+        self.dir = dir
+        self.save_freq_steps = save_freq_steps
+        self.async_save = async_save
+        self.max_to_keep = max_to_keep
+        self.keep_every_n_steps = keep_every_n_steps
+        self.install_signal_handlers = install_signal_handlers
+        self.exit_on_preemption = exit_on_preemption
+        self.save_on_train_end = save_on_train_end
+        self.checkpointer: Optional[AsyncCheckpointer] = None
+        self.preempted = False
+        self.global_step = 0
+        self._epoch = 0
+        self._step_in_epoch = -1
+        self._rng_epoch_begin: Optional[dict] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def on_train_begin(self, logs=None):
+        self.checkpointer = AsyncCheckpointer(
+            self.dir, max_to_keep=self.max_to_keep,
+            keep_every_n_steps=self.keep_every_n_steps)
+        self.preempted = False
+        resume = getattr(self.model, "_resume_state", None) or {}
+        self.global_step = int(resume.get("global_step", 0))
+        if self.install_signal_handlers:
+            _pre.install_preemption_handler()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step_in_epoch = -1
+        # captured BEFORE the loader draws this epoch's shuffle
+        # permutation — the resume loop replays the epoch from here
+        self._rng_epoch_begin = capture_rng_state()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step_in_epoch = step
+        self.global_step += 1
+        if _pre.preemption_requested():
+            # final save MUST be synchronous: the process is about to die
+            self._save(sync=True)
+            self.preempted = True
+            self.model.stop_training = True
+            return
+        if self.save_freq_steps and \
+                self.global_step % self.save_freq_steps == 0:
+            self._save(sync=not self.async_save)
+
+    def on_train_end(self, logs=None):
+        if self.checkpointer is None:
+            return
+        if self.save_on_train_end and not self.preempted \
+                and self.global_step:
+            self._save(sync=True)
+        self.checkpointer.close()
+        if self.install_signal_handlers:
+            _pre.uninstall_preemption_handler()
+        if self.preempted and self.exit_on_preemption:
+            sys.exit(0)
+
+    # -- the save ------------------------------------------------------------
+    def _save(self, sync: bool):
+        state = {"model": self.model.network.state_dict()}
+        opt = self.model._optimizer
+        if opt is not None and hasattr(opt, "state_dict"):
+            # structured-name keys: restorable in a fresh process where
+            # the p.name counter starts over (export_optimizer_state)
+            from .checkpointer import export_optimizer_state
+
+            state["optimizer"] = export_optimizer_state(self.model)
+        rng = capture_rng_state()
+        meta = {
+            "global_step": self.global_step,
+            "epoch": self._epoch,
+            "step_in_epoch": self._step_in_epoch,
+            "rng": rng,
+            "rng_epoch_begin": self._rng_epoch_begin or rng,
+        }
+        self.checkpointer.save(self.global_step, state, meta=meta, sync=sync)
+
+    def latest_checkpoint(self) -> Optional[str]:
+        return self.checkpointer.latest_path() if self.checkpointer else None

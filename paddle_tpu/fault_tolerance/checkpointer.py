@@ -1,0 +1,335 @@
+"""Async checkpointer: millisecond train-thread snapshot, background
+commit, bounded queue, retention GC — the CheckFreq split applied to
+this repo's atomic checkpoint protocol.
+
+The cost model: a synchronous ``save_state_dict`` holds the train
+thread for device->host transfer + pickle + fsync + rename. Of those,
+only the device->host snapshot must happen at the step boundary (the
+arrays are immutable once fetched — later optimizer steps DONATE the
+old device buffers, they never mutate the host copy). So ``save()``
+does exactly that on the caller thread (``jax.device_get`` of the
+model+optimizer pytree, timed as ``paddle_tpu_checkpoint_snapshot_
+seconds`` — the whole train pause), and hands the host pytree to one
+background writer thread that serializes, fsyncs and commits through
+``distributed.checkpoint.atomic``.
+
+The job queue is BOUNDED (default 2) and ``save()`` blocks when it is
+full: if the disk can't keep up with the save cadence, training slows
+instead of snapshots piling up in host RAM. ``wait_until_finished()``
+drains the queue (call it before reading the checkpoint back or at
+train end); background write errors are re-raised there and on the
+next ``save()``.
+
+Retention GC after every commit: keep the newest ``max_to_keep``
+committed steps, plus every ``keep_every_n_steps``-th step forever
+(week-long runs keep sparse history without filling the disk).
+
+Multi-process saves need a barrier inside the commit, which must not
+run on a background thread while the train thread races toward the
+next collective — the checkpointer forces ``sync`` mode there.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..distributed.checkpoint.atomic import (atomic_write, checkpoint_step,
+                                             cleanup_stale_tmp, is_committed,
+                                             latest_checkpoint)
+from ..distributed.checkpoint.load_state_dict import (_read_pickle,
+                                                      read_state_dict)
+from ..distributed.checkpoint.save_state_dict import write_state_dict_files
+from . import metrics as _fm
+
+__all__ = ["AsyncCheckpointer", "snapshot_state_dict", "save_train_state",
+           "load_train_state", "restore_train_state", "latest_checkpoint"]
+
+TRAIN_META_FILE = "train_meta.pkl"
+
+
+def snapshot_state_dict(state_dict) -> Any:
+    """Device->host copy of a nested state dict: Tensors/jax arrays
+    become numpy (one ``device_get`` per leaf — milliseconds on the
+    train thread), everything else passes through. Multi-controller
+    arrays that aren't fully addressable stay as jax arrays; their
+    local shards are read during the (sync) write instead."""
+    from ..core.tensor import Tensor
+
+    def rec(obj):
+        if isinstance(obj, Tensor):
+            obj = obj._data
+        if isinstance(obj, jax.Array):
+            if getattr(obj, "is_fully_addressable", True):
+                return np.asarray(jax.device_get(obj))
+            return obj
+        if isinstance(obj, dict):
+            return {k: rec(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(rec(v) for v in obj)
+        return obj
+
+    return rec(state_dict)
+
+
+def _nbytes(obj) -> int:
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, dict):
+        return sum(_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_nbytes(v) for v in obj)
+    return 0
+
+
+class AsyncCheckpointer:
+    """Step-addressed checkpoints under ``root`` (``step_{n:08d}/``),
+    written through the atomic commit protocol.
+
+    ``save(step, state_dict)``: snapshot now, write in the background.
+    ``save(..., sync=True)``: write+commit before returning (the final
+    preemption save). ``restore`` / ``latest_step`` resolve committed
+    saves only.
+    """
+
+    def __init__(self, root: str, max_to_keep: Optional[int] = None,
+                 keep_every_n_steps: Optional[int] = None,
+                 queue_size: int = 2):
+        self.root = os.path.abspath(root)
+        self.max_to_keep = max_to_keep
+        self.keep_every_n_steps = keep_every_n_steps
+        os.makedirs(self.root, exist_ok=True)
+        cleanup_stale_tmp(self.root)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, queue_size))
+        self._thread: Optional[threading.Thread] = None
+        self._err: Optional[BaseException] = None
+        self._lock = threading.Lock()
+
+    # -- paths ---------------------------------------------------------------
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def latest_path(self) -> Optional[str]:
+        return latest_checkpoint(self.root)
+
+    def latest_step(self) -> Optional[int]:
+        p = self.latest_path()
+        return checkpoint_step(p) if p else None
+
+    # -- save ----------------------------------------------------------------
+    def save(self, step: int, state_dict, meta: Optional[dict] = None,
+             sync: bool = False):
+        """Checkpoint ``state_dict`` (nested dict of Tensors/arrays) as
+        ``step``. Returns after the device->host snapshot (async) or
+        after the commit (sync). Raises any pending background error."""
+        self._raise_pending()
+        if jax.process_count() > 1:
+            sync = True  # commit barrier cannot run on a bg thread
+        t0 = time.perf_counter()
+        snap = snapshot_state_dict(state_dict)
+        _fm.snapshot_seconds.observe(time.perf_counter() - t0)
+        _fm.save_bytes.inc(_nbytes(snap))
+        if sync:
+            # a sync save (preemption/final) supersedes queued async ones;
+            # drain first so two writers never commit the same step dir
+            self.wait_until_finished()
+            self._write(step, snap, meta, "sync")
+            return
+        self._ensure_thread()
+        t1 = time.perf_counter()
+        try:
+            self._q.put((step, snap, meta), block=False)
+        except queue.Full:
+            # bounded queue: block the train thread (and say so in the
+            # metrics) rather than buffering unbounded snapshots
+            self._q.put((step, snap, meta))
+            _fm.queue_blocked_seconds.observe(time.perf_counter() - t1)
+
+    def wait_until_finished(self):
+        """Block until every queued save has committed; re-raise the
+        first background error if one occurred."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self):
+        """Drain and stop the writer thread (idempotent)."""
+        self.wait_until_finished()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            self._q.put(None)
+            t.join(timeout=30)
+
+    # -- restore -------------------------------------------------------------
+    def restore(self, step: Optional[int] = None):
+        """(state_dict, meta) of ``step`` (default: newest committed).
+        Returns (None, None) when nothing committed exists."""
+        path = self.step_path(step) if step is not None else self.latest_path()
+        if path is None or not is_committed(path):
+            return None, None
+        return load_train_state(path)
+
+    # -- internals -----------------------------------------------------------
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._worker, name="paddle-tpu-checkpointer",
+                    daemon=True)
+                self._thread.start()
+
+    def _worker(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._q.task_done()
+                return
+            try:
+                self._write(*job, "async")
+            except BaseException as e:  # surfaced on next save()/wait
+                self._err = e
+                _fm.save_errors_total.inc()
+            finally:
+                self._q.task_done()
+
+    def _write(self, step: int, snap, meta: Optional[dict], mode: str):
+        t0 = time.perf_counter()
+        save_train_state(self.step_path(step), snap, meta,
+                         extra_marker={"step": int(step)})
+        _fm.save_seconds.observe(time.perf_counter() - t0)
+        _fm.saves_total.labels(mode).inc()
+        self._gc()
+
+    def _gc(self):
+        keep_n = self.max_to_keep
+        if keep_n is None:
+            return
+        steps = []
+        for name in os.listdir(self.root):
+            p = os.path.join(self.root, name)
+            if ".tmp-" in name or ".old-" in name or not os.path.isdir(p):
+                continue
+            s = checkpoint_step(p)
+            if s is not None and is_committed(p):
+                steps.append((s, p))
+        steps.sort(reverse=True)
+        for s, p in steps[keep_n:]:
+            if self.keep_every_n_steps and s and \
+                    s % self.keep_every_n_steps == 0:
+                continue  # sparse permanent history
+            shutil.rmtree(p, ignore_errors=True)
+            _fm.gc_deleted_total.inc()
+
+    def _raise_pending(self):
+        err, self._err = self._err, None
+        if err is not None:
+            raise RuntimeError(
+                "background checkpoint save failed") from err
+
+
+# ---------------------------------------------------------------------------
+# Train-state files: the sharded tensor state + one pickled meta record
+# (step counters, RNG states) committed together in one atomic dir.
+# ---------------------------------------------------------------------------
+
+def save_train_state(path: str, state_dict, meta: Optional[dict] = None,
+                     extra_marker: Optional[dict] = None):
+    """One committed checkpoint dir holding ``state_dict`` (tensor
+    state, via the sharded writer) plus ``train_meta.pkl`` — both
+    covered by the COMMITTED digests."""
+    if jax.process_count() > 1:
+        # the sharded saver owns the barrier/commit dance; meta rides
+        # along by being written before the commit barrier
+        from ..distributed.collective import barrier
+        from ..distributed.checkpoint.atomic import commit_dir
+
+        with atomic_write(path, shared_tmp=True) as tmp:
+            write_state_dict_files(state_dict, tmp)
+            if jax.process_index() == 0 and meta is not None:
+                with open(os.path.join(tmp, TRAIN_META_FILE), "wb") as f:
+                    pickle.dump(meta, f, protocol=4)
+        barrier()
+        if jax.process_index() == 0:
+            commit_dir(tmp, os.path.abspath(path), extra_marker)
+        barrier()
+        return
+    with atomic_write(path, extra_marker=extra_marker) as tmp:
+        write_state_dict_files(state_dict, tmp)
+        if meta is not None:
+            with open(os.path.join(tmp, TRAIN_META_FILE), "wb") as f:
+                pickle.dump(meta, f, protocol=4)
+
+
+def load_train_state(path: str):
+    """(state_dict, meta) from a committed checkpoint dir; digests are
+    verified, corruption raises ``CheckpointCorruptError``."""
+    state = read_state_dict(path)
+    meta = None
+    if os.path.exists(os.path.join(path, TRAIN_META_FILE)):
+        meta = _read_pickle(path, TRAIN_META_FILE)
+    return state, meta
+
+
+# Optimizer accumulators are keyed by ``p.name`` — "generated_tensor_N"
+# names minted by a process-global counter, so they differ between the
+# saving process and any restoring model instance. FT checkpoints
+# therefore store optimizer state keyed by the parameter's STRUCTURED
+# name (the model state_dict key, stable across restarts), translated
+# back to the live optimizer's p.names at restore.
+_SEP = "::"
+
+
+def export_optimizer_state(model) -> Dict[str, Any]:
+    opt = model._optimizer
+    state = opt.state_dict()
+    smap = {id(p): n for n, p in model.network.state_dict().items()}
+    params = sorted(getattr(opt, "_parameter_list", []),
+                    key=lambda p: -len(p.name))
+    out = {}
+    for k, v in state.items():
+        for p in params:
+            if k.startswith(p.name + "_") and id(p) in smap:
+                out[f"{smap[id(p)]}{_SEP}{k[len(p.name) + 1:]}"] = v
+                break
+        else:
+            out[k] = v  # @step, LR_Scheduler, unmatched extras
+    return out
+
+
+def import_optimizer_state(model, saved: Dict[str, Any]):
+    opt = model._optimizer
+    smap = {n: p for n, p in model.network.state_dict().items()}
+    state = {}
+    for k, v in saved.items():
+        if _SEP in k:
+            sname, acc = k.rsplit(_SEP, 1)
+            p = smap.get(sname)
+            if p is not None:
+                state[f"{p.name}_{acc}"] = v
+                continue
+        state[k] = v
+    opt.set_state_dict(state)
+
+
+def restore_train_state(path: str, model, cause: str = "resume"):
+    """Restore a ``hapi.Model``'s network + optimizer from a committed
+    train-state checkpoint; returns the train meta (step counters, RNG
+    states) for the caller to fast-forward with. RNG state itself is NOT
+    restored here — the resume loop restores it at the exact step
+    boundary it belongs to."""
+    state, meta = load_train_state(path)
+    if "model" in state:
+        model.network.set_state_dict(state["model"])
+    if "optimizer" in state and model._optimizer is not None and \
+            hasattr(model._optimizer, "set_state_dict"):
+        import_optimizer_state(model, state["optimizer"])
+    _fm.restores_total.labels(cause).inc()
+    return meta or {}

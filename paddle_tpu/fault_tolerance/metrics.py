@@ -1,0 +1,65 @@
+"""Fault-tolerance metrics, registered at import so a scrape shows the
+checkpoint/sentinel story (how often saves ran, how long the train
+thread paused, how many spikes were skipped or rolled back) without
+anyone taking a snapshot first.
+
+Names follow ``paddle_tpu_checkpoint_*`` / ``paddle_tpu_loss_spike_*``;
+the commit-protocol counters (``..._commits_total``,
+``..._corrupt_skipped_total``) live with the protocol in
+``distributed/checkpoint/atomic.py`` — same registry, one scrape.
+"""
+
+from __future__ import annotations
+
+from ..observability import metrics as _m
+
+__all__ = [
+    "saves_total", "save_seconds", "snapshot_seconds", "save_bytes",
+    "queue_blocked_seconds", "gc_deleted_total", "restores_total",
+    "save_errors_total", "preemptions_total",
+    "loss_spike_total", "loss_spike_skipped_updates_total",
+    "loss_spike_rollbacks_total",
+]
+
+saves_total = _m.counter(
+    "paddle_tpu_checkpoint_saves_total",
+    "checkpoints saved, by mode", ("mode",))  # async | sync
+save_seconds = _m.histogram(
+    "paddle_tpu_checkpoint_save_seconds",
+    "serialize+write+commit wall time (background thread for async)",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0, 120.0))
+snapshot_seconds = _m.histogram(
+    "paddle_tpu_checkpoint_snapshot_seconds",
+    "device->host snapshot time — the TRAIN-THREAD pause of an async save",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0))
+save_bytes = _m.counter(
+    "paddle_tpu_checkpoint_bytes_total",
+    "bytes of tensor state handed to checkpoint saves")
+queue_blocked_seconds = _m.histogram(
+    "paddle_tpu_checkpoint_queue_blocked_seconds",
+    "train-thread wait when the bounded async queue was full",
+    buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0))
+gc_deleted_total = _m.counter(
+    "paddle_tpu_checkpoint_gc_deleted_total",
+    "committed checkpoint dirs deleted by retention GC")
+restores_total = _m.counter(
+    "paddle_tpu_checkpoint_restores_total",
+    "train-state restores, by cause", ("cause",))  # resume | rollback
+save_errors_total = _m.counter(
+    "paddle_tpu_checkpoint_save_errors_total",
+    "background checkpoint saves that raised")
+preemptions_total = _m.counter(
+    "paddle_tpu_preemptions_total",
+    "preemption signals observed by the handler", ("signal",))
+
+loss_spike_total = _m.counter(
+    "paddle_tpu_loss_spike_total",
+    "bad training steps detected by the sentinel", ("reason",))  # nan|inf|spike
+loss_spike_skipped_updates_total = _m.counter(
+    "paddle_tpu_loss_spike_skipped_updates_total",
+    "parameter updates the sentinel skipped")
+loss_spike_rollbacks_total = _m.counter(
+    "paddle_tpu_loss_spike_rollbacks_total",
+    "rollbacks to the last committed checkpoint after persistent spikes")

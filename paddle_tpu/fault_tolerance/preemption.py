@@ -1,0 +1,136 @@
+"""Preemption handling: turn SIGTERM/SIGINT into a cooperative
+"checkpoint at the next step boundary, then stop" request.
+
+Preemptible TPU slices get a SIGTERM with a grace window before the
+VM disappears. Killing the process mid-step (or worse, mid-save) is
+exactly what the atomic protocol defends against — but the graceful
+path is better: the signal handler only flips a flag; the training
+loop (``FaultTolerantCheckpoint``) polls it at every step boundary,
+runs one final SYNCHRONOUS save, and stops cleanly.
+
+The handler is process-global (signals are), idempotent to install,
+and restores the previous handlers on uninstall. A second SIGINT
+falls through to the previous handler (double ctrl-C still kills an
+interactive run). Tests drive it with ``request()`` — no real signal
+needed.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import warnings
+from typing import Optional, Tuple
+
+from . import metrics as _fm
+
+__all__ = ["PreemptionHandler", "install_preemption_handler",
+           "uninstall_preemption_handler", "preemption_requested",
+           "clear_preemption", "request_preemption"]
+
+
+class PreemptionHandler:
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+        self.last_signal: Optional[int] = None
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            warnings.warn("PreemptionHandler.install: not on the main "
+                          "thread; signal handlers not installed "
+                          "(request()/polling still works)")
+            return self
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            except (ValueError, OSError):  # non-main interpreter, etc.
+                pass
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        if signum == signal.SIGINT and self._event.is_set():
+            # second ctrl-C: defer to the previous handler (usually
+            # KeyboardInterrupt) so an interactive run stays killable
+            prev = self._prev.get(signum)
+            if callable(prev):
+                return prev(signum, frame)
+            raise KeyboardInterrupt
+        self.last_signal = signum
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        _fm.preemptions_total.labels(name).inc()
+        self._event.set()
+
+    # cooperative surface ----------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self):
+        """Programmatic preemption (tests / external orchestrators)."""
+        _fm.preemptions_total.labels("manual").inc()
+        self._event.set()
+
+    def clear(self):
+        self._event.clear()
+        self.last_signal = None
+
+
+_handler: Optional[PreemptionHandler] = None
+_lock = threading.Lock()
+
+
+def _ensure_handler(signals=(signal.SIGTERM, signal.SIGINT)
+                    ) -> PreemptionHandler:
+    global _handler
+    with _lock:
+        if _handler is None:
+            _handler = PreemptionHandler(signals)
+        return _handler
+
+
+def install_preemption_handler(signals=(signal.SIGTERM, signal.SIGINT)
+                               ) -> PreemptionHandler:
+    """Install (or return) the process-global handler."""
+    return _ensure_handler(signals).install()
+
+
+def uninstall_preemption_handler():
+    global _handler
+    with _lock:
+        if _handler is not None:
+            _handler.uninstall()
+
+
+def preemption_requested() -> bool:
+    h = _handler
+    return h.requested if h is not None else False
+
+
+def request_preemption():
+    """Flag a preemption without a real signal (tests/orchestrators)."""
+    _ensure_handler().request()
+
+
+def clear_preemption():
+    h = _handler
+    if h is not None:
+        h.clear()

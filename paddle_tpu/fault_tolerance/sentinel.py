@@ -1,0 +1,164 @@
+"""Loss-spike sentinel: skip poisoned updates, roll back persistent
+divergence.
+
+Long runs on real fleets hit loss blow-ups — a bad batch, an overflow,
+a flaky host. The sentinel is a hapi callback that watches the per-step
+loss with a ROBUST running statistic (median/MAD over a sliding window
+— one outlier cannot drag the threshold the way a mean/std would) and
+classifies each step:
+
+- ``nan``/``inf``: the loss is not finite (the ``amp/debugging.py``
+  numerics check applied to the step loss);
+- ``spike``: ``|loss - median| > k * (1.4826 * MAD)`` after warmup.
+
+A bad step's parameter update is SKIPPED — the sentinel registers an
+update filter on the model, which ``Model.train_batch`` consults
+between ``backward()`` and ``optimizer.step()``, so the poisoned
+gradients never touch the weights (up to ``max_skips`` consecutive
+times). After ``rollback_after`` consecutive bad steps it rolls the
+model+optimizer back to the last committed checkpoint (when given a
+checkpoint dir or a ``FaultTolerantCheckpoint`` to resolve one).
+
+Every action is counted: ``paddle_tpu_loss_spike_total{reason}``,
+``..._skipped_updates_total``, ``..._rollbacks_total``.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..hapi.callbacks import Callback
+from . import metrics as _fm
+
+__all__ = ["LossSpikeSentinel"]
+
+
+def _loss_scalar(loss) -> Optional[float]:
+    if loss is None:
+        return None
+    if isinstance(loss, (list, tuple)) and loss:
+        loss = loss[0]
+    try:
+        return float(np.ravel(np.asarray(loss))[0])
+    except (TypeError, ValueError):
+        return None
+
+
+class LossSpikeSentinel(Callback):
+    """Args:
+        k: robust z-score threshold (spike when ``|loss-median|`` exceeds
+            ``k`` robust sigmas).
+        window: sliding window of GOOD losses the statistic runs over.
+        warmup_steps: minimum good samples before spike detection arms
+            (NaN/Inf detection is always armed).
+        max_skips: consecutive updates to skip before giving up on
+            skipping (further bad steps still count toward rollback).
+        rollback_after: consecutive bad steps that trigger a rollback.
+        checkpoint_dir: where to resolve the rollback checkpoint
+            (``latest_checkpoint``); alternatively pass ``checkpoint=``
+            a FaultTolerantCheckpoint and its dir is used.
+        min_sigma: floor on the robust sigma so a flat loss curve
+            (MAD ~ 0) doesn't flag numerical noise as spikes.
+    """
+
+    def __init__(self, k: float = 6.0, window: int = 64,
+                 warmup_steps: int = 16, max_skips: int = 4,
+                 rollback_after: int = 8,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint=None, min_sigma: float = 1e-6,
+                 verbose: int = 1):
+        super().__init__()
+        self.k = float(k)
+        self.window = int(window)
+        self.warmup_steps = int(warmup_steps)
+        self.max_skips = int(max_skips)
+        self.rollback_after = int(rollback_after)
+        self.checkpoint_dir = checkpoint_dir
+        self._ft_checkpoint = checkpoint
+        self.min_sigma = float(min_sigma)
+        self.verbose = verbose
+        self._losses: deque = deque(maxlen=self.window)
+        self.consecutive_bad = 0
+        self.skipped = 0
+        self.rollbacks = 0
+
+    # -- wiring --------------------------------------------------------------
+    def set_model(self, model):
+        super().set_model(model)
+        model._update_filter = self._update_filter
+
+    def on_train_begin(self, logs=None):
+        self._losses.clear()
+        self.consecutive_bad = 0
+
+    def on_train_end(self, logs=None):
+        if getattr(self.model, "_update_filter", None) is self._update_filter:
+            self.model._update_filter = None
+
+    # -- classification ------------------------------------------------------
+    def _classify(self, loss: float) -> Optional[str]:
+        from ..amp.debugging import DebugMode, check_numerics
+
+        if not math.isfinite(loss):
+            n_nan, n_inf, _ = check_numerics(
+                np.asarray(loss), op_type="train_step_loss",
+                var_name="loss", debug_mode=DebugMode.CHECK_ALL)
+            return "nan" if int(n_nan.numpy()) else "inf"
+        if len(self._losses) >= self.warmup_steps:
+            med = float(np.median(self._losses))
+            mad = float(np.median(np.abs(np.asarray(self._losses) - med)))
+            sigma = max(1.4826 * mad, self.min_sigma)
+            if abs(loss - med) > self.k * sigma:
+                return "spike"
+        return None
+
+    # -- the filter Model.train_batch consults -------------------------------
+    def _update_filter(self, loss_vals) -> bool:
+        """True: apply the optimizer update. False: skip it."""
+        loss = _loss_scalar(loss_vals)
+        if loss is None:
+            return True
+        reason = self._classify(loss)
+        if reason is None:
+            self.consecutive_bad = 0
+            self._losses.append(loss)
+            return True
+        _fm.loss_spike_total.labels(reason).inc()
+        self.consecutive_bad += 1
+        if self.verbose:
+            print(f"[LossSpikeSentinel] step loss {loss:.6g} flagged "
+                  f"({reason}, consecutive {self.consecutive_bad})")
+        if self.consecutive_bad >= self.rollback_after:
+            if self._rollback():
+                return False
+        if self.consecutive_bad <= self.max_skips:
+            _fm.loss_spike_skipped_updates_total.inc()
+            self.skipped += 1
+            return False
+        # out of skip budget and no rollback target: let training proceed
+        # (the run owner sees the counters and the log line)
+        return True
+
+    def _rollback(self) -> bool:
+        from .checkpointer import latest_checkpoint, restore_train_state
+
+        root = self.checkpoint_dir
+        if root is None and self._ft_checkpoint is not None:
+            root = self._ft_checkpoint.dir
+        if root is None:
+            return False
+        path = latest_checkpoint(root)
+        if path is None:
+            return False
+        restore_train_state(path, self.model, cause="rollback")
+        _fm.loss_spike_rollbacks_total.inc()
+        self.rollbacks += 1
+        self.consecutive_bad = 0
+        self._losses.clear()
+        if self.verbose:
+            print(f"[LossSpikeSentinel] rolled back to {path}")
+        return True

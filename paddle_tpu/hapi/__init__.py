@@ -6,3 +6,14 @@ from .model import Model
 from .model_summary import summary
 
 __all__ = ["Model", "summary", "callbacks"]
+
+
+def __getattr__(name):
+    # fault-tolerance callbacks live in their own package (which imports
+    # hapi.callbacks) — lazy re-export avoids the circular import while
+    # keeping the discoverable `hapi.FaultTolerantCheckpoint` spelling.
+    if name in ("FaultTolerantCheckpoint", "LossSpikeSentinel"):
+        from .. import fault_tolerance
+
+        return getattr(fault_tolerance, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -107,15 +107,43 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+    """Per-epoch checkpoint save. ``max_to_keep`` bounds disk use on
+    long runs: after each save, epoch saves beyond the newest N are
+    deleted (``final``/``best_model`` are never counted or deleted).
+    ``None`` (default) keeps everything — the original behavior."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None,
+                 max_to_keep: Optional[int] = None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.max_to_keep = max_to_keep
+        self._saved: List[str] = []
+
+    def on_train_begin(self, logs=None):
+        self._saved = []
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
             path = os.path.join(self.save_dir, f"{epoch}")
             self.model.save(path)
+            self._saved.append(path)
+            self._retention_gc()
+
+    def _retention_gc(self):
+        if not self.max_to_keep:
+            return
+        while len(self._saved) > self.max_to_keep:
+            old = self._saved.pop(0)
+            for suffix in (".pdparams", ".pdopt"):
+                try:
+                    os.remove(old + suffix)
+                except FileNotFoundError:
+                    pass
+            if os.path.isdir(old):  # committed checkpoint-dir style saves
+                import shutil
+
+                shutil.rmtree(old, ignore_errors=True)
 
     def on_train_end(self, logs=None):
         if self.save_dir:

@@ -41,6 +41,10 @@ class Model:
         self._metrics: List[Metric] = []
         self._input_spec = inputs
         self._label_spec = labels
+        # fault tolerance: update gate (LossSpikeSentinel) + resume meta
+        # (fit(resume_from=...) / FaultTolerantCheckpoint)
+        self._update_filter = None
+        self._resume_state = None
 
     # -- configuration -----------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -69,11 +73,16 @@ class Model:
             for l in losses[1:]:
                 total = total + l
             total.backward()
+            loss_vals = [float(l.numpy()) for l in losses]
+            if update and self._update_filter is not None \
+                    and not self._update_filter(loss_vals):
+                # sentinel veto: drop the poisoned gradients, keep weights
+                self._optimizer.clear_grad()
+                update = False
             if update:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
             metrics = self._update_metrics(outs, labels)
-            loss_vals = [float(l.numpy()) for l in losses]
         return (loss_vals, metrics) if metrics else loss_vals
 
     def eval_batch(self, inputs, labels=None):
@@ -127,8 +136,18 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, resume_from=None):
+        """``resume_from``: a committed fault-tolerance checkpoint dir
+        (or a root of ``step_*`` dirs — the newest committed one is
+        resolved via ``latest_checkpoint``). Weights/optimizer/LR state
+        are restored, then the loop fast-forwards to the saved position:
+        the resume epoch's shuffle permutation is re-drawn from the
+        saved epoch-begin RNG state, already-trained batches are
+        skipped without callbacks, and the exact step-boundary RNG
+        states are restored — a killed-and-resumed run retraces the
+        uninterrupted run step for step (bit-identical weights)."""
         assert train_data is not None, "train_data must be given"
+        resume = self._load_resume_state(resume_from) if resume_from else None
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
         try:
@@ -142,15 +161,36 @@ class Model:
 
         self.stop_training = False
         cbks.on_train_begin()
-        it = 0
+        it = int(resume["global_step"]) if resume else 0
+        resume_epoch = int(resume.get("epoch", -1)) if resume else -1
+        resume_step = int(resume.get("step_in_epoch", -1)) if resume else -1
         for epoch in range(epochs):
             if self.stop_training:
                 break
+            replay = (resume is not None and epoch == resume_epoch
+                      and resume_step >= 0)
+            if resume is not None and epoch < resume_epoch:
+                continue  # whole epoch already trained before the kill
+            if replay:
+                # the epoch's shuffle permutation must come out identical
+                # to the killed run's: rewind RNG to its epoch begin
+                from ..fault_tolerance.callback import restore_rng_state
+
+                restore_rng_state(resume.get("rng_epoch_begin"))
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
             for step, batch in enumerate(loader):
+                if replay and step <= resume_step:
+                    if step == resume_step:
+                        # fast-forward complete: continue with the exact
+                        # RNG the killed run had at this step boundary
+                        from ..fault_tolerance.callback import \
+                            restore_rng_state
+
+                        restore_rng_state(resume.get("rng"))
+                    continue
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
                 update = (step + 1) % accumulate_grad_batches == 0
@@ -158,6 +198,10 @@ class Model:
                 logs = self._result_logs(res)
                 cbks.on_train_batch_end(step, logs)
                 it += 1
+                if self.stop_training:
+                    # a callback (preemption save, sentinel) asked to stop
+                    # at this step boundary — don't finish the epoch first
+                    break
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
                     break
@@ -167,6 +211,29 @@ class Model:
                               num_workers=num_workers, callbacks=cbks,
                               _inner=True)
         cbks.on_train_end(logs)
+        self._resume_state = None
+
+    def _load_resume_state(self, resume_from: str) -> dict:
+        """Restore network/optimizer from a committed checkpoint and
+        return the train meta (step counters + RNG states) for the
+        fast-forward. Accepts a checkpoint dir or a root of them."""
+        from ..distributed.checkpoint.atomic import is_committed
+        from ..fault_tolerance.checkpointer import (latest_checkpoint,
+                                                    restore_train_state)
+
+        path = resume_from
+        if not is_committed(path):
+            resolved = latest_checkpoint(path)
+            if resolved is None:
+                raise FileNotFoundError(
+                    f"resume_from={resume_from!r}: no committed checkpoint "
+                    f"found (is the path a checkpoint dir or a root of "
+                    f"step_* dirs?)")
+            path = resolved
+        meta = restore_train_state(path, self, cause="resume") or {}
+        meta.setdefault("global_step", 0)
+        self._resume_state = meta
+        return meta
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None, _inner=False):

@@ -13,16 +13,39 @@ import itertools
 import multiprocessing as mp
 import queue as queue_mod
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _m
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 _worker_info = threading.local()
+
+retries_total = _m.counter(
+    "paddle_tpu_dataloader_retries_total",
+    "transient Dataset.__getitem__ failures retried instead of killing "
+    "the epoch")
+
+
+def _fetch_with_retry(dataset, index, attempts: int, backoff_s: float):
+    """``dataset[index]`` with bounded exponential-backoff retry: a
+    flaky storage read (the common transient on fleet dataloaders) gets
+    ``attempts`` total tries; the ORIGINAL exception (with its original
+    traceback) is re-raised after exhaustion. KeyboardInterrupt and
+    friends are never swallowed."""
+    for attempt in range(attempts):
+        try:
+            return dataset[index]
+        except Exception:
+            if attempt + 1 >= attempts:
+                raise  # original traceback, not a retry wrapper
+            retries_total.inc()
+            time.sleep(backoff_s * (2 ** attempt))
 
 
 class WorkerInfo:
@@ -66,7 +89,8 @@ def _to_device(batch):
     return batch
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, init_fn):
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, init_fn,
+                 retry_attempts=3, retry_backoff_s=0.05):
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
     if init_fn is not None:
         init_fn(worker_id)
@@ -75,25 +99,35 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_wo
         if item is None:
             break
         batch_id, indices = item
+        base = retries_total.value()
         try:
-            samples = [dataset[i] for i in indices]
+            samples = [_fetch_with_retry(dataset, i, retry_attempts,
+                                         retry_backoff_s) for i in indices]
             batch = collate_fn(samples)
-            data_queue.put((batch_id, batch, None))
+            # retry count rides back with the batch: the fork child's
+            # metrics registry dies with it, the parent re-counts
+            data_queue.put((batch_id, batch, None,
+                            retries_total.value() - base))
         except Exception as e:  # propagate worker errors like the reference
-            data_queue.put((batch_id, None, e))
+            data_queue.put((batch_id, None, e, retries_total.value() - base))
 
 
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False,
+                 retry_attempts=3, retry_backoff_s=0.05):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        # transient __getitem__ failures: total tries per sample and the
+        # base of the exponential backoff between them (1 = no retry)
+        self.retry_attempts = max(1, int(retry_attempts))
+        self.retry_backoff_s = float(retry_backoff_s)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -129,7 +163,9 @@ class DataLoader:
 
     def _iter_single(self):
         for indices in self.batch_sampler:
-            samples = [self.dataset[i] for i in indices]
+            samples = [_fetch_with_retry(self.dataset, i, self.retry_attempts,
+                                         self.retry_backoff_s)
+                       for i in indices]
             yield _to_device(self.collate_fn(samples))
 
     def _iter_multiprocess(self):
@@ -142,7 +178,7 @@ class DataLoader:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, iq, data_queue, self.collate_fn, wid, self.num_workers,
-                      self.worker_init_fn),
+                      self.worker_init_fn, self.retry_attempts, self.retry_backoff_s),
                 daemon=True,
             )
             w.start()
@@ -169,7 +205,10 @@ class DataLoader:
                 if next_yield >= sent:
                     break
                 while next_yield not in reorder:
-                    bid, batch, err = data_queue.get(timeout=self.timeout or None)
+                    bid, batch, err, n_retries = data_queue.get(
+                        timeout=self.timeout or None)
+                    if n_retries:  # worker registries die with the fork
+                        retries_total.inc(n_retries)
                     if err is not None:
                         raise err
                     reorder[bid] = batch

@@ -182,6 +182,7 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
+            code = 200
             if self.path.split("?")[0] == "/metrics":
                 body = prometheus_text().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -200,20 +201,24 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
                     m = reg.get(name)
                     return m.value() if m is not None else None
 
+                unhealthy = _g("paddle_tpu_serving_engine_unhealthy")
+                code = 503 if unhealthy else 200
                 body = json.dumps({
-                    "status": "ok",
+                    "status": "unhealthy" if unhealthy else "ok",
                     "ts": time.time(),
                     "serving_queue_depth": _g("paddle_tpu_serving_queue_depth"),
                     "serving_slots_busy": _g("paddle_tpu_serving_slots_busy"),
                     "serving_slot_occupancy": _g(
                         "paddle_tpu_serving_slot_occupancy"),
+                    "serving_engine_crashes": _g(
+                        "paddle_tpu_serving_engine_crashes_total"),
                 }).encode()
                 ctype = "application/json"
             else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            self.send_response(200)
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -87,13 +87,21 @@ class Optimizer:
         self._step_count = int(state.get("@step", 0))
         for name, store in list(self._accumulators.items()):
             store.clear()
-        for p in self._parameter_list:
-            for name in self._known_accumulators():
-                k = f"{p.name}_{name}"
-                if k in state:
-                    v = state[k]
+        # Accumulator names are inferred from the checkpoint keys (strip
+        # the longest matching parameter-name prefix) instead of a fixed
+        # name list, so any optimizer's state — mean_square, inf_norm,
+        # step_size, … — restores into a FRESH instance that has not
+        # created its accumulators yet (fault-tolerant resume path).
+        params = sorted(self._parameter_list, key=lambda p: -len(p.name))
+        for k, v in state.items():
+            if k in ("@step", "LR_Scheduler"):
+                continue
+            for p in params:
+                if k.startswith(p.name + "_"):
+                    name = k[len(p.name) + 1:]
                     self._accumulators.setdefault(name, {})[id(p)] = jnp.copy(
                         v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    break
         if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state["LR_Scheduler"])
 

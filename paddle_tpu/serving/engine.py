@@ -160,6 +160,8 @@ class ServingEngine:
         self._wake = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._crashed: Optional[str] = None  # repr of the fatal loop error
+        _sm.engine_unhealthy.set(0)  # a fresh engine is the healthy one
 
         run = make_cached_runner(model)
 
@@ -255,6 +257,10 @@ class ServingEngine:
         ``seed``), or pass a prebuilt ``params``. Raises ``ValueError``
         for requests that cannot fit a slot and ``QueueFullError`` under
         backpressure."""
+        if self._crashed is not None:
+            raise RuntimeError(
+                f"serving engine has crashed ({self._crashed}); create a "
+                f"fresh engine — this one's decode state is gone")
         if params is None:
             params = SamplingParams(**sampling)
         elif sampling:
@@ -455,12 +461,52 @@ class ServingEngine:
         return self
 
     def _serve_loop(self):
-        while self._running:
-            if not self.step():
-                with self._wake:
-                    if self._running and not self.scheduler.depth \
-                            and not self.busy_slots():
-                        self._wake.wait(0.05)
+        # the per-request try in _admit guards prefill failures; anything
+        # escaping step() itself (a poisoned pool program, OOM, a bug) is
+        # fatal to the WHOLE pool — without this guard the thread died
+        # silently and every result() caller hung forever
+        try:
+            while self._running:
+                if not self.step():
+                    with self._wake:
+                        if self._running and not self.scheduler.depth \
+                                and not self.busy_slots():
+                            self._wake.wait(0.05)
+        except BaseException as e:  # noqa: BLE001 — loop-level crash
+            self._on_loop_crash(e)
+
+    def _on_loop_crash(self, exc: BaseException):
+        """Decode-loop death: fail EVERY running and queued request with
+        the exception (so ``result()``/``stream()`` callers return
+        instead of hanging), flip health to unhealthy, and count it."""
+        err = repr(exc)
+        with self._step_lock:
+            self._crashed = err
+            self._running = False
+            _sm.engine_crashes_total.inc()
+            _sm.engine_unhealthy.set(1)
+            for slot in range(self.config.max_slots):
+                if self._slot_req[slot] is not None:
+                    self._free_slot(slot, RequestStatus.FAILED, "failed",
+                                    error=f"engine loop crashed: {err}")
+            while True:  # drain the queue; pop_ready finishes
+                req = self.scheduler.pop_ready()  # cancelled/expired itself
+                if req is None:
+                    break
+                req.finish(RequestStatus.FAILED,
+                           error=f"engine loop crashed: {err}")
+                _sm.requests_total.labels("failed").inc()
+                self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
+        with self._wake:
+            self._wake.notify_all()
+
+    @property
+    def crashed(self) -> Optional[str]:
+        return self._crashed
+
+    @property
+    def healthy(self) -> bool:
+        return self._crashed is None
 
     def stop(self):
         self._running = False
@@ -495,4 +541,6 @@ class ServingEngine:
             "mean_occupancy": self.mean_occupancy,
             "outcomes": dict(self._outcomes),
             "running": self._running,
+            "healthy": self.healthy,
+            "crashed": self._crashed,
         }

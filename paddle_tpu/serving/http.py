@@ -72,12 +72,14 @@ def start_serving_http_server(engine, port: int = 0, addr: str = "127.0.0.1",
         def do_GET(self):
             path = self.path.split("?")[0]
             if path == "/healthz":
-                self._json(200, {
-                    "status": "ok",
+                healthy = engine.healthy
+                self._json(200 if healthy else 503, {
+                    "status": "ok" if healthy else "unhealthy",
                     "ts": time.time(),
                     "slots_busy": engine.busy_slots(),
                     "slots_total": engine.config.max_slots,
                     "queue_depth": engine.scheduler.depth,
+                    "crashed": engine.crashed,
                 })
             elif path == "/stats":
                 self._json(200, engine.stats())

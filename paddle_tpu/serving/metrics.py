@@ -15,7 +15,7 @@ from ..observability import metrics as _m
 __all__ = [
     "requests_total", "tokens_total", "queue_depth", "slots_busy",
     "slot_occupancy", "steps_total", "step_seconds", "prefill_seconds",
-    "ttft_seconds", "tpot_seconds",
+    "ttft_seconds", "tpot_seconds", "engine_crashes_total",
 ]
 
 requests_total = _m.counter(
@@ -37,6 +37,14 @@ slot_occupancy = _m.gauge(
 steps_total = _m.counter(
     "paddle_tpu_serving_steps_total",
     "batched decode steps executed")
+engine_crashes_total = _m.counter(
+    "paddle_tpu_serving_engine_crashes_total",
+    "decode-loop crashes outside the per-request guards (every queued "
+    "and running request is failed, /healthz flips unhealthy)")
+engine_unhealthy = _m.gauge(
+    "paddle_tpu_serving_engine_unhealthy",
+    "1 while the most recent serving engine is crash-dead; constructing "
+    "a fresh engine resets it (drives /healthz 503s)")
 step_seconds = _m.histogram(
     "paddle_tpu_serving_step_seconds",
     "wall time of one batched decode step",

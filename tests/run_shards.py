@@ -221,15 +221,18 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     # fold the most recent serving bench artifact (if any) into the lane
     # so one file carries the full telemetry story: compile counts,
     # fused-conv hit rate, AND the continuous-batching numbers
-    serving_bench = None
-    sb_path = os.path.join(os.path.dirname(HERE), "benchmarks",
-                           "bench_serving.json")
-    if os.path.exists(sb_path):
+    def _read_bench(fname):
+        p = os.path.join(os.path.dirname(HERE), "benchmarks", fname)
+        if not os.path.exists(p):
+            return None
         try:
-            with open(sb_path) as fh:
-                serving_bench = json.load(fh)
+            with open(p) as fh:
+                return json.load(fh)
         except (OSError, json.JSONDecodeError):
-            serving_bench = None
+            return None
+
+    serving_bench = _read_bench("bench_serving.json")
+    checkpoint_bench = _read_bench("bench_checkpoint.json")
     out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
                             "telemetry_lane.json")
     with open(out_path, "w") as fh:
@@ -240,6 +243,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
             "totals": totals,
             "shards": shards,
             "serving_bench": serving_bench,
+            "checkpoint_bench": checkpoint_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

@@ -264,7 +264,9 @@ def _scan():
                     key = f"{rel}.{node.name}.{p}"
                     # hapi callback slots are pure interface conformance
                     # (on_* hooks receive logs/step/epoch by contract)
-                    if rel == "hapi.callbacks" and node.name.startswith("on_"):
+                    if node.name.startswith("on_") and rel in (
+                            "hapi.callbacks", "fault_tolerance.callback",
+                            "fault_tolerance.sentinel"):
                         continue
                     hits[key] = True
     return hits
