@@ -413,10 +413,14 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     # eos only shapes the scan-mode whole-generate program; python-mode
     # executables are eos-independent (masking happens outside jit) and
     # must not recompile per eos id
+    # the flash-decode env gate is a python-side dispatch baked into the
+    # trace: flipping it must not reuse executables traced the other way
+    from .pallas_kernels.decode_attention import flash_decode_enabled
+
     gen_key = (B, S, cfg.max_new_tokens, cfg.do_sample, cfg.temperature,
                cfg.top_k, cfg.top_p,
                cfg.eos_token_id if loop_mode == "scan" else None, loop_mode,
-               ragged)
+               ragged, flash_decode_enabled())
     cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
     if gen_key not in cache_store:
 

@@ -67,23 +67,36 @@ class GPTBlock(nn.Layer):
         k = self.attn.k_proj(h).reshape([b, s, nh, hd])
         v = self.attn.v_proj(h).reshape([b, s, nh, hd])
         new_cache = None
+        use_flash_decode = False
         if isinstance(kv_cache, dict):
             # pre-allocated [b, max_len, h, d] buffers updated in place
-            # (the generation.py static-cache protocol, as in llama.py)
+            # (the generation.py static-cache protocol, as in llama.py);
+            # the decode step (s small, no external mask) dispatches to
+            # the Pallas flash-decode kernel — same gate as llama
             from ..generation import update_static_kv_cache
+            from ..pallas_kernels.decode_attention import decode_dispatch
 
+            use_flash_decode = decode_dispatch(
+                "gpt", q_len=s, has_mask=attn_mask is not None,
+                dtype=q.dtype)
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
-                build_mask=attn_mask is None)
-            if attn_mask is None:
+                build_mask=attn_mask is None and not use_flash_decode)
+            if attn_mask is None and not use_flash_decode:
                 attn_mask = mask
         elif kv_cache is not None:
             raise TypeError(
                 f"GPT kv_cache must be the generation.py static-cache dict, "
                 f"got {type(kv_cache).__name__}")
-        a = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            is_causal=attn_mask is None and kv_cache is None)
+        if use_flash_decode:
+            from ..pallas_kernels.decode_attention import \
+                flash_decode_attention
+
+            a = flash_decode_attention(q, k, v, position_offset)
+        else:
+            a = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                is_causal=attn_mask is None and kv_cache is None)
         x = x + self.attn.out_proj(a.reshape([b, s, nh * hd]))
         x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
         if kv_cache is not None:

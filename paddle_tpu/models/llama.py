@@ -189,6 +189,16 @@ class LlamaAttention(nn.Layer):
                          and attn_mask is None
                          and isinstance(position_offset, int)
                          and position_offset == 0 and s > 1)
+        # flash decode: the static-cache decode step (s small) runs the
+        # Pallas flash-decode kernel over the cache, GQA-native and
+        # per-row length-masked — no repeat_kv, no [s, max_len] mask
+        use_flash_decode = False
+        if static_cache and not flash_prefill:
+            from ..pallas_kernels.decode_attention import decode_dispatch
+
+            use_flash_decode = decode_dispatch(
+                "llama", q_len=s, has_mask=attn_mask is not None,
+                dtype=q.dtype)
         if static_cache:
             # pre-allocated [b, max_len, h, d] buffers updated in place at
             # position_offset (jit-friendly decode path; the reference's
@@ -198,10 +208,11 @@ class LlamaAttention(nn.Layer):
             step_k, step_v = k, v
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
-                build_mask=attn_mask is None and not flash_prefill)
+                build_mask=(attn_mask is None and not flash_prefill
+                            and not use_flash_decode))
             if flash_prefill:
                 k, v = step_k, step_v
-            elif attn_mask is None:
+            elif attn_mask is None and not use_flash_decode:
                 attn_mask = mask
         elif kv_cache is not None:
             pk, pv = kv_cache
@@ -213,28 +224,42 @@ class LlamaAttention(nn.Layer):
         else:
             new_cache = None
 
-        # GQA: repeat kv heads
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = repeat_kv(k, rep)
-            v = repeat_kv(v, rep)
+        if use_flash_decode:
+            from ..pallas_kernels.decode_attention import \
+                flash_decode_attention
 
-        if self.config.use_flash_attention and attn_mask is None \
-                and (not static_cache or flash_prefill):
-            from ..pallas_kernels.flash_attention import flash_attention
-
-            if flash_prefill and s % 128:
-                # pad the prompt to the kernel's 128 grid: padded queries
-                # are sliced off below, and causal masking means no REAL
-                # query (row < s) ever attends a padded key (row >= s)
-                pad = ((0, 0), (0, 128 - s % 128), (0, 0), (0, 0))
-                qp, kp, vp = (Tensor(jnp.pad(t._data, pad)) for t in (q, k, v))
-                out = flash_attention(qp, kp, vp, causal=True)[:, :s]
-            else:
-                out = flash_attention(q, k, v, causal=True)
+            out = flash_decode_attention(q, k, v, position_offset)
         else:
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                                 is_causal=attn_mask is None)
+            # GQA: the static-cache (decode/cached-prefill) fallback uses
+            # the grouped contraction — k/v stay [b, max_len, kv, d], no
+            # HBM expansion; the training/uncached paths keep repeat_kv
+            # (the Pallas prefill kernel wants expanded heads)
+            gqa = self.num_kv_heads != self.num_heads
+            grouped_fallback = gqa and static_cache and not flash_prefill
+            if gqa and not grouped_fallback:
+                rep = self.num_heads // self.num_kv_heads
+                k = repeat_kv(k, rep)
+                v = repeat_kv(v, rep)
+
+            if self.config.use_flash_attention and attn_mask is None \
+                    and (not static_cache or flash_prefill):
+                from ..pallas_kernels.flash_attention import flash_attention
+
+                if flash_prefill and s % 128:
+                    # pad the prompt to the kernel's 128 grid: padded queries
+                    # are sliced off below, and causal masking means no REAL
+                    # query (row < s) ever attends a padded key (row >= s)
+                    pad = ((0, 0), (0, 128 - s % 128), (0, 0), (0, 0))
+                    qp, kp, vp = (Tensor(jnp.pad(t._data, pad)) for t in (q, k, v))
+                    out = flash_attention(qp, kp, vp, causal=True)[:, :s]
+                else:
+                    out = flash_attention(q, k, v, causal=True)
+            elif grouped_fallback:
+                out = F.grouped_query_sdpa(q, k, v, attn_mask=attn_mask)
+            else:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask,
+                    is_causal=attn_mask is None)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if kv_cache is not None:

@@ -1274,6 +1274,57 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     return out
 
 
+def grouped_query_sdpa(query, key, value, attn_mask=None, name=None) -> Tensor:
+    """SDPA where key/value carry kv_heads <= num_heads (GQA): each kv
+    head is contracted against its whole query-head group via a grouped
+    einsum, so the repeat_kv-expanded [b, s, num_heads, d] K/V never
+    materializes in HBM (the XLA decode fallback of the flash-decode
+    path; per query head the math is exactly
+    ``scaled_dot_product_attention(q, repeat_kv(k), repeat_kv(v))``).
+
+    query: [b, s, num_heads, d]; key/value: [b, t, kv_heads, d] with
+    num_heads a multiple of kv_heads (query head j reads kv head
+    j // (num_heads // kv_heads)); attn_mask broadcasts like SDPA's
+    ([b, 1, s, t] or per-head [b, num_heads, s, t]; bool or additive).
+    """
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    tensors = [q, k, v]
+    has_mask = attn_mask is not None
+    if has_mask:
+        tensors.append(ensure_tensor(attn_mask))
+
+    def _f(qq, kk, vv, *m):
+        b, s, H, d = qq.shape
+        KV = kk.shape[2]
+        if H % KV:
+            raise ValueError(f"num_heads ({H}) not a multiple of "
+                             f"kv_heads ({KV})")
+        g = H // KV
+        scale = 1.0 / pymath.sqrt(d)
+        qt = jnp.swapaxes(qq, 1, 2).reshape(b, KV, g, s, d)
+        kt = jnp.swapaxes(kk, 1, 2)  # [b, KV, t, d]
+        vt = jnp.swapaxes(vv, 1, 2)
+        scores = jnp.einsum("bkgqd,bktd->bkgqt", qt, kt) * scale
+        if m:
+            mask = m[0]
+            t = kt.shape[2]
+            if mask.ndim == 4 and mask.shape[1] == H:  # per-head mask
+                mask = mask.reshape(b, KV, g, *mask.shape[2:])
+            else:  # [b, 1, s, t] (or broadcastable) — shared over heads
+                mask = mask[:, :, None]
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores,
+                                   jnp.asarray(-1e9, scores.dtype))
+            else:
+                scores = scores + mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32),
+                               axis=-1).astype(vt.dtype)
+        out = jnp.einsum("bkgqt,bktd->bkgqd", probs, vt)
+        return jnp.swapaxes(out.reshape(b, H, s, d), 1, 2)
+
+    return apply_op("gqa_sdpa", _f, *tensors)
+
+
 # ---------------------------------------------------------------------------
 # Misc
 # ---------------------------------------------------------------------------

@@ -372,6 +372,62 @@ _S("flash_attn_varlen", _varlen_attn_ref,
    dtypes=("float32", "bfloat16"), tol=_FLASH_TOL,
    wrap=lambda api: lambda q, k, v, seg: api(q, k, v, segment_ids=seg))
 
+# flash decode (pallas_kernels/decode_attention.py): single-query GQA
+# attention over a static KV cache with per-row lengths. grad=False: the
+# kernel is forward-only by design (decode is inference; the dispatch
+# refuses grad mode). Fixed positions [3, 5]: row 0 mid-cache, row 1 at
+# pos + q_len == max_len (the full-cache edge).
+_FD_SWEEP_POS = np.array([3, 5], np.int32)
+
+
+def _flash_decode_ref(q, kc, vc):
+    B, qlen, H, d = q.shape
+    KV = kc.shape[2]
+    g = H // KV
+    ke = np.repeat(kc.astype(np.float64), g, axis=2)
+    ve = np.repeat(vc.astype(np.float64), g, axis=2)
+    out = np.zeros(q.shape, np.float64)
+    for b in range(B):
+        for i in range(qlen):
+            L = int(_FD_SWEEP_POS[b]) + i + 1
+            for h in range(H):
+                s = (ke[b, :L, h] @ q[b, i, h].astype(np.float64)) / np.sqrt(d)
+                p = np.exp(s - s.max())
+                out[b, i, h] = (p / p.sum()) @ ve[b, :L, h]
+    return out.astype(np.float32)
+
+
+_S("flash_decode_attention", _flash_decode_ref,
+   [((2, 1, 4, 8), "any"), ((2, 6, 2, 8), "any"), ((2, 6, 2, 8), "any")],
+   api="pallas_kernels.flash_decode_attention", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FLASH_TOL,
+   wrap=lambda api: lambda q, kc, vc: api(q, kc, vc, _FD_SWEEP_POS,
+                                          block_k=4))
+
+
+# grouped-query SDPA (the flash-decode XLA fallback): per query head
+# identical to sdpa over repeat_kv-expanded K/V — which is exactly how
+# the oracle computes it.
+def _gqa_sdpa_ref(q, k, v, mask):
+    B, s, H, d = q.shape
+    g = H // k.shape[2]
+    ke = np.repeat(k.astype(np.float64), g, axis=2)
+    ve = np.repeat(v.astype(np.float64), g, axis=2)
+    qt = np.moveaxis(q.astype(np.float64), 2, 1)
+    kt = np.moveaxis(ke, 2, 1)
+    vt = np.moveaxis(ve, 2, 1)
+    logits = np.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d) + mask
+    p = _np_softmax(logits, -1)
+    return np.moveaxis(np.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2
+                       ).astype(np.float32)
+
+
+_S("gqa_sdpa", _gqa_sdpa_ref,
+   [((2, 3, 4, 4), "any"), ((2, 5, 2, 4), "any"), ((2, 5, 2, 4), "any"),
+    ((2, 1, 3, 5), "any")],
+   api="nn.functional.grouped_query_sdpa", tol=_NN_TOL,
+   grad_tol=_GRAD_TOL_ACC)
+
 # ---------------------------------------------------------------------------
 # fused MHA block (incubate.nn.functional) — pre-LN form
 # ---------------------------------------------------------------------------

@@ -5,5 +5,6 @@ CUDA kernels) and the dynloaded flash-attention library
 (phi/kernels/gpu/flash_attn_kernel.cu).
 """
 
+from .decode_attention import flash_decode_attention
 from .flash_attention import flash_attention
 from .fused_conv import fused_conv_bn_eval, fused_conv_bn_train

@@ -1,0 +1,25 @@
+"""Shared block-size selection for the Pallas attention kernels.
+
+One chooser for flash_attention.py (prefill/training) and
+decode_attention.py (flash decode) so the PR-1 non-divisible-length
+fix-up cannot drift between kernels: the wanted block is clamped to the
+dimension and halved until it divides it exactly (Pallas grids here
+assume exact tiling; the final fallback of 1 always divides).
+"""
+
+from __future__ import annotations
+
+
+def pick_block(s: int, want: int) -> int:
+    """Largest power-of-two-ish divisor of ``s`` at most ``want``.
+
+    Starts from ``min(want, s)`` and halves until the candidate divides
+    ``s``. For the usual power-of-two sequence lengths this returns
+    ``want`` (or ``s`` when shorter); for awkward lengths (the ring hop
+    sizes PR 1 hit, odd KV capacities) it degrades gracefully instead of
+    producing a grid that drops the tail.
+    """
+    b = min(want, s)
+    while s % b and b > 1:
+        b //= 2
+    return b

@@ -1,0 +1,319 @@
+"""Flash-decode: GQA-native split-K Pallas attention for the decode hot path.
+
+The serving/generation decode step runs single-query attention (q_len
+small, typically 1) against the static [B, max_len, kv_heads, d] KV
+caches. The plain XLA path scores the ENTIRE padded cache and — for GQA
+models — first materializes the repeat_kv-expanded [B, max_len, heads, d]
+K/V in HBM, multiplying the dominant HBM stream by heads/kv_heads.
+This kernel is the TPU-native fix (reference analogue: the decode branch
+of phi/kernels/gpu/flash_attn_kernel.cu and the flash-decoding split-K
+formulation):
+
+- split-K over the cache length: grid (B, kv_heads, num_kv_blocks);
+  every KV block computes an online-softmax PARTIAL (running max, sum,
+  unnormalized accumulator) and a small XLA combine merges them — short
+  batches still expose B * kv_heads * num_blocks parallel cells, and on
+  TPU the first two grid dims are declared "parallel" for megacore.
+- GQA-native: each grid cell loads its [block_k, d] K/V block ONCE and
+  serves the kv head's whole [group * q_len, d] query bundle through a
+  single MXU matmul — repeat_kv never materializes, so KV bytes drop by
+  the group factor (4x for Llama-70B-style heads/kv_heads ratios).
+- per-row length masking: the engine's per-slot [B] position vector is
+  scalar-prefetched; each row's kv-block loop is bounded by its own
+  length, blocks wholly beyond ``pos + q_len`` are skipped (the K/V
+  BlockSpec index map re-points them at the row's last needed block,
+  which Pallas recognizes as a revisit and does not re-fetch), and the
+  boundary block masks ``kpos <= qpos`` element-wise. A mostly-empty
+  cache therefore costs proportional to occupancy, not max_len; dead
+  slots (the serving engine pins freed slots to pos 0) touch one block.
+- bf16 (or fp32) streams with fp32 statistics and accumulation
+  (preferred_element_type on both matmuls, stats never leave fp32).
+
+Layout contract matches generation.make_kv_caches: q [B, q_len, heads,
+d], caches [B, max_len, kv_heads, d], query head j reads kv head
+j // (heads // kv_heads) (the repeat_kv mapping).
+
+Dispatch: llama/gpt decode paths call ``decode_dispatch`` (env
+``PADDLE_TPU_FLASH_DECODE``; default on for TPU backends, opt-in on CPU
+where Pallas interprets) and fall back to XLA with reason counters —
+``paddle_tpu_flash_decode_{hits,fallbacks}_total`` — mirroring the
+fused-conv instrumentation pattern.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; CPU tests run in interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_TPU_PALLAS = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_TPU_PALLAS = False
+
+from ..observability.metrics import _ENABLED as _obs_on
+from ..observability.metrics import counter as _obs_counter
+from ._blocks import pick_block
+from .flash_attention import NEG_INF, _dot_prec, _interpret
+
+__all__ = ["flash_decode_attention", "flash_decode_enabled",
+           "decode_dispatch", "MAX_DECODE_Q_LEN"]
+
+_FLASH_DECODE_ENV = "PADDLE_TPU_FLASH_DECODE"
+
+# the kernel is built for the short-query decode window; longer chunks
+# (prefill) belong to flash_attention's q-blocked grid
+MAX_DECODE_Q_LEN = 8
+
+# Dispatch outcome counters (PR-2 fused-conv pattern): the decode
+# dispatch is a python-side decision with automatic XLA fallback, so a
+# config regression that silently disables the kernel family would be
+# invisible without them. Under jit they fire once per TRACE.
+_fd_hits = _obs_counter(
+    "paddle_tpu_flash_decode_hits_total",
+    "decode steps dispatched to the Pallas flash-decode kernel",
+    ("model",))
+_fd_fallbacks = _obs_counter(
+    "paddle_tpu_flash_decode_fallbacks_total",
+    "decode steps on the XLA fallback path",
+    ("reason",))
+
+
+def flash_decode_enabled() -> bool:
+    """Env-gated: PADDLE_TPU_FLASH_DECODE=1/0 forces it; default on for
+    TPU backends (where the kernel is compiled) and off on CPU (where
+    Pallas runs in the slow interpreter — tests opt in explicitly)."""
+    v = os.environ.get(_FLASH_DECODE_ENV)
+    if v is not None:
+        return v != "0"
+    return jax.default_backend() == "tpu"
+
+
+def decode_dispatch(model: str, *, q_len: int, has_mask: bool,
+                    dtype) -> bool:
+    """The decode-path dispatch decision for one attention layer call:
+    True -> run ``flash_decode_attention``; False -> XLA fallback, with
+    the reason counted. Called from the static-cache branch of the
+    llama/gpt attention forwards (python-side, so under jit this costs
+    nothing after the first trace)."""
+    reason = None
+    if not flash_decode_enabled():
+        reason = "disabled"
+    elif not _HAS_TPU_PALLAS:  # pragma: no cover — jax without pallas.tpu
+        reason = "no_tpu_pallas"
+    elif has_mask:
+        # caller brought its own attention mask (ragged left-padded
+        # prompts): the kernel's masking is position-derived only
+        reason = "external_mask"
+    elif q_len > MAX_DECODE_Q_LEN:
+        reason = "q_len"
+    elif str(dtype) not in ("float32", "bfloat16"):
+        reason = "dtype"
+    else:
+        from ..core.autograd import is_grad_enabled
+
+        if is_grad_enabled():
+            # forward-only kernel (decode is inference); taping it would
+            # fail at vjp derivation
+            reason = "grad_mode"
+    if reason is None:
+        if _obs_on[0]:
+            _fd_hits.labels(model).inc()
+        return True
+    if _obs_on[0]:
+        _fd_fallbacks.labels(reason).inc()
+    return False
+
+
+_COMPILER_PARAMS = None
+
+
+def _compiler_kwargs():
+    """Megacore partitioning on chip: batch and kv-head grid dims are
+    embarrassingly parallel (every cell writes its own partial), only
+    the kv-block dim needs sequential order (the revisit-skip on the
+    K/V index map). Interpret mode takes no compiler params."""
+    if not _HAS_TPU_PALLAS or _interpret():
+        return {}
+    global _COMPILER_PARAMS
+    if _COMPILER_PARAMS is None:
+        params_cls = (getattr(pltpu, "CompilerParams", None)
+                      or getattr(pltpu, "TPUCompilerParams", None))
+        if params_cls is None:  # pragma: no cover
+            raise RuntimeError(
+                "paddle_tpu flash decode needs pallas TPU compiler params "
+                f"(neither CompilerParams nor TPUCompilerParams on "
+                f"jax=={jax.__version__})")
+        _COMPILER_PARAMS = params_cls(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return {"compiler_params": _COMPILER_PARAMS}
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
+                   block_k: int, sm_scale: float, q_len: int, group: int):
+    """One (batch row, kv head, kv block) cell: the block's online-
+    softmax partial for the whole query bundle.
+
+    Refs (blocked):
+      q [1, q_len, 1, group, d]   — the kv head's query bundle
+      k/v [1, block_k, 1, d]      — one cache block of this kv head
+      o [1, 1, 1, gq, d] f32      — unnormalized accumulator partial
+      m/l [1, 1, 1, gq, 1] f32    — running max / sum partials
+    """
+    b = pl.program_id(0)
+    s = pl.program_id(2)
+    length = lens_ref[b]          # row's valid kv length = pos + q_len
+    start = s * block_k
+    gq = q_len * group
+    d = q_ref.shape[-1]
+
+    @pl.when(start < length)
+    def _compute():
+        q = q_ref[0, :, 0].reshape(gq, d)  # rows r = i*group + g
+        k = k_ref[0, :, 0, :]              # [block_k, d]
+        v = v_ref[0, :, 0, :]
+        sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                     precision=_dot_prec(q.dtype)) * sm_scale
+        kpos = start + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 1)
+        # query row r sits at absolute position pos + r // group; masking
+        # kpos <= qpos covers BOTH the right-pad beyond the row's length
+        # and causality inside the q_len window
+        qpos = (length - q_len) \
+            + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 0) // group
+        sc = jnp.where(kpos <= qpos, sc, NEG_INF)
+        m = sc.max(axis=-1)                # [gq] f32
+        p = jnp.exp(sc - m[:, None])
+        l = p.sum(axis=-1)
+        acc = jnp.dot(p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32,
+                      precision=_dot_prec(q.dtype))
+        o_ref[0, 0, 0] = acc
+        m_ref[0, 0, 0] = m[:, None]
+        l_ref[0, 0, 0] = l[:, None]
+
+    @pl.when(start >= length)
+    def _skip():
+        # skipped blocks still own their partial slots; the finite
+        # NEG_INF sentinel makes them exact zeros in the combine
+        # (exp(NEG_INF - m_total) underflows to 0, l contributes 0)
+        o_ref[0, 0, 0] = jnp.zeros((gq, d), jnp.float32)
+        m_ref[0, 0, 0] = jnp.full((gq, 1), NEG_INF, jnp.float32)
+        l_ref[0, 0, 0] = jnp.zeros((gq, 1), jnp.float32)
+
+
+def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int):
+    """q5 [B, q_len, KV, group, d], caches [B, max_len, KV, d],
+    lens [B] int32 -> [B, KV, gq, d] f32 (unnormalized layout rows
+    r = i*group + g, already combined and normalized)."""
+    B, q_len, KV, group, d = q5.shape
+    max_len = kc.shape[1]
+    bk = pick_block(max_len, block_k)
+    nb = max_len // bk
+    gq = q_len * group
+
+    def _idx_q(b, h, s, lens):
+        return (b, 0, h, 0, 0)
+
+    def _idx_kv(b, h, s, lens):
+        # blocks beyond the row's last needed block re-point AT the last
+        # needed one: Pallas sees a repeated index and skips the fetch,
+        # so right-pad past pos (and dead slots pinned to pos 0) cost no
+        # HBM traffic beyond one block
+        last = jnp.maximum(pl.cdiv(lens[b], bk) - 1, 0)
+        return (b, jnp.minimum(s, last), h, 0)
+
+    def _idx_out(b, h, s, lens):
+        return (b, h, s, 0, 0)
+
+    def _idx_stat(b, h, s, lens):
+        return (b, h, s, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, KV, nb),
+        in_specs=[
+            pl.BlockSpec((1, q_len, 1, group, d), _idx_q),
+            pl.BlockSpec((1, bk, 1, d), _idx_kv),
+            pl.BlockSpec((1, bk, 1, d), _idx_kv),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, gq, d), _idx_out),
+            pl.BlockSpec((1, 1, 1, gq, 1), _idx_stat),
+            pl.BlockSpec((1, 1, 1, gq, 1), _idx_stat),
+        ],
+    )
+
+    def kern(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref):
+        _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                       block_k=bk, sm_scale=sm_scale, q_len=q_len,
+                       group=group)
+
+    o_p, m_p, l_p = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((B, KV, nb, gq, d), jnp.float32),
+                   jax.ShapeDtypeStruct((B, KV, nb, gq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((B, KV, nb, gq, 1), jnp.float32)),
+        interpret=_interpret(),
+        **_compiler_kwargs(),
+    )(lens.astype(jnp.int32), q5, kc, vc)
+
+    # split-K combine (tiny: nb * gq * d floats per row/head): classic
+    # log-sum-exp merge of the blocks' partials. Skipped blocks carry
+    # (m=NEG_INF, l=0, acc=0) and contribute exact zeros; a fully-masked
+    # row (dead slot) ends with l_total=0 and returns zeros.
+    m_tot = m_p.max(axis=2)                        # [B, KV, gq, 1]
+    alpha = jnp.exp(m_p - m_tot[:, :, None])       # [B, KV, nb, gq, 1]
+    l_tot = (l_p * alpha).sum(axis=2)
+    acc = (o_p * alpha).sum(axis=2)
+    return acc / jnp.maximum(l_tot, 1e-30)
+
+
+def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
+                           block_k: int = 256):
+    """Flash-decode attention over the static KV caches.
+
+    q: [B, q_len, heads, d] (q_len <= MAX_DECODE_Q_LEN); k_cache/v_cache:
+    [B, max_len, kv_heads, d] with this step's tokens ALREADY written at
+    [pos, pos + q_len) (the update_static_kv_cache protocol);
+    ``positions``: per-row [B] int32 vector or scalar — query i of row b
+    sits at absolute position positions[b] + i and attends cache
+    positions <= it. Returns [B, q_len, heads, d] in q's dtype.
+
+    heads must be a multiple of kv_heads; query head j reads kv head
+    j // (heads // kv_heads) (the repeat_kv mapping) without ever
+    materializing the expansion.
+    """
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import apply_op
+
+    is_tensor = isinstance(q, Tensor)
+    pos_arr = positions._data if isinstance(positions, Tensor) else positions
+
+    def _f(qa, ka, va):
+        B, q_len, H, d = qa.shape
+        KV = ka.shape[2]
+        if H % KV:
+            raise ValueError(f"heads ({H}) not a multiple of kv_heads ({KV})")
+        group = H // KV
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+        pos = jnp.asarray(pos_arr, jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (B,))
+        lens = jnp.minimum(pos + q_len, ka.shape[1])
+        q5 = qa.reshape(B, q_len, KV, group, d)
+        o = _flash_decode(q5, ka, va, lens, sm_scale=scale, block_k=block_k)
+        # [B, KV, q_len*group, d] rows r = i*group + g -> [B, q_len, H, d]
+        o = o.reshape(B, KV, q_len, group, d)
+        o = jnp.transpose(o, (0, 2, 1, 3, 4)).reshape(B, q_len, H, d)
+        return o.astype(qa.dtype)
+
+    if is_tensor:
+        return apply_op("flash_decode_attention", _f, q, k_cache, v_cache)
+    return _f(jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache))

@@ -489,11 +489,10 @@ def _flash_lse_vjp_bwd(causal, sm_scale, block_q, block_k, res, cts):
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
-def _pick_block(s: int, want: int) -> int:
-    b = min(want, s)
-    while s % b and b > 1:
-        b //= 2
-    return b
+# Shared with decode_attention.py (pallas_kernels/_blocks.py) so the
+# non-divisible-length fix-up can't drift between the kernels; the
+# `_pick_block` name stays importable (distributed/sequence_parallel.py).
+from ._blocks import pick_block as _pick_block  # noqa: E402
 
 
 def flash_attention(q, k, v, causal: bool = True, sm_scale=None,

@@ -16,7 +16,10 @@ decode substrate instead of paging:
   sequence offset), per-slot sampling params and PRNG keys carried as
   traced arrays so mixed greedy/sampled requests share the single step
   program. The step executable compiles exactly once and then runs at
-  whatever occupancy admission sustains;
+  whatever occupancy admission sustains. Free slots ride along as
+  garbage rows with their positions PINNED to 0 (a traced [B] active
+  mask — occupancy patterns never retrace), so the flash-decode
+  kernel's per-row length masking prices a dead slot at one KV block;
 - slots free on EOS / max-tokens / cancellation / deadline and are
   refilled by the next iteration's admission pass.
 
@@ -210,19 +213,24 @@ class ServingEngine:
             return out, state
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def _step(pb, caches, state, any_sampling):
+        def _step(pb, caches, state, any_sampling, active):
             """ONE decode iteration for the whole slot pool: per-slot
             positions (vector ``state["pos"]``) drive per-row RoPE/
             cache-write/mask; per-slot params + keys drive the batched
             sampler. Compiles once — every shape here is fixed by the
-            pool. When NO active slot samples (``any_sampling``, a
-            host-tracked traced scalar — stale params on freed slots
+            pool (``active`` is a traced [B] bool, so occupancy patterns
+            never retrace). When NO active slot samples (``any_sampling``,
+            a host-tracked traced scalar — stale params on freed slots
             can't force the branch), a runtime ``lax.cond`` skips the
             sampling branch (its full-vocab sort is the most expensive
             op in the step) for a pure-argmax step — exact, since
             ``select_tokens`` is row-wise greedy for ds=False rows.
             Free slots keep decoding garbage rows; their tokens are
-            never delivered and admission resets their state."""
+            never delivered and admission resets their state. Their
+            positions are PINNED to 0 (not advanced), so the per-row
+            length masking in the flash-decode kernel prices a dead slot
+            at one KV block — a mostly-empty pool costs proportional to
+            occupancy, not max_len."""
             logits, caches = run(pb, state["tokens"][:, None], caches,
                                  state["pos"])
             last = logits[:, 0]
@@ -234,10 +242,12 @@ class ServingEngine:
                 lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
             state = dict(state)
             state["tokens"] = nxt
-            # free rows advance too — clamp so their cache writes stay
-            # in bounds (the clamped row is overwritten at admission)
-            state["pos"] = jnp.minimum(state["pos"] + 1,
-                                       jnp.int32(config.max_len - 1))
+            # active rows advance (clamped so late cache writes stay in
+            # bounds); free rows pin at 0 until admission resets them
+            state["pos"] = jnp.where(
+                active,
+                jnp.minimum(state["pos"] + 1, jnp.int32(config.max_len - 1)),
+                jnp.int32(0))
             state["keys"] = new_keys
             return nxt, caches, state
 
@@ -412,10 +422,12 @@ class ServingEngine:
 
             t0 = time.perf_counter()
             any_sampling = any(self._slot_sampling[i] for i in active)
+            active_mask = np.zeros(self.config.max_slots, bool)
+            active_mask[active] = True
             with _entrypoint("serving.step"):
                 toks, self._caches, self._state = self._step_fn(
                     self._pb, self._caches, self._state,
-                    jnp.asarray(any_sampling))
+                    jnp.asarray(any_sampling), jnp.asarray(active_mask))
             toks_np = np.asarray(toks)  # the step's ONE device->host sync
             now = time.perf_counter()
             _sm.steps_total.inc()

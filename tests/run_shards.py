@@ -57,6 +57,10 @@ TPU_LANE = [
     # fast on the persistent compile cache). Grad FD checks are sampled
     # (see the grad-policy note in test_op_schema_sweep.py).
     ("test_fused_conv.py", 420, {}),  # Pallas conv+BN on-chip numerics
+    # flash-decode kernel: CPU-interpret-verified in the build container;
+    # this entry is the first on-chip compile/numerics run (pair with
+    # benchmarks/bench_decode_attention.py for the >=1.3x acceptance)
+    ("test_decode_attention.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     *[(f"test_op_schema_sweep.py", 600,
        {"PADDLE_TPU_SWEEP_SHARD": f"{i}/8"}) for i in range(8)],
     # sampled FD-grad lane (every 16th schema incl. grads): ~2 s/op of
@@ -76,6 +80,13 @@ TPU_TOLERANCE_DELTAS = [
      "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
     {"where": "fused_conv_bn_train / fused_conv_bn_eval",
      "delta": "bf16-only on chip, same MXU contract as flash attention",
+     "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
+    {"where": "flash_decode_attention",
+     "delta": "bf16-only on chip (same MXU contract); kernel is "
+              "CPU-interpret-verified in the build container — this lane "
+              "is its first compiled run (tests/test_decode_attention.py "
+              "+ benchmarks/bench_decode_attention.py for the >=1.3x "
+              "kernel-vs-fallback acceptance at GQA 4x, <=50% occupancy)",
      "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
     {"where": "power_to_db",
      "delta": "5e-4 vs the CPU 1e-5 oracle tolerance (TPU log/pow "
@@ -169,6 +180,11 @@ def _summarize_snapshot(snap: dict) -> dict:
         "fused_conv_dispatch": {
             "/".join(s["labels"].values()): int(s["value"])
             for s in series("paddle_tpu_fused_conv_dispatch_total")},
+        "flash_decode_dispatch": {
+            **{"hit/" + "/".join(s["labels"].values()): int(s["value"])
+               for s in series("paddle_tpu_flash_decode_hits_total")},
+            **{"fallback/" + "/".join(s["labels"].values()): int(s["value"])
+               for s in series("paddle_tpu_flash_decode_fallbacks_total")}},
         "compiles_total": int(sum(
             s["value"] for s in series("paddle_tpu_compiles_total"))),
         "compile_seconds_total": round(sum(
@@ -192,7 +208,8 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     import json
 
     shards = []
-    totals: dict = {"fused_conv_dispatch": {}, "compiles_total": 0,
+    totals: dict = {"fused_conv_dispatch": {}, "flash_decode_dispatch": {},
+                    "compiles_total": 0,
                     "compile_seconds_total": 0.0, "retraces_total": 0,
                     "nan_check_trips": 0, "steps_recorded": 0}
     for path in sorted(glob.glob(dump_prefix + ".*.json")):
@@ -204,9 +221,9 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
         summary = _summarize_snapshot(snap)
         summary["pid"] = path.rsplit(".", 2)[-2]
         shards.append(summary)
-        for k, v in summary["fused_conv_dispatch"].items():
-            totals["fused_conv_dispatch"][k] = (
-                totals["fused_conv_dispatch"].get(k, 0) + v)
+        for fam in ("fused_conv_dispatch", "flash_decode_dispatch"):
+            for k, v in summary[fam].items():
+                totals[fam][k] = totals[fam].get(k, 0) + v
         for k in ("compiles_total", "compile_seconds_total",
                   "retraces_total", "nan_check_trips", "steps_recorded"):
             totals[k] += summary[k]
@@ -233,6 +250,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
 
     serving_bench = _read_bench("bench_serving.json")
     checkpoint_bench = _read_bench("bench_checkpoint.json")
+    decode_bench = _read_bench("bench_decode.json")
     out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
                             "telemetry_lane.json")
     with open(out_path, "w") as fh:
@@ -244,6 +262,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
             "shards": shards,
             "serving_bench": serving_bench,
             "checkpoint_bench": checkpoint_bench,
+            "decode_bench": decode_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "
