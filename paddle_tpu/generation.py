@@ -27,7 +27,8 @@ from .utils.functional import functional_call
 
 __all__ = ["GenerationConfig", "generate", "generate_uncached",
            "update_static_kv_cache", "make_kv_caches", "make_cached_runner",
-           "select_tokens", "split_keys"]
+           "select_tokens", "split_keys", "make_paged_kv_pools",
+           "paged_kv_cache_write", "gather_paged_kv"]
 
 
 def _is_per_row(position_offset) -> bool:
@@ -61,8 +62,129 @@ def kv_cache_write(buf, new, position_offset):
                     ensure_tensor(new))
 
 
+def _causal_cache_mask(position_offset, s: int, max_len: int) -> Tensor:
+    """The additive causal mask over a static cache of ``max_len`` key
+    positions for ``s`` query tokens starting at ``position_offset`` —
+    shared by the contiguous and paged cache paths so both build the
+    bit-identical mask (the engine's parity oracle depends on it)."""
+    kpos = jnp.arange(max_len)
+    if _is_per_row(position_offset):
+        po = position_offset
+        qpos = po[:, None] + jnp.arange(s)          # [b, s]
+        m = (kpos[None, None, :] <= qpos[:, :, None]) \
+            & (kpos[None, None, :] < (po[:, None, None] + s))
+        return Tensor(jnp.where(m[:, None], 0.0, -1e30).astype(jnp.float32))
+    qpos = position_offset + jnp.arange(s)
+    m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
+    return Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+
+
+def make_paged_kv_pools(config, num_blocks: int, block_size: int, dtype):
+    """Device-resident paged KV pools: a list (one per decoder layer) of
+    {"k", "v"} jnp arrays shaped [num_blocks, block_size,
+    num_key_value_heads, head_dim]. Slots address the pool through
+    per-slot int32 block tables instead of owning contiguous rows, so
+    HBM is bounded by TOKENS IN FLIGHT, not slots * worst-case length."""
+    n_kv = config.num_key_value_heads
+    head_dim = config.hidden_size // config.num_attention_heads
+    return [{"k": jnp.zeros((num_blocks, block_size, n_kv, head_dim), dtype),
+             "v": jnp.zeros((num_blocks, block_size, n_kv, head_dim), dtype)}
+            for _ in range(config.num_hidden_layers)]
+
+
+def paged_kv_cache_write(pool, new, block_table, position_offset,
+                         valid_len=None):
+    """Scatter a step's [b, s, h, d] K-or-V block into the shared
+    [num_blocks, block_size, h, d] pool through per-row block tables
+    (the paged analogue of ``kv_cache_write``): token j of row b lands
+    in physical block ``block_table[b, (pos_b + j) // block_size]`` at
+    offset ``(pos_b + j) % block_size``.
+
+    ``valid_len`` (scalar or per-row [b]) caps how many of the ``s``
+    tokens are real: padded tail tokens (chunked prefill pads the last
+    chunk to the fixed chunk shape) are routed into the reserved dump
+    block 0 so they can never dirty a live block."""
+    from .ops.dispatch import apply_op, ensure_tensor
+
+    bt = block_table._data if isinstance(block_table, Tensor) \
+        else jnp.asarray(block_table)
+    po = position_offset._data if isinstance(position_offset, Tensor) \
+        else position_offset
+    vl = None if valid_len is None else (
+        valid_len._data if isinstance(valid_len, Tensor) else valid_len)
+
+    def upd(p, n):
+        num_blocks, bs = p.shape[0], p.shape[1]
+        b, s = n.shape[0], n.shape[1]
+        pos = jnp.asarray(po, jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (b,))
+        tpos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        blk = jnp.clip(tpos // bs, 0, bt.shape[1] - 1)
+        phys = jnp.take_along_axis(jnp.asarray(bt, jnp.int32), blk, axis=1)
+        idx = phys * bs + tpos % bs                      # [b, s] flat
+        if vl is not None:
+            va = jnp.asarray(vl, jnp.int32)
+            if va.ndim == 0:
+                va = jnp.broadcast_to(va, (b,))
+            # pad tokens -> flat slot 0 (dump block 0, offset 0)
+            idx = jnp.where(tpos < (pos + va)[:, None], idx, 0)
+        flat = p.reshape((num_blocks * bs,) + p.shape[2:])
+        flat = flat.at[idx.reshape(-1)].set(
+            n.astype(p.dtype).reshape((b * s,) + n.shape[2:]))
+        return flat.reshape(p.shape)
+
+    return apply_op("paged_kv_cache_update", upd, ensure_tensor(pool),
+                    ensure_tensor(new))
+
+
+def gather_paged_kv(pool, block_table):
+    """Materialize a slot-major [b, nb*block_size, h, d] view of the
+    paged pool through the block tables — the XLA fallback read path
+    (CPU lane / kernel-ineligible shapes). Logically identical to the
+    contiguous [b, max_len, h, d] cache: positions past a row's length
+    hold whatever the pool holds there, exactly like the contiguous
+    cache holds zeros — both are exact no-ops under the additive
+    causal mask."""
+    from .ops.dispatch import apply_op, ensure_tensor
+
+    bt = block_table._data if isinstance(block_table, Tensor) \
+        else jnp.asarray(block_table)
+
+    def g(p):
+        out = jnp.take(p, jnp.asarray(bt, jnp.int32), axis=0)
+        b, nb, bs = out.shape[0], out.shape[1], out.shape[2]
+        return out.reshape((b, nb * bs) + p.shape[2:])
+
+    return apply_op("paged_kv_gather", g, ensure_tensor(pool))
+
+
+def _update_paged_kv_cache(kv_cache: dict, k, v, position_offset,
+                           build_mask: bool, gather: bool):
+    """Paged half of ``update_static_kv_cache``: scatter the step's k/v
+    through the block table, then either gather the slot-major view for
+    the XLA attention paths (``gather=True``) or hand the raw pools back
+    for the paged Pallas kernel (``gather=False``)."""
+    bt = kv_cache["bt"]
+    valid = kv_cache.get("valid")
+    ck = paged_kv_cache_write(kv_cache["k"], k, bt, position_offset, valid)
+    cv = paged_kv_cache_write(kv_cache["v"], v, bt, position_offset, valid)
+    new_cache = dict(kv_cache)
+    new_cache["k"] = ck
+    new_cache["v"] = cv
+    bt_arr = bt._data if isinstance(bt, Tensor) else bt
+    bs = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
+    max_len = int(bt_arr.shape[1]) * bs
+    mask = _causal_cache_mask(position_offset, k.shape[1], max_len) \
+        if build_mask else None
+    if gather:
+        return (gather_paged_kv(ck, bt), gather_paged_kv(cv, bt),
+                new_cache, mask)
+    return ck, cv, new_cache, mask
+
+
 def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
-                           build_mask: bool = True):
+                           build_mask: bool = True, gather: bool = True):
     """The static-cache protocol shared by the decoder models (llama/
     gpt): write this step's k/v [b, s, h, d] into the pre-allocated
     [b, max_len, h, d] buffers at ``position_offset`` and (unless the
@@ -72,24 +194,24 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
 
     A per-row [b] ``position_offset`` vector produces per-row writes and
     a per-row [b, 1, s, max_len] mask (slots at different positions in
-    one batch — the serving engine's decode step)."""
+    one batch — the serving engine's decode step).
+
+    PAGED caches (dict carries a ``"bt"`` block table, pools shaped
+    [num_blocks, block_size, h, d]) scatter the write through the table
+    instead; ``gather=True`` additionally materializes the slot-major
+    [b, nb*block_size, h, d] view for the XLA attention fallbacks, while
+    ``gather=False`` (the paged-kernel path, which reads the pool
+    directly) skips that copy and returns the raw pools as (k, v)."""
+    if isinstance(kv_cache, dict) and "bt" in kv_cache:
+        return _update_paged_kv_cache(kv_cache, k, v, position_offset,
+                                      build_mask, gather)
     ck = kv_cache_write(kv_cache["k"], k, position_offset)
     cv = kv_cache_write(kv_cache["v"], v, position_offset)
     mask = None
     if build_mask:
         s = k.shape[1]
         max_len = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
-        kpos = jnp.arange(max_len)
-        if _is_per_row(position_offset):
-            po = position_offset
-            qpos = po[:, None] + jnp.arange(s)          # [b, s]
-            m = (kpos[None, None, :] <= qpos[:, :, None]) \
-                & (kpos[None, None, :] < (po[:, None, None] + s))
-            mask = Tensor(jnp.where(m[:, None], 0.0, -1e30).astype(jnp.float32))
-        else:
-            qpos = position_offset + jnp.arange(s)
-            m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
-            mask = Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+        mask = _causal_cache_mask(position_offset, s, max_len)
     return ck, cv, {"k": ck, "v": cv}, mask
 
 
@@ -242,8 +364,11 @@ def make_cached_runner(model):
 
     def run(pb, token_ids, caches, pos, attn_mask=None):
         with no_grad():
-            caches_t = [{"k": Tensor(c["k"]), "v": Tensor(c["v"])}
-                        for c in caches]
+            # wrap every array entry (k/v buffers, and for paged caches
+            # the bt/valid companions) so the cache dict round-trips the
+            # model as plain Tensors
+            caches_t = [{kk: vv if isinstance(vv, Tensor) else Tensor(vv)
+                         for kk, vv in c.items()} for c in caches]
             am = None
             if attn_mask is not None:
                 am = attn_mask if isinstance(attn_mask, Tensor) else Tensor(attn_mask)
@@ -251,7 +376,8 @@ def make_cached_runner(model):
                 model, pb, Tensor(token_ids), attn_mask=am,
                 kv_caches=caches_t, position_offset=pos)
         return (logits._data,
-                [{"k": c["k"]._data, "v": c["v"]._data} for c in new_caches])
+                [{kk: vv._data if isinstance(vv, Tensor) else vv
+                  for kk, vv in c.items()} for c in new_caches])
 
     return run
 

@@ -68,20 +68,25 @@ class GPTBlock(nn.Layer):
         v = self.attn.v_proj(h).reshape([b, s, nh, hd])
         new_cache = None
         use_flash_decode = False
+        paged_cache = isinstance(kv_cache, dict) and "bt" in kv_cache
         if isinstance(kv_cache, dict):
             # pre-allocated [b, max_len, h, d] buffers updated in place
-            # (the generation.py static-cache protocol, as in llama.py);
-            # the decode step (s small, no external mask) dispatches to
-            # the Pallas flash-decode kernel — same gate as llama
+            # (the generation.py static-cache protocol, as in llama.py;
+            # "bt"-carrying dicts are paged pools + block tables); the
+            # decode step (s small, no external mask) dispatches to the
+            # Pallas flash-decode kernel — same gate as llama
             from ..generation import update_static_kv_cache
-            from ..pallas_kernels.decode_attention import decode_dispatch
+            from ..pallas_kernels.decode_attention import (
+                decode_dispatch, paged_decode_dispatch)
 
-            use_flash_decode = decode_dispatch(
+            dispatch = paged_decode_dispatch if paged_cache else decode_dispatch
+            use_flash_decode = dispatch(
                 "gpt", q_len=s, has_mask=attn_mask is not None,
                 dtype=q.dtype)
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
-                build_mask=attn_mask is None and not use_flash_decode)
+                build_mask=attn_mask is None and not use_flash_decode,
+                gather=not use_flash_decode)
             if attn_mask is None and not use_flash_decode:
                 attn_mask = mask
         elif kv_cache is not None:
@@ -89,10 +94,15 @@ class GPTBlock(nn.Layer):
                 f"GPT kv_cache must be the generation.py static-cache dict, "
                 f"got {type(kv_cache).__name__}")
         if use_flash_decode:
-            from ..pallas_kernels.decode_attention import \
-                flash_decode_attention
+            from ..pallas_kernels.decode_attention import (
+                flash_decode_attention, paged_flash_decode_attention)
 
-            a = flash_decode_attention(q, k, v, position_offset)
+            if paged_cache:
+                a = paged_flash_decode_attention(
+                    q, new_cache["k"], new_cache["v"], new_cache["bt"],
+                    position_offset)
+            else:
+                a = flash_decode_attention(q, k, v, position_offset)
         else:
             a = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask,

@@ -179,13 +179,19 @@ class LlamaAttention(nn.Layer):
         q, k = apply_rotary_pos_emb(q, k, cos_tab, sin_tab, position_offset)
 
         static_cache = isinstance(kv_cache, dict)
+        # paged static cache: the dict carries a "bt" block table and
+        # [num_blocks, block_size, h, d] pools (the serving engine's
+        # paged KV pool) instead of contiguous [b, max_len, h, d] rows
+        paged_cache = static_cache and "bt" in kv_cache
         # flash prefill: at offset 0 causal attention over the prompt
         # alone equals the masked-dense attention over the padded cache
         # (positions >= s are masked out anyway) — keep the step k/v for
         # the Pallas kernel and skip the [s, max_len] mask entirely.
         # Long-prompt serving stays flash-fast; the per-token decode path
-        # (s == 1) is unchanged.
-        flash_prefill = (static_cache and self.config.use_flash_attention
+        # (s == 1) is unchanged. Paged caches never take it: with prefix
+        # sharing the chunk MUST read earlier blocks through the table.
+        flash_prefill = (static_cache and not paged_cache
+                         and self.config.use_flash_attention
                          and attn_mask is None
                          and isinstance(position_offset, int)
                          and position_offset == 0 and s > 1)
@@ -194,22 +200,26 @@ class LlamaAttention(nn.Layer):
         # per-row length-masked — no repeat_kv, no [s, max_len] mask
         use_flash_decode = False
         if static_cache and not flash_prefill:
-            from ..pallas_kernels.decode_attention import decode_dispatch
+            from ..pallas_kernels.decode_attention import (
+                decode_dispatch, paged_decode_dispatch)
 
-            use_flash_decode = decode_dispatch(
+            dispatch = paged_decode_dispatch if paged_cache else decode_dispatch
+            use_flash_decode = dispatch(
                 "llama", q_len=s, has_mask=attn_mask is not None,
                 dtype=q.dtype)
         if static_cache:
-            # pre-allocated [b, max_len, h, d] buffers updated in place at
-            # position_offset (jit-friendly decode path; the reference's
-            # cache_kv semantics with TPU-native dynamic_update_slice)
+            # pre-allocated buffers updated in place at position_offset
+            # (jit-friendly decode path; the reference's cache_kv
+            # semantics with TPU-native dynamic_update_slice — or a
+            # block-table scatter for paged pools)
             from ..generation import update_static_kv_cache
 
             step_k, step_v = k, v
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
                 build_mask=(attn_mask is None and not flash_prefill
-                            and not use_flash_decode))
+                            and not use_flash_decode),
+                gather=not use_flash_decode)
             if flash_prefill:
                 k, v = step_k, step_v
             elif attn_mask is None and not use_flash_decode:
@@ -225,10 +235,15 @@ class LlamaAttention(nn.Layer):
             new_cache = None
 
         if use_flash_decode:
-            from ..pallas_kernels.decode_attention import \
-                flash_decode_attention
+            from ..pallas_kernels.decode_attention import (
+                flash_decode_attention, paged_flash_decode_attention)
 
-            out = flash_decode_attention(q, k, v, position_offset)
+            if paged_cache:
+                out = paged_flash_decode_attention(
+                    q, new_cache["k"], new_cache["v"], new_cache["bt"],
+                    position_offset)
+            else:
+                out = flash_decode_attention(q, k, v, position_offset)
         else:
             # GQA: the static-cache (decode/cached-prefill) fallback uses
             # the grouped contraction — k/v stay [b, max_len, kv, d], no

@@ -101,6 +101,41 @@ _S("kv_cache_update", _kv_write_ref,
    [((2, 6, 2, 3), "any"), ((2, 2, 2, 3), "any")],
    api="generation.kv_cache_write", kwargs={"position_offset": 1})
 
+# paged KV pool (serving round 7): pool [num_blocks, block_size, h, d],
+# per-row block tables route each token's write/read to a physical
+# block. Fixed table [[1, 2], [3, 0]], offset 1, s=2: row 0 writes flat
+# slots {3, 4}, row 1 {7, 0} — distinct, so the scatter ref is exact.
+_PAGED_BT = np.array([[1, 2], [3, 0]], np.int32)
+_PAGED_BS = 2
+
+
+def _paged_kv_write_ref(pool, new):
+    out = pool.copy()
+    flat = out.reshape((-1,) + out.shape[2:])
+    b, s = new.shape[0], new.shape[1]
+    for r in range(b):
+        for j in range(s):
+            p = 1 + j
+            blk = _PAGED_BT[r, p // _PAGED_BS]
+            flat[blk * _PAGED_BS + p % _PAGED_BS] = new[r, j]
+    return flat.reshape(out.shape)
+
+
+_S("paged_kv_cache_update", _paged_kv_write_ref,
+   [((4, 2, 2, 3), "any"), ((2, 2, 2, 3), "any")],
+   api="generation.paged_kv_cache_write",
+   wrap=lambda api: lambda pool, new: api(pool, new, _PAGED_BT, 1))
+
+
+def _paged_gather_ref(pool):
+    out = pool[_PAGED_BT.reshape(-1)]
+    return out.reshape((2, 2 * _PAGED_BS) + pool.shape[2:])
+
+
+_S("paged_kv_gather", _paged_gather_ref, [((4, 2, 2, 3), "any")],
+   api="generation.gather_paged_kv",
+   wrap=lambda api: lambda pool: api(pool, _PAGED_BT))
+
 # ---------------------------------------------------------------------------
 # RNN cells + fused RNN layers (nn/layers_rnn.py)
 # ---------------------------------------------------------------------------
@@ -403,6 +438,26 @@ _S("flash_decode_attention", _flash_decode_ref,
    dtypes=("float32", "bfloat16"), tol=_FLASH_TOL,
    wrap=lambda api: lambda q, kc, vc: api(q, kc, vc, _FD_SWEEP_POS,
                                           block_k=4))
+
+
+# paged variant: the same attention math, with the [2, 6, 2, 8] logical
+# caches living as pool blocks [7, 2, 2, 8] addressed through a fixed
+# [2, 3] block table (block 0 left as the dump block, like the engine).
+_PFD_BT = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+
+
+def _paged_flash_decode_ref(q, kp, vp):
+    gather = lambda p: p[_PFD_BT.reshape(-1)].reshape(
+        2, 6, p.shape[2], p.shape[3])
+    return _flash_decode_ref(q, gather(kp), gather(vp))
+
+
+_S("paged_flash_decode_attention", _paged_flash_decode_ref,
+   [((2, 1, 4, 8), "any"), ((7, 2, 2, 8), "any"), ((7, 2, 2, 8), "any")],
+   api="pallas_kernels.paged_flash_decode_attention", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FLASH_TOL,
+   wrap=lambda api: lambda q, kp, vp: api(q, kp, vp, _PFD_BT,
+                                          _FD_SWEEP_POS))
 
 
 # grouped-query SDPA (the flash-decode XLA fallback): per query head

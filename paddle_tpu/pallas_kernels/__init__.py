@@ -5,6 +5,7 @@ CUDA kernels) and the dynloaded flash-attention library
 (phi/kernels/gpu/flash_attn_kernel.cu).
 """
 
-from .decode_attention import flash_decode_attention
+from .decode_attention import (flash_decode_attention,
+                               paged_flash_decode_attention)
 from .flash_attention import flash_attention
 from .fused_conv import fused_conv_bn_eval, fused_conv_bn_train

@@ -63,13 +63,20 @@ from ._blocks import pick_block
 from .flash_attention import NEG_INF, _dot_prec, _interpret
 
 __all__ = ["flash_decode_attention", "flash_decode_enabled",
-           "decode_dispatch", "MAX_DECODE_Q_LEN"]
+           "decode_dispatch", "MAX_DECODE_Q_LEN",
+           "paged_flash_decode_attention", "paged_decode_dispatch",
+           "MAX_PAGED_Q_LEN"]
 
 _FLASH_DECODE_ENV = "PADDLE_TPU_FLASH_DECODE"
 
 # the kernel is built for the short-query decode window; longer chunks
 # (prefill) belong to flash_attention's q-blocked grid
 MAX_DECODE_Q_LEN = 8
+
+# the paged variant also serves chunked-prefill bundles (one fixed chunk
+# shape replaces every per-bucket prefill executable), so its query
+# window is the chunk, not the decode step
+MAX_PAGED_Q_LEN = 256
 
 # Dispatch outcome counters (PR-2 fused-conv pattern): the decode
 # dispatch is a python-side decision with automatic XLA fallback, so a
@@ -128,6 +135,39 @@ def decode_dispatch(model: str, *, q_len: int, has_mask: bool,
         return True
     if _obs_on[0]:
         _fd_fallbacks.labels(reason).inc()
+    return False
+
+
+def paged_decode_dispatch(model: str, *, q_len: int, has_mask: bool,
+                          dtype) -> bool:
+    """Dispatch decision for the PAGED decode/chunk-prefill path: True
+    -> ``paged_flash_decode_attention`` (block-table gather inside the
+    kernel's index map); False -> the XLA gather fallback
+    (``gather_paged_kv`` + grouped SDPA), with the reason counted under
+    a ``paged_`` prefix. Same gates as ``decode_dispatch`` except the
+    query window covers the prefill chunk (``MAX_PAGED_Q_LEN``)."""
+    reason = None
+    if not flash_decode_enabled():
+        reason = "disabled"
+    elif not _HAS_TPU_PALLAS:  # pragma: no cover — jax without pallas.tpu
+        reason = "no_tpu_pallas"
+    elif has_mask:
+        reason = "external_mask"
+    elif q_len > MAX_PAGED_Q_LEN:
+        reason = "q_len"
+    elif str(dtype) not in ("float32", "bfloat16"):
+        reason = "dtype"
+    else:
+        from ..core.autograd import is_grad_enabled
+
+        if is_grad_enabled():
+            reason = "grad_mode"
+    if reason is None:
+        if _obs_on[0]:
+            _fd_hits.labels(model + "_paged").inc()
+        return True
+    if _obs_on[0]:
+        _fd_fallbacks.labels("paged_" + reason).inc()
     return False
 
 
@@ -317,3 +357,116 @@ def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
     if is_tensor:
         return apply_op("flash_decode_attention", _f, q, k_cache, v_cache)
     return _f(jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache))
+
+
+def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
+    """q5 [B, q_len, KV, group, d], pools [num_blocks, bs, KV, d],
+    bt [B, nb] int32, lens [B] int32 -> [B, KV, gq, d] f32 (combined and
+    normalized). Identical math to ``_flash_decode`` — the only change
+    is the K/V index map, which resolves the grid's logical kv-block
+    through the scalar-prefetched block table into a physical pool
+    block. Out-of-range blocks re-point at the row's LAST needed logical
+    block (the same Pallas revisit-skip as the contiguous kernel), so a
+    short row costs its own length, not the table width."""
+    B, q_len, KV, group, d = q5.shape
+    bs = kp.shape[1]
+    nb = bt.shape[1]
+    gq = q_len * group
+
+    def _idx_q(b, h, s, lens, bt):
+        return (b, 0, h, 0, 0)
+
+    def _idx_kv(b, h, s, lens, bt):
+        last = jnp.maximum(pl.cdiv(lens[b], bs) - 1, 0)
+        return (bt[b, jnp.minimum(s, last)], 0, h, 0)
+
+    def _idx_out(b, h, s, lens, bt):
+        return (b, h, s, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, nb),
+        in_specs=[
+            pl.BlockSpec((1, q_len, 1, group, d), _idx_q),
+            pl.BlockSpec((1, bs, 1, d), _idx_kv),
+            pl.BlockSpec((1, bs, 1, d), _idx_kv),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, gq, d), _idx_out),
+            pl.BlockSpec((1, 1, 1, gq, 1), _idx_out),
+            pl.BlockSpec((1, 1, 1, gq, 1), _idx_out),
+        ],
+    )
+
+    def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref):
+        # bt_ref is consumed by the index maps; the cell body itself is
+        # the contiguous kernel verbatim (same lens-bounded masking)
+        del bt_ref
+        _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                       block_k=bs, sm_scale=sm_scale, q_len=q_len,
+                       group=group)
+
+    o_p, m_p, l_p = pl.pallas_call(
+        _kern,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((B, KV, nb, gq, d), jnp.float32),
+                   jax.ShapeDtypeStruct((B, KV, nb, gq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((B, KV, nb, gq, 1), jnp.float32)),
+        interpret=_interpret(),
+        **_compiler_kwargs(),
+    )(lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp, vp)
+
+    m_tot = m_p.max(axis=2)
+    alpha = jnp.exp(m_p - m_tot[:, :, None])
+    l_tot = (l_p * alpha).sum(axis=2)
+    acc = (o_p * alpha).sum(axis=2)
+    return acc / jnp.maximum(l_tot, 1e-30)
+
+
+def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
+                                 sm_scale=None):
+    """Flash-decode attention over PAGED KV pools.
+
+    q: [B, q_len, heads, d] (q_len <= MAX_PAGED_Q_LEN — the serving
+    decode step OR one chunked-prefill bundle); k_pool/v_pool:
+    [num_blocks, block_size, kv_heads, d] shared pools with this step's
+    tokens ALREADY scattered at their table-resolved positions
+    (``generation.paged_kv_cache_write``); ``block_table``: [B, nb]
+    int32 — row b's logical block j lives in physical pool block
+    ``block_table[b, j]``; ``positions``: per-row [B] int32 vector or
+    scalar, same contract as ``flash_decode_attention``. Returns
+    [B, q_len, heads, d] in q's dtype.
+    """
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import apply_op
+
+    is_tensor = isinstance(q, Tensor)
+    pos_arr = positions._data if isinstance(positions, Tensor) else positions
+    bt_arr = block_table._data if isinstance(block_table, Tensor) \
+        else block_table
+
+    def _f(qa, ka, va):
+        B, q_len, H, d = qa.shape
+        KV = ka.shape[2]
+        if H % KV:
+            raise ValueError(f"heads ({H}) not a multiple of kv_heads ({KV})")
+        group = H // KV
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+        bt = jnp.asarray(bt_arr, jnp.int32)
+        if bt.ndim != 2 or bt.shape[0] != B:
+            raise ValueError(
+                f"block_table must be [B={B}, nb], got {bt.shape}")
+        pos = jnp.asarray(pos_arr, jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (B,))
+        max_len = bt.shape[1] * ka.shape[1]
+        lens = jnp.minimum(pos + q_len, max_len)
+        q5 = qa.reshape(B, q_len, KV, group, d)
+        o = _paged_flash_decode(q5, ka, va, bt, lens, sm_scale=scale)
+        o = o.reshape(B, KV, q_len, group, d)
+        o = jnp.transpose(o, (0, 2, 1, 3, 4)).reshape(B, q_len, H, d)
+        return o.astype(qa.dtype)
+
+    if is_tensor:
+        return apply_op("paged_flash_decode_attention", _f, q, k_pool, v_pool)
+    return _f(jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool))

@@ -1,27 +1,37 @@
-"""paddle_tpu.serving — continuous-batching inference engine over
-slot-based static KV caches.
+"""paddle_tpu.serving — continuous-batching inference engine over a
+paged (block-pool) KV cache.
 
 The north-star workload is "serve heavy traffic from millions of
 users"; ``generation.generate`` is one request at a time, whole-batch
 lockstep. This package is the request-level layer above the same
 static-shape decode substrate:
 
-- ``engine``:    ``ServingEngine`` — a fixed pool of decode slots over
-                 pre-allocated [B, max_len, h, d] KV buffers; bucketed
-                 padded prefill, ``dynamic_update_slice`` cache splice,
-                 ONE jitted decode step for the whole pool (per-slot
-                 positions/sampling params/PRNG keys as traced arrays),
-                 slots freed on EOS/max-tokens and refilled immediately.
-- ``scheduler``: FCFS admission, max-queue-depth backpressure
-                 (``QueueFullError``), deadlines, cancellation.
-- ``request``:   ``Request`` handles — blocking ``result()``, streaming
-                 ``stream()`` iterator, per-token callbacks.
-- ``metrics``:   requests/tokens counters, queue-depth + slot-occupancy
-                 gauges, TTFT/TPOT histograms in the shared
-                 observability registry (registered at import so
-                 scrapes always show serving state).
-- ``http``:      opt-in stdlib HTTP front end
-                 (``start_serving_http_server``).
+- ``engine``:     ``ServingEngine`` — a fixed pool of decode slots whose
+                  KV lives in a shared pool of device blocks addressed
+                  through per-slot traced block tables (capacity bounded
+                  by tokens in flight, not slots * worst-case length);
+                  chunked prefill, ref-counted copy-on-write prefix
+                  sharing, preemption-by-recompute under pool pressure,
+                  ONE jitted decode step for the whole pool (per-slot
+                  positions/params/keys/block tables as traced arrays),
+                  slots freed on EOS/max-tokens and refilled
+                  immediately. ``kv_mode="contiguous"`` keeps the
+                  pre-paging per-slot-buffer engine as the A/B baseline.
+- ``block_pool``: host-side KV block allocator (free list + refcounts,
+                  exhaustion/double-free errors, fragmentation stats)
+                  and the exact-prefix LRU cache behind prefix sharing.
+- ``scheduler``:  FCFS admission, max-queue-depth backpressure
+                  (``QueueFullError``), deadlines, cancellation,
+                  front-of-queue requeue for preempted requests.
+- ``request``:    ``Request`` handles — blocking ``result()``, streaming
+                  ``stream()`` iterator, per-token callbacks.
+- ``metrics``:    requests/tokens counters, queue-depth + slot-occupancy
+                  + KV-block gauges, prefix-cache/COW/preemption
+                  counters, TTFT/TPOT histograms in the shared
+                  observability registry (registered at import so
+                  scrapes always show serving state).
+- ``http``:       opt-in stdlib HTTP front end
+                  (``start_serving_http_server``).
 
 Quick start::
 
@@ -36,6 +46,8 @@ Quick start::
 from __future__ import annotations
 
 from . import metrics  # registers the serving gauges at import
+from .block_pool import (BlockPool, BlockPoolError, PoolExhaustedError,
+                         PrefixCache)
 from .engine import ServingConfig, ServingEngine
 from .http import start_serving_http_server, stop_serving_http_server
 from .request import Request, RequestStatus, SamplingParams
@@ -44,5 +56,6 @@ from .scheduler import QueueFullError, Scheduler
 __all__ = [
     "ServingConfig", "ServingEngine", "SamplingParams", "Request",
     "RequestStatus", "Scheduler", "QueueFullError",
+    "BlockPool", "PrefixCache", "PoolExhaustedError", "BlockPoolError",
     "start_serving_http_server", "stop_serving_http_server",
 ]

@@ -1,39 +1,57 @@
-"""Continuous-batching serving engine over slot-based static KV caches.
+"""Continuous-batching serving engine over a paged (or contiguous) KV
+cache.
 
 The TPU-native translation of iteration-level scheduling (Orca) +
-paged/managed KV serving (vLLM), built on this repo's static-shape
-decode substrate instead of paging:
+PagedAttention-class KV management (vLLM) + RadixAttention-style prefix
+reuse, built on this repo's static-shape decode substrate:
 
-- a fixed pool of ``max_slots`` decode SLOTS over pre-allocated
-  [B, max_len, h, d] KV buffers (one pytree for the whole pool);
-- admission prefills one request at a BUCKETED prompt length (a small
-  set of padded-prefill executables — right-padded, plain causal mask:
-  padded keys sit at positions the causal mask never exposes) and
-  splices the per-layer [1, Lb, h, d] prefill cache into the slot with
-  ``dynamic_update_slice``;
-- decode drives ONE jitted step for the whole slot pool every
-  iteration: per-slot positions ([B] vector — each slot at its own
-  sequence offset), per-slot sampling params and PRNG keys carried as
-  traced arrays so mixed greedy/sampled requests share the single step
-  program. The step executable compiles exactly once and then runs at
-  whatever occupancy admission sustains. Free slots ride along as
-  garbage rows with their positions PINNED to 0 (a traced [B] active
-  mask — occupancy patterns never retrace), so the flash-decode
-  kernel's per-row length masking prices a dead slot at one KV block;
-- slots free on EOS / max-tokens / cancellation / deadline and are
-  refilled by the next iteration's admission pass.
+- ``kv_mode="paged"`` (default): device HBM holds ONE fixed pool of KV
+  blocks (per layer, [num_blocks, block_size, kv_heads, d]); each slot's
+  cache is an int32 block table into the pool. Capacity is bounded by
+  TOKENS IN FLIGHT instead of slots * worst-case length — a short
+  request strands at most ``block_size - 1`` token slots, not
+  ``max_len - L``. On top of the pool:
+
+  * **prefix sharing**: a prompt whose prefix was already prefilled
+    (same tokens, same positions — e.g. a shared system prompt) adopts
+    those blocks by reference from the host-side prefix cache instead of
+    recomputing them; ref-counted copy-on-write forks a shared block on
+    the first divergent write, so sharing is invisible to outputs.
+  * **chunked prefill**: prompts are admitted in fixed-size chunks
+    (ONE ``serving.prefill_chunk`` executable replaces every per-bucket
+    prefill program) interleaved with decode steps, so a long prompt
+    never head-of-line-blocks running requests for its whole length.
+  * **preemption by recompute**: under pool pressure the latest-admitted
+    request is preempted — its blocks freed, the request requeued at the
+    queue front with its generated tokens folded into the prefill and
+    its PRNG chain replayed, so the resumed decode is bit-identical and
+    nothing is ever re-delivered.
+
+- ``kv_mode="contiguous"``: the pre-paging design — per-slot
+  [B, max_len, h, d] buffers, bucketed padded prefill + cache splice —
+  kept as the A/B baseline (``benchmarks/bench_paged_kv.py``).
+
+Both modes drive ONE jitted pool-wide decode step per iteration:
+per-slot positions / sampling params / PRNG keys / active mask — and in
+paged mode the block tables — are traced arrays, so mixed
+occupancy/length/sharing patterns share a single step executable that
+compiles exactly once (recompile-monitor-asserted across request waves).
 
 Per-request outputs are bit-identical to ``generation.generate`` with
-the same sampling seed/params: the slot key chain reproduces generate's
-``key, sub = split(key)`` walk and ``select_tokens`` row-wise equals the
-config-static ``_select_token`` (tests/test_serving.py holds this as an
-oracle).
+the same sampling seed/params in BOTH modes: the slot key chain
+reproduces generate's ``key, sub = split(key)`` walk, ``select_tokens``
+is row-wise equal to the config-static ``_select_token``, and the paged
+read path gathers the exact same K/V values the contiguous cache holds
+(garbage beyond a row's length is an exact no-op under the additive
+causal mask, just like the contiguous cache's zeros).
 
-Observability: requests/tokens counters, queue-depth + slot-occupancy
-gauges, TTFT/TPOT histograms (serving/metrics.py), and every compile is
-attributed to the ``serving.step`` / ``serving.prefill[Lb]`` recompile-
-monitor entries — a retrace on ``serving.step`` after warmup is a bug
-and the monitor will flag it.
+Observability: the ``paddle_tpu_serving_*`` instruments plus the paged
+``paddle_tpu_kv_blocks_{total,in_use,shared}`` gauges and
+``paddle_tpu_prefix_cache_{hits,misses}_total`` counters; compiles are
+attributed to ``serving.step`` / ``serving.prefill_chunk`` /
+``serving.cow`` (paged) or ``serving.prefill[bucket]`` (contiguous) —
+a ``serving.step`` retrace after warmup is a bug and the monitor flags
+it.
 """
 
 from __future__ import annotations
@@ -41,18 +59,19 @@ from __future__ import annotations
 import functools
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..generation import (make_cached_runner, make_kv_caches, select_tokens,
-                          split_keys)
+from ..generation import (make_cached_runner, make_kv_caches,
+                          make_paged_kv_pools, select_tokens, split_keys)
 from ..observability import recompile as _recompile
 from ..observability.recompile import entrypoint as _entrypoint
 from . import metrics as _sm
+from .block_pool import BlockPool, PoolExhaustedError, PrefixCache
 from .request import Request, RequestStatus, SamplingParams
 from .scheduler import Scheduler
 
@@ -77,13 +96,30 @@ class ServingConfig:
     - ``max_slots``: the decode batch B — slots in flight at once.
     - ``max_len``: per-slot KV capacity; every request needs
       prompt_len + max_new_tokens <= max_len.
-    - ``prefill_buckets``: padded prompt lengths; each bucket costs one
-      prefill + one splice compile, so keep the set small. Defaults to
-      powers of two up to max_len.
+    - ``kv_mode``: ``"paged"`` (block-pool KV, prefix sharing, chunked
+      prefill — the default) or ``"contiguous"`` (per-slot buffers,
+      bucketed prefill — the A/B baseline).
+    - ``block_size``: tokens per KV block (paged). Must divide
+      ``max_len`` — the per-slot block table covers max_len in whole
+      blocks.
+    - ``num_blocks``: pool size INCLUDING the reserved dump block.
+      Default ``max_slots * (max_len / block_size) + 1`` (worst case —
+      paging can never run out); size it below that to oversubscribe
+      slots against a fixed HBM budget (preemption keeps it safe).
+    - ``prefill_chunk``: tokens per prefill chunk (paged): one fixed
+      [1, prefill_chunk] executable replaces every prefill bucket, and
+      long prompts are admitted chunk-by-chunk between decode steps.
+    - ``prefix_caching``: reuse previously prefilled prompt prefixes
+      (ref-counted, COW-protected). Disable for strictly independent
+      workloads.
+    - ``prefill_buckets``: (contiguous mode) padded prompt lengths; each
+      bucket costs one prefill + one splice compile. Defaults to powers
+      of two up to max_len.
     - ``max_queue_depth``: admission backpressure bound
       (``QueueFullError`` beyond it).
-    - ``pad_token_id``: right-pad filler for bucketed prefill — any
-      valid token id works (padded positions are causally invisible).
+    - ``pad_token_id``: right-pad filler for padded prefill — any valid
+      token id works (padded positions are causally invisible, and paged
+      mode routes their writes to the dump block).
     """
 
     max_slots: int = 4
@@ -91,6 +127,33 @@ class ServingConfig:
     prefill_buckets: Sequence[int] = ()
     max_queue_depth: int = 64
     pad_token_id: int = 0
+    kv_mode: str = "paged"
+    block_size: int = 16
+    num_blocks: Optional[int] = None
+    prefill_chunk: int = 32
+    prefix_caching: bool = True
+
+    def __post_init__(self):
+        if self.kv_mode not in ("paged", "contiguous"):
+            raise ValueError(
+                f"kv_mode must be 'paged' or 'contiguous', got "
+                f"{self.kv_mode!r}")
+        if self.kv_mode == "paged":
+            if self.block_size < 1 or self.max_len % self.block_size:
+                raise ValueError(
+                    f"block_size ({self.block_size}) must divide max_len "
+                    f"({self.max_len}): the per-slot block table covers "
+                    f"max_len in whole KV blocks — pick a block_size that "
+                    f"divides max_len (e.g. 16) or round max_len up to a "
+                    f"multiple of block_size")
+            if self.prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
+            if self.num_blocks is not None and self.num_blocks < 2:
+                raise ValueError(
+                    f"num_blocks ({self.num_blocks}) must be >= 2: block 0 "
+                    f"is the reserved dump block, so at least one usable "
+                    f"block is needed")
 
     def buckets(self) -> tuple:
         bs = tuple(sorted({int(b) for b in self.prefill_buckets
@@ -100,6 +163,28 @@ class ServingConfig:
         if bs[-1] != self.max_len:
             bs = bs + (self.max_len,)
         return bs
+
+    def blocks_per_slot(self) -> int:
+        return self.max_len // self.block_size
+
+    def default_num_blocks(self) -> int:
+        return self.max_slots * self.blocks_per_slot() + 1
+
+
+@dataclass
+class _PrefillJob:
+    """Host-side progress of one chunked prefill: which tokens remain,
+    the request's PRNG key (split ONCE, at the final chunk — generate's
+    chain), and whether the final select's token was already delivered
+    (preemption resume regenerates the last delivered token)."""
+
+    req: Request
+    tokens: np.ndarray           # prompt (+ replayed generation on resume)
+    total: int
+    done: int                    # tokens already in the cache (prefix hits
+    key: "jax.Array"             # + completed chunks)
+    skip: int                    # 1 on resume: final select re-derives an
+    t0: float = field(default_factory=time.perf_counter)  # already-sent token
 
 
 class ServingEngine:
@@ -124,11 +209,7 @@ class ServingEngine:
             raise ValueError(
                 f"max_len ({config.max_len}) exceeds the model's "
                 f"max_position_embeddings ({mcfg.max_position_embeddings})")
-        self._buckets = config.buckets()
-        # this engine's step/prefill closures are NEW executables — their
-        # first compiles are warmup, not retraces of a previous engine's
-        _recompile.reset_warmup(
-            "serving.step", *(f"serving.prefill[{b}]" for b in self._buckets))
+        self.paged = config.kv_mode == "paged"
         B = int(config.max_slots)
         self.scheduler = Scheduler(config.max_queue_depth)
 
@@ -138,12 +219,11 @@ class ServingEngine:
         self._pb = {**params, **buffers}
         self._mcfg = mcfg
 
-        # slot pool state. The KV pool AND the per-slot decode state
-        # (last token, position, PRNG chain, sampling params) live on
-        # DEVICE across steps — the decode loop transfers ONE [B] token
-        # vector per iteration and nothing else; admission updates a
-        # slot's state rows inside the (jitted) splice program.
-        self._caches = make_kv_caches(mcfg, B, config.max_len, self._dtype)
+        # per-slot decode state (last token, position, PRNG chain,
+        # sampling params) lives on DEVICE across steps — the decode loop
+        # transfers ONE [B] token vector per iteration (plus, in paged
+        # mode, the tiny int32 block table); admission updates a slot's
+        # state rows inside the jitted chunk/splice program.
         self._state = {
             "tokens": jnp.zeros(B, jnp.int32),     # last token per slot
             "pos": jnp.zeros(B, jnp.int32),        # next cache write index
@@ -155,10 +235,14 @@ class ServingEngine:
         }
         self._slot_req: List[Optional[Request]] = [None] * B
         self._slot_sampling = [False] * B  # host mirror for the step cond
+        self._decoding = [False] * B       # past prefill, in the step batch
+        self._slot_seq = [0] * B           # admission order (victim pick)
+        self._admit_seq = 0
 
         self._steps = 0
         self._occupancy_integral = 0
         self._outcomes = {}
+        self._preempt_count = 0
         self._step_lock = threading.RLock()
         self._wake = threading.Condition()
         self._running = False
@@ -167,6 +251,129 @@ class ServingEngine:
         _sm.engine_unhealthy.set(0)  # a fresh engine is the healthy one
 
         run = make_cached_runner(model)
+        self._run = run
+
+        if self.paged:
+            self._init_paged(B, run)
+        else:
+            self._init_contiguous(B, run)
+
+    # -- executables: paged --------------------------------------------------
+    def _init_paged(self, B: int, run):
+        config = self.config
+        mcfg = self._mcfg
+        bs = config.block_size
+        nb = config.blocks_per_slot()
+        self._nblocks = int(config.num_blocks or config.default_num_blocks())
+        self.pool = BlockPool(self._nblocks, bs)
+        self.prefix_cache = PrefixCache(self.pool) if config.prefix_caching \
+            else None
+        self._pools = make_paged_kv_pools(mcfg, self._nblocks, bs, self._dtype)
+        self._bt = np.zeros((B, nb), np.int32)           # host block tables
+        self._slot_blocks: List[List[int]] = [[] for _ in range(B)]
+        self._slot_len = [0] * B                         # host mirror of pos
+        self._jobs: List[Optional[_PrefillJob]] = [None] * B
+        # this engine's closures are NEW executables — their first
+        # compiles are warmup, not retraces of a previous engine's
+        _recompile.reset_warmup("serving.step", "serving.prefill_chunk",
+                                "serving.cow")
+
+        C = int(config.prefill_chunk)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _chunk(pb, pools, state, bt_row, ids, pos0, valid, slot, is_last,
+                   last_idx, key, ds, temp, tk, tp):
+            """ONE fixed-shape prefill chunk: forward ``ids`` [1, C] at
+            offset ``pos0`` through the paged caches (writes scatter
+            through the slot's block table; pad tokens beyond ``valid``
+            land in the dump block), then the final-token select with
+            generate's exact key chain. State rows for ``slot`` are set
+            only when ``is_last`` (traced — chunk count never retraces);
+            the select itself is computed every chunk and simply unused
+            until then."""
+            caches = [{"k": c["k"], "v": c["v"], "bt": bt_row,
+                       "valid": valid[None]} for c in pools]
+            logits, newc = run(pb, ids, caches, pos0)
+            last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
+                                                axis=1)[:, 0]
+            key2, sub = jax.random.split(key)
+            token = jax.lax.cond(
+                ds[0],
+                lambda: select_tokens(last, sub[None], ds, temp, tk, tp),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            state = dict(state)
+
+            def _sel(new, old):
+                return jnp.where(is_last, new, old)
+
+            state["tokens"] = state["tokens"].at[slot].set(
+                _sel(token[0], state["tokens"][slot]))
+            state["pos"] = state["pos"].at[slot].set(
+                _sel(pos0 + valid, state["pos"][slot]))
+            state["keys"] = state["keys"].at[slot].set(
+                _sel(key2, state["keys"][slot]))
+            state["ds"] = state["ds"].at[slot].set(_sel(ds[0], state["ds"][slot]))
+            state["temp"] = state["temp"].at[slot].set(
+                _sel(temp[0], state["temp"][slot]))
+            state["tk"] = state["tk"].at[slot].set(_sel(tk[0], state["tk"][slot]))
+            state["tp"] = state["tp"].at[slot].set(_sel(tp[0], state["tp"][slot]))
+            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            return token, pools_out, state
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _step(pb, pools, state, bt, any_sampling, active):
+            """ONE decode iteration for the whole slot pool, reading and
+            writing KV through the traced block tables ``bt`` [B, nb]
+            (inactive rows are zeroed by the host -> their static-shape
+            writes land in the dump block). Everything else matches the
+            contiguous step: traced per-slot positions/params/keys,
+            ``any_sampling`` cond skipping the sampler for pure-argmax
+            pools, free rows pinned to pos 0. Compiles exactly once —
+            occupancy, length mix, and SHARING patterns are all data."""
+            caches = [{"k": c["k"], "v": c["v"], "bt": bt} for c in pools]
+            logits, newc = run(pb, state["tokens"][:, None], caches,
+                               state["pos"])
+            last = logits[:, 0]
+            new_keys, subs = split_keys(state["keys"])
+            nxt = jax.lax.cond(
+                any_sampling,
+                lambda: select_tokens(last, subs, state["ds"], state["temp"],
+                                      state["tk"], state["tp"]),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            state = dict(state)
+            state["tokens"] = nxt
+            state["pos"] = jnp.where(
+                active,
+                jnp.minimum(state["pos"] + 1, jnp.int32(config.max_len - 1)),
+                jnp.int32(0))
+            state["keys"] = new_keys
+            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            return nxt, pools_out, state
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _cow(pools, src, dst):
+            """Copy-on-write fork: duplicate physical block ``src`` into
+            ``dst`` across every layer's K and V pool (one dispatch;
+            src/dst are traced so every fork shares the executable)."""
+            out = []
+            for c in pools:
+                out.append({"k": c["k"].at[dst].set(c["k"][src]),
+                            "v": c["v"].at[dst].set(c["v"][src])})
+            return out
+
+        self._chunk_fn = _chunk
+        self._step_fn = _step
+        self._cow_fn = _cow
+        self._chunk_size = C
+
+    # -- executables: contiguous (the pre-paging engine, A/B baseline) -------
+    def _init_contiguous(self, B: int, run):
+        config = self.config
+        mcfg = self._mcfg
+        self._buckets = config.buckets()
+        _recompile.reset_warmup(
+            "serving.step", *(f"serving.prefill[{b}]" for b in self._buckets))
+        self._caches = make_kv_caches(mcfg, B, config.max_len, self._dtype)
 
         @jax.jit
         def _prefill(pb, ids, last_idx, key, do_sample, temp, top_k, top_p):
@@ -214,23 +421,10 @@ class ServingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def _step(pb, caches, state, any_sampling, active):
-            """ONE decode iteration for the whole slot pool: per-slot
-            positions (vector ``state["pos"]``) drive per-row RoPE/
-            cache-write/mask; per-slot params + keys drive the batched
-            sampler. Compiles once — every shape here is fixed by the
-            pool (``active`` is a traced [B] bool, so occupancy patterns
-            never retrace). When NO active slot samples (``any_sampling``,
-            a host-tracked traced scalar — stale params on freed slots
-            can't force the branch), a runtime ``lax.cond`` skips the
-            sampling branch (its full-vocab sort is the most expensive
-            op in the step) for a pure-argmax step — exact, since
-            ``select_tokens`` is row-wise greedy for ds=False rows.
-            Free slots keep decoding garbage rows; their tokens are
-            never delivered and admission resets their state. Their
-            positions are PINNED to 0 (not advanced), so the per-row
-            length masking in the flash-decode kernel prices a dead slot
-            at one KV block — a mostly-empty pool costs proportional to
-            occupancy, not max_len."""
+            """ONE decode iteration for the whole slot pool (contiguous
+            caches): per-slot positions drive per-row RoPE/cache-write/
+            mask; per-slot params + keys drive the batched sampler.
+            Compiles once; free slots ride along pinned to pos 0."""
             logits, caches = run(pb, state["tokens"][:, None], caches,
                                  state["pos"])
             last = logits[:, 0]
@@ -242,8 +436,6 @@ class ServingEngine:
                 lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
             state = dict(state)
             state["tokens"] = nxt
-            # active rows advance (clamped so late cache writes stay in
-            # bounds); free rows pin at 0 until admission resets them
             state["pos"] = jnp.where(
                 active,
                 jnp.minimum(state["pos"] + 1, jnp.int32(config.max_len - 1)),
@@ -286,6 +478,16 @@ class ServingEngine:
                 f"prompt ({L}) + max_new_tokens ({params.max_new_tokens}) "
                 f"exceeds the slot KV capacity max_len="
                 f"{self.config.max_len}")
+        if self.paged:
+            bs = self.config.block_size
+            worst = -(-(L + params.max_new_tokens - 1) // bs)
+            if worst > self.pool.usable_blocks:
+                raise ValueError(
+                    f"prompt ({L}) + max_new_tokens "
+                    f"({params.max_new_tokens}) needs up to {worst} KV "
+                    f"blocks of {bs} tokens, but the pool only has "
+                    f"{self.pool.usable_blocks} usable blocks — raise "
+                    f"num_blocks or shrink the request")
         req = Request(prompt, params, deadline_s=deadline_s, on_token=on_token)
         self.scheduler.submit(req)  # may raise QueueFullError
         with self._wake:
@@ -311,11 +513,24 @@ class ServingEngine:
         _sm.slots_busy.set(busy)
         _sm.slot_occupancy.set(busy / max(1, self.config.max_slots))
 
+    def _clear_slot(self, slot: int):
+        """Reset every host-side trace of a slot's occupant (shared by
+        free and preempt paths)."""
+        self._slot_req[slot] = None
+        self._slot_sampling[slot] = False
+        self._decoding[slot] = False
+        if self.paged:
+            self._jobs[slot] = None
+            for b in self._slot_blocks[slot]:
+                self.pool.decref(b)
+            self._slot_blocks[slot] = []
+            self._bt[slot, :] = 0
+            self._slot_len[slot] = 0
+
     def _free_slot(self, slot: int, status: str, outcome: str,
                    error: Optional[str] = None):
         req = self._slot_req[slot]
-        self._slot_req[slot] = None
-        self._slot_sampling[slot] = False
+        self._clear_slot(slot)
         if req is not None:
             req.finish(status, error=error)
             _sm.requests_total.labels(outcome).inc()
@@ -341,7 +556,201 @@ class ServingEngine:
             return True
         return False
 
-    # -- admission / prefill -------------------------------------------------
+    # -- paged: pool pressure (eviction -> preemption) -----------------------
+    def _reclaim_alloc(self, n: int, requester: int,
+                       allow_preempt: bool = True) -> List[int]:
+        """Allocate ``n`` blocks, reclaiming under pressure: first evict
+        prefix-cache entries nobody references, then (decode/COW paths
+        only) preempt the latest-admitted OTHER request. Admission never
+        preempts — a request that cannot be admitted without violence
+        waits at the queue front instead (no admission/preemption
+        thrash)."""
+        while True:
+            try:
+                return self.pool.alloc(n)
+            except PoolExhaustedError:
+                deficit = max(1, n - self.pool.free_blocks)
+                if self.prefix_cache is not None \
+                        and self.prefix_cache.evict(deficit) > 0:
+                    continue
+                victim = self._pick_victim(exclude=requester) \
+                    if allow_preempt else None
+                if victim is None:
+                    raise
+                self._preempt(victim)
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """Latest-admitted busy slot (other than ``exclude``) whose
+        release would actually free at least one block. Oldest requests
+        are never victimized first, so the head of the line always makes
+        progress and preemption terminates."""
+        best, best_seq = None, -1
+        for slot in range(self.config.max_slots):
+            if slot == exclude or self._slot_req[slot] is None:
+                continue
+            if not any(self.pool.ref(b) == 1 for b in self._slot_blocks[slot]):
+                continue  # all shared: releasing frees nothing
+            if self._slot_seq[slot] > best_seq:
+                best, best_seq = slot, self._slot_seq[slot]
+        return best
+
+    def _preempt(self, slot: int):
+        """Preemption by recompute: release the slot's blocks and push
+        the request back to the QUEUE FRONT with its generated tokens
+        folded into the next prefill and its PRNG chain replayed — the
+        resumed decode is bit-identical, and the one token the resumed
+        prefill's select re-derives is skipped, never re-delivered."""
+        req = self._slot_req[slot]
+        job = self._jobs[slot]
+        if job is not None:
+            # mid-prefill: nothing delivered yet; restart the same job
+            req._resume = (job.tokens, job.key, job.skip)
+        else:
+            g = len(req.output_tokens)  # >= 1: prefill delivered one
+            key = jax.random.PRNGKey(req.params.seed)
+            for _ in range(g - 1):
+                key, _ = jax.random.split(key)
+            tokens = np.concatenate(
+                [req.prompt,
+                 np.asarray(req.output_tokens[:g - 1], np.int32)])
+            req._resume = (tokens, key, 1)
+        req.slot = None
+        self._clear_slot(slot)
+        self.scheduler.requeue(req)
+        self._preempt_count += 1
+        _sm.preemptions_total.inc()
+        self._update_occupancy_gauges()
+
+    def _ensure_writable(self, slot: int, block_idx: int):
+        """COW: the first write into a SHARED block forks it — allocate
+        a fresh block, copy the shared content (one jitted dispatch),
+        repoint the slot's table, drop the shared reference."""
+        bid = self._slot_blocks[slot][block_idx]
+        if self.pool.ref(bid) <= 1:
+            return
+        new_id = self._reclaim_alloc(1, slot)[0]
+        with _entrypoint("serving.cow"):
+            self._pools = self._cow_fn(self._pools,
+                                       jnp.asarray(bid, jnp.int32),
+                                       jnp.asarray(new_id, jnp.int32))
+        self.pool.decref(bid)
+        self._slot_blocks[slot][block_idx] = new_id
+        self._bt[slot, block_idx] = new_id
+        self.pool.cow_forks += 1
+        _sm.cow_forks_total.inc()
+
+    # -- paged: admission + chunked prefill ----------------------------------
+    def _begin_prefill(self, req: Request, slot: int):
+        """Claim the slot: match the prompt against the prefix cache,
+        allocate the remaining prompt blocks, and queue the chunk job.
+        No model work happens here — chunks run interleaved with decode
+        steps in ``step()``."""
+        resume = req._resume
+        if resume is not None:
+            tokens, key, skip = resume
+        else:
+            tokens, key, skip = req.prompt, \
+                jax.random.PRNGKey(req.params.seed), 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        total = int(tokens.shape[0])
+        bs = self.config.block_size
+        n_blocks = -(-total // bs)
+        matched_tok, mblocks = 0, []
+        if self.prefix_cache is not None:
+            matched_tok, mblocks = self.prefix_cache.match(tokens, total - 1)
+        try:
+            fresh = self._reclaim_alloc(n_blocks - len(mblocks), slot,
+                                        allow_preempt=False)
+        except PoolExhaustedError:
+            # admission retries later — the resume state MUST survive
+            # this attempt, or a requeued preempted request would
+            # restart as fresh and re-deliver its tokens
+            for b in mblocks:
+                self.pool.decref(b)
+            raise
+        req._resume = None  # consumed only once admission is certain
+        if self.prefix_cache is not None:
+            self.prefix_cache.hits += len(mblocks)
+            self.prefix_cache.misses += n_blocks - len(mblocks)
+            _sm.prefix_cache_hits.inc(len(mblocks))
+            _sm.prefix_cache_misses.inc(n_blocks - len(mblocks))
+            if matched_tok:
+                _sm.tokens_total.labels("prompt_cached").inc(matched_tok)
+        blocks = mblocks + fresh
+        self._slot_blocks[slot] = blocks
+        self._bt[slot, :] = 0
+        self._bt[slot, :len(blocks)] = blocks
+        self._slot_len[slot] = 0
+        self._decoding[slot] = False
+        self._slot_req[slot] = req
+        self._admit_seq += 1
+        self._slot_seq[slot] = self._admit_seq
+        req.slot = slot
+        req.status = RequestStatus.RUNNING
+        self._jobs[slot] = _PrefillJob(req=req, tokens=tokens, total=total,
+                                       done=matched_tok, key=key, skip=skip)
+        self._update_occupancy_gauges()
+
+    def _advance_prefill(self, slot: int):
+        """Run ONE fixed-size prefill chunk for the slot. The final
+        chunk also selects the first token (generate's key chain) and
+        flips the slot into the decode batch; its already-prefilled
+        prompt blocks are registered with the prefix cache BEFORE any
+        decode write can dirty them (COW keeps them pristine)."""
+        job = self._jobs[slot]
+        req = job.req
+        if req.cancel_requested:
+            self._free_slot(slot, RequestStatus.CANCELLED, "cancelled")
+            return
+        C = self._chunk_size
+        bs = self.config.block_size
+        start = job.done
+        end = min(start + C, job.total)
+        is_last = end == job.total
+        for bi in range(start // bs, (end - 1) // bs + 1):
+            self._ensure_writable(slot, bi)
+        ids = np.full((1, C), self.config.pad_token_id, np.int32)
+        ids[0, :end - start] = job.tokens[start:end]
+        p = req.params
+        with _entrypoint("serving.prefill_chunk"):
+            token, self._pools, self._state = self._chunk_fn(
+                self._pb, self._pools, self._state,
+                jnp.asarray(self._bt[slot:slot + 1]),
+                jnp.asarray(ids), jnp.asarray(start, jnp.int32),
+                jnp.asarray(end - start, jnp.int32),
+                jnp.asarray(slot, jnp.int32), jnp.asarray(is_last),
+                jnp.asarray(job.total - 1 - start, jnp.int32), job.key,
+                jnp.asarray([p.do_sample]),
+                jnp.asarray([p.temperature], jnp.float32),
+                jnp.asarray([p.top_k], jnp.int32),
+                jnp.asarray([p.top_p], jnp.float32))
+        job.done = end
+        _sm.prefill_chunks_total.inc()
+        _sm.tokens_total.labels("prompt").inc(end - start)
+        if not is_last:
+            return
+        if self.prefix_cache is not None:
+            n_reg = min(int(req.prompt.shape[0]), job.total)
+            self.prefix_cache.insert(
+                job.tokens, n_reg,
+                self._slot_blocks[slot][:-(-n_reg // bs)])
+        tok0 = int(np.asarray(token)[0])
+        now = time.perf_counter()
+        _sm.prefill_seconds.observe(now - job.t0)
+        self._jobs[slot] = None
+        self._decoding[slot] = True
+        self._slot_len[slot] = job.total
+        self._slot_sampling[slot] = bool(p.do_sample)
+        req.prefill_done_ts = now
+        if job.skip:
+            return  # resumed: tok0 re-derives the last delivered token
+        req.push_token(tok0, now)
+        _sm.ttft_seconds.observe(req.ttft_s)
+        _sm.tokens_total.labels("generated").inc()
+        self._finish_or_keep(slot, req, tok0, now)
+        self._update_occupancy_gauges()
+
+    # -- contiguous: admission / prefill -------------------------------------
     def _prefill_into_slot(self, req: Request, slot: int):
         p = req.params
         L = int(req.prompt.shape[0])
@@ -375,6 +784,7 @@ class ServingEngine:
 
         self._slot_req[slot] = req
         self._slot_sampling[slot] = bool(p.do_sample)
+        self._decoding[slot] = True
         req.slot = slot
         req.status = RequestStatus.RUNNING
         req.prefill_done_ts = now
@@ -385,31 +795,58 @@ class ServingEngine:
         self._update_occupancy_gauges()
 
     def _admit(self):
-        """Fill every free slot FCFS from the queue (prefill + splice);
-        runs at the top of each iteration so a slot freed by EOS is
-        refilled before the next decode step."""
+        """Fill every free slot FCFS from the queue; runs at the top of
+        each iteration so a slot freed by EOS is refilled before the
+        next decode step. Paged admission only claims blocks and queues
+        the chunk job; contiguous admission runs the whole bucketed
+        prefill inline (the pre-paging behavior)."""
         for slot in range(self.config.max_slots):
             while self._slot_req[slot] is None:
                 req = self.scheduler.pop_ready()
                 if req is None:
                     return
                 try:
-                    self._prefill_into_slot(req, slot)
+                    if self.paged:
+                        self._begin_prefill(req, slot)
+                    else:
+                        self._prefill_into_slot(req, slot)
+                except PoolExhaustedError:
+                    # not enough free blocks even after cache eviction:
+                    # FCFS holds — the request waits at the queue front
+                    # until decode completions release blocks
+                    self.scheduler.requeue(req)
+                    return
                 except Exception as e:  # noqa: BLE001 — engine must survive
-                    self._slot_req[slot] = None
+                    self._clear_slot(slot)
                     req.finish(RequestStatus.FAILED, error=repr(e))
                     _sm.requests_total.labels("failed").inc()
                     self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
 
     # -- the iteration -------------------------------------------------------
     def step(self) -> bool:
-        """One engine iteration: admit into free slots, then (if any
-        slot is busy) run the single jitted decode step for the whole
-        pool and deliver/retire per-slot tokens. Returns True when any
-        work happened."""
+        """One engine iteration: admit into free slots, advance every
+        in-flight chunked prefill by one chunk (paged), then (if any
+        slot is decoding) run the single jitted decode step for the
+        whole pool and deliver/retire per-slot tokens. Returns True when
+        any work happened."""
         with self._step_lock:
             self._admit()
-            active = [i for i, r in enumerate(self._slot_req) if r is not None]
+            worked = False
+            if self.paged:
+                for slot in range(self.config.max_slots):
+                    if self._jobs[slot] is None:
+                        continue
+                    worked = True
+                    try:
+                        self._advance_prefill(slot)
+                    except PoolExhaustedError:
+                        self._preempt(slot)  # retried from the queue front
+                    except Exception as e:  # noqa: BLE001
+                        self._free_slot(slot, RequestStatus.FAILED, "failed",
+                                        error=repr(e))
+
+            active = [i for i, r in enumerate(self._slot_req)
+                      if r is not None and self._decoding[i]]
             # cancellation between steps: drop flagged slots without
             # paying another decode step for them
             for i in list(active):
@@ -418,16 +855,51 @@ class ServingEngine:
                     active.remove(i)
             if not active:
                 self._update_occupancy_gauges()
-                return False
+                return worked
+
+            if self.paged:
+                # every active row writes this step's K/V at its current
+                # length: cross a block boundary -> allocate; write into
+                # a shared (prefix-cached) block -> COW fork. Allocation
+                # pressure preempts the latest-admitted request, which
+                # can shrink `active`.
+                bs = self.config.block_size
+                for i in list(active):
+                    if self._slot_req[i] is None or not self._decoding[i]:
+                        continue  # preempted by an earlier row's reclaim
+                    bi = self._slot_len[i] // bs
+                    try:
+                        if bi >= len(self._slot_blocks[i]):
+                            nid = self._reclaim_alloc(1, i)[0]
+                            self._slot_blocks[i].append(nid)
+                            self._bt[i, bi] = nid
+                        else:
+                            self._ensure_writable(i, bi)
+                    except PoolExhaustedError:
+                        self._preempt(i)
+                active = [i for i in active
+                          if self._slot_req[i] is not None
+                          and self._decoding[i]]
+                if not active:
+                    self._update_occupancy_gauges()
+                    return True
 
             t0 = time.perf_counter()
             any_sampling = any(self._slot_sampling[i] for i in active)
             active_mask = np.zeros(self.config.max_slots, bool)
             active_mask[active] = True
             with _entrypoint("serving.step"):
-                toks, self._caches, self._state = self._step_fn(
-                    self._pb, self._caches, self._state,
-                    jnp.asarray(any_sampling), jnp.asarray(active_mask))
+                if self.paged:
+                    bt_step = self._bt.copy()
+                    bt_step[~active_mask] = 0  # inactive rows -> dump block
+                    toks, self._pools, self._state = self._step_fn(
+                        self._pb, self._pools, self._state,
+                        jnp.asarray(bt_step), jnp.asarray(any_sampling),
+                        jnp.asarray(active_mask))
+                else:
+                    toks, self._caches, self._state = self._step_fn(
+                        self._pb, self._caches, self._state,
+                        jnp.asarray(any_sampling), jnp.asarray(active_mask))
             toks_np = np.asarray(toks)  # the step's ONE device->host sync
             now = time.perf_counter()
             _sm.steps_total.inc()
@@ -437,6 +909,9 @@ class ServingEngine:
 
             for i in active:
                 req = self._slot_req[i]
+                if self.paged:
+                    self._slot_len[i] = min(self._slot_len[i] + 1,
+                                            self.config.max_len - 1)
                 t = int(toks_np[i])
                 prev = req.last_token_ts
                 req.push_token(t, now)
@@ -542,13 +1017,30 @@ class ServingEngine:
             return None
         return self._occupancy_integral / (self._steps * self.config.max_slots)
 
+    def kv_block_stats(self) -> Optional[dict]:
+        """Pool utilization + internal fragmentation (allocated token
+        slots the slots' sequences do not fill) — paged mode only."""
+        if not self.paged:
+            return None
+        stats = self.pool.stats()
+        bs = self.config.block_size
+        frag = 0
+        for slot in range(self.config.max_slots):
+            if self._slot_req[slot] is None:
+                continue
+            used = self._jobs[slot].done if self._jobs[slot] is not None \
+                else self._slot_len[slot]
+            frag += len(self._slot_blocks[slot]) * bs - used
+        stats["internal_fragmentation_tokens"] = frag
+        return stats
+
     def stats(self) -> dict:
-        return {
+        out = {
+            "kv_mode": self.config.kv_mode,
             "slots": self.config.max_slots,
             "slots_busy": self.busy_slots(),
             "queue_depth": self.scheduler.depth,
             "max_len": self.config.max_len,
-            "prefill_buckets": list(self._buckets),
             "steps": self._steps,
             "mean_occupancy": self.mean_occupancy,
             "outcomes": dict(self._outcomes),
@@ -556,3 +1048,22 @@ class ServingEngine:
             "healthy": self.healthy,
             "crashed": self._crashed,
         }
+        if self.paged:
+            out["block_size"] = self.config.block_size
+            out["prefill_chunk"] = self.config.prefill_chunk
+            out["kv_blocks"] = self.kv_block_stats()
+            out["prefix_cache"] = (self.prefix_cache.stats()
+                                   if self.prefix_cache is not None else None)
+            out["preemptions"] = self._preempt_count
+            out["requests"] = [
+                {"request_id": r.id, "slot": slot,
+                 "tokens_in_cache": (self._jobs[slot].done
+                                     if self._jobs[slot] is not None
+                                     else self._slot_len[slot]),
+                 "kv_blocks": len(self._slot_blocks[slot]),
+                 "phase": ("prefill" if self._jobs[slot] is not None
+                           else "decode")}
+                for slot, r in enumerate(self._slot_req) if r is not None]
+        else:
+            out["prefill_buckets"] = list(self._buckets)
+        return out

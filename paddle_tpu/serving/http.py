@@ -73,14 +73,22 @@ def start_serving_http_server(engine, port: int = 0, addr: str = "127.0.0.1",
             path = self.path.split("?")[0]
             if path == "/healthz":
                 healthy = engine.healthy
-                self._json(200 if healthy else 503, {
+                payload = {
                     "status": "ok" if healthy else "unhealthy",
                     "ts": time.time(),
                     "slots_busy": engine.busy_slots(),
                     "slots_total": engine.config.max_slots,
                     "queue_depth": engine.scheduler.depth,
                     "crashed": engine.crashed,
-                })
+                }
+                kv = getattr(engine, "kv_block_stats", lambda: None)()
+                if kv is not None:  # paged engines: pool pressure at a
+                    payload["kv_blocks_in_use"] = kv["in_use"]   # glance
+                    payload["kv_blocks_total"] = kv["usable"]
+                    payload["kv_blocks_shared"] = kv["shared"]
+                    payload["kv_block_utilization"] = round(
+                        kv["utilization"], 4)
+                self._json(200 if healthy else 503, payload)
             elif path == "/stats":
                 self._json(200, engine.stats())
             else:

@@ -16,6 +16,9 @@ __all__ = [
     "requests_total", "tokens_total", "queue_depth", "slots_busy",
     "slot_occupancy", "steps_total", "step_seconds", "prefill_seconds",
     "ttft_seconds", "tpot_seconds", "engine_crashes_total",
+    "kv_blocks_total", "kv_blocks_in_use", "kv_blocks_shared",
+    "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
+    "cow_forks_total", "preemptions_total", "prefill_chunks_total",
 ]
 
 requests_total = _m.counter(
@@ -45,6 +48,39 @@ engine_unhealthy = _m.gauge(
     "paddle_tpu_serving_engine_unhealthy",
     "1 while the most recent serving engine is crash-dead; constructing "
     "a fresh engine resets it (drives /healthz 503s)")
+# -- paged KV cache (block pool + prefix sharing) --------------------------
+kv_blocks_total = _m.gauge(
+    "paddle_tpu_kv_blocks_total",
+    "usable KV blocks in the device pool (excludes the reserved dump "
+    "block)")
+kv_blocks_in_use = _m.gauge(
+    "paddle_tpu_kv_blocks_in_use",
+    "KV blocks currently allocated (request-owned or prefix-cached)")
+kv_blocks_shared = _m.gauge(
+    "paddle_tpu_kv_blocks_shared",
+    "KV blocks with more than one reference (COW-protected prefix "
+    "sharing)")
+prefix_cache_hits = _m.counter(
+    "paddle_tpu_prefix_cache_hits_total",
+    "prompt KV blocks adopted from the prefix cache instead of "
+    "prefilled")
+prefix_cache_misses = _m.counter(
+    "paddle_tpu_prefix_cache_misses_total",
+    "prompt KV blocks that had to be prefilled (no cached prefix)")
+prefix_cache_evictions = _m.counter(
+    "paddle_tpu_prefix_cache_evictions_total",
+    "prefix-cache entries dropped (LRU) to reclaim pool blocks")
+cow_forks_total = _m.counter(
+    "paddle_tpu_serving_cow_forks_total",
+    "copy-on-write forks: first divergent write into a shared KV block")
+preemptions_total = _m.counter(
+    "paddle_tpu_serving_preemptions_total",
+    "running requests preempted (blocks reclaimed, requeued for "
+    "recompute) under KV-pool pressure")
+prefill_chunks_total = _m.counter(
+    "paddle_tpu_serving_prefill_chunks_total",
+    "fixed-size prefill chunks executed (chunked-prefill admission)")
+
 step_seconds = _m.histogram(
     "paddle_tpu_serving_step_seconds",
     "wall time of one batched decode step",

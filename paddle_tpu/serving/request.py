@@ -87,6 +87,11 @@ class Request:
         self.finish_ts: Optional[float] = None
 
         self.cancel_requested = False
+        # paged-engine preemption state: (tokens_to_prefill, prng_key,
+        # n_reselected) set when the request is requeued for recompute —
+        # the generated tokens fold into the next prefill and the final
+        # select's re-derived token is skipped, never re-delivered
+        self._resume = None
         self._done = threading.Event()
         self._stream_q: "queue.Queue" = queue.Queue()
 

@@ -57,6 +57,17 @@ class Scheduler:
             self._q.append(req)
             _sm.queue_depth.set(len(self._q))
 
+    def requeue(self, req: Request):
+        """Push a request back to the FRONT of the queue (paged-engine
+        preemption / admission backoff): it keeps its FCFS position and
+        is retried before anything newer. Deliberately exempt from the
+        depth bound — the request was already admitted once; bouncing it
+        with a rejection now would turn pool pressure into data loss."""
+        with self._lock:
+            req.status = RequestStatus.QUEUED
+            self._q.appendleft(req)
+            _sm.queue_depth.set(len(self._q))
+
     def cancel(self, req: Request) -> bool:
         """Cancel a request. Queued: removed immediately. Running: flag
         it; the engine frees the slot at the next step boundary. Returns

@@ -61,6 +61,12 @@ TPU_LANE = [
     # this entry is the first on-chip compile/numerics run (pair with
     # benchmarks/bench_decode_attention.py for the >=1.3x acceptance)
     ("test_decode_attention.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # paged KV serving: block-pool engine + paged flash-decode kernel;
+    # CPU-verified (kernel in interpret mode / XLA gather fallback) in
+    # the build container — this entry is the paged kernel's first
+    # compiled run (pair with benchmarks/bench_paged_kv.py for the
+    # >=1.5x capacity acceptance on chip)
+    ("test_paged_kv.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     *[(f"test_op_schema_sweep.py", 600,
        {"PADDLE_TPU_SWEEP_SHARD": f"{i}/8"}) for i in range(8)],
     # sampled FD-grad lane (every 16th schema incl. grads): ~2 s/op of
@@ -87,6 +93,14 @@ TPU_TOLERANCE_DELTAS = [
               "is its first compiled run (tests/test_decode_attention.py "
               "+ benchmarks/bench_decode_attention.py for the >=1.3x "
               "kernel-vs-fallback acceptance at GQA 4x, <=50% occupancy)",
+     "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
+    {"where": "paged_flash_decode_attention",
+     "delta": "bf16-only on chip (same MXU contract as flash decode); "
+              "block-table gather in the index map is CPU-interpret-"
+              "verified only in the build container — this lane is its "
+              "first compiled run (tests/test_paged_kv.py + "
+              "benchmarks/bench_paged_kv.py for the >=1.5x concurrent-"
+              "capacity acceptance at a fixed HBM budget)",
      "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
     {"where": "power_to_db",
      "delta": "5e-4 vs the CPU 1e-5 oracle tolerance (TPU log/pow "
@@ -251,6 +265,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     serving_bench = _read_bench("bench_serving.json")
     checkpoint_bench = _read_bench("bench_checkpoint.json")
     decode_bench = _read_bench("bench_decode.json")
+    paged_kv_bench = _read_bench("bench_paged_kv.json")
     out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
                             "telemetry_lane.json")
     with open(out_path, "w") as fh:
@@ -263,6 +278,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
             "serving_bench": serving_bench,
             "checkpoint_bench": checkpoint_bench,
             "decode_bench": decode_bench,
+            "paged_kv_bench": paged_kv_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

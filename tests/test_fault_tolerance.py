@@ -512,8 +512,10 @@ class TestServingEngineCrash:
         eng = object.__new__(ServingEngine)
         eng.config = ServingConfig(max_slots=2, max_len=32)
         eng.scheduler = Scheduler(8)
+        eng.paged = False  # skeleton: no block pool to release
         eng._slot_req = [None, None]
         eng._slot_sampling = [False, False]
+        eng._decoding = [False, False]
         eng._outcomes = {}
         eng._step_lock = threading.RLock()
         eng._wake = threading.Condition()

@@ -60,7 +60,7 @@ _TPU_HALF_ONLY = {"flash_attention", "flash_attn_varlen",
                   # same MXU contract as flash: bf16 operands / f32
                   # accumulate (production dtype); fp32 swept on CPU
                   "fused_conv_bn_train", "fused_conv_bn_eval",
-                  "flash_decode_attention"}
+                  "flash_decode_attention", "paged_flash_decode_attention"}
 
 
 def test_registry_is_populated():
