@@ -361,6 +361,12 @@ def _telemetry_summary():
             "/".join(s["labels"].values()): int(s["value"])
             for s in series("paddle_tpu_fused_conv_dispatch_total")},
         "steps_recorded": len(snap["steps"]),
+        # the tracing half rides along: total events + the generation
+        # phase spans recorded while the bench points ran
+        "trace_events_recorded": snap["tracing"]["events_recorded"],
+        "trace_spans": {
+            k: v for k, v in snap["tracing"]["span_counts"].items()
+            if k.startswith(("generation.", "serving."))},
     }
 
 

@@ -29,6 +29,19 @@ __all__ = ["PreemptionHandler", "install_preemption_handler",
            "clear_preemption", "request_preemption"]
 
 
+def _flight_dump(reason: str):
+    """Snapshot the tracing flight recorder on preemption: the grace
+    window is the last chance to capture what the serving engine /
+    training loop was doing. The write is small (last-N events + state
+    providers) and must never turn a graceful preemption into a crash."""
+    try:
+        from ..observability import tracing
+
+        tracing.flight_dump(reason)
+    except Exception:  # noqa: BLE001 — never block the shutdown path
+        pass
+
+
 class PreemptionHandler:
     def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,
                                                    signal.SIGINT)):
@@ -78,6 +91,7 @@ class PreemptionHandler:
             name = str(signum)
         _fm.preemptions_total.labels(name).inc()
         self._event.set()
+        _flight_dump(f"signal_{name}")
 
     # cooperative surface ----------------------------------------------------
     @property
@@ -88,6 +102,7 @@ class PreemptionHandler:
         """Programmatic preemption (tests / external orchestrators)."""
         _fm.preemptions_total.labels("manual").inc()
         self._event.set()
+        _flight_dump("preemption_requested")
 
     def clear(self):
         self._event.clear()

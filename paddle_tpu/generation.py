@@ -22,6 +22,7 @@ import numpy as np
 
 from .core.autograd import no_grad
 from .core.tensor import Tensor
+from .observability import tracing as _tracing
 from .observability.recompile import entrypoint as _entrypoint
 from .utils.functional import functional_call
 
@@ -603,27 +604,35 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
         vector per position, EOS-masked, exiting early once every row
         has emitted EOS."""
         with _entrypoint("generation.generate"):
-            caches = make_caches()
-            last_logits, caches = prefill(pb, ids, caches, pads)
+            with _tracing.span("generation.prefill", cat="generation",
+                               args={"B": B, "S": S}):
+                caches = make_caches()
+                last_logits, caches = prefill(pb, ids, caches, pads)
             k = key
             k, sub = jax.random.split(k)
             token = _select_token(last_logits, cfg, sub)
             done = np.zeros(B, bool)
-            for i in range(cfg.max_new_tokens):
-                if i > 0:
-                    k, sub = jax.random.split(k)
-                    # pos as a traced scalar: one compiled step
-                    # executable for all tokens
-                    token, caches = step(pb, token, caches,
-                                         jnp.asarray(S + i - 1, jnp.int32),
-                                         sub, pads)
-                tok_np = np.asarray(token).astype(np.int32)
-                if cfg.eos_token_id is not None:
-                    tok_np = np.where(done, cfg.eos_token_id, tok_np)
-                    done |= tok_np == cfg.eos_token_id
-                yield tok_np
-                if cfg.eos_token_id is not None and done.all():
-                    return
+            decode_sp = _tracing.begin_span(
+                "generation.decode", cat="generation",
+                args={"B": B, "N": cfg.max_new_tokens})
+            try:
+                for i in range(cfg.max_new_tokens):
+                    if i > 0:
+                        k, sub = jax.random.split(k)
+                        # pos as a traced scalar: one compiled step
+                        # executable for all tokens
+                        token, caches = step(pb, token, caches,
+                                             jnp.asarray(S + i - 1, jnp.int32),
+                                             sub, pads)
+                    tok_np = np.asarray(token).astype(np.int32)
+                    if cfg.eos_token_id is not None:
+                        tok_np = np.where(done, cfg.eos_token_id, tok_np)
+                        done |= tok_np == cfg.eos_token_id
+                    yield tok_np
+                    if cfg.eos_token_id is not None and done.all():
+                        return
+            finally:
+                _tracing.end_span(decode_sp)
 
     # recompile-monitor attribution: prefill/step/whole-program compiles
     # charge to this entry; a compile after the first completed generate
@@ -633,7 +642,13 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
 
     with _entrypoint("generation.generate"):
         if loop_mode == "scan" and cfg.max_new_tokens > 1:
-            return Tensor(generate_program(pb, ids, key, pads))
+            # one span for the whole fused program: prefill + decode are
+            # a single dispatch in scan mode, host-side phases don't exist
+            with _tracing.span("generation.generate", cat="generation",
+                               args={"B": B, "S": S,
+                                     "N": cfg.max_new_tokens,
+                                     "mode": "scan"}):
+                return Tensor(generate_program(pb, ids, key, pads))
 
         if cfg.eos_token_id is not None:
             # early-exit python loop: host-syncs each token (the
@@ -648,16 +663,20 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
             return Tensor(jnp.concatenate(
                 [ids, jnp.asarray(gen)], axis=1))
 
-        caches = make_caches()
-        last_logits, caches = prefill(pb, ids, caches, pads)
+        with _tracing.span("generation.prefill", cat="generation",
+                           args={"B": B, "S": S}):
+            caches = make_caches()
+            last_logits, caches = prefill(pb, ids, caches, pads)
         key, sub = jax.random.split(key)
         token = _select_token(last_logits, cfg, sub)
 
-        out = [token]
-        for i in range(1, cfg.max_new_tokens):
-            key, sub = jax.random.split(key)
-            # pos as a traced scalar: one compiled step executable for all tokens
-            token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub, pads)
-            out.append(token)
-        gen = jnp.stack(out, axis=1)  # [B, N]
+        with _tracing.span("generation.decode", cat="generation",
+                           args={"B": B, "N": cfg.max_new_tokens}):
+            out = [token]
+            for i in range(1, cfg.max_new_tokens):
+                key, sub = jax.random.split(key)
+                # pos as a traced scalar: one compiled step executable for all tokens
+                token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub, pads)
+                out.append(token)
+            gen = jnp.stack(out, axis=1)  # [B, N]
         return Tensor(jnp.concatenate([ids, gen], axis=1))

@@ -1,17 +1,27 @@
-"""Metric exporters: Prometheus text exposition, JSONL snapshots, and an
-opt-in stdlib ``http.server`` scrape endpoint.
+"""Metric exporters: Prometheus text exposition, JSONL snapshots, a
+size-rotating JSONL sink, and an opt-in stdlib ``http.server`` scrape
+endpoint.
 
 The Prometheus text format follows the exposition spec (``# HELP`` /
-``# TYPE`` headers, escaped label values, cumulative histogram buckets
-with an explicit ``+Inf`` le plus ``_sum``/``_count`` series).
-``parse_prometheus_text`` is the matching reader — used by the
-round-trip test and by anyone scraping the JSONL lane without a real
-Prometheus.
+``# TYPE`` headers, escaped HELP text (``\\`` and ``\\n``) and label
+values (``\\``, ``"``, ``\\n``), cumulative histogram buckets with an
+explicit ``+Inf`` le plus ``_sum``/``_count`` series, summary quantile
+series). ``parse_prometheus_text`` is the matching reader — used by
+the round-trip test and by anyone scraping the JSONL lane without a
+real Prometheus.
+
+Sinks: every file-appending exporter (``StepTelemetry`` JSONL, trace
+JSONL, flight dumps) resolves RELATIVE paths against the
+``PADDLE_TPU_SINK_DIR`` env var when set (one knob moves every
+artifact off a read-only cwd), and ``RotatingJsonlSink`` bounds them —
+``max_bytes`` with keep-1 rotation, so a long serving run cannot grow
+a telemetry file without bound.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -21,11 +31,75 @@ from .metrics import MetricsRegistry, get_registry
 __all__ = [
     "prometheus_text", "parse_prometheus_text", "write_jsonl_snapshot",
     "start_http_server", "stop_http_server",
+    "RotatingJsonlSink", "resolve_sink_path",
 ]
+
+SINK_DIR_ENV = "PADDLE_TPU_SINK_DIR"
+
+
+def resolve_sink_path(path: str) -> str:
+    """Relative sink paths land in ``$PADDLE_TPU_SINK_DIR`` when set
+    (created on demand); absolute paths and unset env pass through."""
+    sink_dir = os.environ.get(SINK_DIR_ENV)
+    if sink_dir and not os.path.isabs(path):
+        os.makedirs(sink_dir, exist_ok=True)
+        return os.path.join(sink_dir, path)
+    return path
+
+
+class RotatingJsonlSink:
+    """Append-one-JSON-line-per-record sink with size-based rotation:
+    when the file would exceed ``max_bytes``, it is renamed to
+    ``<path>.1`` (replacing the previous rotation — keep-1) and a fresh
+    file is started, so total disk use is bounded at ~2x max_bytes."""
+
+    def __init__(self, path: str, max_bytes: int = 64 << 20):
+        self.path = resolve_sink_path(path)
+        self.max_bytes = int(max_bytes)
+        self._fh = None
+        self._size = 0
+
+    def write(self, rec: dict):
+        line = json.dumps(rec) + "\n"
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+            self._size = self._fh.tell()
+        if self._size and self._size + len(line) > self.max_bytes:
+            self._fh.close()
+            os.replace(self.path, self.path + ".1")
+            self._fh = open(self.path, "a")
+            self._size = 0
+        self._fh.write(line)
+        self._fh.flush()
+        self._size += len(line)
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # exposition spec: HELP text escapes backslash and newline (a raw
+    # newline here would corrupt the whole exposition — every following
+    # fragment would parse as a sample line)
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _unescape_help(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            out.append({"n": "\n", "\\": "\\"}.get(v[i + 1], v[i + 1]))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
 
 
 def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
@@ -53,11 +127,22 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     reg = registry or get_registry()
     lines: List[str] = []
     for m in sorted(reg.metrics(), key=lambda m: m.name):
-        lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         for sample in m.collect():
             labels = sample["labels"]
-            if m.kind == "histogram":
+            if m.kind == "summary":
+                for q, v in sample["quantiles"].items():
+                    if v is None:
+                        continue
+                    lines.append(
+                        f"{m.name}{_fmt_labels(labels, {'quantile': q})}"
+                        f" {_fmt_value(v)}")
+                lines.append(f"{m.name}_sum{_fmt_labels(labels)}"
+                             f" {_fmt_value(sample['sum'])}")
+                lines.append(f"{m.name}_count{_fmt_labels(labels)}"
+                             f" {sample['count']}")
+            elif m.kind == "histogram":
                 cum = 0
                 for le, c in zip(sample["buckets"], sample["counts"]):
                     cum += c
@@ -114,6 +199,7 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
         if line.startswith("# HELP "):
             _, _, rest = line.partition("# HELP ")
             name, _, help_text = rest.partition(" ")
+            help_text = _unescape_help(help_text)
             families.setdefault(name, {"type": "untyped", "help": help_text,
                                        "samples": []})
             families[name]["help"] = help_text
@@ -140,7 +226,7 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
         family = name
         for suffix in ("_bucket", "_sum", "_count"):
             base = name[:-len(suffix)] if name.endswith(suffix) else None
-            if base and types.get(base) == "histogram":
+            if base and types.get(base) in ("histogram", "summary"):
                 family = base
                 break
         families.setdefault(family, {"type": "untyped", "help": "",

@@ -27,8 +27,9 @@ from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "counter", "gauge", "histogram", "DEFAULT_BUCKETS",
+    "Counter", "Gauge", "Histogram", "Summary", "MetricsRegistry",
+    "get_registry", "counter", "gauge", "histogram", "summary",
+    "DEFAULT_BUCKETS", "DEFAULT_QUANTILES",
 ]
 
 # Zero-cost kill switch shared with the instrumentation sites (ops
@@ -44,6 +45,10 @@ _COMPACT_AT = 4096
 # populations we time: sub-ms op spans and multi-second XLA compiles.
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+# Summary quantiles: the serving-latency trio (median + the two tails
+# a latency SLO is written against).
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
 
 
 class _CounterChild:
@@ -142,8 +147,57 @@ class _HistogramChild:
         return self._compact()[1]
 
 
+class _SummaryChild:
+    """Streaming quantiles: a bounded ring of the most recent samples
+    (``deque(maxlen)`` append — lock-free) with exact percentiles over
+    the window computed at collect time. Same design as
+    ``tracing.Digest``; kept separate so this module stays import-leaf."""
+
+    __slots__ = ("_q", "_sum", "_count", "_quantiles", "_lock")
+
+    def __init__(self, lock: threading.Lock, quantiles: Sequence[float],
+                 window: int):
+        self._q: deque = deque(maxlen=int(window))
+        self._quantiles = tuple(quantiles)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = lock
+
+    def observe(self, value: float):
+        self._q.append(value)
+        # count/sum are stats, not invariants: racing += may rarely drop
+        # one under threads; the serving writers are single-threaded
+        self._count += 1
+        self._sum += value
+
+    def snapshot(self) -> Tuple[Dict[float, Optional[float]], float, int]:
+        xs = sorted(self._q)
+
+        def at(q):
+            if not xs:
+                return None
+            pos = q * (len(xs) - 1)
+            lo = int(pos)
+            hi = min(lo + 1, len(xs) - 1)
+            return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+        return ({q: at(q) for q in self._quantiles}, self._sum, self._count)
+
+    def quantile(self, q: float) -> Optional[float]:
+        xs = sorted(self._q)
+        if not xs:
+            return None
+        pos = q * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+    def value(self) -> float:
+        return self._sum
+
+
 _CHILD_TYPES = {"counter": _CounterChild, "gauge": _GaugeChild,
-                "histogram": _HistogramChild}
+                "histogram": _HistogramChild, "summary": _SummaryChild}
 
 
 class _MetricBase:
@@ -206,6 +260,12 @@ class _MetricBase:
                 counts, s, c = child.snapshot()
                 out.append({"labels": labels, "buckets": list(self.buckets),
                             "counts": counts, "sum": s, "count": c})
+            elif isinstance(child, _SummaryChild):
+                quantiles, s, c = child.snapshot()
+                out.append({"labels": labels,
+                            "quantiles": {str(q): v
+                                          for q, v in quantiles.items()},
+                            "sum": s, "count": c})
             else:
                 out.append({"labels": labels, "value": child.value()})
         return out
@@ -261,6 +321,34 @@ class Histogram(_MetricBase):
         return self._d().value()
 
 
+class Summary(_MetricBase):
+    """Prometheus summary: streaming quantiles over a sliding sample
+    window plus ``_sum``/``_count`` series. The serving latency digests
+    (TTFT, TPOT, queue wait, prefill-chunk) are Summaries — tails
+    (p95/p99) that a fixed histogram bucketing would quantize away."""
+
+    kind = "summary"
+
+    def __init__(self, name, help="", labelnames=(),
+                 quantiles: Sequence[float] = DEFAULT_QUANTILES,
+                 window: int = 4096):
+        self.quantiles = tuple(sorted(quantiles))
+        self.window = int(window)
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _SummaryChild(self._lock, self.quantiles, self.window)
+
+    def observe(self, value: float):
+        self._d().observe(value)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._d().quantile(q)
+
+    def value(self) -> float:
+        return self._d().value()
+
+
 class MetricsRegistry:
     """Name -> metric map; creation is idempotent (same name + kind
     returns the existing metric, so instrumentation sites can declare
@@ -295,6 +383,11 @@ class MetricsRegistry:
                   buckets=DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
                                    buckets=buckets)
+
+    def summary(self, name, help="", labelnames=(),
+                quantiles=DEFAULT_QUANTILES, window: int = 4096) -> Summary:
+        return self._get_or_create(Summary, name, help, labelnames,
+                                   quantiles=quantiles, window=window)
 
     def get(self, name) -> Optional[_MetricBase]:
         return self._metrics.get(name)
@@ -334,3 +427,9 @@ def gauge(name, help="", labelnames=()) -> Gauge:
 
 def histogram(name, help="", labelnames=(), buckets=DEFAULT_BUCKETS) -> Histogram:
     return _registry.histogram(name, help, labelnames, buckets=buckets)
+
+
+def summary(name, help="", labelnames=(), quantiles=DEFAULT_QUANTILES,
+            window: int = 4096) -> Summary:
+    return _registry.summary(name, help, labelnames, quantiles=quantiles,
+                             window=window)

@@ -115,6 +115,12 @@ def _on_duration(name: str, duration: float, **kwargs):
         _compile_seconds.labels(entry).observe(duration)
         _events.append({"entry": entry, "event": "backend_compile",
                         "duration_s": duration, "ts": time.time()})
+        # attribute the compile into the active request trace (compiles
+        # run synchronously on the dispatching thread, so the tracing
+        # thread-local context is the request that paid for it)
+        from . import tracing as _tracing
+
+        _tracing._on_compile(entry, duration)
         st = _entry_state(entry)
         st["compiles"] += 1
         st["compile_seconds"] += duration

@@ -15,7 +15,6 @@ of ad-hoc prints.
 
 from __future__ import annotations
 
-import json
 import time
 from collections import deque
 from typing import Optional, Tuple
@@ -91,14 +90,20 @@ class StepTelemetry:
     ``step()`` cost when idle-configured: a perf_counter read, a
     memory_stats call, and a handful of deque appends — safe to leave on
     in production loops (the reference ips timer already pays the clock
-    read)."""
+    read).
+
+    The JSONL stream is bounded: ``max_bytes`` (keep-1 rotation to
+    ``<path>.1``) caps the file a long serving/training run can grow,
+    and a relative ``jsonl_path`` lands in ``$PADDLE_TPU_SINK_DIR``
+    when that override is set (see ``exporters.RotatingJsonlSink``)."""
 
     def __init__(self, entry: str = "train", jsonl_path: Optional[str] = None,
-                 record_memory: bool = True):
+                 record_memory: bool = True, max_bytes: int = 64 << 20):
         self.entry = entry
         self.jsonl_path = jsonl_path
         self.record_memory = record_memory
-        self._fh = None
+        self.max_bytes = int(max_bytes)
+        self._sink = None
         self._idx = 0
         self._last = time.perf_counter()
         self._compiles_seen = _rc.total_compiles()
@@ -141,10 +146,12 @@ class StepTelemetry:
             _ips_gauge.labels(self.entry).set(ips)
         _STEP_RECORDS.append(rec)
         if self.jsonl_path:
-            if self._fh is None:
-                self._fh = open(self.jsonl_path, "a")
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
+            if self._sink is None:
+                from .exporters import RotatingJsonlSink
+
+                self._sink = RotatingJsonlSink(self.jsonl_path,
+                                               max_bytes=self.max_bytes)
+            self._sink.write(rec)
         return rec
 
     def mark(self):
@@ -178,9 +185,9 @@ class StepTelemetry:
 
     def close(self):
         self.detach_benchmark()
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
 
     def __enter__(self):
         self.mark()

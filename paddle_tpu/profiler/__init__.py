@@ -70,6 +70,13 @@ _recording = [False]  # single source of truth; dispatch.py imports this list
 # None check is the whole cost when nothing is attached.
 _telemetry_sink = [None]
 
+# observability.tracing installs itself here (attach_profiler_spans) so
+# completed RecordEvent spans also land in the request-trace buffer —
+# one /trace export carries request lifecycle AND step-internal spans
+# on the shared perf_counter_ns clock. Detached (the default) costs one
+# list-index check per span.
+_trace_sink = [None]
+
 
 class RecordEvent:
     """Span context manager/decorator (reference event_tracing.h RecordEvent).
@@ -85,6 +92,12 @@ class RecordEvent:
         self._t0 = None
 
     def begin(self):
+        if _trace_sink[0] is not None:
+            # tracing interop records host timestamps even on the native
+            # path (the C++ ring keeps its own) and even when the
+            # profiler itself is CLOSED — a serving box traces without
+            # running a profiler session
+            self._trace_t0 = time.perf_counter_ns()
         if not _recording[0]:
             return
         lib = get_native()
@@ -94,6 +107,14 @@ class RecordEvent:
             self._t0 = time.perf_counter_ns()
 
     def end(self):
+        sink = _trace_sink[0]
+        if sink is not None and getattr(self, "_trace_t0", None) is not None:
+            try:
+                sink(self.name, self._trace_t0, time.perf_counter_ns(),
+                     int(self.event_type))
+            except Exception:
+                pass  # tracing must never break instrumented code
+            self._trace_t0 = None
         if not _recording[0]:
             return
         lib = get_native()

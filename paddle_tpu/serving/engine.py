@@ -59,6 +59,8 @@ from __future__ import annotations
 import functools
 import threading
 import time
+import weakref
+from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
@@ -69,6 +71,7 @@ import numpy as np
 from ..generation import (make_cached_runner, make_kv_caches,
                           make_paged_kv_pools, select_tokens, split_keys)
 from ..observability import recompile as _recompile
+from ..observability import tracing as _trace
 from ..observability.recompile import entrypoint as _entrypoint
 from . import metrics as _sm
 from .block_pool import BlockPool, PoolExhaustedError, PrefixCache
@@ -249,6 +252,19 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._crashed: Optional[str] = None  # repr of the fatal loop error
         _sm.engine_unhealthy.set(0)  # a fresh engine is the healthy one
+
+        # /debug/requests keeps the tail of finished requests next to the
+        # live ones; goodput is deadline-met tokens over a sliding window
+        self._recent: deque = deque(maxlen=256)
+        self._goodput_window: deque = deque()  # (finish_ts, tokens)
+        self._goodput_span_s = 30.0
+        # flight-recorder state provider: a crash dump carries this
+        # engine's full stats() (pool accounting, per-slot phases, queue
+        # depth) — weakref'd so a dead engine drops out of dumps
+        ref = weakref.ref(self)
+        _trace.register_state_provider(
+            "serving_engine",
+            lambda ref=ref: (ref().stats() if ref() is not None else None))
 
         run = make_cached_runner(model)
         self._run = run
@@ -527,6 +543,37 @@ class ServingEngine:
             self._bt[slot, :] = 0
             self._slot_len[slot] = 0
 
+    def _note_admission(self, req: Request, now: float,
+                        resumed: bool = False):
+        """Queue-wait digest + trace transitions shared by both engines:
+        the ``queued`` span ends, ``admitted`` (and ``resume`` for a
+        preempted request) lands, and the wait feeds the p50/p95/p99
+        digest."""
+        wait = max(now - req.queued_since_ts, 0.0)
+        req.queue_wait_total_s += wait
+        req.admitted_ts = now
+        _sm.queue_wait_seconds.observe(wait)
+        req._tr_end("queued", wait_s=round(wait, 6))
+        if resumed:
+            req._tr_event("resume", generated=len(req.output_tokens))
+        req._tr_event("admitted", slot=req.slot)
+        req._tr_begin("prefill")
+
+    def _note_goodput(self, req: Request, now: float):
+        """Completed within deadline (or no deadline): its tokens count
+        toward the goodput gauge over the sliding window."""
+        if req.deadline_ts is not None and now > req.deadline_ts:
+            return
+        w = self._goodput_window
+        w.append((now, len(req.output_tokens)))
+        horizon = now - self._goodput_span_s
+        while w and w[0][0] < horizon:
+            w.popleft()
+        span = max(now - w[0][0], 1e-9) if len(w) > 1 \
+            else self._goodput_span_s
+        _sm.goodput_tokens_per_second.set(
+            sum(n for _, n in w) / max(span, 1e-9))
+
     def _free_slot(self, slot: int, status: str, outcome: str,
                    error: Optional[str] = None):
         req = self._slot_req[slot]
@@ -535,6 +582,9 @@ class ServingEngine:
             req.finish(status, error=error)
             _sm.requests_total.labels(outcome).inc()
             self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+            self._recent.append(req)
+            if outcome == "completed":
+                self._note_goodput(req, req.finish_ts)
         self._update_occupancy_gauges()
 
     def _finish_or_keep(self, slot: int, req: Request, token: int,
@@ -615,6 +665,13 @@ class ServingEngine:
                  np.asarray(req.output_tokens[:g - 1], np.int32)])
             req._resume = (tokens, key, 1)
         req.slot = None
+        req.preempt_count += 1
+        # whichever lifecycle span is open (prefill or decode) ends at
+        # the preemption boundary; requeue() opens the next queued span
+        req._tr_end("prefill")
+        req._tr_end("decode")
+        req._tr_event("preempted", slot=slot,
+                      generated=len(req.output_tokens))
         self._clear_slot(slot)
         self.scheduler.requeue(req)
         self._preempt_count += 1
@@ -638,6 +695,9 @@ class ServingEngine:
         self._bt[slot, block_idx] = new_id
         self.pool.cow_forks += 1
         _sm.cow_forks_total.inc()
+        req = self._slot_req[slot]
+        if req is not None:
+            req._tr_event("cow_fork", block=block_idx, src=bid, dst=new_id)
 
     # -- paged: admission + chunked prefill ----------------------------------
     def _begin_prefill(self, req: Request, slot: int):
@@ -676,6 +736,11 @@ class ServingEngine:
             _sm.prefix_cache_misses.inc(n_blocks - len(mblocks))
             if matched_tok:
                 _sm.tokens_total.labels("prompt_cached").inc(matched_tok)
+            if mblocks:
+                req._tr_event("prefix_cache_hit", blocks=len(mblocks),
+                              tokens=matched_tok)
+            else:
+                req._tr_event("prefix_cache_miss", blocks=n_blocks)
         blocks = mblocks + fresh
         self._slot_blocks[slot] = blocks
         self._bt[slot, :] = 0
@@ -687,6 +752,8 @@ class ServingEngine:
         self._slot_seq[slot] = self._admit_seq
         req.slot = slot
         req.status = RequestStatus.RUNNING
+        self._note_admission(req, time.perf_counter(),
+                             resumed=resume is not None)
         self._jobs[slot] = _PrefillJob(req=req, tokens=tokens, total=total,
                                        done=matched_tok, key=key, skip=skip)
         self._update_occupancy_gauges()
@@ -712,7 +779,12 @@ class ServingEngine:
         ids = np.full((1, C), self.config.pad_token_id, np.int32)
         ids[0, :end - start] = job.tokens[start:end]
         p = req.params
-        with _entrypoint("serving.prefill_chunk"):
+        tc0 = time.perf_counter_ns()
+        # the request is the active trace during its chunk, so an XLA
+        # compile fired here (the one serving.prefill_chunk warmup, or a
+        # would-be-retrace bug) lands in this request's timeline
+        with _trace.trace_context(req.id), \
+                _entrypoint("serving.prefill_chunk"):
             token, self._pools, self._state = self._chunk_fn(
                 self._pb, self._pools, self._state,
                 jnp.asarray(self._bt[slot:slot + 1]),
@@ -724,6 +796,11 @@ class ServingEngine:
                 jnp.asarray([p.temperature], jnp.float32),
                 jnp.asarray([p.top_k], jnp.int32),
                 jnp.asarray([p.top_p], jnp.float32))
+        tc1 = time.perf_counter_ns()
+        _trace.complete("prefill_chunk", "request", req.id, tc0, tc1 - tc0,
+                        {"slot": slot, "start": start, "end": end,
+                         "last": is_last})
+        _sm.prefill_chunk_seconds.observe((tc1 - tc0) / 1e9)
         job.done = end
         _sm.prefill_chunks_total.inc()
         _sm.tokens_total.labels("prompt").inc(end - start)
@@ -742,10 +819,14 @@ class ServingEngine:
         self._slot_len[slot] = job.total
         self._slot_sampling[slot] = bool(p.do_sample)
         req.prefill_done_ts = now
+        req._tr_end("prefill", tokens=job.total)
+        req._tr_begin("decode")
         if job.skip:
             return  # resumed: tok0 re-derives the last delivered token
         req.push_token(tok0, now)
+        req._tr_event("first_token")
         _sm.ttft_seconds.observe(req.ttft_s)
+        _sm.ttft_summary.observe(req.ttft_s)
         _sm.tokens_total.labels("generated").inc()
         self._finish_or_keep(slot, req, tok0, now)
         self._update_occupancy_gauges()
@@ -758,7 +839,10 @@ class ServingEngine:
         ids = np.full((1, Lb), self.config.pad_token_id, np.int32)
         ids[0, :L] = req.prompt
         t0 = time.perf_counter()
-        with _entrypoint(f"serving.prefill[{Lb}]"):
+        req.slot = slot
+        self._note_admission(req, t0)
+        with _trace.trace_context(req.id), \
+                _entrypoint(f"serving.prefill[{Lb}]"):
             token, key, pcaches = self._prefill_fn(
                 self._pb, jnp.asarray(ids), jnp.asarray(L - 1, jnp.int32),
                 jax.random.PRNGKey(p.seed),
@@ -788,9 +872,13 @@ class ServingEngine:
         req.slot = slot
         req.status = RequestStatus.RUNNING
         req.prefill_done_ts = now
+        req._tr_end("prefill", tokens=L)
+        req._tr_begin("decode")
 
         req.push_token(tok0, now)
+        req._tr_event("first_token")
         _sm.ttft_seconds.observe(req.ttft_s)
+        _sm.ttft_summary.observe(req.ttft_s)
         self._finish_or_keep(slot, req, tok0, now)
         self._update_occupancy_gauges()
 
@@ -828,7 +916,20 @@ class ServingEngine:
         in-flight chunked prefill by one chunk (paged), then (if any
         slot is decoding) run the single jitted decode step for the
         whole pool and deliver/retire per-slot tokens. Returns True when
-        any work happened."""
+        any work happened.
+
+        A ``PoolExhaustedError`` escaping the iteration (every in-loop
+        exhaustion is normally absorbed by eviction/preemption — an
+        escape means the reclaim logic is stuck) snapshots the flight
+        recorder before propagating: the dump carries the pool/slot
+        state that produced the wedge."""
+        try:
+            return self._step_impl()
+        except PoolExhaustedError as e:
+            _trace.flight_dump("pool_exhausted", extra={"error": repr(e)})
+            raise
+
+    def _step_impl(self) -> bool:
         with self._step_lock:
             self._admit()
             worked = False
@@ -904,6 +1005,12 @@ class ServingEngine:
             now = time.perf_counter()
             _sm.steps_total.inc()
             _sm.step_seconds.observe(now - t0)
+            # the engine-lane step span reuses the timestamps already
+            # taken for the histogram: zero extra clock reads on the
+            # decode hot path
+            _trace.complete("serving.step", "engine", "engine",
+                            int(t0 * 1e9), int((now - t0) * 1e9),
+                            {"active": len(active), "step": self._steps})
             self._steps += 1
             self._occupancy_integral += len(active)
 
@@ -918,6 +1025,7 @@ class ServingEngine:
                 _sm.tokens_total.labels("generated").inc()
                 if prev is not None:
                     _sm.tpot_seconds.observe(now - prev)
+                    _sm.tpot_summary.observe(now - prev)
                 self._finish_or_keep(i, req, t, now)
             return True
 
@@ -972,6 +1080,10 @@ class ServingEngine:
             self._running = False
             _sm.engine_crashes_total.inc()
             _sm.engine_unhealthy.set(1)
+            # post-mortem first, while the slot/queue state still shows
+            # what the engine was doing when it died (the dump's state
+            # provider reads stats() — before the requests are failed)
+            _trace.flight_dump("engine_crash", extra={"error": err})
             for slot in range(self.config.max_slots):
                 if self._slot_req[slot] is not None:
                     self._free_slot(slot, RequestStatus.FAILED, "failed",
@@ -1034,6 +1146,30 @@ class ServingEngine:
         stats["internal_fragmentation_tokens"] = frag
         return stats
 
+    def debug_requests(self) -> dict:
+        """The live per-request state table (``GET /debug/requests``):
+        every queued and running request plus the recent-finished tail,
+        each as a ``Request.debug_row`` (+ slot-phase and KV-block
+        accounting for running ones)."""
+        queued = [r.debug_row() for r in self.scheduler.snapshot()]
+        running = []
+        for slot, r in enumerate(self._slot_req):
+            if r is None:
+                continue
+            row = r.debug_row()
+            if self.paged:
+                job = self._jobs[slot]
+                row["phase"] = "prefill" if job is not None else "decode"
+                row["tokens_in_cache"] = (job.done if job is not None
+                                          else self._slot_len[slot])
+                row["kv_blocks"] = len(self._slot_blocks[slot])
+            else:
+                row["phase"] = "decode"
+            running.append(row)
+        recent = [r.debug_row() for r in list(self._recent)]
+        return {"ts": time.time(), "queued": queued, "running": running,
+                "recent": recent}
+
     def stats(self) -> dict:
         out = {
             "kv_mode": self.config.kv_mode,
@@ -1047,6 +1183,9 @@ class ServingEngine:
             "running": self._running,
             "healthy": self.healthy,
             "crashed": self._crashed,
+            "latency_digests": _sm.latency_digests(),
+            "goodput_tokens_per_s": _sm.goodput_tokens_per_second.value(),
+            "preemptions": self._preempt_count,
         }
         if self.paged:
             out["block_size"] = self.config.block_size
@@ -1054,7 +1193,6 @@ class ServingEngine:
             out["kv_blocks"] = self.kv_block_stats()
             out["prefix_cache"] = (self.prefix_cache.stats()
                                    if self.prefix_cache is not None else None)
-            out["preemptions"] = self._preempt_count
             out["requests"] = [
                 {"request_id": r.id, "slot": slot,
                  "tokens_in_cache": (self._jobs[slot].done

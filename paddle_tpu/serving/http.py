@@ -15,7 +15,14 @@ completions are lists of token ids.
   lands, then a final ``{"done": true, "status": ...}`` line.
 - ``GET /healthz``  -> liveness + the serving gauges
   (slots busy/total, queue depth) as JSON.
-- ``GET /stats``    -> ``engine.stats()``.
+- ``GET /stats``    -> ``engine.stats()`` (incl. the streaming latency
+  digests — TTFT/TPOT/queue-wait/prefill-chunk p50/p95/p99 — and the
+  goodput gauge).
+- ``GET /trace``    -> the request-lifecycle trace as Chrome-trace
+  (catapult) JSON — save it and load in chrome://tracing / Perfetto;
+  ``?trace=<request_id>`` filters to one request's timeline.
+- ``GET /debug/requests`` -> the live per-request state table (queued /
+  running / recent-finished, with phase, KV blocks, waits, latencies).
 
 Backpressure maps to ``429``, invalid requests to ``400``.
 Opt-in only: nothing starts this server implicitly.
@@ -27,6 +34,7 @@ import json
 import threading
 import time
 
+from ..observability import tracing as _tracing
 from .scheduler import QueueFullError
 
 __all__ = ["start_serving_http_server", "stop_serving_http_server"]
@@ -91,6 +99,21 @@ def start_serving_http_server(engine, port: int = 0, addr: str = "127.0.0.1",
                 self._json(200 if healthy else 503, payload)
             elif path == "/stats":
                 self._json(200, engine.stats())
+            elif path == "/trace":
+                # catapult JSON for chrome://tracing; ?trace=<id>
+                # filters to one request's lanes
+                trace = None
+                query = self.path.partition("?")[2]
+                for kv in query.split("&"):
+                    k, _, v = kv.partition("=")
+                    if k == "trace" and v:
+                        try:
+                            trace = int(v)
+                        except ValueError:
+                            trace = v
+                self._json(200, _tracing.chrome_trace(trace))
+            elif path == "/debug/requests":
+                self._json(200, engine.debug_requests())
             else:
                 self._json(404, {"error": f"no such path {path!r}"})
 

@@ -19,6 +19,9 @@ __all__ = [
     "kv_blocks_total", "kv_blocks_in_use", "kv_blocks_shared",
     "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
     "cow_forks_total", "preemptions_total", "prefill_chunks_total",
+    "ttft_summary", "tpot_summary", "queue_wait_seconds",
+    "prefill_chunk_seconds", "goodput_tokens_per_second",
+    "latency_digests",
 ]
 
 requests_total = _m.counter(
@@ -102,3 +105,47 @@ tpot_seconds = _m.histogram(
     "request)",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1.0, 2.5))
+
+# -- streaming latency digests (summaries: exact p50/p95/p99 over a
+# sliding sample window — the tails the fixed histogram buckets above
+# quantize away; surfaced on /stats and in observability.snapshot()) ----
+ttft_summary = _m.summary(
+    "paddle_tpu_serving_ttft_summary_seconds",
+    "time to first token, streaming p50/p95/p99 over the recent window")
+tpot_summary = _m.summary(
+    "paddle_tpu_serving_tpot_summary_seconds",
+    "inter-token decode latency, streaming p50/p95/p99 over the recent "
+    "window")
+queue_wait_seconds = _m.summary(
+    "paddle_tpu_serving_queue_wait_seconds",
+    "time a request waited for a decode slot (submission-or-requeue -> "
+    "admission), streaming p50/p95/p99")
+prefill_chunk_seconds = _m.summary(
+    "paddle_tpu_serving_prefill_chunk_seconds",
+    "host wall time of one chunked-prefill dispatch, streaming "
+    "p50/p95/p99")
+goodput_tokens_per_second = _m.gauge(
+    "paddle_tpu_serving_goodput_tokens_per_second",
+    "deadline-met throughput: tokens of requests that COMPLETED within "
+    "their deadline (or had none), per second over the recent window — "
+    "the number a load-aware router balances on (tokens delivered past "
+    "a deadline are work, not goodput)")
+
+_DIGESTS = {
+    "ttft_s": ttft_summary,
+    "tpot_s": tpot_summary,
+    "queue_wait_s": queue_wait_seconds,
+    "prefill_chunk_s": prefill_chunk_seconds,
+}
+
+
+def latency_digests() -> dict:
+    """Percentile snapshot of every serving latency digest — the
+    ``/stats`` ``latency_digests`` block and the CI trace summary."""
+    out = {}
+    for name, s in _DIGESTS.items():
+        quantiles, total, count = s._d().snapshot()
+        out[name] = {f"p{round(q * 100)}": v for q, v in quantiles.items()}
+        out[name]["count"] = count
+        out[name]["mean"] = (total / count) if count else None
+    return out

@@ -20,6 +20,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from ..observability import tracing as _tracing
+
 __all__ = ["SamplingParams", "Request", "RequestStatus"]
 
 
@@ -85,8 +87,27 @@ class Request:
         self.first_token_ts: Optional[float] = None
         self.last_token_ts: Optional[float] = None
         self.finish_ts: Optional[float] = None
+        # queue-wait accounting: reset by requeue (preemption/backoff) so
+        # the digest measures each wait, not lifetime-minus-decode
+        self.queued_since_ts: float = self.arrival_ts
+        self.admitted_ts: Optional[float] = None
+        self.queue_wait_total_s: float = 0.0  # summed over re-admissions
+        self.preempt_count = 0
 
         self.cancel_requested = False
+        # request-lifecycle trace: one root span for the whole life plus
+        # named child spans the engine opens/closes (queued, prefill,
+        # decode); finish() closes whatever is still open so every
+        # terminal path — including scheduler-side cancel/expire — leaves
+        # a complete, nesting-consistent trace
+        ts0 = int(self.arrival_ts * 1e9)
+        self._root_span = _tracing.begin_span(
+            "request", cat="request", trace=self.id,
+            args={"prompt_len": int(prompt.shape[0]),
+                  "max_new_tokens": params.max_new_tokens,
+                  "do_sample": params.do_sample}, ts_ns=ts0)
+        self._open_spans = {}
+        self._tr_begin("queued", ts_ns=ts0)
         # paged-engine preemption state: (tokens_to_prefill, prng_key,
         # n_reselected) set when the request is requeued for recompute —
         # the generated tokens fold into the next prefill and the final
@@ -94,6 +115,24 @@ class Request:
         self._resume = None
         self._done = threading.Event()
         self._stream_q: "queue.Queue" = queue.Queue()
+
+    # -- tracing -------------------------------------------------------------
+    def _tr_begin(self, name: str, ts_ns: Optional[int] = None, **args):
+        """Open a named lifecycle span (engine thread). Idempotent per
+        name: re-beginning an open span is a no-op."""
+        if name not in self._open_spans:
+            self._open_spans[name] = _tracing.begin_span(
+                name, cat="request", trace=self.id, args=args or None,
+                ts_ns=ts_ns)
+
+    def _tr_end(self, name: str, **args):
+        sp = self._open_spans.pop(name, None)
+        if sp is not None:
+            _tracing.end_span(sp, args=args or None)
+
+    def _tr_event(self, name: str, ts_ns: Optional[int] = None, **args):
+        _tracing.instant(name, cat="request", trace=self.id,
+                         args=args or None, ts_ns=ts_ns)
 
     # -- engine side ---------------------------------------------------------
     def push_token(self, token: int, now: float):
@@ -116,6 +155,18 @@ class Request:
         self.status = status
         self.error = error
         self.finish_ts = time.perf_counter()
+        # close the trace: whatever lifecycle span is still open ends
+        # here, the terminal status lands as an instant, and the root
+        # span closes last so children stay inside it
+        end_ns = int(self.finish_ts * 1e9)
+        for name in list(self._open_spans):
+            sp = self._open_spans.pop(name)
+            _tracing.end_span(sp, ts_ns=end_ns)
+        self._tr_event(status, ts_ns=end_ns,
+                       generated=len(self.output_tokens),
+                       **({"error": error} if error else {}))
+        _tracing.end_span(self._root_span, ts_ns=end_ns,
+                          args={"status": status})
         self._stream_q.put(_STOP)
         self._done.set()
 
@@ -171,6 +222,30 @@ class Request:
         if n <= 0:
             return None
         return (self.last_token_ts - self.first_token_ts) / n
+
+    def debug_row(self) -> dict:
+        """One row of the ``/debug/requests`` live state table."""
+        now = time.perf_counter()
+        return {
+            "request_id": self.id,
+            "status": self.status,
+            "slot": self.slot,
+            "prompt_len": int(self.prompt.shape[0]),
+            "generated": len(self.output_tokens),
+            "max_new_tokens": self.params.max_new_tokens,
+            "age_s": round(now - self.arrival_ts, 4),
+            "queue_wait_s": round(self.queue_wait_total_s, 4)
+                if self.admitted_ts is not None else None,
+            "ttft_s": self.ttft_s,
+            "tpot_s": self.tpot_s,
+            "preemptions": self.preempt_count,
+            "deadline_in_s": (round(self.deadline_ts - now, 4)
+                              if self.deadline_ts is not None
+                              and self.finish_ts is None else None),
+            "latency_s": (round(self.finish_ts - self.arrival_ts, 4)
+                          if self.finish_ts is not None else None),
+            "error": self.error,
+        }
 
     def __repr__(self):
         return (f"Request(id={self.id}, status={self.status}, "

@@ -64,9 +64,25 @@ class Scheduler:
         depth bound — the request was already admitted once; bouncing it
         with a rejection now would turn pool pressure into data loss."""
         with self._lock:
+            if req.status != RequestStatus.QUEUED:
+                # preemption: a fresh queue-wait window + a fresh
+                # `queued` span, so the trace shows each wait separately
+                # (queued → preempted → requeued/queued → resume). An
+                # admission-BACKOFF requeue (popped, no free blocks, put
+                # straight back) keeps the running wait window — the
+                # request has been waiting the whole time.
+                req.queued_since_ts = time.perf_counter()
+                req._tr_event("requeued")
+            req._tr_begin("queued")
             req.status = RequestStatus.QUEUED
             self._q.appendleft(req)
             _sm.queue_depth.set(len(self._q))
+
+    def snapshot(self) -> list:
+        """Queued requests, FCFS order (the /debug/requests live
+        table's waiting section)."""
+        with self._lock:
+            return list(self._q)
 
     def cancel(self, req: Request) -> bool:
         """Cancel a request. Queued: removed immediately. Running: flag

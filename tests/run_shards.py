@@ -67,6 +67,10 @@ TPU_LANE = [
     # compiled run (pair with benchmarks/bench_paged_kv.py for the
     # >=1.5x capacity acceptance on chip)
     ("test_paged_kv.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # request-lifecycle tracing: host-side by design, but the zero-
+    # retrace-with-tracing-on and engine-lifecycle assertions deserve
+    # one compiled run (remote-PJRT dispatch timing differs from CPU)
+    ("test_tracing.py", 420, {}),
     *[(f"test_op_schema_sweep.py", 600,
        {"PADDLE_TPU_SWEEP_SHARD": f"{i}/8"}) for i in range(8)],
     # sampled FD-grad lane (every 16th schema incl. grads): ~2 s/op of
@@ -184,13 +188,31 @@ def setup_telemetry_dump() -> str:
 def _summarize_snapshot(snap: dict) -> dict:
     """Reduce one shard's observability snapshot to the lane-relevant
     aggregates (fused-conv dispatch outcomes, compile counts/seconds,
-    retraces, step records)."""
+    retraces, step records, trace span counts + serving latency
+    digests)."""
     fams = snap.get("metrics", {})
 
     def series(name):
         return fams.get(name, {}).get("samples", [])
 
+    def digest(name):
+        for s in series(name):
+            if "quantiles" in s:
+                return {**{f"p{round(float(q) * 100)}": v
+                           for q, v in s["quantiles"].items()},
+                        "count": s.get("count", 0)}
+        return None
+
+    digests = {short: d for short, name in (
+        ("ttft_s", "paddle_tpu_serving_ttft_summary_seconds"),
+        ("tpot_s", "paddle_tpu_serving_tpot_summary_seconds"),
+        ("queue_wait_s", "paddle_tpu_serving_queue_wait_seconds"),
+        ("prefill_chunk_s", "paddle_tpu_serving_prefill_chunk_seconds"),
+    ) if (d := digest(name)) is not None and d["count"]}
+
     return {
+        "trace_spans": dict(snap.get("tracing", {}).get("span_counts", {})),
+        "serving_digests": digests,
         "fused_conv_dispatch": {
             "/".join(s["labels"].values()): int(s["value"])
             for s in series("paddle_tpu_fused_conv_dispatch_total")},
@@ -223,6 +245,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
 
     shards = []
     totals: dict = {"fused_conv_dispatch": {}, "flash_decode_dispatch": {},
+                    "trace_spans": {}, "serving_digests": {},
                     "compiles_total": 0,
                     "compile_seconds_total": 0.0, "retraces_total": 0,
                     "nan_check_trips": 0, "steps_recorded": 0}
@@ -235,9 +258,16 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
         summary = _summarize_snapshot(snap)
         summary["pid"] = path.rsplit(".", 2)[-2]
         shards.append(summary)
-        for fam in ("fused_conv_dispatch", "flash_decode_dispatch"):
+        for fam in ("fused_conv_dispatch", "flash_decode_dispatch",
+                    "trace_spans"):
             for k, v in summary[fam].items():
                 totals[fam][k] = totals[fam].get(k, 0) + v
+        # percentiles don't sum: keep the busiest shard's digest per
+        # latency (the serving suite runs in one shard anyway)
+        for k, d in summary["serving_digests"].items():
+            if d["count"] > totals["serving_digests"].get(
+                    k, {"count": 0})["count"]:
+                totals["serving_digests"][k] = d
         for k in ("compiles_total", "compile_seconds_total",
                   "retraces_total", "nan_check_trips", "steps_recorded"):
             totals[k] += summary[k]

@@ -524,6 +524,13 @@ class TestServingEngineCrash:
         eng._crashed = None
         eng._steps = 0
         eng._occupancy_integral = 0
+        # round-8 observability state: the /debug/requests recent ring +
+        # goodput window (_free_slot touches both on the crash path)
+        from collections import deque
+
+        eng._recent = deque(maxlen=256)
+        eng._goodput_window = deque()
+        eng._goodput_span_s = 30.0
         return eng
 
     def test_crash_fails_running_and_queued(self):

@@ -153,6 +153,32 @@ class TestExporters:
         assert series[("t_exp_hist_sum", None)] == pytest.approx(5.55)
         assert series[("t_exp_hist_count", None)] == 3
 
+    def test_hostile_help_and_label_values_round_trip(self):
+        """Regression: HELP text with raw newlines/backslashes used to
+        corrupt the whole exposition (the continuation line parsed as a
+        sample and blew up the reader). Per the exposition format, HELP
+        escapes ``\\`` and newline; label values escape ``\\``, ``\"``
+        and newline — all of them must round-trip, and metrics AFTER the
+        hostile one must stay parseable."""
+        c = obs.counter("t_exp_hostile_total",
+                        'line one\nline two with \\slash and "quote"',
+                        ("v",))
+        hostile = ['a} b', 'trail\\', 'x="y",z', 'lit\\nnewline',
+                   'real\nnewline', 'quote"brace}']
+        for v in hostile:
+            c.labels(v).inc()
+        obs.counter("t_exp_after_total", "survives the hostile family").inc()
+
+        parsed = obs.parse_prometheus_text(obs.prometheus_text())
+        fam = parsed["t_exp_hostile_total"]
+        assert fam["help"] == \
+            'line one\nline two with \\slash and "quote"'
+        got = sorted(s["labels"]["v"] for s in fam["samples"])
+        assert got == sorted(hostile)
+        assert all(s["value"] == 1 for s in fam["samples"])
+        # the family AFTER the hostile one parsed cleanly too
+        assert parsed["t_exp_after_total"]["samples"][0]["value"] >= 1
+
     def test_jsonl_snapshot_appends_one_line(self, tmp_path):
         obs.counter("t_exp_jsonl_total").inc()
         path = tmp_path / "metrics.jsonl"
@@ -163,6 +189,45 @@ class TestExporters:
         rec = json.loads(lines[0])
         assert rec["shard"] == 7
         assert rec["metrics"]["t_exp_jsonl_total"]["samples"][0]["value"] >= 1
+
+    def test_rotating_jsonl_sink_bounds_file_size(self, tmp_path):
+        path = tmp_path / "stream.jsonl"
+        sink = obs.RotatingJsonlSink(str(path), max_bytes=400)
+        for i in range(50):
+            sink.write({"i": i, "pad": "x" * 20})
+        sink.close()
+        assert path.exists() and (tmp_path / "stream.jsonl.1").exists()
+        assert path.stat().st_size <= 400
+        assert (tmp_path / "stream.jsonl.1").stat().st_size <= 400
+        # the live file holds the NEWEST records (keep-1 rotation)
+        last = json.loads(path.read_text().splitlines()[-1])
+        assert last["i"] == 49
+        # no unrotated growth: only the two files exist
+        assert sorted(p.name for p in tmp_path.iterdir()) == [
+            "stream.jsonl", "stream.jsonl.1"]
+
+    def test_sink_dir_env_override(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SINK_DIR", str(tmp_path / "sinks"))
+        sink = obs.RotatingJsonlSink("relative.jsonl")
+        sink.write({"ok": True})
+        sink.close()
+        assert (tmp_path / "sinks" / "relative.jsonl").exists()
+        # absolute paths are untouched by the override
+        abs_path = tmp_path / "absolute.jsonl"
+        assert obs.resolve_sink_path(str(abs_path)) == str(abs_path)
+
+    def test_step_telemetry_jsonl_rotates(self, tmp_path):
+        path = tmp_path / "steps.jsonl"
+        st = obs.StepTelemetry(entry="t_rot", jsonl_path=str(path),
+                               record_memory=False, max_bytes=512)
+        for _ in range(40):
+            st.step(num_samples=1)
+        st.close()
+        assert path.stat().st_size <= 512
+        assert (tmp_path / "steps.jsonl.1").exists()
+        # records stayed well-formed across the rotation boundary
+        for line in path.read_text().splitlines():
+            assert "step_time_s" in json.loads(line)
 
     def test_http_scrape_endpoint(self):
         import urllib.request
@@ -367,6 +432,30 @@ class TestStepTelemetry:
         # the JSONL stream mirrors the in-memory records
         lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
         assert len(lines) == 3 and all("ips" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# snapshot structure (serving + tracing sections)
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotSections:
+    def test_snapshot_has_serving_and_tracing_sections(self):
+        """satellite: snapshot() carries the serving gauges (scrape-free)
+        and the tracing summary even with no engine alive; with a live
+        engine the engine's stats ride along (covered end-to-end in
+        test_tracing.py)."""
+        from paddle_tpu import serving  # registers the serving gauges
+
+        assert serving  # the import is the point
+        obs.tracing.instant("t_snap_mark")
+        snap = obs.snapshot()
+        assert isinstance(snap["serving"]["gauges"], dict)
+        assert "paddle_tpu_serving_queue_depth" in snap["serving"]["gauges"]
+        tr = snap["tracing"]
+        assert tr["span_counts"].get("t_snap_mark", 0) >= 1
+        assert tr["ring_capacity"] > 0
+        json.dumps(snap)  # JSON-clean
 
 
 # ---------------------------------------------------------------------------
