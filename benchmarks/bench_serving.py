@@ -24,7 +24,13 @@ engine's three acceptance properties while it measures:
 - aggregate serving tok/s > sequential tok/s;
 - request-lifecycle tracing (default-on) costs <2% tok/s: a
   tracing-off serving pass rides in the same alternating rotation and
-  the A/B lands in the artifact's ``tracing`` block.
+  the A/B lands in the artifact's ``tracing`` block;
+- SPECULATIVE on/off rides the same rotation: a draft-model engine
+  (independent random draft — the adversarial accept-rate floor, so
+  this is a pure correctness/overhead lane; ``bench_spec_decode.py``
+  owns the speedup acceptance) must produce BIT-IDENTICAL outputs and
+  zero spec_draft/spec_verify compiles across the measured passes —
+  both asserted in the exit code.
 
 Artifact: ``benchmarks/bench_serving.json`` — tok/s all lanes, speedup,
 mean/p95 TTFT + TPOT, mean slot occupancy, parity/compile verdicts,
@@ -147,6 +153,22 @@ def main():
         and len(r.output_tokens) == len(ref)
         for r, ref in zip(warm_reqs, refs))
 
+    # speculative engine: independent random draft (worst-case accept
+    # rate) — the lane asserts the spec machinery NEVER changes output
+    # and never recompiles, whatever the accept pattern
+    paddle.seed(123)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        hidden_size=256, intermediate_size=512, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=4,
+        vocab_size=MODEL_KW["vocab_size"]))
+    spec_eng = serving.ServingEngine(
+        model, draft_model=draft, max_slots=MAX_SLOTS, max_len=MAX_LEN,
+        max_queue_depth=len(workload), spec_k=2)
+    spec_warm, _ = run_serving(spec_eng, workload)
+    spec_parity = all(
+        r.result(timeout=1.0) == list(ref)
+        for r, ref in zip(spec_warm, refs))
+
     # -- measured passes: 3 rounds per lane, ALTERNATING so an ambient
     # slowdown (shared box) hits every lane; keep each lane's best.
     # The tracing A/B rides in the same rotation: serving runs once with
@@ -155,9 +177,13 @@ def main():
     assert tracing.tracing_enabled(), "tracing must be default-on"
     step_before = recompile.entry_stats().get(
         "serving.step", {"compiles": 0, "retraces": 0})
+    _SPEC_ENTRIES = ("serving.spec_draft", "serving.spec_verify")
+    spec_before = {n: recompile.entry_stats().get(
+        n, {"compiles": 0, "retraces": 0}) for n in _SPEC_ENTRIES}
     reqs, serving_wall = None, float("inf")
     seq_wall = float("inf")
     notrace_wall = float("inf")
+    spec_wall = float("inf")
     for _ in range(3):
         r, w = run_serving(eng, workload)
         if w < serving_wall:
@@ -168,10 +194,21 @@ def main():
         finally:
             tracing.enable_tracing()
         notrace_wall = min(notrace_wall, w)
+        spec_r, w = run_serving(spec_eng, workload)
+        spec_wall = min(spec_wall, w)
+        spec_parity = spec_parity and all(
+            r2.result(timeout=1.0) == list(ref)
+            for r2, ref in zip(spec_r, refs))
         _, w = run_sequential(model, workload)
         seq_wall = min(seq_wall, w)
     step_after = recompile.entry_stats().get(
         "serving.step", {"compiles": 0, "retraces": 0})
+    spec_after = {n: recompile.entry_stats().get(
+        n, {"compiles": 0, "retraces": 0}) for n in _SPEC_ENTRIES}
+    spec_compiles = sum(spec_after[n]["compiles"] - spec_before[n]["compiles"]
+                        for n in _SPEC_ENTRIES)
+    spec_retraces = sum(spec_after[n]["retraces"] - spec_before[n]["retraces"]
+                        for n in _SPEC_ENTRIES)
 
     ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
     tpots = [r.tpot_s for r in reqs if r.tpot_s is not None]
@@ -217,6 +254,18 @@ def main():
                 step_after["retraces"] == step_before["retraces"],
             "events_recorded": tracing.summary()["events_recorded"],
         },
+        "spec": {
+            "spec_k": 2,
+            "draft": "independent random 2-layer (adversarial accept "
+                     "floor; see bench_spec_decode.py for the coupled "
+                     "speedup lane)",
+            "on_tok_s": round(n_tokens / spec_wall, 1),
+            "off_tok_s": round(serving_tps, 1),
+            "accept_rate": spec_eng.stats()["spec"]["accept_rate"],
+            "per_request_parity": bool(spec_parity),
+            "spec_compiles_measured_pass": spec_compiles,
+            "spec_retraces_measured_pass": spec_retraces,
+        },
     }
 
     path = os.path.join(HERE, "bench_serving.json")
@@ -228,7 +277,8 @@ def main():
     ok = (parity and result["speedup"] > 1.0
           and result["step_compiles_measured_pass"] == 0
           and result["step_retraces_measured_pass"] == 0
-          and result["tracing"]["overhead_lt_2pct"])
+          and result["tracing"]["overhead_lt_2pct"]
+          and spec_parity and spec_compiles == 0 and spec_retraces == 0)
     if not ok:
         print("[bench_serving] ACCEPTANCE FAILED", file=sys.stderr)
     return 0 if ok else 1

@@ -1,0 +1,221 @@
+"""Speculative-decoding lane: A/B spec vs plain paged decode.
+
+The acceptance workload for the serving engine's draft+verify lane:
+identical request sets decoded through (a) the plain paged engine and
+(b) the speculative engine at k ∈ {2, 4, 8}, across occupancy levels
+(1, half, full slots). Two draft configurations bound the answer:
+
+- ``coupled``: the target's tail layers are zeroed to exact identities
+  and the draft is ``generation.truncated_draft`` of the live prefix —
+  functionally ONE model in two sizes, so the accept rate is
+  deterministically 1.0 and the measured speedup is the MECHANICAL
+  ceiling of the lane (draft cost + verify cost vs per-token steps) at
+  each k. Real models land between this and the floor in proportion to
+  their accept rate — which is why the artifact reports accept rate
+  next to every tok/s number.
+- ``adversarial``: an independent random draft (accept rate ~0) — the
+  overhead floor: every round pays k draft forwards + one k+1-wide
+  verify and advances one token.
+
+The bench asserts while it measures:
+- every speculative request bit-matches its plain-engine twin (the
+  coupling contract: speculation NEVER changes output);
+- zero spec_draft/spec_verify compiles in the measured passes (warmup
+  compiled them; accept-length patterns are data);
+- best coupled config reaches >= 1.3x plain paged decode tok/s.
+
+Artifact: ``benchmarks/bench_spec_decode.json`` — per (k, occupancy,
+draft) tok/s + accept rates + verdicts; ``tests/run_shards.py`` folds it
+into ``telemetry_lane.json`` as ``spec_decode_bench``. CPU numbers size
+the win on the dev box (decode here is weight-streaming/dispatch-bound,
+the same regime that makes spec decode pay on chip); the chip lane
+reruns this for real numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import generation, serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import recompile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+MAX_SLOTS = 4
+MAX_LEN = 128
+MAX_NEW = 48
+PROMPT_LEN = 12
+KS = (2, 4, 8)
+OCCUPANCIES = (1, 2, 4)  # concurrent requests per pass
+
+# weight-streaming-bound decode (the serving regime — see
+# bench_serving.py): wide enough that a [B, q] forward's wall time is
+# dominated by streaming the weights, so a k+1-wide verify costs about
+# one step and the draft's layer ratio is the whole draft cost
+MODEL_KW = dict(hidden_size=512, intermediate_size=1024,
+                num_hidden_layers=6, num_attention_heads=8,
+                num_key_value_heads=4, vocab_size=4096,
+                max_position_embeddings=MAX_LEN)
+DRAFT_LAYERS = 1
+
+
+def zero_tail_layers(model, keep: int):
+    """Zero the attn output / MLP down projections of layers >= keep:
+    pre-norm residual blocks become exact identities, so the target IS
+    its first ``keep`` layers (deterministic accept-rate-1 coupling)."""
+    for name, p in model.state_dict().items():
+        for i in range(keep, model.config.num_hidden_layers):
+            if (f"layers.{i}.self_attn.o_proj" in name
+                    or f"layers.{i}.mlp.down_proj" in name):
+                p._data = p._data * 0.0
+
+
+def run_requests(engine, prompts):
+    """Submit all prompts, drive to idle, return (requests, wall_s)."""
+    t0 = time.perf_counter()
+    reqs = [engine.submit(p, max_new_tokens=MAX_NEW) for p in prompts]
+    engine.run_until_idle()
+    return reqs, time.perf_counter() - t0
+
+
+def bench_engine(make_engine, prompt_sets, entries):
+    """Warmup once (compiles), then one measured pass per occupancy
+    level; returns per-occupancy {tok_s, accept_rate} plus compile
+    deltas for the named recompile entries over the measured passes."""
+    eng = make_engine()
+    run_requests(eng, prompt_sets[-1])  # warmup at full occupancy
+    before = {n: recompile.entry_stats().get(n, {"compiles": 0,
+                                                 "retraces": 0})
+              for n in entries}
+    out = {}
+    outputs = {}
+    for prompts in prompt_sets:
+        occ = len(prompts)
+        best = float("inf")
+        reqs = None
+        for _ in range(2):
+            r, wall = run_requests(eng, prompts)
+            if wall < best:
+                best, reqs = wall, r
+        spec = eng.stats()["spec"]
+        out[occ] = {
+            "tok_s": round(occ * MAX_NEW / best, 1),
+            "wall_s": round(best, 3),
+            "accept_rate": (round(spec["accept_rate"], 3)
+                            if spec.get("accept_rate") is not None
+                            else None),
+        }
+        outputs[occ] = [r.result(timeout=5) for r in reqs]
+    after = {n: recompile.entry_stats().get(n, {"compiles": 0,
+                                                "retraces": 0})
+             for n in entries}
+    compiles = {n: after[n]["compiles"] - before[n]["compiles"]
+                for n in entries}
+    retraces = {n: after[n]["retraces"] - before[n]["retraces"]
+                for n in entries}
+    return out, outputs, compiles, retraces
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(**MODEL_KW)
+    target = LlamaForCausalLM(cfg)
+    zero_tail_layers(target, DRAFT_LAYERS)
+    draft = generation.truncated_draft(target, DRAFT_LAYERS)
+    paddle.seed(77)
+    adversarial = LlamaForCausalLM(LlamaConfig.tiny(
+        **{**MODEL_KW, "num_hidden_layers": DRAFT_LAYERS}))
+
+    rng = np.random.RandomState(42)
+    prompt_sets = [
+        [rng.randint(1, cfg.vocab_size, PROMPT_LEN).astype(np.int32)
+         for _ in range(occ)]
+        for occ in OCCUPANCIES]
+
+    def eng_kw():
+        return dict(max_slots=MAX_SLOTS, max_len=MAX_LEN,
+                    max_queue_depth=32)
+
+    result = {
+        "bench": "spec_decode_vs_plain_paged",
+        "platform": jax.default_backend(),
+        "model": {"family": "llama", **MODEL_KW,
+                  "draft_layers": DRAFT_LAYERS},
+        "max_new_tokens": MAX_NEW,
+        "occupancies": list(OCCUPANCIES),
+    }
+
+    plain, plain_out, _, plain_retr = bench_engine(
+        lambda: serving.ServingEngine(target, **eng_kw()),
+        prompt_sets, ("serving.step",))
+    result["plain"] = plain
+
+    spec_entries = ("serving.spec_draft", "serving.spec_verify")
+    parity_ok = True
+    zero_compiles = True
+    for k in KS:
+        spec, spec_out, compiles, retraces = bench_engine(
+            lambda k=k: serving.ServingEngine(
+                target, draft_model=draft, spec_k=k, **eng_kw()),
+            prompt_sets, spec_entries)
+        for occ in OCCUPANCIES:
+            if spec_out[occ] != plain_out[occ]:
+                parity_ok = False
+            spec[occ]["speedup_vs_plain"] = round(
+                spec[occ]["tok_s"] / plain[occ]["tok_s"], 2)
+        if any(compiles.values()) or any(retraces.values()):
+            zero_compiles = False
+        result[f"spec_k{k}_coupled"] = {
+            "by_occupancy": spec,
+            "measured_pass_compiles": compiles,
+            "measured_pass_retraces": retraces,
+        }
+
+    # adversarial draft: the overhead floor, one config is enough
+    adv, adv_out, _, _ = bench_engine(
+        lambda: serving.ServingEngine(
+            target, draft_model=adversarial, spec_k=4, **eng_kw()),
+        prompt_sets[:1], spec_entries)
+    if adv_out[OCCUPANCIES[0]] != plain_out[OCCUPANCIES[0]]:
+        parity_ok = False
+    adv[OCCUPANCIES[0]]["speedup_vs_plain"] = round(
+        adv[OCCUPANCIES[0]]["tok_s"] / plain[OCCUPANCIES[0]]["tok_s"], 2)
+    result["spec_k4_adversarial"] = adv
+
+    best = max(
+        result[f"spec_k{k}_coupled"]["by_occupancy"][occ]
+        ["speedup_vs_plain"]
+        for k in KS for occ in OCCUPANCIES)
+    best_rate = max(
+        result[f"spec_k{k}_coupled"]["by_occupancy"][occ]["accept_rate"]
+        for k in KS for occ in OCCUPANCIES)
+    result["best_speedup"] = best
+    result["best_config_accept_rate"] = best_rate
+    result["per_request_parity"] = bool(parity_ok)
+    result["zero_spec_compiles_measured"] = bool(zero_compiles)
+    result["acceptance_1p3x"] = bool(best >= 1.3)
+
+    path = os.path.join(HERE, "bench_spec_decode.json")
+    with open(path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result, indent=1))
+    print(f"[bench_spec_decode] artifact -> {path}")
+
+    ok = parity_ok and zero_compiles and best >= 1.3
+    if not ok:
+        print("[bench_spec_decode] ACCEPTANCE FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,8 @@ from .utils.functional import functional_call
 
 __all__ = ["GenerationConfig", "generate", "generate_uncached",
            "update_static_kv_cache", "make_kv_caches", "make_cached_runner",
-           "select_tokens", "split_keys", "make_paged_kv_pools",
+           "select_tokens", "split_keys", "split_key_levels",
+           "spec_accept_length", "truncated_draft", "make_paged_kv_pools",
            "paged_kv_cache_write", "gather_paged_kv"]
 
 
@@ -263,6 +264,51 @@ def split_keys(keys):
     return pairs[:, 0], pairs[:, 1]
 
 
+def split_key_levels(keys, n: int):
+    """Walk the per-row chain ``n`` levels ahead WITHOUT committing:
+    [B, 2] keys -> (levels [B, n+1, 2], subs [B, n, 2]) where
+    ``levels[:, j]`` is each row's chain key after ``j`` splits
+    (``levels[:, 0]`` is the input) and ``subs[:, j]`` is the subkey the
+    j+1-th split yields — exactly the subkey ``split_keys`` would hand
+    the sampler for the j+1-th emitted token.
+
+    Speculative decoding needs the chain pre-walked: the verify step
+    selects up to ``n`` candidate tokens with their per-token subkeys in
+    one program, then commits the chain at ``levels[:, n_emit]`` — one
+    split per EMITTED token, so the slot's key state stays the exact
+    function of (seed, tokens emitted) the preemption-resume replay
+    depends on."""
+    levels, subs = [keys], []
+    for _ in range(n):
+        keys, sub = split_keys(keys)
+        levels.append(keys)
+        subs.append(sub)
+    return jnp.stack(levels, axis=1), jnp.stack(subs, axis=1)
+
+
+def spec_accept_length(drafts, candidates, spec_len):
+    """Accepted-prefix emit count for one speculative verify round.
+
+    ``drafts`` [B, k] are the proposed tokens, ``candidates`` [B, k+1]
+    the target-model selections for every bundle position (candidate j
+    is the token the target emits AFTER bundle position j, valid as
+    long as every earlier draft matched), ``spec_len`` [B] the per-row
+    live bundle width (0 = row idle). Returns ``n_emit`` [B] int32: the
+    emitted tokens are ``candidates[b, :n_emit[b]]``.
+
+    This is the Leviathan/Chen acceptance rule under the common-noise
+    coupling this repo uses (draft and target select with the SAME
+    per-position subkey): accept-with-prob-min(1, p/q) collapses to an
+    exact token match, every emitted token is literally the one the
+    non-speculative sampler would have drawn, and the target
+    distribution is preserved because the output SEQUENCE is
+    bit-identical to non-speculative decode — greedy and sampled both."""
+    k = drafts.shape[1]
+    match = (drafts == candidates[:, :k]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    return jnp.minimum(n_acc + 1, jnp.asarray(spec_len, jnp.int32))
+
+
 # Bounded-nucleus fast path for select_tokens: a full-vocab XLA sort is
 # by far the most expensive op in a decode step (CPU: ~8x a
 # lax.top_k(256) on a [4, 4096] batch), so rows whose top-k filter fits
@@ -342,6 +388,35 @@ def select_tokens(logits, keys, do_sample, temperature, top_k, top_p):
     sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(
         keys, lg).astype(jnp.int32)
     return jnp.where(do_sample, sampled, greedy)
+
+
+def truncated_draft(model, num_layers: int):
+    """Self-speculative draft: a fresh model of the same family whose
+    config keeps only the first ``num_layers`` decoder layers, with the
+    embeddings, those layers, the final norm, and the lm head COPIED
+    from ``model`` (LayerSkip-style early-exit draft — no second
+    checkpoint to ship, and the vocab matches by construction).
+
+    Weight transfer rides ``set_state_dict``'s name matching: the
+    truncated model's parameter names are a strict subset of the full
+    model's (``layers.0..n-1`` / ``h.0..n-1``), so the full state dict
+    restores every draft tensor and the surplus layers land in the
+    ``unexpected`` list."""
+    import dataclasses
+
+    cfg = model.config
+    n = int(num_layers)
+    if not 1 <= n <= cfg.num_hidden_layers:
+        raise ValueError(
+            f"truncated_draft needs 1 <= num_layers <= "
+            f"{cfg.num_hidden_layers}, got {num_layers}")
+    draft = type(model)(dataclasses.replace(cfg, num_hidden_layers=n))
+    missing, _ = draft.set_state_dict(model.state_dict())
+    if missing:  # a family whose names don't nest — refuse loudly
+        raise ValueError(
+            f"truncated_draft could not map {len(missing)} draft "
+            f"parameters from the source model (first: {missing[0]})")
+    return draft
 
 
 def make_kv_caches(config, batch_size: int, max_len: int, dtype):
@@ -455,11 +530,177 @@ def _normalize_prompts(input_ids, pad_token_id):
     return ids, pad_lens
 
 
+def _spec_row_keys(seed: int, B: int):
+    """Per-row PRNG chain roots for the speculative path. B=1 uses
+    ``PRNGKey(seed)`` directly — the exact chain ``generate`` walks, so
+    single-row speculative output is bit-identical to plain generate for
+    sampled requests too (the serving engine's per-request contract).
+    B>1 rows get independent ``fold_in`` chains (plain generate draws
+    all rows from one shared key per position, which rows advancing at
+    different speculative rates cannot share; greedy output is
+    key-independent and stays bit-identical at any B)."""
+    root = jax.random.PRNGKey(seed)
+    if B == 1:
+        return root[None]
+    return jax.vmap(lambda r: jax.random.fold_in(root, r))(
+        jnp.arange(B, dtype=jnp.uint32))
+
+
+def _generate_speculative(model, draft_model, ids, cfg: GenerationConfig,
+                          spec_k: int):
+    """Offline speculative decode (the serving lane's oracle): draft
+    ``spec_k`` tokens with the small model, score every bundle position
+    with the target in ONE cached forward (q_len = spec_k + 1), accept
+    the longest draft prefix that matches the target's own selections.
+
+    Under the common-noise coupling (draft and target select with the
+    same per-position subkey — see ``spec_accept_length``) the emitted
+    sequence is bit-identical to non-speculative ``generate``; the
+    draft model only decides how many tokens each round advances.
+    Rejected draft KV is rolled back BY POSITION: the next round's
+    writes land on top of it before any query can attend it, so neither
+    model's cache is ever copied or cleared."""
+    B, S = ids.shape
+    N = cfg.max_new_tokens
+    k = int(spec_k)
+    mcfg = model.config
+    dcfg = draft_model.config
+    if dcfg.vocab_size != mcfg.vocab_size:
+        raise ValueError(
+            f"draft/target vocab mismatch: draft vocab_size "
+            f"({dcfg.vocab_size}) != target vocab_size "
+            f"({mcfg.vocab_size}) — speculative decoding verifies draft "
+            f"token ids against target logits, so both models must share "
+            f"one tokenizer/vocab (e.g. build the draft with "
+            f"generation.truncated_draft)")
+    if S + N > dcfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({N}) exceeds the DRAFT "
+            f"model's max_position_embeddings "
+            f"({dcfg.max_position_embeddings}); the draft decodes the "
+            f"same positions the target does")
+    dtype = next(iter(model.parameters()))._data.dtype
+    ddtype = next(iter(draft_model.parameters()))._data.dtype
+    # verify bundles write [pos, pos+k]; the +k tail keeps every per-row
+    # dynamic_update_slice window in bounds (a clamped start would SHIFT
+    # the write over live entries)
+    cache_len = S + N + k
+    run = make_cached_runner(model)
+    drun = make_cached_runner(draft_model)
+    pb = {**{kk: v._data for kk, v in model.named_parameters_dict().items()},
+          **{kk: v._data for kk, v in model.named_buffers_dict().items()}}
+    dpb = {**{kk: v._data
+              for kk, v in draft_model.named_parameters_dict().items()},
+           **{kk: v._data
+              for kk, v in draft_model.named_buffers_dict().items()}}
+    # row-wise traced params: select_tokens row-wise == the config-static
+    # _select_token, so these selections ARE plain generate's
+    ds = jnp.full((B,), cfg.do_sample)
+    temp = jnp.full((B,), cfg.temperature, jnp.float32)
+    tkv = jnp.full((B,), cfg.top_k, jnp.int32)
+    tpv = jnp.full((B,), cfg.top_p, jnp.float32)
+
+    from .pallas_kernels.decode_attention import flash_decode_enabled
+
+    darch = (type(draft_model).__name__, dcfg.num_hidden_layers,
+             dcfg.hidden_size, dcfg.num_attention_heads,
+             dcfg.num_key_value_heads, dcfg.intermediate_size)
+    gen_key = ("spec", B, S, N, k, cfg.do_sample, cfg.temperature,
+               cfg.top_k, cfg.top_p, darch, flash_decode_enabled())
+    cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
+    if gen_key not in cache_store:
+
+        @jax.jit
+        def sprefill(pb, dpb, ids, keys):
+            caches = make_kv_caches(mcfg, B, cache_len, dtype)
+            dcaches = make_kv_caches(dcfg, B, cache_len, ddtype)
+            logits, caches = run(pb, ids, caches, 0)
+            _, dcaches = drun(dpb, ids, dcaches, 0)
+            levels, subs = split_key_levels(keys, 1)
+            token = select_tokens(logits[:, -1], subs[:, 0], ds, temp,
+                                  tkv, tpv)
+            return token, levels[:, 1], caches, dcaches
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def sdraft(dpb, dcaches, tokens, pos, keys):
+            # the draft proposes with the SAME subkeys the verify step
+            # will select with (common-noise coupling): the proposal IS
+            # the draft's guess of the target's next selection
+            _, subs = split_key_levels(keys, k)
+            tok = tokens
+            drafts = []
+            for j in range(k):
+                logits, dcaches = drun(dpb, tok[:, None], dcaches, pos + j)
+                tok = select_tokens(logits[:, 0], subs[:, j], ds, temp,
+                                    tkv, tpv)
+                drafts.append(tok)
+            # write-only forward for the last draft token's KV: a full
+            # accept advances past pos+k, and without this the next
+            # round's draft attends a hole there (accept rate drops;
+            # outputs unaffected — verify is target-authoritative)
+            _, dcaches = drun(dpb, tok[:, None], dcaches, pos + k)
+            return jnp.stack(drafts, axis=1), dcaches
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def sverify(pb, caches, tokens, drafts, pos, keys, spec_len):
+            bundle = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            logits, caches = run(pb, bundle, caches, pos)  # [B, k+1, V]
+            levels, subs = split_key_levels(keys, k + 1)
+            V = logits.shape[-1]
+
+            def _rep(x):
+                return jnp.broadcast_to(
+                    x[:, None], (B, k + 1)).reshape(B * (k + 1))
+
+            cand = select_tokens(
+                logits.reshape(B * (k + 1), V),
+                subs.reshape(B * (k + 1), 2),
+                _rep(ds), _rep(temp), _rep(tkv), _rep(tpv)).reshape(B, k + 1)
+            n_emit = spec_accept_length(drafts, cand, spec_len)
+            new_keys = jnp.take_along_axis(
+                levels, n_emit[:, None, None], axis=1)[:, 0]
+            last = jnp.take_along_axis(
+                cand, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(n_emit > 0, last, tokens)
+            return cand, n_emit, new_keys, new_tok, caches
+
+        cache_store[gen_key] = (sprefill, sdraft, sverify)
+    sprefill, sdraft, sverify = cache_store[gen_key]
+
+    with _entrypoint("generation.generate"), \
+            _tracing.span("generation.spec_decode", cat="generation",
+                          args={"B": B, "S": S, "N": N, "k": k}):
+        keys = _spec_row_keys(cfg.seed, B)
+        token, keys, caches, dcaches = sprefill(pb, dpb, jnp.asarray(ids),
+                                                keys)
+        tok_np = np.asarray(token)
+        out = [[int(tok_np[b])] for b in range(B)]
+        emitted = np.ones(B, np.int64)
+        pos = np.full(B, S, np.int64)
+        while int(emitted.min()) < N:
+            spec_len = np.minimum(k + 1, N - emitted).astype(np.int32)
+            drafts, dcaches = sdraft(dpb, dcaches, token,
+                                     jnp.asarray(pos, jnp.int32), keys)
+            cand, n_emit, keys, token, caches = sverify(
+                pb, caches, token, drafts, jnp.asarray(pos, jnp.int32),
+                keys, jnp.asarray(spec_len))
+            n_np = np.asarray(n_emit)
+            cand_np = np.asarray(cand)
+            for b in range(B):
+                out[b].extend(int(t) for t in cand_np[b, :n_np[b]])
+            pos += n_np
+            emitted += n_np
+    gen = jnp.asarray(np.stack([np.asarray(r[:N], np.int32) for r in out]))
+    if cfg.eos_token_id is not None:
+        gen = _mask_after_eos(gen, cfg.eos_token_id)
+    return Tensor(jnp.concatenate([ids, gen], axis=1))
+
+
 def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              loop_mode: str = "scan", pad_token_id: Optional[int] = None,
-             stream: bool = False):
+             stream: bool = False, draft_model=None, spec_k: int = 4):
     """Generate continuations for ``input_ids`` [B, S]; returns [B, S+N].
 
     Greedy by default; sampling with temperature/top-k/top-p when
@@ -486,7 +727,16 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     ``stream=True`` (forces python mode) returns a generator that yields
     one np.int32 [B] token vector per generated position as it lands
     (EOS-masked rows keep yielding EOS) and stops early once every row
-    is done."""
+    is done.
+
+    ``draft_model=`` enables SPECULATIVE decoding (offline oracle for
+    the serving engine's spec lane): the draft proposes ``spec_k``
+    tokens per round and the target scores the whole bundle in one
+    cached forward. Outputs are bit-identical to the non-speculative
+    path — greedy at any batch size, sampled at B=1 (B>1 sampled rows
+    use independent per-row key chains; see ``_spec_row_keys``) — the
+    draft only changes how fast rows advance. Unsupported together with
+    ``stream`` and with ragged/left-padded prompts (``pad_token_id``)."""
     cfg = GenerationConfig(max_new_tokens, do_sample, temperature, top_k, top_p,
                            eos_token_id, seed)
     ids, pad_lens = _normalize_prompts(input_ids, pad_token_id)
@@ -533,6 +783,20 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
         if stream:
             return iter(())
         return Tensor(ids)
+    if draft_model is not None and spec_k >= 1:
+        if stream:
+            raise ValueError(
+                "stream=True is not supported with draft_model: the "
+                "speculative loop emits a variable number of tokens per "
+                "round — drop draft_model to stream, or poll the serving "
+                "engine's Request.stream()")
+        if ragged:
+            raise ValueError(
+                "draft_model is not supported with ragged/left-padded "
+                "prompts (pad_token_id): the speculative verify derives "
+                "its masking from positions only — pass equal-length "
+                "prompts or drop draft_model")
+        return _generate_speculative(model, draft_model, ids, cfg, spec_k)
 
     # jitted executables are cached on the model so repeat generate() calls
     # with the same shapes/config reuse the compiled programs; the KV cache

@@ -65,7 +65,7 @@ from .flash_attention import NEG_INF, _dot_prec, _interpret
 __all__ = ["flash_decode_attention", "flash_decode_enabled",
            "decode_dispatch", "MAX_DECODE_Q_LEN",
            "paged_flash_decode_attention", "paged_decode_dispatch",
-           "MAX_PAGED_Q_LEN"]
+           "MAX_PAGED_Q_LEN", "MAX_SPEC_K", "spec_verify_eligibility"]
 
 _FLASH_DECODE_ENV = "PADDLE_TPU_FLASH_DECODE"
 
@@ -74,9 +74,16 @@ _FLASH_DECODE_ENV = "PADDLE_TPU_FLASH_DECODE"
 MAX_DECODE_Q_LEN = 8
 
 # the paged variant also serves chunked-prefill bundles (one fixed chunk
-# shape replaces every per-bucket prefill executable), so its query
-# window is the chunk, not the decode step
+# shape replaces every per-bucket prefill executable) and speculative
+# verify bundles (q_len = spec_k + 1), so its query window is the
+# chunk/bundle, not the decode step
 MAX_PAGED_Q_LEN = 256
+
+# largest per-round draft count the serving engine accepts: the verify
+# bundle must fit the paged kernel's query window (ServingConfig
+# validates spec_k against this so an oversized k fails at construction
+# with an actionable error instead of silently falling back)
+MAX_SPEC_K = MAX_PAGED_Q_LEN - 1
 
 # Dispatch outcome counters (PR-2 fused-conv pattern): the decode
 # dispatch is a python-side decision with automatic XLA fallback, so a
@@ -169,6 +176,31 @@ def paged_decode_dispatch(model: str, *, q_len: int, has_mask: bool,
     if _obs_on[0]:
         _fd_fallbacks.labels("paged_" + reason).inc()
     return False
+
+
+def spec_verify_eligibility(spec_k: int, dtype):
+    """Will a speculative verify bundle (q_len = spec_k + 1) take the
+    paged flash-decode kernel, and if not, why? Called ONCE per engine
+    at construction — the per-layer dispatch still decides each trace
+    via ``paged_decode_dispatch``; this is the engine-level preflight
+    that records the expected path (and its fallback reason, under the
+    ``spec_`` prefix) so a config that silently pushes every verify
+    onto the XLA gather fallback is visible in the metrics before any
+    traffic arrives."""
+    reason = None
+    if not flash_decode_enabled():
+        reason = "disabled"
+    elif not _HAS_TPU_PALLAS:  # pragma: no cover
+        reason = "no_tpu_pallas"
+    elif spec_k + 1 > MAX_PAGED_Q_LEN:
+        reason = "q_len"
+    elif str(dtype) not in ("float32", "bfloat16"):
+        reason = "dtype"
+    if reason is None:
+        return True, None
+    if _obs_on[0]:
+        _fd_fallbacks.labels("spec_" + reason).inc()
+    return False, reason
 
 
 _COMPILER_PARAMS = None

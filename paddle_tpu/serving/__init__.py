@@ -17,6 +17,13 @@ static-shape decode substrate:
                   slots freed on EOS/max-tokens and refilled
                   immediately. ``kv_mode="contiguous"`` keeps the
                   pre-paging per-slot-buffer engine as the A/B baseline.
+                  ``draft_model=`` adds SPECULATIVE DECODING: a small
+                  draft proposes ``spec_k`` tokens per slot, the target
+                  scores the whole bundle in one paged flash-decode
+                  call, and each slot advances by its own accept length
+                  through the block tables — outputs stay bit-identical
+                  to plain decode (greedy and sampled), speculation only
+                  moves throughput.
 - ``block_pool``: host-side KV block allocator (free list + refcounts,
                   exhaustion/double-free errors, fragmentation stats)
                   and the exact-prefix LRU cache behind prefix sharing.

@@ -31,6 +31,25 @@ reuse, built on this repo's static-shape decode substrate:
   [B, max_len, h, d] buffers, bucketed padded prefill + cache splice —
   kept as the A/B baseline (``benchmarks/bench_paged_kv.py``).
 
+- ``draft_model=`` (paged only): SPECULATIVE DECODING. Decode is
+  KV-bandwidth-bound, so idle FLOPs verify ``spec_k`` draft tokens per
+  slot per round: ONE jitted draft program (k cached draft-model
+  forwards over draft KV pools that share the target's block tables),
+  then ONE jitted verify scoring the whole [B, k+1] bundle with the
+  target through ``paged_flash_decode_attention``'s q_len > 1 path.
+  Acceptance is the Leviathan/Chen rule under a common-noise coupling:
+  draft and target select with the SAME per-position PRNG subkey, so
+  accept-with-prob-min(1, p/q) collapses to exact token match and the
+  emitted sequence is BIT-IDENTICAL to non-speculative decode — greedy
+  and sampled — while the chain still advances one split per emitted
+  token (preemption replay untouched). Rejected draft KV rolls back BY
+  POSITION (the next bundle overwrites it before any in-length query
+  can attend it); variable per-slot accept length is a per-row position
+  bump through the block tables. Requests opt out (or shrink k) via
+  ``SamplingParams.spec_k``; opted-out rows ride the verify bundle at
+  width 1 as plain decode steps, so mixed pools share the same two
+  executables — each compiles exactly once.
+
 Both modes drive ONE jitted pool-wide decode step per iteration:
 per-slot positions / sampling params / PRNG keys / active mask — and in
 paged mode the block tables — are traced arrays, so mixed
@@ -69,7 +88,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..generation import (make_cached_runner, make_kv_caches,
-                          make_paged_kv_pools, select_tokens, split_keys)
+                          make_paged_kv_pools, select_tokens,
+                          spec_accept_length, split_key_levels, split_keys)
 from ..observability import recompile as _recompile
 from ..observability import tracing as _trace
 from ..observability.recompile import entrypoint as _entrypoint
@@ -123,6 +143,11 @@ class ServingConfig:
     - ``pad_token_id``: right-pad filler for padded prefill — any valid
       token id works (padded positions are causally invisible, and paged
       mode routes their writes to the dump block).
+    - ``spec_k``: draft tokens per speculative round when the engine is
+      built with a ``draft_model`` (the verify bundle is ``spec_k + 1``
+      query positions through the paged kernel). Requests opt out (or
+      shrink their k) per-request via ``SamplingParams.spec_k``; ignored
+      without a draft model.
     """
 
     max_slots: int = 4
@@ -135,12 +160,23 @@ class ServingConfig:
     num_blocks: Optional[int] = None
     prefill_chunk: int = 32
     prefix_caching: bool = True
+    spec_k: int = 4
 
     def __post_init__(self):
         if self.kv_mode not in ("paged", "contiguous"):
             raise ValueError(
                 f"kv_mode must be 'paged' or 'contiguous', got "
                 f"{self.kv_mode!r}")
+        from ..pallas_kernels.decode_attention import MAX_SPEC_K
+
+        if not 0 <= int(self.spec_k) <= MAX_SPEC_K:
+            raise ValueError(
+                f"spec_k ({self.spec_k}) must be in [0, {MAX_SPEC_K}]: the "
+                f"speculative verify scores spec_k + 1 bundle positions in "
+                f"one paged flash-decode call, whose query window is "
+                f"MAX_PAGED_Q_LEN = {MAX_SPEC_K + 1} — shrink spec_k (draft "
+                f"win saturates long before that) or raise MAX_PAGED_Q_LEN "
+                f"with the kernel's block budget in mind")
         if self.kv_mode == "paged":
             if self.block_size < 1 or self.max_len % self.block_size:
                 raise ValueError(
@@ -157,6 +193,37 @@ class ServingConfig:
                     f"num_blocks ({self.num_blocks}) must be >= 2: block 0 "
                     f"is the reserved dump block, so at least one usable "
                     f"block is needed")
+
+    def validate_draft(self, model_config, draft_config):
+        """Speculative-lane compatibility checks between the target and
+        draft models (called by the engine when ``draft_model`` is
+        given; lives here so the error surface sits with the other
+        config validation)."""
+        if self.kv_mode != "paged":
+            raise ValueError(
+                "speculative decoding requires kv_mode='paged': the "
+                "verify bundle and rollback-by-position ride the block "
+                "tables — drop draft_model or switch kv_mode to 'paged'")
+        if self.spec_k < 1:
+            raise ValueError(
+                f"spec_k ({self.spec_k}) must be >= 1 when a draft_model "
+                f"is given — with 0 draft tokens per round the draft "
+                f"model is dead weight; drop draft_model instead")
+        if draft_config.vocab_size != model_config.vocab_size:
+            raise ValueError(
+                f"draft/target vocab mismatch: draft vocab_size "
+                f"({draft_config.vocab_size}) != target vocab_size "
+                f"({model_config.vocab_size}) — speculative verify "
+                f"compares draft TOKEN IDS against target selections, so "
+                f"both models must share one tokenizer/vocab (e.g. build "
+                f"the draft with generation.truncated_draft)")
+        if self.max_len > draft_config.max_position_embeddings:
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds the DRAFT model's "
+                f"max_position_embeddings "
+                f"({draft_config.max_position_embeddings}); the draft "
+                f"decodes the same positions the target does — shrink "
+                f"max_len or use a draft with a longer position table")
 
     def buckets(self) -> tuple:
         bs = tuple(sorted({int(b) for b in self.prefill_buckets
@@ -200,7 +267,8 @@ class ServingEngine:
     on ``Request.result()`` / iterate ``Request.stream()``).
     """
 
-    def __init__(self, model, config: Optional[ServingConfig] = None, **overrides):
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 draft_model=None, **overrides):
         if config is None:
             config = ServingConfig(**overrides)
         elif overrides:
@@ -213,6 +281,23 @@ class ServingEngine:
                 f"max_len ({config.max_len}) exceeds the model's "
                 f"max_position_embeddings ({mcfg.max_position_embeddings})")
         self.paged = config.kv_mode == "paged"
+        self.draft_model = draft_model
+        self.spec = draft_model is not None
+        if self.spec:
+            config.validate_draft(mcfg, draft_model.config)
+            self._spec_k = int(config.spec_k)
+            from ..pallas_kernels.decode_attention import \
+                spec_verify_eligibility
+            ok, reason = spec_verify_eligibility(
+                self._spec_k,
+                next(iter(model.parameters()))._data.dtype)
+            # expected verify-bundle path, recorded once per engine: the
+            # kernel serves q_len = spec_k + 1 bundles, or the XLA
+            # gather fallback does (reason-counted either way)
+            self._spec_verify_kernel = ok
+            _trace.instant("spec_verify_path", cat="engine",
+                           args={"kernel": ok, "reason": reason,
+                                 "k": self._spec_k})
         B = int(config.max_slots)
         self.scheduler = Scheduler(config.max_queue_depth)
 
@@ -221,6 +306,21 @@ class ServingEngine:
         buffers = {k: v._data for k, v in model.named_buffers_dict().items()}
         self._pb = {**params, **buffers}
         self._mcfg = mcfg
+        if self.spec:
+            self._dcfg = draft_model.config
+            self._ddtype = next(iter(draft_model.parameters()))._data.dtype
+            self._dpb = {
+                **{k: v._data
+                   for k, v in draft_model.named_parameters_dict().items()},
+                **{k: v._data
+                   for k, v in draft_model.named_buffers_dict().items()}}
+            self._spec_drafted = 0
+            self._spec_accepted = 0
+            self._spec_rounds = 0
+            # engine-local accept-length histogram (0..k accepted per
+            # round): /stats percentiles come from THIS engine's rounds;
+            # the registry Summary stays the fleet-wide scrape surface
+            self._accept_hist = [0] * (int(config.spec_k) + 1)
 
         # per-slot decode state (last token, position, PRNG chain,
         # sampling params) lives on DEVICE across steps — the decode loop
@@ -291,8 +391,18 @@ class ServingEngine:
         self._jobs: List[Optional[_PrefillJob]] = [None] * B
         # this engine's closures are NEW executables — their first
         # compiles are warmup, not retraces of a previous engine's
-        _recompile.reset_warmup("serving.step", "serving.prefill_chunk",
-                                "serving.cow")
+        warm = ["serving.step", "serving.prefill_chunk", "serving.cow"]
+        if self.spec:
+            warm += ["serving.spec_draft", "serving.spec_verify"]
+        _recompile.reset_warmup(*warm)
+        if self.spec:
+            # the draft model's KV pools mirror the target's block
+            # structure and are addressed by the SAME per-slot block
+            # tables, so one host-side allocator/prefix-cache/COW
+            # bookkeeping drives both models' caches
+            self._dpools = make_paged_kv_pools(
+                self._dcfg, self._nblocks, bs, self._ddtype)
+            self._drun = make_cached_runner(self.draft_model)
 
         C = int(config.prefill_chunk)
 
@@ -381,6 +491,184 @@ class ServingEngine:
         self._step_fn = _step
         self._cow_fn = _cow
         self._chunk_size = C
+        if self.spec:
+            self._init_spec(B, run)
+
+    # -- executables: speculative lane (paged only) --------------------------
+    def _init_spec(self, B: int, run):
+        """Draft + verify executables over the shared block tables.
+
+        Two programs replace the plain decode step: ``spec_draft`` runs
+        k cached draft-model forwards (q_len 1) proposing one token
+        each, ``spec_verify`` scores the whole [B, k+1] bundle with the
+        target in ONE paged flash-decode call and accepts the longest
+        draft prefix matching the target's own selections. Every
+        per-row quantity (positions, block tables, live bundle width
+        ``spec_valid``, accept length) is traced data, so both compile
+        exactly once whatever the accept-length pattern.
+
+        PRNG contract: the draft proposes with the SAME chain subkeys
+        the verify selects with (common-noise coupling), and the verify
+        commits the chain at level ``n_emit`` — one split per EMITTED
+        token, exactly the non-speculative chain, so outputs are
+        bit-identical to plain decode (greedy AND sampled) and
+        preemption's replay-by-token-count machinery works untouched.
+
+        KV rollback is BY POSITION: rejected draft/target writes stay in
+        the pool past the committed length; the next round's bundle
+        lands on top of them before any in-length query can attend them
+        (the same contract the contiguous cache's garbage rides on)."""
+        config = self.config
+        k = self._spec_k
+        drun = self._drun
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _draft(dpb, dpools, state, bt, spec_valid, any_sampling):
+            """k cached draft forwards proposing the bundle's draft
+            tokens. ``spec_valid`` [B] is each row's live bundle width:
+            draft writes beyond it are routed to the dump block (rows
+            opted out of speculation still get their last token's draft
+            KV at width 1, keeping the draft cache consistent for
+            free)."""
+            _, subs = split_key_levels(state["keys"], k)
+            tok = state["tokens"]
+            pos = state["pos"]
+            drafts = []
+            cur = dpools
+            for j in range(k):
+                caches = [{"k": c["k"], "v": c["v"], "bt": bt,
+                           "valid": jnp.maximum(spec_valid - j, 0)}
+                          for c in cur]
+                logits, newdc = drun(dpb, tok[:, None], caches, pos + j)
+                last = logits[:, 0]
+                sub_j = subs[:, j]
+                tok = jax.lax.cond(
+                    any_sampling,
+                    lambda l=last, s=sub_j: select_tokens(
+                        l, s, state["ds"], state["temp"], state["tk"],
+                        state["tp"]),
+                    lambda l=last: jnp.argmax(l, axis=-1).astype(jnp.int32))
+                drafts.append(tok)
+                cur = [{"k": c["k"], "v": c["v"]} for c in newdc]
+            # one write-only forward for the LAST draft token: on a
+            # full accept the sequence advances past pos+k, and d_k's
+            # draft KV was only ever an output — without this write the
+            # next round's draft attends a hole there and falls off the
+            # chain (accept rate halves; outputs are unaffected since
+            # verify is target-authoritative). Dump-routed unless the
+            # row's bundle really spans k+1 positions.
+            caches = [{"k": c["k"], "v": c["v"], "bt": bt,
+                       "valid": jnp.maximum(spec_valid - k, 0)}
+                      for c in cur]
+            _, newdc = drun(dpb, tok[:, None], caches, pos + k)
+            cur = [{"k": c["k"], "v": c["v"]} for c in newdc]
+            return jnp.stack(drafts, axis=1), cur
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _verify(pb, pools, state, bt, drafts, spec_valid, any_sampling,
+                    active):
+            """ONE target forward over the [B, k+1] bundle (the paged
+            kernel's q_len > 1 path), candidate selection for every
+            position with that position's chain subkey, accept-length
+            commit. Rows with ``spec_valid`` 1 ride as plain decode
+            steps (their drafts are ignored), width-0 rows are inert —
+            mixed spec/non-spec pools share this one executable."""
+            bundle = jnp.concatenate([state["tokens"][:, None], drafts],
+                                     axis=1)
+            caches = [{"k": c["k"], "v": c["v"], "bt": bt,
+                       "valid": spec_valid} for c in pools]
+            logits, newc = run(pb, bundle, caches, state["pos"])
+            levels, subs = split_key_levels(state["keys"], k + 1)
+            V = logits.shape[-1]
+            flat = logits.reshape(B * (k + 1), V)
+
+            def _rep(x):
+                return jnp.broadcast_to(
+                    x[:, None], (B, k + 1)).reshape(B * (k + 1))
+
+            cand = jax.lax.cond(
+                any_sampling,
+                lambda: select_tokens(
+                    flat, subs.reshape(B * (k + 1), 2), _rep(state["ds"]),
+                    _rep(state["temp"]), _rep(state["tk"]),
+                    _rep(state["tp"])),
+                lambda: jnp.argmax(flat, axis=-1).astype(jnp.int32)
+            ).reshape(B, k + 1)
+            n_emit = spec_accept_length(drafts, cand, spec_valid)
+            new_keys = jnp.take_along_axis(
+                levels, n_emit[:, None, None], axis=1)[:, 0]
+            last = jnp.take_along_axis(
+                cand, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            state = dict(state)
+            state["tokens"] = jnp.where(n_emit > 0, last, state["tokens"])
+            state["pos"] = jnp.where(
+                active,
+                jnp.minimum(state["pos"] + n_emit,
+                            jnp.int32(config.max_len - 1)),
+                jnp.int32(0))
+            state["keys"] = new_keys
+            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            return cand, n_emit, pools_out, state
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3, 4))
+        def _chunk_spec(pb, dpb, pools, dpools, state, bt_row, ids, pos0,
+                        valid, slot, is_last, last_idx, key, ds, temp, tk,
+                        tp):
+            """The prefill chunk with the draft model riding along: both
+            models' paged caches take the chunk's writes through the one
+            block table, so prefix-cached blocks carry BOTH models' KV
+            and preemption-resume re-prefills both. Select/state logic
+            is the plain chunk's, verbatim."""
+            caches = [{"k": c["k"], "v": c["v"], "bt": bt_row,
+                       "valid": valid[None]} for c in pools]
+            dcaches = [{"k": c["k"], "v": c["v"], "bt": bt_row,
+                       "valid": valid[None]} for c in dpools]
+            logits, newc = run(pb, ids, caches, pos0)
+            _, newdc = drun(dpb, ids, dcaches, pos0)
+            last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
+                                                axis=1)[:, 0]
+            key2, sub = jax.random.split(key)
+            token = jax.lax.cond(
+                ds[0],
+                lambda: select_tokens(last, sub[None], ds, temp, tk, tp),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            state = dict(state)
+
+            def _sel(new, old):
+                return jnp.where(is_last, new, old)
+
+            state["tokens"] = state["tokens"].at[slot].set(
+                _sel(token[0], state["tokens"][slot]))
+            state["pos"] = state["pos"].at[slot].set(
+                _sel(pos0 + valid, state["pos"][slot]))
+            state["keys"] = state["keys"].at[slot].set(
+                _sel(key2, state["keys"][slot]))
+            state["ds"] = state["ds"].at[slot].set(_sel(ds[0], state["ds"][slot]))
+            state["temp"] = state["temp"].at[slot].set(
+                _sel(temp[0], state["temp"][slot]))
+            state["tk"] = state["tk"].at[slot].set(_sel(tk[0], state["tk"][slot]))
+            state["tp"] = state["tp"].at[slot].set(_sel(tp[0], state["tp"][slot]))
+            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            dpools_out = [{"k": c["k"], "v": c["v"]} for c in newdc]
+            return token, pools_out, dpools_out, state
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _cow_spec(pools, dpools, src, dst):
+            """COW fork across BOTH models' pools (same block ids)."""
+            out, dout = [], []
+            for c in pools:
+                out.append({"k": c["k"].at[dst].set(c["k"][src]),
+                            "v": c["v"].at[dst].set(c["v"][src])})
+            for c in dpools:
+                dout.append({"k": c["k"].at[dst].set(c["k"][src]),
+                             "v": c["v"].at[dst].set(c["v"][src])})
+            return out, dout
+
+        self._draft_fn = _draft
+        self._verify_fn = _verify
+        self._chunk_spec_fn = _chunk_spec
+        self._cow_spec_fn = _cow_spec
+        self._zero_drafts = jnp.zeros((B, k), jnp.int32)
 
     # -- executables: contiguous (the pre-paging engine, A/B baseline) -------
     def _init_contiguous(self, B: int, run):
@@ -687,9 +975,15 @@ class ServingEngine:
             return
         new_id = self._reclaim_alloc(1, slot)[0]
         with _entrypoint("serving.cow"):
-            self._pools = self._cow_fn(self._pools,
-                                       jnp.asarray(bid, jnp.int32),
-                                       jnp.asarray(new_id, jnp.int32))
+            if self.spec:
+                self._pools, self._dpools = self._cow_spec_fn(
+                    self._pools, self._dpools,
+                    jnp.asarray(bid, jnp.int32),
+                    jnp.asarray(new_id, jnp.int32))
+            else:
+                self._pools = self._cow_fn(self._pools,
+                                           jnp.asarray(bid, jnp.int32),
+                                           jnp.asarray(new_id, jnp.int32))
         self.pool.decref(bid)
         self._slot_blocks[slot][block_idx] = new_id
         self._bt[slot, block_idx] = new_id
@@ -785,8 +1079,7 @@ class ServingEngine:
         # would-be-retrace bug) lands in this request's timeline
         with _trace.trace_context(req.id), \
                 _entrypoint("serving.prefill_chunk"):
-            token, self._pools, self._state = self._chunk_fn(
-                self._pb, self._pools, self._state,
+            chunk_args = (
                 jnp.asarray(self._bt[slot:slot + 1]),
                 jnp.asarray(ids), jnp.asarray(start, jnp.int32),
                 jnp.asarray(end - start, jnp.int32),
@@ -796,6 +1089,14 @@ class ServingEngine:
                 jnp.asarray([p.temperature], jnp.float32),
                 jnp.asarray([p.top_k], jnp.int32),
                 jnp.asarray([p.top_p], jnp.float32))
+            if self.spec:
+                token, self._pools, self._dpools, self._state = \
+                    self._chunk_spec_fn(self._pb, self._dpb, self._pools,
+                                        self._dpools, self._state,
+                                        *chunk_args)
+            else:
+                token, self._pools, self._state = self._chunk_fn(
+                    self._pb, self._pools, self._state, *chunk_args)
         tc1 = time.perf_counter_ns()
         _trace.complete("prefill_chunk", "request", req.id, tc0, tc1 - tc0,
                         {"slot": slot, "start": start, "end": end,
@@ -960,22 +1261,29 @@ class ServingEngine:
 
             if self.paged:
                 # every active row writes this step's K/V at its current
-                # length: cross a block boundary -> allocate; write into
-                # a shared (prefix-cached) block -> COW fork. Allocation
-                # pressure preempts the latest-admitted request, which
-                # can shrink `active`.
+                # length — or, speculatively, at its whole verify-bundle
+                # window [len, len + spec_len): cross a block boundary
+                # -> allocate; write into a shared (prefix-cached) block
+                # -> COW fork. Allocation pressure preempts the
+                # latest-admitted request, which can shrink `active`.
                 bs = self.config.block_size
                 for i in list(active):
                     if self._slot_req[i] is None or not self._decoding[i]:
                         continue  # preempted by an earlier row's reclaim
-                    bi = self._slot_len[i] // bs
+                    # _row_spec_len is a pure function of host state that
+                    # does not change between here and the dispatch, so
+                    # the bundle can never write past this coverage
+                    m = self._row_spec_len(i) if self.spec else 1
+                    first_bi = self._slot_len[i] // bs
+                    last_bi = (self._slot_len[i] + m - 1) // bs
                     try:
-                        if bi >= len(self._slot_blocks[i]):
-                            nid = self._reclaim_alloc(1, i)[0]
-                            self._slot_blocks[i].append(nid)
-                            self._bt[i, bi] = nid
-                        else:
-                            self._ensure_writable(i, bi)
+                        for bi in range(first_bi, last_bi + 1):
+                            if bi >= len(self._slot_blocks[i]):
+                                nid = self._reclaim_alloc(1, i)[0]
+                                self._slot_blocks[i].append(nid)
+                                self._bt[i, bi] = nid
+                            else:
+                                self._ensure_writable(i, bi)
                     except PoolExhaustedError:
                         self._preempt(i)
                 active = [i for i in active
@@ -989,6 +1297,8 @@ class ServingEngine:
             any_sampling = any(self._slot_sampling[i] for i in active)
             active_mask = np.zeros(self.config.max_slots, bool)
             active_mask[active] = True
+            if self.spec:
+                return self._spec_step(active, active_mask, any_sampling, t0)
             with _entrypoint("serving.step"):
                 if self.paged:
                     bt_step = self._bt.copy()
@@ -1028,6 +1338,104 @@ class ServingEngine:
                     _sm.tpot_summary.observe(now - prev)
                 self._finish_or_keep(i, req, t, now)
             return True
+
+    # -- the speculative iteration -------------------------------------------
+    def _row_spec_len(self, slot: int) -> int:
+        """Live bundle width for one decoding slot this round: 1 + the
+        row's draft count, clamped by the request's own ``spec_k``
+        (opt-out = 0 -> width 1 = a plain decode step riding the
+        bundle), its remaining token budget (drafting past
+        ``max_new_tokens`` is pure waste), and the slot's KV capacity
+        (the bundle writes ``width`` positions through the table)."""
+        req = self._slot_req[slot]
+        p = req.params
+        k_req = self._spec_k if p.spec_k is None \
+            else max(0, min(int(p.spec_k), self._spec_k))
+        remaining = p.max_new_tokens - len(req.output_tokens)
+        room = self.config.max_len - self._slot_len[slot]
+        return max(1, min(k_req + 1, remaining, room))
+
+    def _spec_step(self, active, active_mask, any_sampling, t0: float) -> bool:
+        """One speculative iteration for the whole pool: ONE jitted
+        draft program (k draft-model forwards), ONE jitted verify
+        (target scores the k+1-wide bundle through the paged kernel,
+        accepts the longest matching prefix, bumps each row's position
+        by its own accept length through the block tables). The draft
+        program is skipped — host-side, no recompile — when no live row
+        wants more than a plain step this round."""
+        B = self.config.max_slots
+        k = self._spec_k
+        spec_valid = np.zeros(B, np.int32)
+        for i in active:
+            spec_valid[i] = self._row_spec_len(i)
+        bt_step = self._bt.copy()
+        bt_step[~active_mask] = 0
+        bt_j = jnp.asarray(bt_step)
+        sv_j = jnp.asarray(spec_valid)
+        as_j = jnp.asarray(any_sampling)
+        need_draft = bool((spec_valid > 1).any())
+        if need_draft:
+            td0 = time.perf_counter()
+            with _entrypoint("serving.spec_draft"):
+                drafts, self._dpools = self._draft_fn(
+                    self._dpb, self._dpools, self._state, bt_j, sv_j, as_j)
+            td1 = time.perf_counter()
+            _trace.complete("serving.spec_draft", "engine", "engine",
+                            int(td0 * 1e9), int((td1 - td0) * 1e9),
+                            {"active": len(active), "k": k})
+        else:
+            drafts = self._zero_drafts
+        tv0 = time.perf_counter()
+        with _entrypoint("serving.spec_verify"):
+            cand, n_emit, self._pools, self._state = self._verify_fn(
+                self._pb, self._pools, self._state, bt_j, drafts, sv_j,
+                as_j, jnp.asarray(active_mask))
+        cand_np = np.asarray(cand)   # the round's device->host sync
+        n_np = np.asarray(n_emit)
+        now = time.perf_counter()
+        _sm.steps_total.inc()
+        _sm.step_seconds.observe(now - t0)
+        _trace.complete("serving.spec_verify", "engine", "engine",
+                        int(tv0 * 1e9), int((now - tv0) * 1e9),
+                        {"active": len(active), "step": self._steps})
+        self._steps += 1
+        self._occupancy_integral += len(active)
+        self._spec_rounds += 1
+
+        for i in active:
+            req = self._slot_req[i]
+            n = int(n_np[i])
+            drafted = int(spec_valid[i]) - 1
+            accepted = n - 1
+            if drafted > 0:
+                self._spec_drafted += drafted
+                self._spec_accepted += accepted
+                req.spec_drafted += drafted
+                req.spec_accepted += accepted
+                _sm.spec_drafted_tokens.inc(drafted)
+                _sm.spec_accepted_tokens.inc(accepted)
+                _sm.spec_rejected_tokens.inc(drafted - accepted)
+                _sm.spec_accept_len.observe(accepted)
+                self._accept_hist[accepted] += 1
+                # accepted-k instant on the request's PR-7 trace lane
+                req._tr_event("spec_accept", drafted=drafted,
+                              accepted=accepted, emitted=n)
+            self._slot_len[i] = min(self._slot_len[i] + n,
+                                    self.config.max_len - 1)
+            prev = req.last_token_ts
+            interval = (now - prev) if prev is not None else None
+            for j in range(n):
+                t = int(cand_np[i, j])
+                req.push_token(t, now)
+                _sm.tokens_total.labels("generated").inc()
+                if interval is not None:
+                    # the round's wall time amortized over its tokens —
+                    # the honest per-token cadence of a multi-token step
+                    _sm.tpot_seconds.observe(interval / n)
+                    _sm.tpot_summary.observe(interval / n)
+                if self._finish_or_keep(i, req, t, now):
+                    break
+        return True
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> int:
         """Drive ``step()`` until queue and slots are empty (the
@@ -1129,6 +1537,45 @@ class ServingEngine:
             return None
         return self._occupancy_integral / (self._steps * self.config.max_slots)
 
+    def spec_stats(self) -> dict:
+        """Speculative-lane accounting for ``/stats`` and the flight
+        recorder: engine-lifetime drafted/accepted/rejected totals, the
+        pool-wide accept rate, and the accept-length digest."""
+        if not self.spec:
+            return {"enabled": False}
+        count = sum(self._accept_hist)
+        total = sum(i * n for i, n in enumerate(self._accept_hist))
+
+        def _pct(p):
+            # exact percentile over the engine's own rounds (the hist is
+            # tiny: one bucket per accept length 0..k)
+            target = p * count
+            seen = 0
+            for i, n in enumerate(self._accept_hist):
+                seen += n
+                if seen >= target:
+                    return float(i)
+            return float(len(self._accept_hist) - 1)
+
+        return {
+            "enabled": True,
+            "k": self._spec_k,
+            "verify_kernel": self._spec_verify_kernel,
+            "rounds": self._spec_rounds,
+            "drafted_tokens": self._spec_drafted,
+            "accepted_tokens": self._spec_accepted,
+            "rejected_tokens": self._spec_drafted - self._spec_accepted,
+            "accept_rate": (self._spec_accepted / self._spec_drafted
+                            if self._spec_drafted else None),
+            "queue_spec_opted_out": self.scheduler.depth_spec_opted_out(),
+            "accept_len": {
+                **({f"p{round(p * 100)}": _pct(p)
+                    for p in (0.5, 0.95, 0.99)} if count else {}),
+                "hist": list(self._accept_hist),
+                "mean": (total / count) if count else None,
+                "count": count},
+        }
+
     def kv_block_stats(self) -> Optional[dict]:
         """Pool utilization + internal fragmentation (allocated token
         slots the slots' sequences do not fill) — paged mode only."""
@@ -1187,6 +1634,7 @@ class ServingEngine:
             "goodput_tokens_per_s": _sm.goodput_tokens_per_second.value(),
             "preemptions": self._preempt_count,
         }
+        out["spec"] = self.spec_stats()
         if self.paged:
             out["block_size"] = self.config.block_size
             out["prefill_chunk"] = self.config.prefill_chunk

@@ -8,9 +8,13 @@ completions are lists of token ids.
 - ``POST /generate`` body
   ``{"prompt": [ids], "max_new_tokens": 16, "do_sample": false,
      "temperature": 1.0, "top_k": 0, "top_p": 1.0, "eos_token_id": null,
-     "seed": 0, "deadline_s": null, "stream": false}``
+     "seed": 0, "spec_k": null, "deadline_s": null, "stream": false}``
+  (``spec_k`` is the per-request speculative override on draft-model
+  engines: 0 opts out, null takes the engine default — outputs are
+  identical either way, only throughput moves)
   -> ``{"request_id", "status", "prompt_len", "tokens", "ttft_s",
-        "tpot_s", "latency_s"}``; with ``"stream": true`` the response
+        "tpot_s", "latency_s", "spec_drafted", "spec_accepted"}``;
+  with ``"stream": true`` the response
   is newline-delimited JSON, one ``{"token": id}`` line per token as it
   lands, then a final ``{"done": true, "status": ...}`` line.
 - ``GET /healthz``  -> liveness + the serving gauges
@@ -54,6 +58,8 @@ def _request_record(req) -> dict:
         "tpot_s": req.tpot_s,
         "latency_s": (req.finish_ts - req.arrival_ts
                       if req.finish_ts else None),
+        "spec_drafted": req.spec_drafted,
+        "spec_accepted": req.spec_accepted,
         "error": req.error,
     }
 

@@ -21,7 +21,8 @@ __all__ = [
     "cow_forks_total", "preemptions_total", "prefill_chunks_total",
     "ttft_summary", "tpot_summary", "queue_wait_seconds",
     "prefill_chunk_seconds", "goodput_tokens_per_second",
-    "latency_digests",
+    "latency_digests", "spec_drafted_tokens", "spec_accepted_tokens",
+    "spec_rejected_tokens", "spec_accept_len",
 ]
 
 requests_total = _m.counter(
@@ -83,6 +84,18 @@ preemptions_total = _m.counter(
 prefill_chunks_total = _m.counter(
     "paddle_tpu_serving_prefill_chunks_total",
     "fixed-size prefill chunks executed (chunked-prefill admission)")
+# -- speculative decoding (draft-model engines) ----------------------------
+spec_drafted_tokens = _m.counter(
+    "paddle_tpu_serving_spec_drafted_tokens_total",
+    "draft tokens proposed to speculative verify rounds")
+spec_accepted_tokens = _m.counter(
+    "paddle_tpu_serving_spec_accepted_tokens_total",
+    "draft tokens accepted by the target model (each one a decode step "
+    "the pool did not have to run)")
+spec_rejected_tokens = _m.counter(
+    "paddle_tpu_serving_spec_rejected_tokens_total",
+    "draft tokens rejected at verify (the round still emits the "
+    "target's own token, so rejection costs draft work, never output)")
 
 step_seconds = _m.histogram(
     "paddle_tpu_serving_step_seconds",
@@ -124,6 +137,11 @@ prefill_chunk_seconds = _m.summary(
     "paddle_tpu_serving_prefill_chunk_seconds",
     "host wall time of one chunked-prefill dispatch, streaming "
     "p50/p95/p99")
+spec_accept_len = _m.summary(
+    "paddle_tpu_serving_spec_accept_len_summary",
+    "accepted draft tokens per speculative verify round (0..k), "
+    "streaming p50/p95/p99 — the live accept-length distribution the "
+    "spec_k knob should be tuned against")
 goodput_tokens_per_second = _m.gauge(
     "paddle_tpu_serving_goodput_tokens_per_second",
     "deadline-met throughput: tokens of requests that COMPLETED within "

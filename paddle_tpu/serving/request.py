@@ -44,7 +44,16 @@ class RequestStatus:
 class SamplingParams:
     """Per-request decode knobs — the same surface as
     ``generation.generate`` so outputs are comparable request-for-request
-    (greedy by default; temperature/top-k/top-p when ``do_sample``)."""
+    (greedy by default; temperature/top-k/top-p when ``do_sample``).
+
+    ``spec_k`` is the per-request speculative-decoding override on a
+    draft-model engine: ``None`` takes the engine's configured k, ``0``
+    opts this request out of speculation entirely (it rides the verify
+    bundle as a plain one-token step), and ``1..engine_k`` shrinks the
+    draft window. Values above the engine's k clamp down to it (the
+    compiled bundle width is the engine's). Outputs are identical at
+    every setting — speculation only changes how many tokens a round
+    advances."""
 
     max_new_tokens: int = 32
     do_sample: bool = False
@@ -53,6 +62,7 @@ class SamplingParams:
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
+    spec_k: Optional[int] = None
 
 
 _ids = itertools.count()
@@ -93,6 +103,10 @@ class Request:
         self.admitted_ts: Optional[float] = None
         self.queue_wait_total_s: float = 0.0  # summed over re-admissions
         self.preempt_count = 0
+        # speculative-lane accounting (engine thread): draft tokens
+        # proposed for / accepted by this request's verify rounds
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
         self.cancel_requested = False
         # request-lifecycle trace: one root span for the whole life plus
@@ -239,6 +253,12 @@ class Request:
             "ttft_s": self.ttft_s,
             "tpot_s": self.tpot_s,
             "preemptions": self.preempt_count,
+            "spec_k": self.params.spec_k,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": (round(self.spec_accepted
+                                       / self.spec_drafted, 4)
+                                 if self.spec_drafted else None),
             "deadline_in_s": (round(self.deadline_ts - now, 4)
                               if self.deadline_ts is not None
                               and self.finish_ts is None else None),

@@ -84,6 +84,16 @@ class Scheduler:
         with self._lock:
             return list(self._q)
 
+    def depth_spec_opted_out(self) -> int:
+        """Queued requests that opted OUT of speculation
+        (``SamplingParams.spec_k == 0``). A draft-model engine whose
+        queue is mostly opt-outs is paying verify-bundle width for
+        plain decode — ``/stats`` surfaces this so the operator can see
+        the mismatch between the engine's spec config and the actual
+        admission mix."""
+        with self._lock:
+            return sum(1 for r in self._q if r.params.spec_k == 0)
+
     def cancel(self, req: Request) -> bool:
         """Cancel a request. Queued: removed immediately. Running: flag
         it; the engine frees the slot at the next step boundary. Returns

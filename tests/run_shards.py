@@ -71,6 +71,11 @@ TPU_LANE = [
     # retrace-with-tracing-on and engine-lifecycle assertions deserve
     # one compiled run (remote-PJRT dispatch timing differs from CPU)
     ("test_tracing.py", 420, {}),
+    # speculative decoding: bit-parity + one-compile draft/verify on the
+    # paged kernel's q_len>1 bundle path; CPU-verified in the build
+    # container — pair with benchmarks/bench_spec_decode.py for the
+    # >=1.3x coupled-draft acceptance on chip
+    ("test_spec_decode.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     *[(f"test_op_schema_sweep.py", 600,
        {"PADDLE_TPU_SWEEP_SHARD": f"{i}/8"}) for i in range(8)],
     # sampled FD-grad lane (every 16th schema incl. grads): ~2 s/op of
@@ -296,6 +301,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     checkpoint_bench = _read_bench("bench_checkpoint.json")
     decode_bench = _read_bench("bench_decode.json")
     paged_kv_bench = _read_bench("bench_paged_kv.json")
+    spec_decode_bench = _read_bench("bench_spec_decode.json")
     out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
                             "telemetry_lane.json")
     with open(out_path, "w") as fh:
@@ -309,6 +315,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
             "checkpoint_bench": checkpoint_bench,
             "decode_bench": decode_bench,
             "paged_kv_bench": paged_kv_bench,
+            "spec_decode_bench": spec_decode_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "
