@@ -1,0 +1,55 @@
+"""paddle_tpu.analysis — static trace-safety / PRNG / lock / Pallas
+analyzer with a CI gate.
+
+Every hard invariant in this repo — the one-step-compile rule, the
+one-split-per-emitted-token PRNG chain behind speculative decode's
+bit-parity, the lock discipline keeping BlockPool/scheduler/metrics
+exact under threads, the Pallas grid/BlockSpec contracts — used to be
+enforced only *dynamically* (recompile monitor, parity tests) after a
+regression already shipped. This package is the review-time half: an
+``ast``-based analyzer (no execution, no imports of the analyzed code)
+with four pass families tuned to this codebase:
+
+- **trace-safety** (``trace_safety``): host syncs (``.item()``,
+  ``float()/int()`` on traced values, numpy materialization), impure
+  calls (time/random/datetime), Python ``if``/``while`` on traced
+  values, and mutable-capture hazards — inside functions textually
+  jitted or reachable from a jit root in the same module.
+- **PRNG discipline** (``prng``): key reuse (same key consumed twice
+  without a split/fold_in, including per-loop-iteration reuse) and
+  keys seeded from non-chain sources (wall clock, np.random).
+- **lock discipline** (``locks``): ``GUARDED_BY`` maps /
+  ``# guarded-by:`` annotations, ``# holds-lock:`` helper contracts,
+  and foreign writes to another object's guarded attributes.
+- **Pallas checks** (``pallas_checks``): BlockSpec index-map arity vs
+  grid rank + scalar-prefetch count, index-map return rank vs block
+  shape, kernel ref arity, and grid-tiling divisibility
+  (``pick_block`` or an explicit ``%`` guard).
+
+CLI: ``python -m paddle_tpu.analysis [paths] [--json] [--changed-only]
+[--list-rules] [--rules a,b]``. Suppress a finding inline with
+``# pt-analysis: disable=<rule> -- <reason>`` (the reason is
+mandatory; unused suppressions are themselves findings). The analyzer
+runs self-clean over ``paddle_tpu/`` as a tier-1 test
+(``tests/test_analysis.py``) and ``--changed-only`` gates both CI
+lanes via ``tests/run_shards.py``.
+"""
+
+from __future__ import annotations
+
+from .cli import (PACKAGE_ROOT, REPO_ROOT, changed_files, iter_py_files,
+                  main, record_metrics, run_analysis)
+from .core import (RULES, Finding, Rule, analyze_project, analyze_source,
+                   format_findings)
+# the pass modules register their rules at import: pull them in eagerly
+# so RULES is complete before --list-rules / --rules validation runs
+from . import locks, pallas_checks, prng, trace_safety  # noqa: F401
+from .resolver import source_location
+
+__all__ = [
+    "Finding", "Rule", "RULES",
+    "analyze_project", "analyze_source", "format_findings",
+    "run_analysis", "record_metrics", "main",
+    "iter_py_files", "changed_files", "source_location",
+    "PACKAGE_ROOT", "REPO_ROOT",
+]

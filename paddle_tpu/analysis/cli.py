@@ -1,0 +1,183 @@
+"""``python -m paddle_tpu.analysis`` — the CI gate.
+
+Text output is one finding per line (``file:line:col: [rule] message``
+plus an indented fix hint); ``--json`` emits a machine-readable report;
+``--changed-only`` restricts the scan to files git reports as modified
+or untracked (the review-time mode run_shards wires into both lanes).
+Exit code 1 when any unsuppressed finding (including unused
+suppressions) survives, 0 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional, Set
+
+from .core import RULES, analyze_project, format_findings
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+PACKAGE_ROOT = os.path.dirname(_HERE)          # paddle_tpu/
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+
+# generated/vendored trees would go here; nothing excluded today
+_EXCLUDE_PARTS = ("__pycache__",)
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_PARTS]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return sorted(set(out))
+
+
+def changed_files(repo_root: str = REPO_ROOT) -> Optional[List[str]]:
+    """Python files under the package that git reports modified (staged,
+    unstaged, or untracked). None when git is unavailable."""
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo_root,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    files: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if not path.startswith("paddle_tpu/"):
+            continue
+        full = os.path.join(repo_root, path)
+        if path.endswith("/") and os.path.isdir(full):
+            # git reports a fully-untracked directory as one entry
+            files.extend(iter_py_files([full]))
+        elif path.endswith(".py") and os.path.exists(full):
+            files.append(full)
+    return sorted(set(files))
+
+
+def _relpath(path: str) -> str:
+    try:
+        rel = os.path.relpath(path, REPO_ROOT)
+    except ValueError:  # different drive
+        return path
+    return rel if not rel.startswith("..") else path
+
+
+def run_analysis(paths: List[str], rules: Optional[Set[str]] = None):
+    sources = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                sources.append((_relpath(path), fh.read()))
+        except (OSError, UnicodeDecodeError) as e:
+            print(f"[pt-analysis] skipping {path}: {e}", file=sys.stderr)
+    return analyze_project(sources, rules=rules)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="static trace-safety / PRNG / lock / Pallas analyzer")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files or directories (default: {PACKAGE_ROOT})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="only analyze git-modified/untracked package files")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to enable (default all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--no-metrics", action="store_true",
+                    help="skip recording paddle_tpu_analysis_* counters")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: (r.family, r.id)):
+            print(f"{rule.id:28s} [{rule.family}] {rule.description}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}; see --list-rules",
+                  file=sys.stderr)
+            return 2
+
+    if args.changed_only:
+        paths = changed_files()
+        if paths is None:
+            print("[pt-analysis] git unavailable; falling back to the "
+                  "full package", file=sys.stderr)
+            paths = args.paths or [PACKAGE_ROOT]
+        elif not paths:
+            if args.as_json:
+                print(json.dumps({"findings": [], "suppressed": 0,
+                                  "files": 0, "by_rule": {}}))
+            else:
+                print("[pt-analysis] no changed paddle_tpu/*.py files")
+            return 0
+    else:
+        paths = args.paths or [PACKAGE_ROOT]
+
+    result = run_analysis(paths, rules=rules)
+    if not args.no_metrics:
+        record_metrics(result)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in result.findings],
+            "suppressed": len(result.suppressed),
+            "files": result.files,
+            "by_rule": result.counts_by_rule(),
+        }, indent=1))
+    else:
+        print(format_findings(result))
+    return 1 if result.findings else 0
+
+
+def record_metrics(result) -> None:
+    """Fold a run into the observability registry so CI trend lines
+    ride the telemetry_lane.json merge. Best-effort: the analyzer must
+    work in environments without jax."""
+    try:
+        from ..observability import metrics as _m
+    except Exception:
+        return
+    findings = _m.counter(
+        "paddle_tpu_analysis_findings_total",
+        "unsuppressed static-analysis findings by rule", ("rule",))
+    sup_used = _m.counter(
+        "paddle_tpu_analysis_suppressions_used_total",
+        "inline pt-analysis suppressions that waived a finding", ("rule",))
+    sup_unused = _m.counter(
+        "paddle_tpu_analysis_suppressions_unused_total",
+        "stale pt-analysis suppressions (no finding on their line)",
+        ("rule",))
+    files_gauge = _m.gauge(
+        "paddle_tpu_analysis_files_analyzed",
+        "files covered by the most recent analyzer run")
+    for rule, n in result.counts_by_rule().items():
+        if rule != "unused-suppression":
+            findings.labels(rule).inc(n)
+    for f in result.suppressed:
+        sup_used.labels(f.rule).inc()
+    for f in result.findings:
+        if f.rule == "unused-suppression":
+            sup_unused.labels(f.rule).inc()
+    files_gauge.set(result.files)

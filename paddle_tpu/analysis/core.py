@@ -1,0 +1,336 @@
+"""Shared infrastructure for the static-analysis passes.
+
+The analyzer is a plain-``ast`` framework (no runtime imports of the
+analyzed code, no execution): every pass receives a ``ModuleContext``
+carrying the parsed tree, parent links, an import-alias map for
+resolving dotted call names (``jnp.asarray`` -> ``jax.numpy.asarray``),
+and the module's suppression comments; project-wide passes additionally
+see a ``ProjectContext`` built over the whole file set (the lock pass
+uses it to flag writes to another module's guarded attributes).
+
+Findings carry ``file:line`` + a stable rule id + a fix hint, so a CI
+failure is actionable without opening the analyzer. Suppressions are
+inline comments::
+
+    some_call()  # pt-analysis: disable=rule-id -- why this is safe
+
+or, standalone on the line above the flagged statement::
+
+    # pt-analysis: disable=rule-a,rule-b -- reason
+    some_call()
+
+A reason (the ``-- ...`` tail) is mandatory — a bare suppression is
+itself a finding (``suppression-missing-reason``), and a suppression
+whose rule never fires on its line is flagged too
+(``unused-suppression``), so stale waivers cannot accumulate.
+Suppression comments are extracted with ``tokenize`` (real COMMENT
+tokens only), so string literals that merely *mention* the syntax —
+this docstring, test fixtures — can never act as waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "Rule", "RULES", "ModuleContext", "ProjectContext",
+           "Suppression", "analyze_project", "analyze_source",
+           "format_findings"]
+
+
+@dataclass
+class Finding:
+    """One diagnostic: where, which rule, what, and how to fix it."""
+
+    file: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        out = f"{self.file}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return {"file": self.file, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message, "hint": self.hint}
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    family: str
+    description: str
+    hint: str
+
+
+# The rule catalog. Pass modules look their rules up here so the CLI's
+# --list-rules, the README table, and the finding hints stay in one place.
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(id: str, family: str, description: str, hint: str) -> Rule:
+    rule = Rule(id, family, description, hint)
+    RULES[id] = rule
+    return rule
+
+
+register_rule(
+    "suppression-missing-reason", "meta",
+    "a '# pt-analysis: disable=...' comment without a '-- reason' tail",
+    "append ' -- <why this is safe>' to the suppression comment")
+register_rule(
+    "unused-suppression", "meta",
+    "a suppression whose rule produced no finding on its line",
+    "delete the stale suppression (the code it excused has moved or "
+    "been fixed)")
+register_rule(
+    "parse-error", "meta",
+    "file failed to parse as Python",
+    "fix the syntax error (the analyzer sees the same grammar as the "
+    "interpreter)")
+
+
+_SUPPRESS_RE = re.compile(
+    r"pt-analysis:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*--\s*(\S.*))?")
+
+
+@dataclass
+class Suppression:
+    line: int                 # line the suppression APPLIES to
+    comment_line: int         # line the comment sits on
+    rules: Set[str]
+    reason: Optional[str]
+    used: Set[str] = field(default_factory=set)
+
+
+def _extract_suppressions(src: str, filename: str
+                          ) -> Tuple[List[Suppression], List[Finding]]:
+    """Real COMMENT tokens only (string literals can't waive findings).
+    A comment that is the whole line applies to the next line; an inline
+    comment applies to its own line."""
+    sups: List[Suppression] = []
+    meta: List[Finding] = []
+    lines = src.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return sups, meta
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2)
+        row = tok.start[0]
+        standalone = tok.line[:tok.start[1]].strip() == ""
+        target = row
+        if standalone:
+            # a standalone (possibly multi-line) suppression comment
+            # applies to the next code line
+            target = row + 1
+            while target <= len(lines) and (
+                    not lines[target - 1].strip()
+                    or lines[target - 1].lstrip().startswith("#")):
+                target += 1
+        sups.append(Suppression(line=target, comment_line=row, rules=rules,
+                                reason=reason))
+        if not reason:
+            meta.append(Finding(
+                filename, row, tok.start[1], "suppression-missing-reason",
+                f"suppression of {sorted(rules)} has no reason",
+                RULES["suppression-missing-reason"].hint))
+    return sups, meta
+
+
+class ModuleContext:
+    """One parsed module + the lookup helpers every pass needs."""
+
+    def __init__(self, src: str, filename: str):
+        self.src = src
+        self.filename = filename
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=filename)
+        self.parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+        self.aliases = self._build_aliases()
+        self.suppressions, self.meta_findings = _extract_suppressions(
+            src, filename)
+
+    # -- tree helpers --------------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    # -- name resolution -----------------------------------------------------
+    def _build_aliases(self) -> Dict[str, str]:
+        """local name -> dotted origin ('np' -> 'numpy', 'jr' ->
+        'jax.random', 'split' -> 'jax.random.split'). Relative imports
+        keep their leading dots so callers match on suffixes."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+                    if a.asname is None and "." in a.name:
+                        out[a.name.split(".")[0]] = a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{base}.{a.name}"
+        return out
+
+    def dotted_name(self, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain through the import aliases:
+        ``jnp.asarray`` (with ``import jax.numpy as jnp``) ->
+        ``jax.numpy.asarray``. Returns None for non-name expressions."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        base = self.aliases.get(cur.id, cur.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return self.dotted_name(call.func)
+
+
+class ProjectContext:
+    """Whole-file-set view for the cross-module checks."""
+
+    def __init__(self, modules: Sequence[ModuleContext]):
+        self.modules = list(modules)
+        # class name -> {attr -> lock} across every analyzed module, and
+        # the flat guarded-attribute name set (the lock pass's
+        # foreign-write check keys on attribute names, which is precise
+        # enough for this repo's deliberately-unique stat names)
+        self.guarded_classes: Dict[str, Dict[str, str]] = {}
+        self.guarded_attr_names: Set[str] = set()
+
+
+class AnalysisResult:
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self.files: int = 0
+
+    def counts_by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def _apply_suppressions(ctx: ModuleContext,
+                        findings: List[Finding]) -> Tuple[List[Finding],
+                                                          List[Finding]]:
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_line: Dict[int, List[Suppression]] = {}
+    for sup in ctx.suppressions:
+        by_line.setdefault(sup.line, []).append(sup)
+    for f in findings:
+        hit = None
+        for sup in by_line.get(f.line, []):
+            if f.rule in sup.rules:
+                hit = sup
+                break
+        if hit is not None:
+            hit.used.add(f.rule)
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    for sup in ctx.suppressions:
+        for rule in sorted(sup.rules - sup.used):
+            if rule == "unused-suppression":
+                continue
+            kept.append(Finding(
+                ctx.filename, sup.comment_line, 0, "unused-suppression",
+                f"suppression of '{rule}' matched no finding on line "
+                f"{sup.line}", RULES["unused-suppression"].hint))
+    return kept, suppressed
+
+
+def _module_passes():
+    # imported lazily so core stays importable from the pass modules
+    from . import locks, pallas_checks, prng, trace_safety
+
+    return [trace_safety.run, prng.run, pallas_checks.run, locks.run]
+
+
+def analyze_project(sources: Sequence[Tuple[str, str]],
+                    rules: Optional[Set[str]] = None) -> AnalysisResult:
+    """Analyze ``[(filename, source), ...]`` as one project. ``rules``
+    optionally restricts the emitted rule ids (meta rules always run)."""
+    from .locks import collect_guarded
+
+    result = AnalysisResult()
+    modules: List[ModuleContext] = []
+    for filename, src in sources:
+        result.files += 1
+        try:
+            modules.append(ModuleContext(src, filename))
+        except SyntaxError as e:
+            result.findings.append(Finding(
+                filename, e.lineno or 0, e.offset or 0, "parse-error",
+                f"syntax error: {e.msg}", RULES["parse-error"].hint))
+    project = ProjectContext(modules)
+    for ctx in modules:
+        collect_guarded(ctx, project)
+    for ctx in modules:
+        findings: List[Finding] = list(ctx.meta_findings)
+        for run in _module_passes():
+            findings.extend(run(ctx, project))
+        if rules is not None:
+            findings = [f for f in findings
+                        if f.rule in rules or RULES[f.rule].family == "meta"]
+        kept, suppressed = _apply_suppressions(ctx, findings)
+        result.findings.extend(kept)
+        result.suppressed.extend(suppressed)
+    result.findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return result
+
+
+def analyze_source(src: str, filename: str = "<snippet>",
+                   rules: Optional[Set[str]] = None) -> AnalysisResult:
+    """Single-snippet convenience wrapper (the test fixtures' entry)."""
+    return analyze_project([(filename, src)], rules=rules)
+
+
+def format_findings(result: AnalysisResult) -> str:
+    lines = [f.format() for f in result.findings]
+    lines.append(
+        f"{len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, {result.files} file(s)")
+    return "\n".join(lines)

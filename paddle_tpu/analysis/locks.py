@@ -1,0 +1,234 @@
+"""Lock-discipline pass: guarded attributes must be touched under their
+lock.
+
+Annotation surface (Clang thread-safety style, Python-sized):
+
+- ``GUARDED_BY = {"_free": "_lock", ...}`` as a class attribute maps
+  attribute names to the lock attribute that protects them; or
+- a ``# guarded-by: <lock>`` comment on the ``self.attr = ...`` line
+  (usually in ``__init__``) marks one attribute.
+
+Checks inside annotated classes:
+
+- ``lock-guarded-access``: a read/write of ``self.<guarded>`` in a
+  method without an enclosing ``with self.<lock>:`` (``__init__`` /
+  ``__post_init__`` are exempt — construction happens-before
+  publication). Comprehension/generator bodies count as inline (they
+  run under the enclosing ``with``); nested ``def``/``lambda`` bodies
+  do NOT (they run later, lock released).
+- ``# holds-lock: <lock>`` on a ``def`` line declares "caller holds the
+  lock": the method's guarded accesses are fine, and CALLING it from a
+  context that does not hold the lock is ``lock-helper-unlocked-call``.
+- ``lock-foreign-write``: a write (``x.obj.attr = / += ...``) to an
+  attribute that some analyzed class guards, reached through anything
+  other than ``self`` — another object's invariants cannot be protected
+  by the caller's locks; route the write through a locked method of the
+  owning class. (Writes only: guarded-attr names in this repo are
+  unique enough that this is precise; reads are left to the owning
+  class's accessors.)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from .core import Finding, ModuleContext, ProjectContext, RULES, register_rule
+
+register_rule(
+    "lock-guarded-access", "locks",
+    "read/write of a guarded attribute outside 'with self.<lock>'",
+    "wrap the access in `with self.<lock>:`, move it into a locked "
+    "method, or annotate the method `# holds-lock: <lock>` if every "
+    "caller already holds it")
+register_rule(
+    "lock-helper-unlocked-call", "locks",
+    "call to a '# holds-lock' helper from a context that does not hold "
+    "the lock",
+    "take the lock around the call (`with self.<lock>:`), or call a "
+    "public locked wrapper instead of the unlocked helper")
+register_rule(
+    "lock-foreign-write", "locks",
+    "write to another object's guarded attribute — the caller's locks "
+    "cannot protect a foreign object's invariants",
+    "add a locked mutator method on the owning class and call that "
+    "instead of poking the attribute")
+
+_GUARDED_COMMENT = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_HOLDS_COMMENT = re.compile(r"#\s*holds-lock:\s*([A-Za-z_]\w*)")
+
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "__new__"}
+
+
+def _line(ctx: ModuleContext, lineno: int) -> str:
+    if 1 <= lineno <= len(ctx.lines):
+        return ctx.lines[lineno - 1]
+    return ""
+
+
+def _guarded_map(ctx: ModuleContext, cls: ast.ClassDef) -> Dict[str, str]:
+    guarded: Dict[str, str] = {}
+    # class-level GUARDED_BY = {...}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Dict):
+            names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+            if "GUARDED_BY" in names:
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                            v, ast.Constant):
+                        guarded[str(k.value)] = str(v.value)
+    # `self.x = ...  # guarded-by: _lock` lines anywhere in the class
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        m = _GUARDED_COMMENT.search(_line(ctx, node.lineno))
+        if not m:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id == "self":
+                guarded[t.attr] = m.group(1)
+    return guarded
+
+
+def collect_guarded(ctx: ModuleContext, project: ProjectContext) -> None:
+    """Phase-1 hook: record every class's guarded map into the project
+    context so the foreign-write check sees the whole file set."""
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = _guarded_map(ctx, cls)
+        if guarded:
+            project.guarded_classes[cls.name] = guarded
+            project.guarded_attr_names.update(guarded)
+
+
+def _holds_locks(ctx: ModuleContext, fn: ast.FunctionDef) -> Set[str]:
+    """`# holds-lock: <name>` on the def line, a decorator line, or the
+    line directly above the def."""
+    out: Set[str] = set()
+    lines = [fn.lineno] + [d.lineno for d in fn.decorator_list]
+    first = min(lines)
+    for lineno in lines + [first - 1]:
+        m = _HOLDS_COMMENT.search(_line(ctx, lineno))
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def _lock_held_at(ctx: ModuleContext, node: ast.AST,
+                  method: ast.FunctionDef, lock: str) -> bool:
+    """Is ``node`` under ``with self.<lock>``? Crossing a nested
+    def/lambda boundary discards held locks (deferred execution)."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and anc is not method:
+            return False
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) and e.attr == lock \
+                        and isinstance(e.value, ast.Name) \
+                        and e.value.id == "self":
+                    return True
+        if anc is method:
+            break
+    return False
+
+
+def _check_class(ctx: ModuleContext, cls: ast.ClassDef,
+                 guarded: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    holds: Dict[str, Set[str]] = {m.name: _holds_locks(ctx, m)
+                                  for m in methods}
+
+    for method in methods:
+        if method.name in _EXEMPT_METHODS:
+            continue
+        method_holds = holds.get(method.name, set())
+        for node in ast.walk(method):
+            # guarded self-attribute accesses
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name) and node.value.id == "self" \
+                    and node.attr in guarded:
+                lock = guarded[node.attr]
+                if lock in method_holds:
+                    continue
+                if _lock_held_at(ctx, node, method, lock):
+                    continue
+                kind = "write to" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "read of"
+                findings.append(Finding(
+                    ctx.filename, node.lineno, node.col_offset,
+                    "lock-guarded-access",
+                    f"{kind} '{cls.name}.{node.attr}' (guarded by "
+                    f"'{lock}') outside 'with self.{lock}' in "
+                    f"'{method.name}'", RULES["lock-guarded-access"].hint))
+            # calls to holds-lock helpers without the lock
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                callee = node.func.attr
+                needed = holds.get(callee, set())
+                for lock in sorted(needed):
+                    if lock in method_holds:
+                        continue
+                    if _lock_held_at(ctx, node, method, lock):
+                        continue
+                    findings.append(Finding(
+                        ctx.filename, node.lineno, node.col_offset,
+                        "lock-helper-unlocked-call",
+                        f"'{method.name}' calls '# holds-lock: {lock}' "
+                        f"helper '{callee}' without holding "
+                        f"'self.{lock}'",
+                        RULES["lock-helper-unlocked-call"].hint))
+    return findings
+
+
+def _check_foreign_writes(ctx: ModuleContext,
+                          project: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    if not project.guarded_attr_names:
+        return findings
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Attribute)
+                    and t.attr in project.guarded_attr_names):
+                continue
+            base = t.value
+            # self.attr writes are the owning class's business (checked
+            # above); anything deeper (self.pool.attr, obj.attr) is a
+            # foreign write
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                continue
+            owner = [c for c, g in project.guarded_classes.items()
+                     if t.attr in g]
+            findings.append(Finding(
+                ctx.filename, t.lineno, t.col_offset, "lock-foreign-write",
+                f"write to guarded attribute '{t.attr}' (guarded in "
+                f"{', '.join(sorted(owner))}) through a foreign object",
+                RULES["lock-foreign-write"].hint))
+    return findings
+
+
+def run(ctx: ModuleContext, project: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if isinstance(cls, ast.ClassDef):
+            guarded = _guarded_map(ctx, cls)
+            if guarded:
+                findings.extend(_check_class(ctx, cls, guarded))
+    findings.extend(_check_foreign_writes(ctx, project))
+    return findings

@@ -1,0 +1,302 @@
+"""Pallas kernel structure checks for ``pallas_kernels/*``.
+
+A Pallas grid/BlockSpec mismatch is the nastiest class of kernel bug:
+nothing fails at trace time, the kernel just reads the wrong block (or
+silently drops the tail of the array). Three structural invariants are
+fully decidable from the AST, because this repo builds its grid specs
+as literals inside the same function as the ``pallas_call``:
+
+- ``pallas-indexmap-arity``: every BlockSpec index map must accept
+  exactly ``grid_rank + num_scalar_prefetch`` arguments (the prefetch
+  refs are appended to the grid coordinates).
+- ``pallas-indexmap-rank``: an index map must return as many
+  coordinates as its block shape has dimensions.
+- ``pallas-kernel-arity``: the kernel function must accept
+  ``num_scalar_prefetch + len(in_specs) + len(out_specs)`` refs
+  (skipped when the spec lists are built dynamically or the kernel
+  takes ``*args``).
+- ``pallas-block-divide``: a grid dimension computed as ``total //
+  block`` requires ``block`` to divide ``total`` — otherwise the tail
+  blocks are silently never visited. The block must come from
+  ``_blocks.pick_block`` (which halves until it divides) or the
+  function must contain an explicit ``total % block`` check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, ModuleContext, ProjectContext, RULES, register_rule
+
+register_rule(
+    "pallas-indexmap-arity", "pallas",
+    "BlockSpec index map arity != grid rank + num_scalar_prefetch — "
+    "Pallas passes one argument per grid dimension plus every "
+    "scalar-prefetch ref",
+    "make the index map take exactly (grid_rank + num_scalar_prefetch) "
+    "parameters, in grid order then prefetch order")
+register_rule(
+    "pallas-indexmap-rank", "pallas",
+    "BlockSpec index map returns a different number of coordinates "
+    "than the block shape has dimensions",
+    "return one block coordinate per block-shape dimension")
+register_rule(
+    "pallas-kernel-arity", "pallas",
+    "kernel ref count != num_scalar_prefetch + len(in_specs) + "
+    "len(out_specs)",
+    "give the kernel one ref parameter per scalar-prefetch array, "
+    "input spec, and output spec — in that order")
+register_rule(
+    "pallas-block-divide", "pallas",
+    "grid dimension 'total // block' where nothing guarantees block "
+    "divides total — the remainder is silently never computed",
+    "route the block size through pallas_kernels._blocks.pick_block "
+    "(halves until it divides) or add an explicit 'total % block' "
+    "check that raises")
+
+
+def _const_tuple_len(node: ast.AST) -> Optional[int]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    return None
+
+
+def _local_assignments(fn: ast.AST) -> Dict[str, ast.AST]:
+    """name -> last assigned value expression within ``fn`` (single
+    targets only — good enough for grid/spec literals)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _resolve(node: ast.AST, env: Dict[str, ast.AST],
+             depth: int = 0) -> ast.AST:
+    while isinstance(node, ast.Name) and node.id in env and depth < 8:
+        node = env[node.id]
+        depth += 1
+    return node
+
+
+def _callee_is(ctx: ModuleContext, node: ast.AST, suffix: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = ctx.call_name(node)
+    return bool(name and name.endswith(suffix))
+
+
+def _fn_arity(fn_node: ast.AST, env_defs: Dict[str, ast.FunctionDef]
+              ) -> Optional[Tuple[int, bool]]:
+    """(positional arity, has_varargs) of a lambda or resolvable def."""
+    target = None
+    if isinstance(fn_node, ast.Lambda):
+        target = fn_node
+    elif isinstance(fn_node, ast.Name) and fn_node.id in env_defs:
+        target = env_defs[fn_node.id]
+    if target is None:
+        return None
+    a = target.args
+    return (len(a.posonlyargs) + len(a.args), a.vararg is not None)
+
+
+def _fn_return_len(fn_node: ast.AST, env_defs: Dict[str, ast.FunctionDef]
+                   ) -> Optional[int]:
+    if isinstance(fn_node, ast.Lambda):
+        return _const_tuple_len(fn_node.body)
+    if isinstance(fn_node, ast.Name) and fn_node.id in env_defs:
+        returns = [n for n in ast.walk(env_defs[fn_node.id])
+                   if isinstance(n, ast.Return) and n.value is not None]
+        lens = {_const_tuple_len(r.value) for r in returns}
+        if len(lens) == 1:
+            return lens.pop()
+    return None
+
+
+def _collect_blockspecs(ctx: ModuleContext, node: ast.AST,
+                        env: Dict[str, ast.AST]) -> Tuple[List[ast.Call],
+                                                          bool]:
+    """BlockSpec call nodes reachable from an in_specs/out_specs
+    expression. Returns (specs, complete): ``complete`` is False when
+    the expression involves anything we cannot enumerate statically
+    (function results, conditional appends)."""
+    node = _resolve(node, env)
+    if _callee_is(ctx, node, "BlockSpec"):
+        return [node], True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        specs: List[ast.Call] = []
+        complete = True
+        for elt in node.elts:
+            sub, ok = _collect_blockspecs(ctx, elt, env)
+            specs.extend(sub)
+            complete = complete and ok
+        return specs, complete
+    return [], False
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _PallasCallSite:
+    """One pl.pallas_call with its statically-resolved grid context."""
+
+    def __init__(self, ctx: ModuleContext, call: ast.Call,
+                 fn: ast.AST):
+        self.ctx = ctx
+        self.call = call
+        self.env = _local_assignments(fn)
+        self.defs = {n.name: n for n in ast.walk(fn)
+                     if isinstance(n, ast.FunctionDef)}
+        self._fn_nodes = list(ast.walk(fn))
+        self.prefetch = 0
+        self.grid_node: Optional[ast.AST] = None
+        in_specs = _kw(call, "in_specs")
+        out_specs = _kw(call, "out_specs")
+        grid = _kw(call, "grid")
+        spec = _kw(call, "grid_spec")
+        if spec is not None:
+            spec = _resolve(spec, self.env)
+            if _callee_is(ctx, spec, "PrefetchScalarGridSpec") or \
+                    _callee_is(ctx, spec, "GridSpec"):
+                pf = _kw(spec, "num_scalar_prefetch")
+                if isinstance(pf, ast.Constant) and isinstance(pf.value, int):
+                    self.prefetch = pf.value
+                grid = _kw(spec, "grid")
+                in_specs = _kw(spec, "in_specs")
+                out_specs = _kw(spec, "out_specs")
+        self.grid_node = _resolve(grid, self.env) if grid is not None \
+            else None
+        self.grid_rank = _const_tuple_len(self.grid_node) \
+            if self.grid_node is not None else None
+        self.in_specs, self.in_complete = (
+            _collect_blockspecs(ctx, in_specs, self.env)
+            if in_specs is not None else ([], False))
+        if out_specs is not None:
+            out_resolved = _resolve(out_specs, self.env)
+            if _callee_is(ctx, out_resolved, "BlockSpec"):
+                self.out_specs, self.out_complete = [out_resolved], True
+            else:
+                self.out_specs, self.out_complete = _collect_blockspecs(
+                    ctx, out_specs, self.env)
+        else:
+            self.out_specs, self.out_complete = [], False
+
+    # -- checks --------------------------------------------------------------
+    def check(self) -> List[Finding]:
+        out: List[Finding] = []
+        ctx = self.ctx
+        expected_args = (self.grid_rank + self.prefetch
+                         if self.grid_rank is not None else None)
+        for spec in self.in_specs + self.out_specs:
+            shape = spec.args[0] if spec.args else None
+            idx = spec.args[1] if len(spec.args) > 1 \
+                else _kw(spec, "index_map")
+            if idx is None:
+                continue
+            arity = _fn_arity(idx, self.defs)
+            if arity is not None and expected_args is not None:
+                n, varargs = arity
+                if not varargs and n != expected_args:
+                    out.append(Finding(
+                        ctx.filename, spec.lineno, spec.col_offset,
+                        "pallas-indexmap-arity",
+                        f"index map takes {n} arg(s) but the grid has "
+                        f"rank {self.grid_rank} with {self.prefetch} "
+                        f"scalar-prefetch ref(s) (expected "
+                        f"{expected_args})",
+                        RULES["pallas-indexmap-arity"].hint))
+            shape_len = _const_tuple_len(shape) if shape is not None \
+                else None
+            ret_len = _fn_return_len(idx, self.defs)
+            if shape_len is not None and ret_len is not None \
+                    and shape_len != ret_len:
+                out.append(Finding(
+                    ctx.filename, spec.lineno, spec.col_offset,
+                    "pallas-indexmap-rank",
+                    f"index map returns {ret_len} coordinate(s) for a "
+                    f"{shape_len}-dimensional block shape",
+                    RULES["pallas-indexmap-rank"].hint))
+        out.extend(self._check_kernel_arity())
+        out.extend(self._check_grid_divisibility())
+        return out
+
+    def _check_kernel_arity(self) -> List[Finding]:
+        if not (self.in_complete and self.out_complete):
+            return []
+        kernel = self.call.args[0] if self.call.args else None
+        arity = _fn_arity(kernel, self.defs) if kernel is not None else None
+        if arity is None:
+            return []
+        n, varargs = arity
+        if varargs:
+            return []
+        expected = self.prefetch + len(self.in_specs) + len(self.out_specs)
+        if n != expected:
+            return [Finding(
+                self.ctx.filename, self.call.lineno, self.call.col_offset,
+                "pallas-kernel-arity",
+                f"kernel takes {n} ref(s) but pallas_call provides "
+                f"{expected} ({self.prefetch} scalar-prefetch + "
+                f"{len(self.in_specs)} in + {len(self.out_specs)} out)",
+                RULES["pallas-kernel-arity"].hint)]
+        return []
+
+    def _check_grid_divisibility(self) -> List[Finding]:
+        if self.grid_node is None or not isinstance(
+                self.grid_node, (ast.Tuple, ast.List)):
+            return []
+        out: List[Finding] = []
+        for entry in self.grid_node.elts:
+            resolved = _resolve(entry, self.env)
+            if not (isinstance(resolved, ast.BinOp)
+                    and isinstance(resolved.op, ast.FloorDiv)):
+                continue
+            total, block = resolved.left, resolved.right
+            if isinstance(block, ast.Constant) and block.value == 1:
+                continue
+            if not isinstance(block, ast.Name):
+                continue
+            if self._block_is_safe(total, block.id):
+                continue
+            out.append(Finding(
+                self.ctx.filename, resolved.lineno, resolved.col_offset,
+                "pallas-block-divide",
+                f"grid dimension '{ast.unparse(resolved)}' — "
+                f"'{block.id}' is not pick_block-derived and no "
+                f"divisibility check guards it; a non-dividing block "
+                f"size silently drops the tail",
+                RULES["pallas-block-divide"].hint))
+        return out
+
+    def _block_is_safe(self, total: ast.AST, block_name: str) -> bool:
+        # (a) block assigned from pick_block(...) in this function
+        value = self.env.get(block_name)
+        if value is not None and _callee_is(self.ctx, value, "pick_block"):
+            return True
+        # (b) an explicit `... % block` check anywhere in the function
+        #     (a guard that raises, or a fix-up loop)
+        for node in self._fn_nodes:
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                if isinstance(node.right, ast.Name) \
+                        and node.right.id == block_name:
+                    return True
+        return False
+
+
+def run(ctx: ModuleContext, project: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _callee_is(ctx, node, "pallas_call")):
+            continue
+        # the call's statically-visible context is its innermost
+        # enclosing function (module scope for top-level calls)
+        owner = ctx.enclosing_function(node) or ctx.tree
+        findings.extend(_PallasCallSite(ctx, node, owner).check())
+    return findings

@@ -1,0 +1,262 @@
+"""PRNG-discipline pass: key reuse and non-chain key derivation.
+
+The serving/generation bit-parity contract rests on one invariant: the
+PRNG chain advances EXACTLY one ``split`` per emitted token, and every
+consuming call (``jax.random.categorical`` and friends, the repo's
+``select_tokens``/``_select_token`` samplers) receives a subkey that is
+used ONCE. A reused key makes two draws correlated (speculative-decode
+coupling silently breaks, sampled outputs diverge from the
+``generate`` oracle); a key derived from wall clock or ``np.random``
+breaks replay determinism (preemption resume, kill-and-restart).
+
+Dataflow is per-function and linear (source order), which matches how
+chain code is actually written:
+
+- TRACKED keys: names bound from ``jax.random.PRNGKey`` / ``split`` /
+  ``fold_in`` (tuple unpacking included), from the repo's chain
+  helpers (``split_keys``, ``split_key_levels``), and parameters whose
+  name looks like a key (``key``, ``keys``, ``subkey``, ``rng`` ...).
+- CONSUMERS: ``jax.random.<draw>`` calls and the known sampler helpers.
+  A consumption marks the key spent; a second consumption of a spent
+  key without an interleaving re-split is ``prng-key-reuse``.
+- LOOPS: consuming a key inside a for/while whose body never refreshes
+  it is reuse-per-iteration and flagged too — unless the consuming
+  expression indexes the key by the loop variable (``subs[:, j]``: a
+  pre-split level walk, each iteration uses a distinct subkey).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, ModuleContext, ProjectContext, RULES, register_rule
+
+register_rule(
+    "prng-key-reuse", "prng",
+    "the same PRNG key is consumed by two draws without an "
+    "interleaving split/fold_in — the draws are correlated and the "
+    "one-split-per-token chain contract is broken",
+    "split first: `key, sub = jax.random.split(key)` and consume `sub` "
+    "exactly once (or fold_in a distinct constant per consumer)")
+register_rule(
+    "prng-nonchain-seed", "prng",
+    "PRNG key derived from a non-chain source (wall clock, os entropy, "
+    "np.random) — replay (preemption resume, kill-and-restart, "
+    "speculative coupling) can no longer reproduce the draw",
+    "derive the key from the request/config seed via "
+    "PRNGKey(seed)/fold_in so the chain is a pure function of "
+    "(seed, tokens emitted)")
+
+# producers: a call whose result is a fresh (unconsumed) key
+_PRODUCER_SUFFIX = ("jax.random.PRNGKey", "jax.random.key",
+                    "jax.random.split", "jax.random.fold_in",
+                    "jax.random.clone")
+_PRODUCER_LOCAL = {"split_keys", "split_key_levels"}
+
+# consumers: a call that SPENDS the key it is given
+_CONSUMER_DRAWS = {
+    "categorical", "normal", "uniform", "bernoulli", "gumbel", "choice",
+    "permutation", "randint", "truncated_normal", "bits", "exponential",
+    "laplace", "dirichlet", "gamma", "poisson", "beta", "binomial",
+    "cauchy", "loggamma", "maxwell", "rayleigh", "t", "shuffle",
+    "ball", "orthogonal", "rademacher",
+}
+_CONSUMER_LOCAL = {"select_tokens", "_select_token"}
+
+# seeds that are not a deterministic chain function
+_NONCHAIN_EXACT = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "os.urandom", "os.getpid", "uuid.uuid4", "id",
+}
+_NONCHAIN_PREFIX = ("random.", "numpy.random.", "secrets.")
+
+_KEYLIKE = re.compile(r"(^|_)(key|keys|subkey|subkeys|rng|prng)s?($|_)|key$")
+
+
+def _is_producer(ctx: ModuleContext, call: ast.Call) -> bool:
+    name = ctx.call_name(call)
+    if not name:
+        return False
+    if any(name.endswith(s) for s in _PRODUCER_SUFFIX):
+        return True
+    return name.rsplit(".", 1)[-1] in _PRODUCER_LOCAL
+
+
+def _is_consumer(ctx: ModuleContext, call: ast.Call) -> bool:
+    name = ctx.call_name(call)
+    if not name:
+        return False
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] == "random" \
+            and parts[-1] in _CONSUMER_DRAWS:
+        return True
+    return parts[-1] in _CONSUMER_LOCAL
+
+
+def _nonchain_source(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = ctx.call_name(sub)
+            if name and (name in _NONCHAIN_EXACT
+                         or name.startswith(_NONCHAIN_PREFIX)):
+                return name
+    return None
+
+
+def _loop_vars(ctx: ModuleContext, node: ast.AST,
+               stop: ast.FunctionDef) -> Set[str]:
+    """Loop variables of every for-loop enclosing ``node`` within the
+    function (plus comprehension targets)."""
+    out: Set[str] = set()
+    for anc in ctx.ancestors(node):
+        if anc is stop:
+            break
+        if isinstance(anc, ast.For):
+            for t in ast.walk(anc.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        if isinstance(anc, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            for gen in anc.generators:
+                for t in ast.walk(gen.target):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+class _FnScan:
+    """Linear (source-order) scan of one function body."""
+
+    def __init__(self, ctx: ModuleContext, fn: ast.FunctionDef):
+        self.ctx = ctx
+        self.fn = fn
+        self.findings: List[Finding] = []
+        # key name -> ("fresh"|"spent", line of last event)
+        self.state: Dict[str, tuple] = {}
+        for name in self._param_keys():
+            self.state[name] = ("fresh", fn.lineno)
+
+    def _param_keys(self) -> Set[str]:
+        args = self.fn.args
+        names = {a.arg for a in args.posonlyargs + args.args
+                 + args.kwonlyargs}
+        return {n for n in names if _KEYLIKE.search(n)}
+
+    # -- events --------------------------------------------------------------
+    def _bind(self, target: ast.AST, fresh: bool, line: int):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, fresh, line)
+        elif isinstance(target, ast.Name):
+            if fresh:
+                self.state[target.id] = ("fresh", line)
+            else:
+                self.state.pop(target.id, None)
+
+    def _loops_without_refresh(self, call: ast.Call, name: str) -> bool:
+        """Consumption inside a loop whose body never rebinds ``name``
+        from a producer — every iteration reuses the same key."""
+        for anc in self.ctx.ancestors(call):
+            if anc is self.fn:
+                break
+            if isinstance(anc, (ast.For, ast.While)):
+                for sub in ast.walk(anc):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                            sub.value, ast.Call) \
+                            and _is_producer(self.ctx, sub.value):
+                        bound: Set[str] = set()
+                        for t in sub.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    bound.add(n.id)
+                        if name in bound:
+                            return False
+                return True
+        return False
+
+    def _consume(self, call: ast.Call):
+        loop_vars = _loop_vars(self.ctx, call, self.fn)
+        spent_here: Set[str] = set()
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if not (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in self.state):
+                    continue
+                # `subs[:, j]`: indexed by the loop variable — each
+                # iteration consumes a DIFFERENT pre-split level
+                parent = self.ctx.parent(sub)
+                if isinstance(parent, ast.Subscript) \
+                        and parent.value is sub and any(
+                            isinstance(n, ast.Name) and n.id in loop_vars
+                            for n in ast.walk(parent.slice)):
+                    continue
+                name = sub.id
+                if name in spent_here:
+                    continue
+                status, line = self.state[name]
+                if status == "spent":
+                    self.findings.append(Finding(
+                        self.ctx.filename, call.lineno, call.col_offset,
+                        "prng-key-reuse",
+                        f"key '{name}' already consumed (line {line}) is "
+                        f"consumed again without a split/fold_in in "
+                        f"'{self.fn.name}'", RULES["prng-key-reuse"].hint))
+                elif self._loops_without_refresh(call, name):
+                    self.findings.append(Finding(
+                        self.ctx.filename, call.lineno, call.col_offset,
+                        "prng-key-reuse",
+                        f"key '{name}' is consumed every loop iteration "
+                        f"in '{self.fn.name}' without being re-split in "
+                        f"the loop body", RULES["prng-key-reuse"].hint))
+                self.state[name] = ("spent", call.lineno)
+                spent_here.add(name)
+
+    # -- walk ----------------------------------------------------------------
+    def scan(self) -> List[Finding]:
+        nodes = [n for n in ast.walk(self.fn)
+                 if self.ctx.enclosing_function(n) is self.fn
+                 or n is self.fn]
+        # linear source order: good enough for straight-line chain code
+        nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                  getattr(n, "col_offset", 0)))
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                is_prod = isinstance(node.value, ast.Call) \
+                    and _is_producer(self.ctx, node.value)
+                if is_prod:
+                    src = _nonchain_source(self.ctx, node.value)
+                    if src:
+                        self.findings.append(Finding(
+                            self.ctx.filename, node.lineno,
+                            node.col_offset, "prng-nonchain-seed",
+                            f"PRNG key seeded from '{src}' in "
+                            f"'{self.fn.name}'",
+                            RULES["prng-nonchain-seed"].hint))
+                for t in node.targets:
+                    self._bind(t, is_prod, node.lineno)
+            elif isinstance(node, ast.Call):
+                if _is_consumer(self.ctx, node):
+                    self._consume(node)
+                elif _is_producer(self.ctx, node) and not isinstance(
+                        self.ctx.parent(node), ast.Assign):
+                    src = _nonchain_source(self.ctx, node)
+                    if src:
+                        self.findings.append(Finding(
+                            self.ctx.filename, node.lineno,
+                            node.col_offset, "prng-nonchain-seed",
+                            f"PRNG key seeded from '{src}' in "
+                            f"'{self.fn.name}'",
+                            RULES["prng-nonchain-seed"].hint))
+        return self.findings
+
+
+def run(ctx: ModuleContext, project: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_FnScan(ctx, fn).scan())
+    return findings

@@ -1,0 +1,46 @@
+"""Source-location resolution shared with the runtime monitors.
+
+The recompile monitor's retrace warning names the jitted *entry* that
+recompiled; this helper turns the entry's callable into the
+``file:line`` of its definition so the runtime warning and the static
+analyzer's findings cross-reference the same place in the tree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["source_location"]
+
+_REPO_MARKER = os.sep + "paddle_tpu" + os.sep
+
+
+def source_location(fn) -> Optional[str]:
+    """``file:line`` of a callable's definition (repo-relative when the
+    file lives under the package), or None for builtins/C functions."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # layers / partials: follow the usual wrappers
+        for attr in ("__wrapped__", "func", "__call__"):
+            inner = getattr(fn, attr, None)
+            code = getattr(inner, "__code__", None)
+            if code is not None:
+                break
+    if code is None:
+        cls = fn if isinstance(fn, type) else type(fn)
+        try:
+            import inspect
+
+            path = inspect.getsourcefile(cls)
+            line = inspect.getsourcelines(cls)[1]
+        except (OSError, TypeError):
+            return None
+        return f"{_shorten(path)}:{line}"
+    return f"{_shorten(code.co_filename)}:{code.co_firstlineno}"
+
+
+def _shorten(path: str) -> str:
+    if path and _REPO_MARKER in path:
+        return "paddle_tpu" + os.sep + path.split(_REPO_MARKER, 1)[1]
+    return path or "<unknown>"

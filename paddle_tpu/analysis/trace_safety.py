@@ -1,0 +1,377 @@
+"""Trace-safety pass: host syncs, impurity, and Python control flow on
+traced values inside jit-compiled functions.
+
+Scope. Whole-repo call-graph reachability is neither cheap nor precise
+in Python, so the pass anchors on what is *textually jitted* — functions
+decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)`` /
+``to_static``, or passed to a ``jax.jit(...)`` call — and propagates
+reachability through direct by-name calls to functions defined in the
+same module (nearest enclosing scope first, then module scope). That
+covers this repo's idiom exactly: ``jit/api.py`` and the serving engine
+build their jitted entries as local defs that call module-level helpers
+(``select_tokens``, ``split_keys``, ``update_static_kv_cache``...), and
+those helpers are where a stray host sync would hide. Cross-module
+calls are deliberately out of scope (the callee is analyzed when its
+own module's jit roots reach it).
+
+Inside the reach set, a function's parameters are treated as traced
+values. The checks are tuned against known-static idioms so the pass
+runs clean over intentional code: ``x is None``, ``isinstance``,
+``.shape``/``.ndim``/``.dtype`` attribute reads and ``len()`` are all
+trace-time constants and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleContext, ProjectContext, RULES, register_rule
+
+register_rule(
+    "trace-host-sync", "trace-safety",
+    "host synchronization inside a jitted function: .item(), "
+    "float()/int()/bool() on a traced value, or numpy materialization "
+    "of a tracer — each one blocks dispatch and can force a retrace",
+    "keep the value on device (jnp ops / lax.cond select) or hoist the "
+    "host read out of the jitted function")
+register_rule(
+    "trace-impure-call", "trace-safety",
+    "impure host call (time/random/datetime) inside a jitted function "
+    "— the value is baked in at trace time and silently frozen",
+    "pass the value in as an argument (traced) or compute it outside "
+    "the jitted function")
+register_rule(
+    "trace-py-branch", "trace-safety",
+    "Python if/while on a traced value inside a jitted function — "
+    "either a ConcretizationTypeError at runtime or a per-value retrace",
+    "use jax.lax.cond / jax.lax.while_loop / jnp.where, or mark the "
+    "argument static")
+register_rule(
+    "trace-mutable-capture", "trace-safety",
+    "jitted function closes over a mutable container (list/dict/set) "
+    "that the enclosing scope also mutates — the capture is baked in at "
+    "trace time, later mutations are silently ignored (or retrace)",
+    "pass the container's contents as traced arguments, or make the "
+    "capture immutable (tuple) at trace time")
+
+# host-call tables ----------------------------------------------------------
+_IMPURE_EXACT = {
+    "time.time", "time.perf_counter", "time.perf_counter_ns",
+    "time.monotonic", "time.monotonic_ns", "time.time_ns", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.date.today",
+    "os.urandom", "uuid.uuid4",
+}
+_IMPURE_PREFIX = ("random.", "numpy.random.", "secrets.")
+
+# numpy calls that materialize their array argument on the host (a
+# tracer passed to one of these forces a device sync / trace failure)
+_NP_MATERIALIZE = {"asarray", "array", "ascontiguousarray", "asfortranarray",
+                   "copy", "frombuffer", "save", "savez"}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval"}
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop", "popitem",
+             "remove", "discard", "clear", "setdefault"}
+
+
+def _is_jit_decorator(ctx: ModuleContext, dec: ast.AST) -> bool:
+    name = ctx.dotted_name(dec)
+    if name and (name.endswith("jax.jit") or name.endswith("to_static")):
+        return True
+    if isinstance(dec, ast.Call):
+        fname = ctx.call_name(dec)
+        if fname and fname.endswith("jax.jit"):
+            return True  # jax.jit(static_argnums=...) used as decorator
+        if fname and fname.endswith("functools.partial") and dec.args:
+            inner = ctx.dotted_name(dec.args[0])
+            return bool(inner and inner.endswith("jax.jit"))
+    return False
+
+
+def _collect_functions(ctx: ModuleContext) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _jit_roots(ctx: ModuleContext,
+               fns: List[ast.FunctionDef]) -> Set[ast.FunctionDef]:
+    roots: Set[ast.FunctionDef] = set()
+    by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for fn in fns:
+        by_name.setdefault(fn.name, []).append(fn)
+        if any(_is_jit_decorator(ctx, d) for d in fn.decorator_list):
+            roots.add(fn)
+    # fn passed to a jax.jit(...) call: jitted = jax.jit(fn)
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        name = ctx.call_name(call)
+        if not (name and name.endswith("jax.jit")):
+            continue
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name):
+                for fn in by_name.get(arg.id, []):
+                    roots.add(fn)
+    return roots
+
+
+def _resolve_call(ctx: ModuleContext, call: ast.Call,
+                  site_fn: ast.FunctionDef,
+                  fns: List[ast.FunctionDef]) -> Optional[ast.FunctionDef]:
+    """A by-name call resolved to a def visible from the call site:
+    nearest enclosing function scope first, then module scope."""
+    if not isinstance(call.func, ast.Name):
+        return None
+    target = call.func.id
+    scope_chain = [site_fn] + [a for a in ctx.ancestors(site_fn)
+                               if isinstance(a, ast.FunctionDef)]
+    candidates = [fn for fn in fns if fn.name == target]
+    for scope in scope_chain:
+        for fn in candidates:
+            if ctx.parent(fn) is scope:
+                return fn
+    for fn in candidates:  # module level
+        if isinstance(ctx.parent(fn), ast.Module):
+            return fn
+    return None
+
+
+def _reach_set(ctx: ModuleContext) -> Set[ast.FunctionDef]:
+    fns = _collect_functions(ctx)
+    reach = _jit_roots(ctx, fns)
+    frontier = list(reach)
+    while frontier:
+        fn = frontier.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = _resolve_call(ctx, node, fn, fns)
+                if callee is not None and callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+    return reach
+
+
+def _jit_static_params(ctx: ModuleContext, fn: ast.FunctionDef) -> Set[str]:
+    """Parameters declared static on the jit decorator
+    (``static_argnums`` / ``static_argnames``) — NOT traced values."""
+    positional = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Set[str] = set()
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnums":
+                nums = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                for n in nums:
+                    if isinstance(n, ast.Constant) \
+                            and isinstance(n.value, int) \
+                            and 0 <= n.value < len(positional):
+                        out.add(positional[n.value])
+            elif kw.arg == "static_argnames":
+                names = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                for n in names:
+                    if isinstance(n, ast.Constant):
+                        out.add(str(n.value))
+    return out
+
+
+def _param_names(ctx: ModuleContext, fn: ast.FunctionDef) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names - _jit_static_params(ctx, fn)
+
+
+def _traced_names_in(node: ast.AST, ctx: ModuleContext,
+                     traced: Set[str]) -> List[ast.Name]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in traced \
+                and isinstance(sub.ctx, ast.Load):
+            parent = ctx.parent(sub)
+            if isinstance(parent, ast.Attribute) and parent.value is sub \
+                    and parent.attr in _STATIC_ATTRS:
+                continue  # x.shape / x.ndim: static under tracing
+            out.append(sub)
+    return out
+
+
+def _branch_is_static(ctx: ModuleContext, test: ast.AST,
+                      traced: Set[str]) -> bool:
+    """Known-static condition shapes: is/is-not comparisons, isinstance
+    and other calls (host predicates over static structure), attribute
+    reads (config flags, .ndim), len(), pure-constant tests."""
+    for sub in ast.walk(test):
+        # `x is None` is identity; `vid in skip_vids` is host-container
+        # membership — both are trace-time constants in this codebase
+        if isinstance(sub, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in sub.ops):
+            return True
+    names = []
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            return True  # a call's truthiness is the callee's contract
+        if isinstance(sub, ast.Name) and sub.id in traced \
+                and isinstance(sub.ctx, ast.Load):
+            parent = ctx.parent(sub)
+            if isinstance(parent, ast.Attribute):
+                continue  # cfg.do_sample / x.ndim: static attributes
+            names.append(sub)
+    return not names
+
+
+def _check_function(ctx: ModuleContext, fn: ast.FunctionDef,
+                    reach: Set[ast.FunctionDef]) -> List[Finding]:
+    findings: List[Finding] = []
+    traced = _param_names(ctx, fn)
+
+    for node in ast.walk(fn):
+        # don't descend into nested defs that are separately in/out of
+        # the reach set — they are visited on their own
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        owner = ctx.enclosing_function(node)
+        while owner is not None and owner is not fn \
+                and owner not in reach:
+            owner = ctx.enclosing_function(owner)
+        if owner is not fn:
+            continue
+
+        if isinstance(node, ast.Call):
+            name = ctx.call_name(node)
+            # .item() on anything is a device->host sync
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                findings.append(Finding(
+                    ctx.filename, node.lineno, node.col_offset,
+                    "trace-host-sync",
+                    f"'.item()' inside jitted '{fn.name}' forces a "
+                    f"device->host sync (ConcretizationTypeError under "
+                    f"trace)", RULES["trace-host-sync"].hint))
+                continue
+            if name in ("float", "int", "bool") and len(node.args) == 1:
+                arg = node.args[0]
+                if _traced_names_in(arg, ctx, traced) and not any(
+                        isinstance(s, ast.Call) and
+                        ctx.call_name(s) == "len" for s in ast.walk(arg)):
+                    findings.append(Finding(
+                        ctx.filename, node.lineno, node.col_offset,
+                        "trace-host-sync",
+                        f"'{name}()' on traced value inside jitted "
+                        f"'{fn.name}'", RULES["trace-host-sync"].hint))
+                continue
+            if name:
+                parts = name.split(".")
+                if parts[0] == "numpy" and len(parts) == 2 \
+                        and parts[1] in _NP_MATERIALIZE:
+                    if any(_traced_names_in(a, ctx, traced)
+                           for a in node.args):
+                        findings.append(Finding(
+                            ctx.filename, node.lineno, node.col_offset,
+                            "trace-host-sync",
+                            f"'{name}' materializes a traced value on "
+                            f"the host inside jitted '{fn.name}'",
+                            RULES["trace-host-sync"].hint))
+                    continue
+                if name in _IMPURE_EXACT or name.startswith(_IMPURE_PREFIX):
+                    findings.append(Finding(
+                        ctx.filename, node.lineno, node.col_offset,
+                        "trace-impure-call",
+                        f"impure call '{name}' inside jitted "
+                        f"'{fn.name}' is frozen at trace time",
+                        RULES["trace-impure-call"].hint))
+                    continue
+
+        if isinstance(node, (ast.If, ast.While)):
+            if not _branch_is_static(ctx, node.test, traced):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                names = sorted({n.id for n in _traced_names_in(
+                    node.test, ctx, traced)})
+                findings.append(Finding(
+                    ctx.filename, node.lineno, node.col_offset,
+                    "trace-py-branch",
+                    f"Python '{kind}' on traced value(s) {names} inside "
+                    f"jitted '{fn.name}'", RULES["trace-py-branch"].hint))
+    return findings
+
+
+def _check_mutable_capture(ctx: ModuleContext, root: ast.FunctionDef
+                           ) -> List[Finding]:
+    """Free variables of a jit ROOT that the enclosing scope binds to a
+    mutable literal AND mutates outside the root."""
+    enclosing = ctx.enclosing_function(root)
+    if enclosing is None:
+        return []
+
+    bound: Set[str] = set(_param_names(ctx, root)) | {root.name}
+    for node in ast.walk(root):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+    free = {n.id for n in ast.walk(root)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            and n.id not in bound}
+
+    # names the ENCLOSING function binds to a list/dict/set literal
+    mutable: Dict[str, int] = {}
+    for node in ast.walk(enclosing):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mutable[t.id] = node.lineno
+
+    findings = []
+    for name in sorted(free & set(mutable)):
+        for node in ast.walk(enclosing):
+            inside_root = node is root or any(
+                a is root for a in ctx.ancestors(node))
+            if inside_root:
+                continue
+            hit = False
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == name:
+                hit = True
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = node.targets if isinstance(node, ast.Assign) else (
+                    [node.target] if isinstance(node, ast.AugAssign)
+                    else node.targets)
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name) and t.value.id == name:
+                        hit = True
+            if hit:
+                findings.append(Finding(
+                    ctx.filename, root.lineno, root.col_offset,
+                    "trace-mutable-capture",
+                    f"jitted '{root.name}' captures mutable '{name}' "
+                    f"(bound line {mutable[name]}) which the enclosing "
+                    f"scope mutates (line {node.lineno})",
+                    RULES["trace-mutable-capture"].hint))
+                break
+    return findings
+
+
+def run(ctx: ModuleContext, project: ProjectContext) -> List[Finding]:
+    findings: List[Finding] = []
+    fns = _collect_functions(ctx)
+    roots = _jit_roots(ctx, fns)
+    reach = _reach_set(ctx)
+    for fn in reach:
+        findings.extend(_check_function(ctx, fn, reach))
+    for root in roots:
+        findings.extend(_check_mutable_capture(ctx, root))
+    return findings

@@ -944,3 +944,10 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                 out.append(token)
             gen = jnp.stack(out, axis=1)  # [B, N]
         return Tensor(jnp.concatenate([ids, gen], axis=1))
+
+
+# retrace warnings for the generate entry cite this definition
+from .observability.recompile import \
+    register_entry_location as _register_entry  # noqa: E402
+
+_register_entry("generation.generate", generate)

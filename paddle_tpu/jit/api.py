@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..observability.recompile import entrypoint as _entrypoint
+from ..observability.recompile import \
+    register_entry_location as _register_entry
 
 _tls = threading.local()
 
@@ -63,6 +65,9 @@ class StaticFunction:
         # completed call is flagged as a retrace (shape/dtype churn)
         self._entry_name = "to_static:" + getattr(
             fn, "__qualname__", getattr(fn, "__name__", "fn"))
+        # retrace warnings cite the wrapped function's file:line (the
+        # spot the static analyzer's findings also point at)
+        _register_entry(self._entry_name, fn)
         functools.update_wrapper(self, fn, updated=[])
 
         # compiled control flow (reference: dy2static AST transformers):
@@ -164,6 +169,7 @@ class _LayerStaticWrapper:
     def __init__(self, layer):
         self._layer = layer
         self._entry_name = "to_static:" + type(layer).__name__
+        _register_entry(self._entry_name, type(layer))
 
         def runner(params, buffers, *datas, **kw):
             with _TraceScope(), no_grad():

@@ -31,7 +31,7 @@ from . import metrics as _m
 
 __all__ = ["install", "installed", "entrypoint", "current_entry",
            "compile_events", "total_compiles", "entry_stats", "reset_entries",
-           "reset_warmup"]
+           "reset_warmup", "register_entry_location", "entry_location"]
 
 logger = logging.getLogger("paddle_tpu.observability")
 
@@ -47,6 +47,31 @@ _events: deque = deque(maxlen=512)
 # Per-entry call/compile bookkeeping for retrace detection
 _entries: Dict[str, dict] = {}
 _entries_lock = threading.Lock()
+# entry name -> "file:line" of the jitted definition, so the retrace
+# warning points at the source the static analyzer also reports on
+_entry_locations: Dict[str, str] = {}
+
+
+def register_entry_location(name: str, fn=None,
+                            location: Optional[str] = None) -> None:
+    """Record where a jitted entry point is defined (``file:line``).
+    Owners pass the callable (``StaticFunction``'s wrapped fn, the
+    engine's local step/chunk defs) and the analyzer's resolver does the
+    rest; an explicit ``location`` string overrides. Best-effort — a
+    callable without source never raises."""
+    if location is None and fn is not None:
+        try:
+            from ..analysis.resolver import source_location
+
+            location = source_location(fn)
+        except Exception:  # pragma: no cover — resolver must never break
+            location = None
+    if location:
+        _entry_locations[name] = location
+
+
+def entry_location(name: str) -> Optional[str]:
+    return _entry_locations.get(name)
 
 _compiles = _m.counter(
     "paddle_tpu_compiles_total",
@@ -129,11 +154,14 @@ def _on_duration(name: str, duration: float, **kwargs):
             _retraces.labels(entry).inc()
             if not st["warned"]:
                 st["warned"] = True
+                loc = _entry_locations.get(entry)
                 logger.warning(
-                    "unexpected retrace: entry %r recompiled (%.3fs) after "
-                    "%d completed call(s) — input shapes/dtypes changed or "
-                    "the jit cache key is unstable (compile #%d)",
-                    entry, duration, st["calls"], st["compiles"])
+                    "unexpected retrace: entry %r%s recompiled (%.3fs) "
+                    "after %d completed call(s) — input shapes/dtypes "
+                    "changed or the jit cache key is unstable (compile "
+                    "#%d)",
+                    entry, f" (defined at {loc})" if loc else "",
+                    duration, st["calls"], st["compiles"])
     except Exception:  # a metrics bug must never break a compile
         logger.debug("recompile monitor listener failed", exc_info=True)
 

@@ -179,8 +179,12 @@ def _varlen_specs(seg, s: int, *, col_block=None):
 def _flash_fwd(q, k, v, seg, *, causal: bool, sm_scale: float, block_q: int,
                block_k: int):
     bh, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    # clamp AND make the tiling exact: a block that does not divide s
+    # would silently drop the tail rows of the (bh, s // block) grid.
+    # The public entries already pick_block, but the invariant belongs
+    # where the grid is built (pt-analysis pallas-block-divide).
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
     varlen = seg is not None
     grid = (bh, s // block_q)
     in_specs = [
@@ -337,8 +341,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, seg, out, lse, do, *, causal: bool, sm_scale: float,
                block_q: int, block_k: int, dlse=None):
     bh, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    # same exact-tiling contract as _flash_fwd (and the same inputs pick
+    # the same blocks, so fwd/bwd tile identically)
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
     varlen = seg is not None
     # delta = rowsum(dO * O): phrased as a dot so XLA accumulates bf16
     # products in f32 WITHOUT materializing f32 copies of dO and O (the

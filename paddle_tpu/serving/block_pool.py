@@ -64,6 +64,18 @@ class BlockPool:
     deterministic.
     """
 
+    # pt-analysis lock discipline: every mutable piece of allocator
+    # state is touched only under self._lock (methods below either take
+    # it or are '# holds-lock' helpers whose callers do)
+    GUARDED_BY = {
+        "_free": "_lock",
+        "_ref": "_lock",
+        "alloc_total": "_lock",
+        "free_total": "_lock",
+        "cow_forks": "_lock",
+        "high_watermark": "_lock",
+    }
+
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 2:
             raise ValueError(
@@ -80,9 +92,10 @@ class BlockPool:
         self._ref[DUMP_BLOCK] = 1  # pinned forever
         self.alloc_total = 0
         self.free_total = 0
-        self.cow_forks = 0          # incremented by the engine on forks
+        self.cow_forks = 0   # engine reports forks via note_cow_fork()
         self.high_watermark = 0
-        self._set_gauges()
+        with self._lock:
+            self._set_gauges()
 
     # -- core ops ------------------------------------------------------------
     def alloc(self, n: int = 1) -> List[int]:
@@ -99,7 +112,8 @@ class BlockPool:
             for b in ids:
                 self._ref[b] = 1
             self.alloc_total += n
-            self.high_watermark = max(self.high_watermark, self.used_blocks)
+            self.high_watermark = max(self.high_watermark,
+                                      self._used_unlocked())
             self._set_gauges()
             return ids
 
@@ -129,7 +143,7 @@ class BlockPool:
                 raise BlockPoolError(f"bad block id {block_id}")
             return int(self._ref[block_id])
 
-    def _check_live(self, block_id: int):
+    def _check_live(self, block_id: int):  # holds-lock: _lock
         if not (0 < block_id < self.num_blocks):
             raise BlockPoolError(
                 f"bad block id {block_id} (usable ids are "
@@ -139,35 +153,57 @@ class BlockPool:
                 f"block {block_id} is not allocated (double free / "
                 f"use-after-free)")
 
+    def note_cow_fork(self) -> None:
+        """Engine-side fork accounting (the fork itself is the engine's
+        jitted copy; only the counter lives behind the pool lock)."""
+        with self._lock:
+            self.cow_forks += 1
+
     # -- accounting ----------------------------------------------------------
+    # The public properties take the lock (they are read from the HTTP
+    # stats/health threads while the engine allocates); the *_unlocked
+    # helpers are for use inside an operation that already holds it.
+    def _free_unlocked(self) -> int:  # holds-lock: _lock
+        return len(self._free)
+
+    def _used_unlocked(self) -> int:  # holds-lock: _lock
+        return self.usable_blocks - len(self._free)
+
+    def _shared_unlocked(self) -> int:  # holds-lock: _lock
+        return int((self._ref[1:] > 1).sum())
+
     @property
     def usable_blocks(self) -> int:
-        return self.num_blocks - 1  # minus the dump block
+        return self.num_blocks - 1  # minus the dump block (immutable)
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return self._free_unlocked()
 
     @property
     def used_blocks(self) -> int:
-        return self.usable_blocks - len(self._free)
+        with self._lock:
+            return self._used_unlocked()
 
     @property
     def shared_blocks(self) -> int:
         """Blocks referenced by more than one owner (COW-protected)."""
-        return int((self._ref[1:] > 1).sum())
+        with self._lock:
+            return self._shared_unlocked()
 
     def stats(self) -> dict:
-        """Fragmentation/utilization accounting for /stats and tests."""
+        """Fragmentation/utilization accounting for /stats and tests —
+        one lock hold, so the snapshot is internally consistent."""
         with self._lock:
-            used = self.used_blocks
+            used = self._used_unlocked()
             return {
                 "num_blocks": self.num_blocks,
                 "block_size": self.block_size,
                 "usable": self.usable_blocks,
                 "in_use": used,
-                "free": self.free_blocks,
-                "shared": self.shared_blocks,
+                "free": self._free_unlocked(),
+                "shared": self._shared_unlocked(),
                 "utilization": used / max(1, self.usable_blocks),
                 "high_watermark": self.high_watermark,
                 "alloc_total": self.alloc_total,
@@ -175,10 +211,10 @@ class BlockPool:
                 "cow_forks": self.cow_forks,
             }
 
-    def _set_gauges(self):
+    def _set_gauges(self):  # holds-lock: _lock
         _sm.kv_blocks_total.set(self.usable_blocks)
-        _sm.kv_blocks_in_use.set(self.used_blocks)
-        _sm.kv_blocks_shared.set(self.shared_blocks)
+        _sm.kv_blocks_in_use.set(self._used_unlocked())
+        _sm.kv_blocks_shared.set(self._shared_unlocked())
 
 
 class PrefixCache:
@@ -193,6 +229,8 @@ class PrefixCache:
     releases it back to the pool.
     """
 
+    GUARDED_BY = {"_map": "_lock", "hits": "_lock", "misses": "_lock"}
+
     def __init__(self, pool: BlockPool):
         self.pool = pool
         # key -> (block_id, covered_end); ordered for LRU (oldest first)
@@ -206,7 +244,16 @@ class PrefixCache:
         return np.ascontiguousarray(tokens[:end], dtype=np.int32).tobytes()
 
     def __len__(self) -> int:
-        return len(self._map)
+        with self._lock:
+            return len(self._map)
+
+    def note(self, hit_blocks: int, miss_blocks: int) -> None:
+        """Admission-side hit/miss accounting (the engine calls this
+        once per admission; keeping the tallies behind the cache lock
+        means a /stats scrape never reads a torn update)."""
+        with self._lock:
+            self.hits += hit_blocks
+            self.misses += miss_blocks
 
     def match(self, tokens: np.ndarray, limit: int) -> Tuple[int, List[int]]:
         """Longest reusable prefix of ``tokens`` covering at most
@@ -288,5 +335,6 @@ class PrefixCache:
                 self.pool.decref(block_id)
 
     def stats(self) -> dict:
-        return {"entries": len(self._map), "hits": self.hits,
-                "misses": self.misses}
+        with self._lock:
+            return {"entries": len(self._map), "hits": self.hits,
+                    "misses": self.misses}

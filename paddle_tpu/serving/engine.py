@@ -491,6 +491,10 @@ class ServingEngine:
         self._step_fn = _step
         self._cow_fn = _cow
         self._chunk_size = C
+        # retrace warnings for the engine entries cite these defs
+        _recompile.register_entry_location("serving.step", _step)
+        _recompile.register_entry_location("serving.prefill_chunk", _chunk)
+        _recompile.register_entry_location("serving.cow", _cow)
         if self.spec:
             self._init_spec(B, run)
 
@@ -669,6 +673,11 @@ class ServingEngine:
         self._chunk_spec_fn = _chunk_spec
         self._cow_spec_fn = _cow_spec
         self._zero_drafts = jnp.zeros((B, k), jnp.int32)
+        _recompile.register_entry_location("serving.spec_draft", _draft)
+        _recompile.register_entry_location("serving.spec_verify", _verify)
+        _recompile.register_entry_location("serving.prefill_chunk",
+                                           _chunk_spec)
+        _recompile.register_entry_location("serving.cow", _cow_spec)
 
     # -- executables: contiguous (the pre-paging engine, A/B baseline) -------
     def _init_contiguous(self, B: int, run):
@@ -750,6 +759,10 @@ class ServingEngine:
         self._prefill_fn = _prefill
         self._splice_fn = _splice
         self._step_fn = _step
+        _recompile.register_entry_location("serving.step", _step)
+        for b in self._buckets:
+            _recompile.register_entry_location(f"serving.prefill[{b}]",
+                                               _prefill)
 
     # -- submission ----------------------------------------------------------
     def submit(self, prompt, deadline_s: Optional[float] = None,
@@ -987,7 +1000,7 @@ class ServingEngine:
         self.pool.decref(bid)
         self._slot_blocks[slot][block_idx] = new_id
         self._bt[slot, block_idx] = new_id
-        self.pool.cow_forks += 1
+        self.pool.note_cow_fork()
         _sm.cow_forks_total.inc()
         req = self._slot_req[slot]
         if req is not None:
@@ -1024,8 +1037,7 @@ class ServingEngine:
             raise
         req._resume = None  # consumed only once admission is certain
         if self.prefix_cache is not None:
-            self.prefix_cache.hits += len(mblocks)
-            self.prefix_cache.misses += n_blocks - len(mblocks)
+            self.prefix_cache.note(len(mblocks), n_blocks - len(mblocks))
             _sm.prefix_cache_hits.inc(len(mblocks))
             _sm.prefix_cache_misses.inc(n_blocks - len(mblocks))
             if matched_tok:

@@ -28,17 +28,21 @@ class QueueFullError(RuntimeError):
 
 
 class Scheduler:
+    GUARDED_BY = {"_q": "_lock"}
+
     def __init__(self, max_queue_depth: int = 64):
         self.max_queue_depth = int(max_queue_depth)
         self._q: deque = deque()
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
-        return len(self._q)
+        with self._lock:
+            return len(self._q)
 
     @property
     def depth(self) -> int:
-        return len(self._q)
+        with self._lock:
+            return len(self._q)
 
     def submit(self, req: Request):
         """FCFS enqueue. Raises ``QueueFullError`` (and marks the request

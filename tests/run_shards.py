@@ -218,6 +218,18 @@ def _summarize_snapshot(snap: dict) -> dict:
     return {
         "trace_spans": dict(snap.get("tracing", {}).get("span_counts", {})),
         "serving_digests": digests,
+        # pt-analysis CI trend lines: findings by rule + suppression
+        # accounting (recorded by the self-clean test's analyzer run)
+        "analysis_findings": {
+            "/".join(s["labels"].values()): int(s["value"])
+            for s in series("paddle_tpu_analysis_findings_total")},
+        "analysis_suppressions": {
+            **{"used/" + "/".join(s["labels"].values()): int(s["value"])
+               for s in series(
+                   "paddle_tpu_analysis_suppressions_used_total")},
+            **{"unused/" + "/".join(s["labels"].values()): int(s["value"])
+               for s in series(
+                   "paddle_tpu_analysis_suppressions_unused_total")}},
         "fused_conv_dispatch": {
             "/".join(s["labels"].values()): int(s["value"])
             for s in series("paddle_tpu_fused_conv_dispatch_total")},
@@ -251,6 +263,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     shards = []
     totals: dict = {"fused_conv_dispatch": {}, "flash_decode_dispatch": {},
                     "trace_spans": {}, "serving_digests": {},
+                    "analysis_findings": {}, "analysis_suppressions": {},
                     "compiles_total": 0,
                     "compile_seconds_total": 0.0, "retraces_total": 0,
                     "nan_check_trips": 0, "steps_recorded": 0}
@@ -264,7 +277,8 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
         summary["pid"] = path.rsplit(".", 2)[-2]
         shards.append(summary)
         for fam in ("fused_conv_dispatch", "flash_decode_dispatch",
-                    "trace_spans"):
+                    "trace_spans", "analysis_findings",
+                    "analysis_suppressions"):
             for k, v in summary[fam].items():
                 totals[fam][k] = totals[fam].get(k, 0) + v
         # percentiles don't sum: keep the busiest shard's digest per
@@ -323,6 +337,24 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     return out_path
 
 
+def run_static_analysis(label: str) -> int:
+    """The pt-analysis CI gate: analyze the files git reports changed
+    (text mode, exact rule ids + fix hints on stdout). Runs in BOTH
+    lanes before any pytest shard — a trace-safety/PRNG/lock/Pallas
+    regression fails fast, without waiting out a full shard budget. The
+    full-tree self-clean gate is tests/test_analysis.py."""
+    cmd = [sys.executable, "-m", "paddle_tpu.analysis", "--changed-only"]
+    print(f"[run_shards] static analysis ({label}): {' '.join(cmd)}",
+          flush=True)
+    try:
+        proc = subprocess.run(cmd, timeout=300, cwd=os.path.dirname(HERE))
+        return proc.returncode
+    except subprocess.TimeoutExpired:
+        print("[run_shards] static analysis EXCEEDED its 300s budget",
+              flush=True)
+        return 124
+
+
 def run_pytest(files, budget, label, extra_env=None):
     cmd = [sys.executable, "-m", "pytest", "-q", "--no-header",
            *(os.path.join(HERE, f) for f in files)]
@@ -348,7 +380,7 @@ def run_tpu_lane(slack: float) -> int:
     import json
 
     tdump = setup_telemetry_dump()
-    rc = 0
+    rc = run_static_analysis("tpu lane")
     shards = []
     for f, timeout, extra in TPU_LANE:
         t0 = time.monotonic()
@@ -419,7 +451,7 @@ def main(argv=None):
         print("serial: " + " ".join(r["file"] for r in ser))
         return 0
 
-    rc = 0
+    rc = run_static_analysis("cpu lane")
     if not args.serial_only:
         targets = range(args.shards) if args.shard is None else [args.shard]
         for i in targets:

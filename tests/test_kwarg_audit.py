@@ -27,6 +27,11 @@ _SPARSE_GRAD = ("sparse gradients are a CUDA memory optimization; XLA "
                 "gradients are dense by design")
 
 ALLOWED = {
+    # -- analysis passes share one run(ctx, project) interface; only the
+    # lock pass needs the project-wide view today
+    "analysis.trace_safety.run.project": _INTERFACE,
+    "analysis.prng.run.project": _INTERFACE,
+    "analysis.pallas_checks.run.project": _INTERFACE,
     # -- custom-vjp aux index inputs: consumed by the BACKWARD rule, so
     # the forward body never reads them (moe permutation formulation)
     "distributed.moe.moe_dispatch_perm.inv_idx":
