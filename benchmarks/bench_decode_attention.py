@@ -144,9 +144,53 @@ def run_config(gqa, occ, dtype):
     }
 
 
+def run_format_config(gqa, occ, dtype):
+    """Quantized-cache columns at one config: the dequant-prologue
+    kernel per format vs the float kernel on the SAME (dequantized)
+    values — per-format ms, max-abs-err, and the KV byte accounting
+    that drives the capacity story (bf16 2 bytes/value vs 1 byte +
+    4/d scale tax)."""
+    from paddle_tpu.quantization import intx
+
+    H = KV * gqa
+    rng = np.random.RandomState(77)
+    q = jnp.asarray(rng.randn(B, Q_LEN, H, D), dtype)
+    kc = jnp.asarray(rng.randn(B, MAX_LEN, KV, D), dtype)
+    vc = jnp.asarray(rng.randn(B, MAX_LEN, KV, D), dtype)
+    pos = jnp.asarray(np.full(B, int(occ * MAX_LEN) - Q_LEN, np.int32))
+
+    base = jax.jit(lambda q, k, v, p: flash_decode_attention(
+        q, k, v, p, block_k=BLOCK_K))
+    base_ms = _time(base, q, kc, vc, pos)
+    out_base = np.asarray(base(q, kc, vc, pos), np.float32)
+    rows = {"bf16" if dtype == "bfloat16" else "float32": {
+        "kernel_ms": round(base_ms, 4),
+        "kv_bytes_per_value": jnp.dtype(dtype).itemsize,
+        "max_abs_err_vs_float": 0.0}}
+    formats = ["int8"] + (["fp8"] if intx.fp8_available() else [])
+    for fmt in formats:
+        ks = intx.absmax_along(kc, -1)
+        vs = intx.absmax_along(vc, -1)
+        kq = intx.pack_absmax(kc, ks[..., None], fmt)
+        vq = intx.pack_absmax(vc, vs[..., None], fmt)
+        kern = jax.jit(lambda q, k, v, ks, vs, p: flash_decode_attention(
+            q, k, v, p, block_k=BLOCK_K, k_scale=ks, v_scale=vs))
+        out_q = np.asarray(kern(q, kq, vq, ks, vs, pos), np.float32)
+        rows[fmt] = {
+            "kernel_ms": round(_time(kern, q, kq, vq, ks, vs, pos), 4),
+            # 1 byte/value + f32 scale amortized over the head_dim
+            "kv_bytes_per_value": round(1 + 4 / D, 4),
+            "max_abs_err_vs_float": float(np.abs(out_q - out_base).max()),
+        }
+        rows[fmt]["kv_bytes_vs_bf16"] = round(
+            2 / rows[fmt]["kv_bytes_per_value"], 3)
+    return {"gqa": gqa, "occupancy": occ, "formats": rows}
+
+
 def main():
     dtype = "bfloat16" if ON_TPU else "float32"
     rows = [run_config(g, o, dtype) for g in GQA_RATIOS for o in OCCUPANCIES]
+    fmt_rows = [run_format_config(4, 0.5, dtype)]
 
     parity_ok = all(r["parity"] for r in rows)
     accept_rows = [r for r in rows if r["gqa"] == 4 and r["occupancy"] <= 0.5]
@@ -159,6 +203,7 @@ def main():
         "shapes": {"batch": B, "kv_heads": KV, "head_dim": D,
                    "max_len": MAX_LEN, "q_len": Q_LEN, "block_k": BLOCK_K},
         "configs": rows,
+        "quantized_kv": fmt_rows,
         "parity": parity_ok,
         "speedup_target": ACCEPT_SPEEDUP,
         "speedup_ok": speedup_ok,

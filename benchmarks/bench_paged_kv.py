@@ -70,6 +70,24 @@ SYS_PROMPT_LEN = 64
 SHARED_TAILS = 12
 TAIL_LEN = 8
 
+# ---- quantized-KV format lane (bf16 vs int8 vs fp8) ----------------------
+# head_dim 64 — the serving geometry class. Capacity accounting is per
+# CACHED TOKEN: bf16 stores 2 bytes/value, int8/fp8 store 1 byte/value
+# + 4 bytes/head per token of f32 absmax scale, so the fixed-byte-budget
+# multiplier is 2d / (d + 4) = 1.88x at d=64 (the scale tax shrinks as
+# d grows; at d=16 it would only be 1.6x — head_dim matters).
+FMT_MODEL_KW = dict(hidden_size=256, intermediate_size=256,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, vocab_size=1024,
+                    max_position_embeddings=256)
+FMT_MIX = ([(6, 8), (10, 6), (8, 10), (12, 8), (7, 6), (9, 8)]
+           + [(40, 24), (48, 20), (36, 16), (44, 12)])
+FMT_SLOTS = 12
+# budget chosen so the POOL (not the slot count) binds concurrency on
+# this mix: the bf16 lane runs pool-starved (preemption/queueing), the
+# int8 lane's ~1.88x extra blocks convert directly into active requests
+FMT_BF16_BLOCKS = 12          # the byte budget, expressed in bf16 blocks
+
 
 def make_requests(cfg, mix, seed):
     rng = np.random.RandomState(seed)
@@ -199,6 +217,127 @@ def run_shared_prefix_lane(model, cfg):
     }
 
 
+def _kernel_format_err(cfg, fmt):
+    """Max-abs attention error of the quantized read path vs bf16-class
+    float caches at the lane's geometry — the per-format numerics column
+    (fast, kernel-level, no engine)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(99)
+    KV = cfg.num_key_value_heads
+    d = cfg.hidden_size // cfg.num_attention_heads
+    H = cfg.num_attention_heads
+    q = jnp.asarray(rng.randn(4, 1, H, d), jnp.float32)
+    kc = jnp.asarray(rng.randn(4, 128, KV, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(4, 128, KV, d), jnp.float32)
+    pos = jnp.asarray([32, 64, 96, 127], jnp.int32)
+    from paddle_tpu.generation import (dequantize_kv_buffer,
+                                       kv_cache_write_quant,
+                                       make_kv_caches)
+    from paddle_tpu.nn import functional as F
+
+    def _attend(k, v):
+        # the XLA grouped fallback — format-independent oracle
+        import paddle_tpu as pt
+
+        kpos = np.arange(128)
+        m = (kpos[None, None] <= np.asarray(pos)[:, None, None])
+        mask = jnp.asarray(np.where(m[:, None], 0.0, -1e30), jnp.float32)
+        return F.grouped_query_sdpa(
+            pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+            attn_mask=pt.to_tensor(mask)).numpy()
+
+    ref = _attend(kc, vc)
+    caches = make_kv_caches(cfg, 4, 128, jnp.float32, fmt)
+    ck, cks = kv_cache_write_quant(caches[0]["k"], caches[0]["ks"], kc, 0,
+                                   fmt)
+    cv, cvs = kv_cache_write_quant(caches[0]["v"], caches[0]["vs"], vc, 0,
+                                   fmt)
+    kd = dequantize_kv_buffer(ck, cks, jnp.float32)._data
+    vd = dequantize_kv_buffer(cv, cvs, jnp.float32)._data
+    got = _attend(kd, vd)
+    return float(np.abs(got - ref).max())
+
+
+def run_format_lane():
+    """bf16 vs int8 (vs fp8) at ONE fixed KV byte budget: the pool each
+    format affords (host-side accounting — bytes per cached token incl.
+    scale overhead, at the canonical bf16 compute dtype), the measured
+    concurrency/throughput of a long-tail drain through that pool, and
+    the per-format numerics error. Acceptance: int8 holds >= 1.8x the
+    tokens (and therefore concurrent requests at a token-bound mix) of
+    bf16 on the same bytes."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.generation import kv_cache_bytes_per_token
+    from paddle_tpu.quantization import intx
+
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(**FMT_MODEL_KW)
+    model = LlamaForCausalLM(cfg)
+    workload = make_requests(cfg, FMT_MIX, seed=23)
+    gen_tokens = sum(params["max_new_tokens"] for _, params in workload)
+
+    bpt_bf16 = kv_cache_bytes_per_token(cfg, "bf16", jnp.bfloat16)
+    budget_bytes = FMT_BF16_BLOCKS * BLOCK_SIZE * bpt_bf16
+    formats = ["bf16", "int8"] + (["fp8"] if intx.fp8_available() else [])
+    lanes = {}
+    for fmt in formats:
+        bpt = (bpt_bf16 if fmt == "bf16"
+               else kv_cache_bytes_per_token(cfg, fmt))
+        blocks = int(budget_bytes // (bpt * BLOCK_SIZE))
+        eng = serving.ServingEngine(
+            model, max_slots=FMT_SLOTS, max_len=128,
+            block_size=BLOCK_SIZE, num_blocks=blocks + 1,
+            prefix_caching=False, kv_format=fmt,
+            max_queue_depth=len(workload))
+        drain(eng, workload)  # warmup: compile every executable
+        base_steps, base_occ = eng._steps, eng._occupancy_integral
+        reqs, wall = drain(eng, workload)
+        steps = eng._steps - base_steps
+        mean_active = (eng._occupancy_integral - base_occ) / max(1, steps)
+        # parity spot-check on 4 requests vs generate at the SAME format
+        parity = True
+        for req, (p, params) in list(zip(reqs, workload))[:4]:
+            ref = generation.generate(
+                model, p[None], kv_format=fmt,
+                **params).numpy()[0, len(p):]
+            got = np.asarray(req.result(timeout=5.0))
+            parity = parity and np.array_equal(got, ref)
+        lanes[fmt] = {
+            "bytes_per_token": bpt,
+            "blocks_at_budget": blocks,
+            "capacity_tokens_at_budget": blocks * BLOCK_SIZE,
+            "completed": sum(r.status == "completed" for r in reqs),
+            "mean_active_requests": round(mean_active, 2),
+            "wall_s": round(wall, 3),
+            "tok_s": round(gen_tokens / wall, 1),
+            "preemptions": eng._preempt_count,
+            "parity": parity,
+            "max_abs_err_vs_bf16": (
+                0.0 if fmt == "bf16" else
+                round(_kernel_format_err(cfg, fmt), 5)),
+        }
+    for fmt in formats[1:]:
+        lanes[fmt]["capacity_vs_bf16"] = round(
+            lanes[fmt]["capacity_tokens_at_budget"]
+            / lanes["bf16"]["capacity_tokens_at_budget"], 3)
+        lanes[fmt]["mean_active_vs_bf16"] = round(
+            lanes[fmt]["mean_active_requests"]
+            / max(1e-9, lanes["bf16"]["mean_active_requests"]), 2)
+        lanes[fmt]["tok_s_vs_bf16"] = round(
+            lanes[fmt]["tok_s"] / max(1e-9, lanes["bf16"]["tok_s"]), 2)
+    return {
+        "model": {"family": "llama", **FMT_MODEL_KW},
+        "head_dim": cfg.hidden_size // cfg.num_attention_heads,
+        "kv_byte_budget": budget_bytes,
+        "block_size": BLOCK_SIZE,
+        "slots": FMT_SLOTS,
+        "requests": len(workload),
+        "formats": lanes,
+    }
+
+
 def main():
     paddle.seed(0)
     cfg = LlamaConfig.tiny(**MODEL_KW)
@@ -206,6 +345,7 @@ def main():
 
     capacity = run_capacity_lane(model, cfg)
     shared = run_shared_prefix_lane(model, cfg)
+    formats = run_format_lane()
 
     verdicts = {
         "capacity_ge_1_5x": capacity["capacity_ratio"] >= 1.5,
@@ -215,6 +355,13 @@ def main():
         "one_step_compile": (
             capacity["paged"]["step_compiles_measured"] == 0
             and capacity["paged"]["step_retraces_measured"] == 0),
+        # the quantized-KV acceptance: int8 >= 1.8x tokens (and thus
+        # token-bound concurrency) at a FIXED byte budget, with every
+        # format's engine bit-matching generate at the same format
+        "int8_capacity_ge_1_8x":
+            formats["formats"]["int8"]["capacity_vs_bf16"] >= 1.8,
+        "format_parity": all(l["parity"]
+                             for l in formats["formats"].values()),
     }
     result = {
         "bench": "paged_kv",
@@ -222,6 +369,7 @@ def main():
         "model": {"family": "llama", **MODEL_KW},
         "capacity_ab": capacity,
         "shared_prefix": shared,
+        "kv_format_ab": formats,
         "verdicts": verdicts,
     }
     path = os.path.join(HERE, "bench_paged_kv.json")

@@ -1,0 +1,131 @@
+"""Weight-only quantized matmul A/B: Pallas dequant-fused kernel vs the
+XLA dequant-fusion fallback vs the float matmul.
+
+The decode-hot Linear shapes of a served model (qkv/o projection, MLP,
+lm_head at small decode batch) timed three ways per format:
+
+- ``float``:  ``x @ w`` with full-precision weights (the HBM baseline);
+- ``xla``:    ``nn.quant.weight_only_linear``'s fallback — int8/fp8
+              convert+scale fused into the matmul's weight read;
+- ``kernel``: ``pallas_kernels.quant_matmul`` — dequant in the Pallas
+              weight-load prologue, per-channel scale on the f32
+              accumulator.
+
+Parity (kernel vs xla, same quantized weights) is asserted per shape.
+On CPU the kernel runs in the Pallas INTERPRETER: timings are recorded
+for the curious, only parity gates the lane. On TPU the interesting
+number is kernel-vs-float at the weight-bound shapes (the ~2x weight
+byte cut), plus kernel-vs-xla (is the structural fusion beating the
+barrier-pinned XLA form?).
+
+Artifact: ``benchmarks/bench_quant.json``; ``tests/run_shards.py`` folds
+it into ``telemetry_lane.json`` as the ``quant_bench`` block.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.quant import weight_quantize
+from paddle_tpu.pallas_kernels.quant_matmul import quant_matmul
+from paddle_tpu.quantization import intx
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ON_TPU = jax.default_backend() == "tpu"
+
+# (label, m, k, n): decode-batch activations against serving weights
+SHAPES = ([("qkv_proj", 8, 2048, 2048), ("mlp_up", 8, 2048, 8192),
+           ("lm_head", 8, 2048, 32000)] if ON_TPU else
+          [("qkv_proj", 4, 256, 256), ("mlp_up", 4, 256, 512),
+           ("lm_head", 4, 256, 1024)])
+
+FORMATS = ["int8"] + (["fp8"] if intx.fp8_available() else [])
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def run_shape(label, m, k, n, fmt, dtype):
+    rng = np.random.RandomState(hash((label, fmt)) % (2 ** 31))
+    import paddle_tpu as paddle
+
+    x = jnp.asarray(rng.randn(m, k) * 0.1, dtype)
+    w = jnp.asarray(rng.randn(k, n) * 0.05, jnp.float32)
+    q, s = weight_quantize(paddle.to_tensor(w), algo=f"weight_only_{fmt}")
+    qa, sa = q._data, s._data
+    wd = w.astype(dtype)
+
+    flt = jax.jit(lambda x, w: (x @ w).astype(x.dtype))
+    xla = jax.jit(lambda x, q, s: (
+        x @ (jax.lax.optimization_barrier(q).astype(x.dtype)
+             * s[:, None].astype(x.dtype)).T))
+    kern = jax.jit(lambda x, q, s: quant_matmul(x, q, s))
+
+    out_x = np.asarray(xla(x, qa, sa), np.float32)
+    out_k = np.asarray(kern(x, qa, sa), np.float32)
+    out_f = np.asarray(flt(x, wd), np.float32)
+    denom = max(np.abs(out_f).max(), 1e-9)
+    err_vs_float = float(np.abs(out_k - out_f).max() / denom)
+    kernel_vs_xla_err = float(np.abs(out_k - out_x).max() / denom)
+
+    float_ms = _time(flt, x, wd)
+    xla_ms = _time(xla, x, qa, sa)
+    kernel_ms = _time(kern, x, qa, sa)
+    tol = 5e-3 if dtype == jnp.float32 else 5e-2
+    return {
+        "shape": label, "m": m, "k": k, "n": n, "fmt": fmt,
+        "float_ms": round(float_ms, 4),
+        "xla_dequant_ms": round(xla_ms, 4),
+        "kernel_ms": round(kernel_ms, 4),
+        "kernel_vs_float": round(float_ms / kernel_ms, 2),
+        "kernel_vs_xla": round(xla_ms / kernel_ms, 2),
+        "rel_err_vs_float": err_vs_float,
+        "kernel_vs_xla_rel_err": kernel_vs_xla_err,
+        "parity": bool(kernel_vs_xla_err < tol),
+    }
+
+
+def main():
+    dtype = jnp.bfloat16 if ON_TPU else jnp.float32
+    rows = [run_shape(*sh, fmt, dtype) for sh in SHAPES for fmt in FORMATS]
+    parity_ok = all(r["parity"] for r in rows)
+    result = {
+        "bench": "quant_matmul",
+        "platform": jax.default_backend(),
+        "dtype": str(jnp.dtype(dtype)),
+        "formats": FORMATS,
+        "configs": rows,
+        "parity": parity_ok,
+        # CPU: interpreter timings — parity-only lane; the weight-byte
+        # win is a chip statement (see README capacity math)
+        "mode": "compiled" if ON_TPU else "interpret (parity only)",
+    }
+    path = os.path.join(HERE, "bench_quant.json")
+    with open(path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result, indent=1))
+    print(f"[bench_quant_matmul] artifact -> {path}")
+    if not parity_ok:
+        print("[bench_quant_matmul] ACCEPTANCE FAILED", file=sys.stderr)
+    return 0 if parity_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

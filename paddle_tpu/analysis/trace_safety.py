@@ -214,6 +214,13 @@ def _branch_is_static(ctx: ModuleContext, test: ast.AST,
                 isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
                 for op in sub.ops):
             return True
+        # comparison against a STRING literal (kv_format != "bf16",
+        # mode == "paged"): traced arrays are never compared to strings,
+        # so the operand is a static python string by construction
+        if isinstance(sub, ast.Compare) and any(
+                isinstance(c, ast.Constant) and isinstance(c.value, str)
+                for c in [sub.left, *sub.comparators]):
+            return True
     names = []
     for sub in ast.walk(test):
         if isinstance(sub, ast.Call):

@@ -30,7 +30,10 @@ __all__ = ["GenerationConfig", "generate", "generate_uncached",
            "update_static_kv_cache", "make_kv_caches", "make_cached_runner",
            "select_tokens", "split_keys", "split_key_levels",
            "spec_accept_length", "truncated_draft", "make_paged_kv_pools",
-           "paged_kv_cache_write", "gather_paged_kv"]
+           "paged_kv_cache_write", "gather_paged_kv",
+           "kv_cache_write_quant", "paged_kv_cache_write_quant",
+           "gather_paged_kv_dequant", "dequantize_kv_buffer",
+           "kv_format_of", "kv_cache_bytes_per_token"]
 
 
 def _is_per_row(position_offset) -> bool:
@@ -81,14 +84,68 @@ def _causal_cache_mask(position_offset, s: int, max_len: int) -> Tensor:
     return Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
 
 
-def make_paged_kv_pools(config, num_blocks: int, block_size: int, dtype):
+def kv_format_of(arr) -> str:
+    """Storage format of a KV buffer, derived from its dtype (the cache
+    dict needs no extra tag: int8/fp8 storage IS the format)."""
+    from .quantization import intx as _intx
+
+    d = arr._data.dtype if isinstance(arr, Tensor) else \
+        jnp.asarray(arr).dtype
+    if d == jnp.int8:
+        return "int8"
+    fp8 = _intx.fp8_dtype()
+    if fp8 is not None and d == jnp.dtype(fp8):
+        return "fp8"
+    return "bf16"
+
+
+def kv_cache_bytes_per_token(config, kv_format: str = "bf16",
+                             dtype=jnp.float32) -> int:
+    """HBM bytes one cached token costs across all layers (K + V values
+    plus, for quantized formats, the per-token-per-head f32 absmax
+    scales) — the host-side accounting the capacity benches and the
+    ``paddle_tpu_kv_bytes_per_token`` gauge report."""
+    from .quantization import intx as _intx
+
+    n_kv = config.num_key_value_heads
+    head_dim = config.hidden_size // config.num_attention_heads
+    if kv_format == "bf16":
+        per = n_kv * head_dim * jnp.dtype(dtype).itemsize
+    else:
+        per = n_kv * (head_dim * _intx.format_itemsize(kv_format) + 4)
+    return 2 * per * config.num_hidden_layers
+
+
+def make_paged_kv_pools(config, num_blocks: int, block_size: int, dtype,
+                        kv_format: str = "bf16"):
     """Device-resident paged KV pools: a list (one per decoder layer) of
     {"k", "v"} jnp arrays shaped [num_blocks, block_size,
     num_key_value_heads, head_dim]. Slots address the pool through
     per-slot int32 block tables instead of owning contiguous rows, so
-    HBM is bounded by TOKENS IN FLIGHT, not slots * worst-case length."""
+    HBM is bounded by TOKENS IN FLIGHT, not slots * worst-case length.
+
+    ``kv_format="int8"``/``"fp8"`` stores the values in the narrow dtype
+    and adds per-token-per-head absmax scale pools ``ks``/``vs``
+    ([num_blocks, block_size, n_kv] f32) riding the same block structure
+    — writes quantize in the scatter epilogue, reads dequantize in the
+    paged flash-decode prologue (or the XLA gather fallback), so KV HBM
+    traffic drops ~2x and everything else (block tables, COW, prefix
+    sharing, preemption) is unchanged."""
+    from .quantization import intx as _intx
+
     n_kv = config.num_key_value_heads
     head_dim = config.hidden_size // config.num_attention_heads
+    if kv_format != "bf16":
+        sdt = _intx.format_dtype(kv_format)  # raises actionably for fp8
+        return [{"k": jnp.zeros((num_blocks, block_size, n_kv, head_dim),
+                                sdt),
+                 "v": jnp.zeros((num_blocks, block_size, n_kv, head_dim),
+                                sdt),
+                 "ks": jnp.zeros((num_blocks, block_size, n_kv),
+                                 jnp.float32),
+                 "vs": jnp.zeros((num_blocks, block_size, n_kv),
+                                 jnp.float32)}
+                for _ in range(config.num_hidden_layers)]
     return [{"k": jnp.zeros((num_blocks, block_size, n_kv, head_dim), dtype),
              "v": jnp.zeros((num_blocks, block_size, n_kv, head_dim), dtype)}
             for _ in range(config.num_hidden_layers)]
@@ -118,19 +175,7 @@ def paged_kv_cache_write(pool, new, block_table, position_offset,
     def upd(p, n):
         num_blocks, bs = p.shape[0], p.shape[1]
         b, s = n.shape[0], n.shape[1]
-        pos = jnp.asarray(po, jnp.int32)
-        if pos.ndim == 0:
-            pos = jnp.broadcast_to(pos, (b,))
-        tpos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
-        blk = jnp.clip(tpos // bs, 0, bt.shape[1] - 1)
-        phys = jnp.take_along_axis(jnp.asarray(bt, jnp.int32), blk, axis=1)
-        idx = phys * bs + tpos % bs                      # [b, s] flat
-        if vl is not None:
-            va = jnp.asarray(vl, jnp.int32)
-            if va.ndim == 0:
-                va = jnp.broadcast_to(va, (b,))
-            # pad tokens -> flat slot 0 (dump block 0, offset 0)
-            idx = jnp.where(tpos < (pos + va)[:, None], idx, 0)
+        idx = _paged_flat_indices(bt, po, vl, num_blocks, bs, b, s)
         flat = p.reshape((num_blocks * bs,) + p.shape[2:])
         flat = flat.at[idx.reshape(-1)].set(
             n.astype(p.dtype).reshape((b * s,) + n.shape[2:]))
@@ -138,6 +183,111 @@ def paged_kv_cache_write(pool, new, block_table, position_offset,
 
     return apply_op("paged_kv_cache_update", upd, ensure_tensor(pool),
                     ensure_tensor(new))
+
+
+def _paged_flat_indices(bt, po, vl, num_blocks, bs, b, s):
+    """Flat [b, s] pool indices for a paged scatter (shared by the plain
+    and quantized writes): token j of row b lands at
+    ``block_table[b, (pos_b + j) // bs] * bs + (pos_b + j) % bs``;
+    tokens past ``valid`` route to flat slot 0 (the dump block)."""
+    pos = jnp.asarray(po, jnp.int32)
+    if pos.ndim == 0:
+        pos = jnp.broadcast_to(pos, (b,))
+    tpos = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    blk = jnp.clip(tpos // bs, 0, bt.shape[1] - 1)
+    phys = jnp.take_along_axis(jnp.asarray(bt, jnp.int32), blk, axis=1)
+    idx = phys * bs + tpos % bs
+    if vl is not None:
+        va = jnp.asarray(vl, jnp.int32)
+        if va.ndim == 0:
+            va = jnp.broadcast_to(va, (b,))
+        idx = jnp.where(tpos < (pos + va)[:, None], idx, 0)
+    return idx
+
+
+def paged_kv_cache_write_quant(pool, scales, new, block_table,
+                               position_offset, valid_len=None,
+                               kv_format: str = "int8"):
+    """The quantizing scatter epilogue: quantize this step's [b, s, h, d]
+    K-or-V block PER TOKEN PER HEAD (absmax over d — a later token can
+    never force already-written tokens to be requantized, which a
+    block-wide scalar scale would) and scatter values + scales through
+    the block table. Returns (pool', scales')."""
+    from .ops.dispatch import apply_op, ensure_tensor
+    from .quantization import intx as _intx
+
+    bt = block_table._data if isinstance(block_table, Tensor) \
+        else jnp.asarray(block_table)
+    po = position_offset._data if isinstance(position_offset, Tensor) \
+        else position_offset
+    vl = None if valid_len is None else (
+        valid_len._data if isinstance(valid_len, Tensor) else valid_len)
+
+    def upd(p, sc, n):
+        num_blocks, bs = p.shape[0], p.shape[1]
+        b, s = n.shape[0], n.shape[1]
+        idx = _paged_flat_indices(bt, po, vl, num_blocks, bs, b, s)
+        amax = _intx.absmax_along(n, axis=-1)          # [b, s, h]
+        q = _intx.pack_absmax(n, amax[..., None], kv_format)
+        flat = p.reshape((num_blocks * bs,) + p.shape[2:])
+        flat = flat.at[idx.reshape(-1)].set(
+            q.reshape((b * s,) + q.shape[2:]))
+        sflat = sc.reshape((num_blocks * bs,) + sc.shape[2:])
+        sflat = sflat.at[idx.reshape(-1)].set(
+            amax.reshape((b * s,) + amax.shape[2:]).astype(sc.dtype))
+        return flat.reshape(p.shape), sflat.reshape(sc.shape)
+
+    return apply_op("paged_kv_cache_update_quant", upd, ensure_tensor(pool),
+                    ensure_tensor(scales), ensure_tensor(new))
+
+
+def kv_cache_write_quant(buf, scales, new, position_offset,
+                         kv_format: str = "int8"):
+    """Contiguous twin of ``paged_kv_cache_write_quant``: quantize the
+    step's [b, s, h, d] block per token per head and write values into
+    the int8/fp8 [b, max_len, h, d] buffer + scales into the
+    [b, max_len, h] f32 buffer at ``position_offset``. Returns
+    (buf', scales')."""
+    from .ops.dispatch import apply_op, ensure_tensor
+    from .quantization import intx as _intx
+
+    po = position_offset._data if isinstance(position_offset, Tensor) \
+        else position_offset
+
+    def upd(b, sc, n):
+        amax = _intx.absmax_along(n, axis=-1)          # [bR, s, h]
+        q = _intx.pack_absmax(n, amax[..., None], kv_format)
+        amax = amax.astype(sc.dtype)
+        if _is_per_row(po):
+            nb = jax.vmap(
+                lambda br, nr, off: jax.lax.dynamic_update_slice(
+                    br, nr, (off, 0, 0)))(b, q, po)
+            ns = jax.vmap(
+                lambda br, nr, off: jax.lax.dynamic_update_slice(
+                    br, nr, (off, 0)))(sc, amax, po)
+            return nb, ns
+        nb = jax.lax.dynamic_update_slice(b, q, (0, po, 0, 0))
+        ns = jax.lax.dynamic_update_slice(sc, amax, (0, po, 0))
+        return nb, ns
+
+    return apply_op("kv_cache_update_quant", upd, ensure_tensor(buf),
+                    ensure_tensor(scales), ensure_tensor(new))
+
+
+def dequantize_kv_buffer(buf, scales, out_dtype=jnp.float32):
+    """Dense dequantized view of a quantized contiguous cache (the XLA
+    fallback read path): [b, max_len, h, d] storage + [b, max_len, h]
+    absmax scales -> float [b, max_len, h, d]."""
+    from .ops.dispatch import apply_op, ensure_tensor
+    from .quantization import intx as _intx
+
+    fmt = kv_format_of(buf)
+
+    def g(p, sc):
+        return _intx.unpack_absmax(p, sc[..., None], fmt, out_dtype)
+
+    return apply_op("kv_cache_dequant", g, ensure_tensor(buf),
+                    ensure_tensor(scales))
 
 
 def gather_paged_kv(pool, block_table):
@@ -161,6 +311,32 @@ def gather_paged_kv(pool, block_table):
     return apply_op("paged_kv_gather", g, ensure_tensor(pool))
 
 
+def gather_paged_kv_dequant(pool, scales, block_table,
+                            out_dtype=jnp.float32):
+    """Quantized-pool twin of ``gather_paged_kv``: materialize the
+    slot-major view AND dequantize it in one fused op (the XLA gather
+    fallback for quantized pools — on the kernel path the dequant
+    happens in the Pallas prologue instead and this copy never
+    exists)."""
+    from .ops.dispatch import apply_op, ensure_tensor
+    from .quantization import intx as _intx
+
+    bt = block_table._data if isinstance(block_table, Tensor) \
+        else jnp.asarray(block_table)
+    fmt = kv_format_of(pool)
+
+    def g(p, sc):
+        bi = jnp.asarray(bt, jnp.int32)
+        out = jnp.take(p, bi, axis=0)
+        s_out = jnp.take(sc, bi, axis=0)
+        b, nb, bs = out.shape[0], out.shape[1], out.shape[2]
+        deq = _intx.unpack_absmax(out, s_out[..., None], fmt, out_dtype)
+        return deq.reshape((b, nb * bs) + p.shape[2:])
+
+    return apply_op("paged_kv_gather_dequant", g, ensure_tensor(pool),
+                    ensure_tensor(scales))
+
+
 def _update_paged_kv_cache(kv_cache: dict, k, v, position_offset,
                            build_mask: bool, gather: bool):
     """Paged half of ``update_static_kv_cache``: scatter the step's k/v
@@ -169,9 +345,23 @@ def _update_paged_kv_cache(kv_cache: dict, k, v, position_offset,
     for the paged Pallas kernel (``gather=False``)."""
     bt = kv_cache["bt"]
     valid = kv_cache.get("valid")
-    ck = paged_kv_cache_write(kv_cache["k"], k, bt, position_offset, valid)
-    cv = paged_kv_cache_write(kv_cache["v"], v, bt, position_offset, valid)
+    quant = "ks" in kv_cache
     new_cache = dict(kv_cache)
+    if quant:
+        fmt = kv_format_of(kv_cache["k"])
+        ck, cks = paged_kv_cache_write_quant(
+            kv_cache["k"], kv_cache["ks"], k, bt, position_offset, valid,
+            fmt)
+        cv, cvs = paged_kv_cache_write_quant(
+            kv_cache["v"], kv_cache["vs"], v, bt, position_offset, valid,
+            fmt)
+        new_cache["ks"] = cks
+        new_cache["vs"] = cvs
+    else:
+        ck = paged_kv_cache_write(kv_cache["k"], k, bt, position_offset,
+                                  valid)
+        cv = paged_kv_cache_write(kv_cache["v"], v, bt, position_offset,
+                                  valid)
     new_cache["k"] = ck
     new_cache["v"] = cv
     bt_arr = bt._data if isinstance(bt, Tensor) else bt
@@ -180,6 +370,11 @@ def _update_paged_kv_cache(kv_cache: dict, k, v, position_offset,
     mask = _causal_cache_mask(position_offset, k.shape[1], max_len) \
         if build_mask else None
     if gather:
+        if quant:
+            cd = (k._data if isinstance(k, Tensor) else k).dtype
+            return (gather_paged_kv_dequant(ck, cks, bt, cd),
+                    gather_paged_kv_dequant(cv, cvs, bt, cd),
+                    new_cache, mask)
         return (gather_paged_kv(ck, bt), gather_paged_kv(cv, bt),
                 new_cache, mask)
     return ck, cv, new_cache, mask
@@ -207,6 +402,23 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
     if isinstance(kv_cache, dict) and "bt" in kv_cache:
         return _update_paged_kv_cache(kv_cache, k, v, position_offset,
                                       build_mask, gather)
+    if "ks" in kv_cache:  # quantized contiguous cache
+        fmt = kv_format_of(kv_cache["k"])
+        ck, cks = kv_cache_write_quant(kv_cache["k"], kv_cache["ks"], k,
+                                       position_offset, fmt)
+        cv, cvs = kv_cache_write_quant(kv_cache["v"], kv_cache["vs"], v,
+                                       position_offset, fmt)
+        new_cache = {"k": ck, "v": cv, "ks": cks, "vs": cvs}
+        mask = None
+        if build_mask:
+            max_len = int(ck._data.shape[1] if isinstance(ck, Tensor)
+                          else ck.shape[1])
+            mask = _causal_cache_mask(position_offset, k.shape[1], max_len)
+        if gather:
+            cd = (k._data if isinstance(k, Tensor) else k).dtype
+            return (dequantize_kv_buffer(ck, cks, cd),
+                    dequantize_kv_buffer(cv, cvs, cd), new_cache, mask)
+        return ck, cv, new_cache, mask
     ck = kv_cache_write(kv_cache["k"], k, position_offset)
     cv = kv_cache_write(kv_cache["v"], v, position_offset)
     mask = None
@@ -419,12 +631,25 @@ def truncated_draft(model, num_layers: int):
     return draft
 
 
-def make_kv_caches(config, batch_size: int, max_len: int, dtype):
+def make_kv_caches(config, batch_size: int, max_len: int, dtype,
+                   kv_format: str = "bf16"):
     """Pre-allocated per-layer static KV buffers: a list (one per
     decoder layer) of {"k", "v"} jnp arrays shaped
-    [batch_size, max_len, num_key_value_heads, head_dim]."""
+    [batch_size, max_len, num_key_value_heads, head_dim].
+    ``kv_format="int8"``/``"fp8"`` stores narrow values plus
+    per-token-per-head absmax scales ``ks``/``vs`` ([b, max_len, n_kv]
+    f32) — the contiguous twin of the quantized paged pools."""
+    from .quantization import intx as _intx
+
     n_kv = config.num_key_value_heads
     head_dim = config.hidden_size // config.num_attention_heads
+    if kv_format != "bf16":
+        sdt = _intx.format_dtype(kv_format)
+        return [{"k": jnp.zeros((batch_size, max_len, n_kv, head_dim), sdt),
+                 "v": jnp.zeros((batch_size, max_len, n_kv, head_dim), sdt),
+                 "ks": jnp.zeros((batch_size, max_len, n_kv), jnp.float32),
+                 "vs": jnp.zeros((batch_size, max_len, n_kv), jnp.float32)}
+                for _ in range(config.num_hidden_layers)]
     return [{"k": jnp.zeros((batch_size, max_len, n_kv, head_dim), dtype),
              "v": jnp.zeros((batch_size, max_len, n_kv, head_dim), dtype)}
             for _ in range(config.num_hidden_layers)]
@@ -601,12 +826,14 @@ def _generate_speculative(model, draft_model, ids, cfg: GenerationConfig,
     tpv = jnp.full((B,), cfg.top_p, jnp.float32)
 
     from .pallas_kernels.decode_attention import flash_decode_enabled
+    from .pallas_kernels.quant_matmul import quant_matmul_enabled
 
     darch = (type(draft_model).__name__, dcfg.num_hidden_layers,
              dcfg.hidden_size, dcfg.num_attention_heads,
              dcfg.num_key_value_heads, dcfg.intermediate_size)
     gen_key = ("spec", B, S, N, k, cfg.do_sample, cfg.temperature,
-               cfg.top_k, cfg.top_p, darch, flash_decode_enabled())
+               cfg.top_k, cfg.top_p, darch, flash_decode_enabled(),
+               quant_matmul_enabled())
     cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
     if gen_key not in cache_store:
 
@@ -700,7 +927,8 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              loop_mode: str = "scan", pad_token_id: Optional[int] = None,
-             stream: bool = False, draft_model=None, spec_k: int = 4):
+             stream: bool = False, draft_model=None, spec_k: int = 4,
+             kv_format: str = "bf16"):
     """Generate continuations for ``input_ids`` [B, S]; returns [B, S+N].
 
     Greedy by default; sampling with temperature/top-k/top-p when
@@ -736,9 +964,34 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     path — greedy at any batch size, sampled at B=1 (B>1 sampled rows
     use independent per-row key chains; see ``_spec_row_keys``) — the
     draft only changes how fast rows advance. Unsupported together with
-    ``stream`` and with ragged/left-padded prompts (``pad_token_id``)."""
+    ``stream`` and with ragged/left-padded prompts (``pad_token_id``).
+
+    ``kv_format="int8"``/``"fp8"`` stores the KV cache quantized
+    (per-token-per-head absmax scales; fp8 = e4m3 where the jnp dtype
+    exists, int8 the portable floor): cache writes quantize, the
+    flash-decode kernel dequantizes in its prologue (the XLA fallback
+    dequantizes at the gather), halving decode KV bytes. Greedy outputs
+    at the tiny-model test points match bf16 token-for-token (pinned in
+    tests/test_quantization_serving.py); logits move by the absmax
+    rounding step. Not supported with ``draft_model`` here — the
+    serving engine's spec lane runs on quantized pools instead."""
     cfg = GenerationConfig(max_new_tokens, do_sample, temperature, top_k, top_p,
                            eos_token_id, seed)
+    from .quantization.intx import KV_FORMATS
+
+    if kv_format not in KV_FORMATS:
+        raise ValueError(
+            f"kv_format must be one of {KV_FORMATS}, got {kv_format!r}")
+    if kv_format != "bf16":
+        from .quantization.intx import format_dtype
+
+        format_dtype(kv_format)  # actionable error when fp8 is absent
+        if draft_model is not None:
+            raise ValueError(
+                "kv_format is not supported with draft_model in offline "
+                "generate — run speculative decoding on the serving "
+                "engine (ServingConfig.kv_format), whose draft/verify "
+                "lane operates on quantized pools")
     ids, pad_lens = _normalize_prompts(input_ids, pad_token_id)
     ragged = pad_lens is not None
     B, S = ids.shape
@@ -756,7 +1009,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     buffers = {k: v._data for k, v in model.named_buffers_dict().items()}
 
     def make_caches():
-        return make_kv_caches(config, B, max_len, dtype)
+        return make_kv_caches(config, B, max_len, dtype, kv_format)
 
     base_run = make_cached_runner(model)
 
@@ -807,11 +1060,13 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     # the flash-decode env gate is a python-side dispatch baked into the
     # trace: flipping it must not reuse executables traced the other way
     from .pallas_kernels.decode_attention import flash_decode_enabled
+    from .pallas_kernels.quant_matmul import quant_matmul_enabled
 
     gen_key = (B, S, cfg.max_new_tokens, cfg.do_sample, cfg.temperature,
                cfg.top_k, cfg.top_p,
                cfg.eos_token_id if loop_mode == "scan" else None, loop_mode,
-               ragged, flash_decode_enabled())
+               ragged, flash_decode_enabled(), kv_format,
+               quant_matmul_enabled())
     cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
     if gen_key not in cache_store:
 

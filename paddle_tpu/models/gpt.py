@@ -82,7 +82,7 @@ class GPTBlock(nn.Layer):
             dispatch = paged_decode_dispatch if paged_cache else decode_dispatch
             use_flash_decode = dispatch(
                 "gpt", q_len=s, has_mask=attn_mask is not None,
-                dtype=q.dtype)
+                dtype=q.dtype, quantized="ks" in kv_cache)
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
                 build_mask=attn_mask is None and not use_flash_decode,
@@ -100,9 +100,13 @@ class GPTBlock(nn.Layer):
             if paged_cache:
                 a = paged_flash_decode_attention(
                     q, new_cache["k"], new_cache["v"], new_cache["bt"],
-                    position_offset)
+                    position_offset, k_scale=new_cache.get("ks"),
+                    v_scale=new_cache.get("vs"))
             else:
-                a = flash_decode_attention(q, k, v, position_offset)
+                a = flash_decode_attention(
+                    q, k, v, position_offset,
+                    k_scale=new_cache.get("ks"),
+                    v_scale=new_cache.get("vs"))
         else:
             a = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask,

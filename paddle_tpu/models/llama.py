@@ -183,6 +183,10 @@ class LlamaAttention(nn.Layer):
         # [num_blocks, block_size, h, d] pools (the serving engine's
         # paged KV pool) instead of contiguous [b, max_len, h, d] rows
         paged_cache = static_cache and "bt" in kv_cache
+        # quantized cache: int8/fp8 storage + "ks"/"vs" absmax scale
+        # companions; the flash-decode kernels dequantize in their
+        # prologue, the XLA fallbacks at the gather
+        quant_cache = static_cache and "ks" in kv_cache
         # flash prefill: at offset 0 causal attention over the prompt
         # alone equals the masked-dense attention over the padded cache
         # (positions >= s are masked out anyway) — keep the step k/v for
@@ -206,7 +210,7 @@ class LlamaAttention(nn.Layer):
             dispatch = paged_decode_dispatch if paged_cache else decode_dispatch
             use_flash_decode = dispatch(
                 "llama", q_len=s, has_mask=attn_mask is not None,
-                dtype=q.dtype)
+                dtype=q.dtype, quantized=quant_cache)
         if static_cache:
             # pre-allocated buffers updated in place at position_offset
             # (jit-friendly decode path; the reference's cache_kv
@@ -241,9 +245,13 @@ class LlamaAttention(nn.Layer):
             if paged_cache:
                 out = paged_flash_decode_attention(
                     q, new_cache["k"], new_cache["v"], new_cache["bt"],
-                    position_offset)
+                    position_offset, k_scale=new_cache.get("ks"),
+                    v_scale=new_cache.get("vs"))
             else:
-                out = flash_decode_attention(q, k, v, position_offset)
+                out = flash_decode_attention(
+                    q, k, v, position_offset,
+                    k_scale=new_cache.get("ks"),
+                    v_scale=new_cache.get("vs"))
         else:
             # GQA: the static-cache (decode/cached-prefill) fallback uses
             # the grouped contraction — k/v stay [b, max_len, kv, d], no

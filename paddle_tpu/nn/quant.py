@@ -31,26 +31,44 @@ __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
            "WeightOnlyLinear", "quantize_for_inference"]
 
 
+_ALGO_FMT = {"weight_only_int8": "int8", "weight_only_fp8": "fp8"}
+
+
+def _fmt_of_storage(q) -> str:
+    """Weight format from the storage dtype (int8 vs fp8 e4m3)."""
+    d = q._data.dtype if isinstance(q, Tensor) else jnp.asarray(q).dtype
+    return "int8" if d == jnp.int8 else "fp8"
+
+
 def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
                     group_size: int = -1):
-    """Quantize a [in, out] float weight; returns (int8 [out, in], f32
-    scale [out]). ``arch`` is accepted for API compatibility and ignored
-    (no SM architectures on TPU); only per-channel (group_size=-1) int8
-    is implemented."""
-    if algo != "weight_only_int8":
+    """Quantize a [in, out] float weight; returns (int8-or-fp8
+    [out, in], f32 scale [out] — the DEQUANT multiplier, absmax/127 for
+    int8 and absmax/448 for fp8 e4m3). ``arch`` is accepted for API
+    compatibility and ignored (no SM architectures on TPU); only
+    per-channel (group_size=-1) scales are implemented."""
+    if algo not in _ALGO_FMT:
         raise NotImplementedError(
-            f"algo={algo!r}: only 'weight_only_int8' is implemented "
-            "(int4 packing / llm.int8 outlier split are CUDA-kernel "
-            "specific in the reference)")
+            f"algo={algo!r}: only 'weight_only_int8' / 'weight_only_fp8' "
+            "are implemented (int4 packing / llm.int8 outlier split are "
+            "CUDA-kernel specific in the reference)")
     if group_size != -1:
         raise NotImplementedError("only per-channel (group_size=-1) scales")
+    fmt = _ALGO_FMT[algo]
+    from ..quantization.intx import format_bound, format_dtype
+
+    sdt = format_dtype(fmt)  # actionable error when fp8 is unavailable
+    bound = format_bound(fmt)
 
     def _q(w):
         wt = w.astype(jnp.float32).T  # [out, in]
-        scale = jnp.max(jnp.abs(wt), axis=1) / 127.0
+        scale = jnp.max(jnp.abs(wt), axis=1) / bound
         safe = jnp.maximum(scale, 1e-10)
-        q = jnp.clip(jnp.round(wt / safe[:, None]), -127, 127).astype(jnp.int8)
-        return q, scale
+        if fmt == "int8":
+            return (jnp.clip(jnp.round(wt / safe[:, None]), -bound,
+                             bound).astype(jnp.int8), scale)
+        return (jnp.clip(wt / safe[:, None], -bound, bound).astype(sdt),
+                scale)
 
     q, scale = apply_op("weight_quantize", _q, x)
     return q, scale
@@ -58,9 +76,10 @@ def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
 
 def weight_dequantize(x, scale, algo: str = "weight_only_int8",
                       out_dtype: str = "float16", group_size: int = -1):
-    """int8 [out, in] + scale [out] -> float [in, out]."""
-    if algo != "weight_only_int8":
-        raise NotImplementedError("only 'weight_only_int8'")
+    """int8/fp8 [out, in] + scale [out] -> float [in, out]."""
+    if algo not in _ALGO_FMT:
+        raise NotImplementedError(
+            "only 'weight_only_int8' / 'weight_only_fp8'")
     if group_size != -1:
         raise NotImplementedError("only per-channel (group_size=-1) scales")
 
@@ -76,15 +95,33 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        group_size: int = -1):
     """``x [.., in] @ dequant(weight [out, in]).T + bias`` in x's dtype.
 
-    The convert+scale fuses into the matmul's weight read under XLA —
-    this is the whole point: half the weight bytes on the
-    bandwidth-bound decode path."""
-    if weight_dtype != "int8":
-        raise NotImplementedError("only weight_dtype='int8'")
+    Two lanes, chosen per call by ``quant_matmul_dispatch`` (env
+    ``PADDLE_TPU_QUANT_WEIGHTS``, hit/fallback counters):
+
+    - the Pallas ``quant_matmul`` kernel — dequant fused into the
+      weight-load prologue, per-channel scale on the f32 accumulator;
+    - the XLA fallback below, where the convert+scale fuses into the
+      matmul's weight read.
+
+    Either way the narrow weight is what crosses HBM — half (bf16) or a
+    quarter (f32) of the weight bytes on the bandwidth-bound decode
+    path."""
+    if weight_dtype not in ("int8", "fp8"):
+        raise NotImplementedError("only weight_dtype='int8' or 'fp8'")
     if weight_scale is None:
-        raise ValueError("weight_scale is required for int8 weights")
+        raise ValueError("weight_scale is required for int8/fp8 weights")
     if group_size != -1:
         raise NotImplementedError("only per-channel (group_size=-1) scales")
+
+    from ..pallas_kernels.quant_matmul import (quant_matmul,
+                                               quant_matmul_dispatch)
+
+    xdt = x.dtype if hasattr(x, "dtype") else jnp.asarray(x).dtype
+    if quant_matmul_dispatch(dtype=xdt, fmt=weight_dtype):
+        out = quant_matmul(x, weight, weight_scale)
+        if bias is not None:
+            out = out + bias
+        return out
 
     def _f(xx, q, s, *b):
         # optimization_barrier: inside a decode lax.scan the dequant is
@@ -128,23 +165,38 @@ class WeightOnlyLinear(Layer):
             self.bias = None
 
     @classmethod
-    def from_linear(cls, linear):
+    def from_linear(cls, linear, fmt: str = "int8", scale=None):
+        """``fmt`` picks the storage ("int8" or "fp8" e4m3); ``scale``
+        optionally supplies precomputed per-out-channel ABSMAX values
+        (e.g. from ``quantization.PerChannelAbsmaxObserver``) instead of
+        reading them off the live weight."""
         from ..core.autograd import no_grad
 
         with no_grad():
-            q, scale = weight_quantize(linear.weight)
-        return cls(q, scale, linear.bias)
+            if scale is None:
+                q, dq_scale = weight_quantize(
+                    linear.weight, algo=f"weight_only_{fmt}")
+            else:
+                from ..quantization.intx import (format_bound,
+                                                 pack_absmax)
+
+                absmax = jnp.asarray(scale, jnp.float32).reshape(-1)
+                wt = linear.weight._data.T  # [out, in]
+                q = pack_absmax(wt, absmax[:, None], fmt)
+                dq_scale = absmax / format_bound(fmt)
+        return cls(q, dq_scale, linear.bias)
 
     def forward(self, x):
-        return weight_only_linear(x, self.qweight, self.bias, self.scale)
+        return weight_only_linear(x, self.qweight, self.bias, self.scale,
+                                  weight_dtype=_fmt_of_storage(self.qweight))
 
 
-def quantize_for_inference(model, include=None):
+def quantize_for_inference(model, include=None, fmt: str = "int8"):
     """Replace every nn.Linear in ``model`` (in place) with a
     WeightOnlyLinear built from its weights. ``include``: optional
-    ``fn(qualified_name, layer) -> bool`` filter. Returns the model.
-    Serving-only: quantized layers carry buffers, so the engine/optimizer
-    will not train them."""
+    ``fn(qualified_name, layer) -> bool`` filter; ``fmt``: "int8" or
+    "fp8". Returns the model. Serving-only: quantized layers carry
+    buffers, so the engine/optimizer will not train them."""
     from .layers_common import Linear
 
     def _walk(layer, prefix):
@@ -152,7 +204,8 @@ def quantize_for_inference(model, include=None):
             qual = f"{prefix}.{name}" if prefix else name
             if isinstance(sub, Linear):
                 if include is None or include(qual, sub):
-                    layer._sub_layers[name] = WeightOnlyLinear.from_linear(sub)
+                    layer._sub_layers[name] = WeightOnlyLinear.from_linear(
+                        sub, fmt=fmt)
             else:
                 _walk(sub, qual)
 

@@ -593,3 +593,152 @@ _S("fused_conv_bn_eval", _fused_conv_bn_eval_ref,
    dtypes=("float32", "bfloat16"), tol=_FUSED_CONV_TOL,
    wrap=lambda api: lambda x, wc, rm, rv, g, b: api(
        x, wc, rm, rv, g, b, training=False))
+
+# ---------------------------------------------------------------------------
+# quantized serving data path (round 11): int8 KV cache writes/reads and
+# the weight-only dequant-fused matmul. All refs replicate the absmax
+# convention of quantization/intx.py (q = clip(round(x/s*127)), dequant
+# q*s/127) in numpy, so the comparisons pin the convention, not just the
+# shapes. grad=False throughout: serving-only forward ops.
+# ---------------------------------------------------------------------------
+
+_QDOM_SCALE = "pos"   # absmax scales are positive by construction
+
+
+def _np_absmax_pack(x):
+    amax = np.abs(x).max(axis=-1)
+    s = np.maximum(amax, 1e-9)[..., None]
+    q = np.clip(np.round(x.astype(np.float32) / s * 127.0),
+                -127.0, 127.0).astype(np.int8)
+    return q, amax.astype(np.float32)
+
+
+def _np_absmax_unpack(q, amax):
+    s = np.maximum(amax, 1e-9)[..., None]
+    return q.astype(np.float32) * s / 127.0
+
+
+_KVQ_OFF = 3
+
+
+def _kv_write_quant_ref(buf, sc, new):
+    q, amax = _np_absmax_pack(new)
+    b = buf.copy()
+    s2 = sc.copy()
+    b[:, _KVQ_OFF:_KVQ_OFF + new.shape[1]] = q
+    s2[:, _KVQ_OFF:_KVQ_OFF + new.shape[1]] = amax
+    return b, s2
+
+
+_S("kv_cache_update_quant", _kv_write_quant_ref,
+   [((2, 6, 2, 4), "int8w"), ((2, 6, 2), _QDOM_SCALE),
+    ((2, 1, 2, 4), "any")],
+   api="generation.kv_cache_write_quant", grad=False, dtypes=("float32",),
+   wrap=lambda api: lambda b, s, n: api(b, s, n, _KVQ_OFF))
+
+# paged twin: the [2, 6] logical caches live as pool blocks [7, 2, ...]
+# through the same fixed block table the paged attention schemas use
+_PKQ_BT = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+_PKQ_POS = np.array([1, 3], np.int32)
+
+
+def _paged_kv_write_quant_ref(pool, sc, new):
+    q, amax = _np_absmax_pack(new)
+    p = pool.copy()
+    s2 = sc.copy()
+    bs = pool.shape[1]
+    for b in range(new.shape[0]):
+        for j in range(new.shape[1]):
+            t = _PKQ_POS[b] + j
+            phys = _PKQ_BT[b, t // bs]
+            p[phys, t % bs] = q[b, j]
+            s2[phys, t % bs] = amax[b, j]
+    return p, s2
+
+
+_S("paged_kv_cache_update_quant", _paged_kv_write_quant_ref,
+   [((7, 2, 2, 4), "int8w"), ((7, 2, 2), _QDOM_SCALE),
+    ((2, 2, 2, 4), "any")],
+   api="generation.paged_kv_cache_write_quant", grad=False,
+   dtypes=("float32",),
+   wrap=lambda api: lambda p, s, n: api(p, s, n, _PKQ_BT, _PKQ_POS))
+
+
+def _kv_dequant_ref(buf, sc):
+    return _np_absmax_unpack(buf, sc)
+
+
+_S("kv_cache_dequant", _kv_dequant_ref,
+   [((2, 6, 2, 4), "int8w"), ((2, 6, 2), _QDOM_SCALE)],
+   api="generation.dequantize_kv_buffer", grad=False, dtypes=("float32",))
+
+
+def _paged_gather_dequant_ref(pool, sc):
+    g = pool[_PKQ_BT.reshape(-1)].reshape(2, 6, *pool.shape[2:])
+    gs = sc[_PKQ_BT.reshape(-1)].reshape(2, 6, *sc.shape[2:])
+    return _np_absmax_unpack(g, gs)
+
+
+_S("paged_kv_gather_dequant", _paged_gather_dequant_ref,
+   [((7, 2, 2, 4), "int8w"), ((7, 2, 2), _QDOM_SCALE)],
+   api="generation.gather_paged_kv_dequant", grad=False,
+   dtypes=("float32",),
+   wrap=lambda api: lambda p, s: api(p, s, _PKQ_BT))
+
+
+# weight-only matmul with the dequant fused into the Pallas prologue:
+# x [m, k] @ dequant(q [n, k]).T, scale = per-out-channel dequant
+# multiplier (nn.quant.weight_quantize convention: absmax/127, so the
+# dequantized weight is O(1) — sampling the multiplier at O(1) instead
+# would make outputs O(1e3) and void the bf16 tolerance)
+from .schemas import _DOMAINS  # noqa: E402
+
+_DOMAINS["qscale"] = lambda rng, sh: (
+    rng.uniform(0.5, 2.5, sh) / 127.0).astype(np.float32)
+
+
+def _quant_matmul_ref(x, q, s):
+    return x.astype(np.float32) @ (q.astype(np.float32)
+                                   * s[:, None].astype(np.float32)).T
+
+
+_S("quant_matmul", _quant_matmul_ref,
+   [((4, 32), "any"), ((16, 32), "int8w"), ((16,), "qscale")],
+   api="pallas_kernels.quant_matmul", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FLASH_TOL)
+
+
+# quantized flash decode: the SAME attention oracle as the float
+# schemas, fed the numpy-dequantized caches — pins that the kernel's
+# fused dequant prologue computes exactly what unpack-then-attend does
+def _flash_decode_int8_ref(q, kq, vq, ks, vs):
+    return _flash_decode_ref(q, _np_absmax_unpack(kq, ks),
+                             _np_absmax_unpack(vq, vs))
+
+
+_S("flash_decode_attention_int8", _flash_decode_int8_ref,
+   [((2, 1, 4, 8), "any"), ((2, 6, 2, 8), "int8w"),
+    ((2, 6, 2, 8), "int8w"), ((2, 6, 2), _QDOM_SCALE),
+    ((2, 6, 2), _QDOM_SCALE)],
+   api="pallas_kernels.flash_decode_attention", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FLASH_TOL,
+   wrap=lambda api: lambda q, kq, vq, ks, vs: api(
+       q, kq, vq, _FD_SWEEP_POS, block_k=4, k_scale=ks, v_scale=vs))
+
+
+def _paged_flash_decode_int8_ref(q, kp, vp, ksp, vsp):
+    gather = lambda p: p[_PFD_BT.reshape(-1)].reshape(
+        2, 6, *p.shape[2:])
+    return _flash_decode_ref(
+        q, _np_absmax_unpack(gather(kp), gather(ksp)),
+        _np_absmax_unpack(gather(vp), gather(vsp)))
+
+
+_S("paged_flash_decode_attention_int8", _paged_flash_decode_int8_ref,
+   [((2, 1, 4, 8), "any"), ((7, 2, 2, 8), "int8w"),
+    ((7, 2, 2, 8), "int8w"), ((7, 2, 2), _QDOM_SCALE),
+    ((7, 2, 2), _QDOM_SCALE)],
+   api="pallas_kernels.paged_flash_decode_attention", grad=False,
+   dtypes=("float32", "bfloat16"), tol=_FLASH_TOL,
+   wrap=lambda api: lambda q, kp, vp, ks, vs: api(
+       q, kp, vp, _PFD_BT, _FD_SWEEP_POS, k_scale=ks, v_scale=vs))

@@ -9,3 +9,4 @@ from .decode_attention import (flash_decode_attention,
                                paged_flash_decode_attention)
 from .flash_attention import flash_attention
 from .fused_conv import fused_conv_bn_eval, fused_conv_bn_train
+from .quant_matmul import quant_matmul

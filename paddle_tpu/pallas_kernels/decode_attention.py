@@ -110,12 +110,17 @@ def flash_decode_enabled() -> bool:
 
 
 def decode_dispatch(model: str, *, q_len: int, has_mask: bool,
-                    dtype) -> bool:
+                    dtype, quantized: bool = False) -> bool:
     """The decode-path dispatch decision for one attention layer call:
     True -> run ``flash_decode_attention``; False -> XLA fallback, with
     the reason counted. Called from the static-cache branch of the
     llama/gpt attention forwards (python-side, so under jit this costs
-    nothing after the first trace)."""
+    nothing after the first trace).
+
+    ``quantized``: the cache is an int8/fp8 store — hits count under a
+    ``<model>_quant`` label and fallbacks under ``quant_<reason>``, so a
+    config regression that silently pushes the quantized lane onto the
+    XLA dequant-gather fallback is visible in the metrics."""
     reason = None
     if not flash_decode_enabled():
         reason = "disabled"
@@ -138,21 +143,23 @@ def decode_dispatch(model: str, *, q_len: int, has_mask: bool,
             reason = "grad_mode"
     if reason is None:
         if _obs_on[0]:
-            _fd_hits.labels(model).inc()
+            _fd_hits.labels(model + ("_quant" if quantized else "")).inc()
         return True
     if _obs_on[0]:
-        _fd_fallbacks.labels(reason).inc()
+        _fd_fallbacks.labels(("quant_" if quantized else "") + reason).inc()
     return False
 
 
 def paged_decode_dispatch(model: str, *, q_len: int, has_mask: bool,
-                          dtype) -> bool:
+                          dtype, quantized: bool = False) -> bool:
     """Dispatch decision for the PAGED decode/chunk-prefill path: True
     -> ``paged_flash_decode_attention`` (block-table gather inside the
     kernel's index map); False -> the XLA gather fallback
-    (``gather_paged_kv`` + grouped SDPA), with the reason counted under
-    a ``paged_`` prefix. Same gates as ``decode_dispatch`` except the
-    query window covers the prefill chunk (``MAX_PAGED_Q_LEN``)."""
+    (``gather_paged_kv`` + grouped SDPA — ``gather_paged_kv_dequant``
+    for quantized pools), with the reason counted under a ``paged_``
+    prefix (``paged_quant_`` when the pool is quantized). Same gates as
+    ``decode_dispatch`` except the query window covers the prefill
+    chunk (``MAX_PAGED_Q_LEN``)."""
     reason = None
     if not flash_decode_enabled():
         reason = "disabled"
@@ -171,10 +178,12 @@ def paged_decode_dispatch(model: str, *, q_len: int, has_mask: bool,
             reason = "grad_mode"
     if reason is None:
         if _obs_on[0]:
-            _fd_hits.labels(model + "_paged").inc()
+            _fd_hits.labels(
+                model + "_paged" + ("_quant" if quantized else "")).inc()
         return True
     if _obs_on[0]:
-        _fd_fallbacks.labels("paged_" + reason).inc()
+        _fd_fallbacks.labels(
+            ("paged_quant_" if quantized else "paged_") + reason).inc()
     return False
 
 
@@ -227,6 +236,42 @@ def _compiler_kwargs():
     return {"compiler_params": _COMPILER_PARAMS}
 
 
+def _cell_partial(q, k, v, length, start, o_ref, m_ref, l_ref, *,
+                  block_k: int, sm_scale: float, q_len: int, group: int):
+    """The block's online-softmax partial for the whole query bundle —
+    shared by the plain and dequantizing kernel variants so the math can
+    never drift between them (quantized vs bf16 parity oracles depend on
+    identical masking/summation order)."""
+    gq, d = q.shape
+    sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                 precision=_dot_prec(q.dtype)) * sm_scale
+    kpos = start + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 1)
+    # query row r sits at absolute position pos + r // group; masking
+    # kpos <= qpos covers BOTH the right-pad beyond the row's length
+    # and causality inside the q_len window
+    qpos = (length - q_len) \
+        + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 0) // group
+    sc = jnp.where(kpos <= qpos, sc, NEG_INF)
+    m = sc.max(axis=-1)                # [gq] f32
+    p = jnp.exp(sc - m[:, None])
+    l = p.sum(axis=-1)
+    acc = jnp.dot(p.astype(v.dtype), v,
+                  preferred_element_type=jnp.float32,
+                  precision=_dot_prec(q.dtype))
+    o_ref[0, 0, 0] = acc
+    m_ref[0, 0, 0] = m[:, None]
+    l_ref[0, 0, 0] = l[:, None]
+
+
+def _cell_skip(o_ref, m_ref, l_ref, gq: int, d: int):
+    # skipped blocks still own their partial slots; the finite
+    # NEG_INF sentinel makes them exact zeros in the combine
+    # (exp(NEG_INF - m_total) underflows to 0, l contributes 0)
+    o_ref[0, 0, 0] = jnp.zeros((gq, d), jnp.float32)
+    m_ref[0, 0, 0] = jnp.full((gq, 1), NEG_INF, jnp.float32)
+    l_ref[0, 0, 0] = jnp.zeros((gq, 1), jnp.float32)
+
+
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
                    block_k: int, sm_scale: float, q_len: int, group: int):
     """One (batch row, kv head, kv block) cell: the block's online-
@@ -250,44 +295,72 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
         q = q_ref[0, :, 0].reshape(gq, d)  # rows r = i*group + g
         k = k_ref[0, :, 0, :]              # [block_k, d]
         v = v_ref[0, :, 0, :]
-        sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
-                     precision=_dot_prec(q.dtype)) * sm_scale
-        kpos = start + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 1)
-        # query row r sits at absolute position pos + r // group; masking
-        # kpos <= qpos covers BOTH the right-pad beyond the row's length
-        # and causality inside the q_len window
-        qpos = (length - q_len) \
-            + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 0) // group
-        sc = jnp.where(kpos <= qpos, sc, NEG_INF)
-        m = sc.max(axis=-1)                # [gq] f32
-        p = jnp.exp(sc - m[:, None])
-        l = p.sum(axis=-1)
-        acc = jnp.dot(p.astype(v.dtype), v,
-                      preferred_element_type=jnp.float32,
-                      precision=_dot_prec(q.dtype))
-        o_ref[0, 0, 0] = acc
-        m_ref[0, 0, 0] = m[:, None]
-        l_ref[0, 0, 0] = l[:, None]
+        _cell_partial(q, k, v, length, start, o_ref, m_ref, l_ref,
+                      block_k=block_k, sm_scale=sm_scale, q_len=q_len,
+                      group=group)
 
     @pl.when(start >= length)
     def _skip():
-        # skipped blocks still own their partial slots; the finite
-        # NEG_INF sentinel makes them exact zeros in the combine
-        # (exp(NEG_INF - m_total) underflows to 0, l contributes 0)
-        o_ref[0, 0, 0] = jnp.zeros((gq, d), jnp.float32)
-        m_ref[0, 0, 0] = jnp.full((gq, 1), NEG_INF, jnp.float32)
-        l_ref[0, 0, 0] = jnp.zeros((gq, 1), jnp.float32)
+        _cell_skip(o_ref, m_ref, l_ref, gq, d)
 
 
-def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int):
+def _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                         o_ref, m_ref, l_ref, *, block_k: int,
+                         sm_scale: float, q_len: int, group: int,
+                         bound: float):
+    """The quantized-cache cell: identical to ``_decode_kernel`` plus a
+    DEQUANT PROLOGUE — the int8/fp8 K/V block and its per-token absmax
+    scale column ([1, block_k, 1] f32) are widened to the query dtype in
+    VMEM before the MXU matmuls, so the HBM stream is the narrow one.
+    ``q * s / bound`` in that exact order matches
+    ``quantization.intx.unpack_absmax`` bitwise, keeping the kernel and
+    the XLA gather fallback interchangeable."""
+    b = pl.program_id(0)
+    s = pl.program_id(2)
+    length = lens_ref[b]
+    start = s * block_k
+    gq = q_len * group
+    d = q_ref.shape[-1]
+
+    @pl.when(start < length)
+    def _compute():
+        q = q_ref[0, :, 0].reshape(gq, d)
+        # dequant prologue: [block_k, d] narrow values * [block_k, 1]
+        # absmax scales, widened in VMEM — nothing else in the cell
+        # changes
+        ks = ks_ref[0, :, 0]
+        vs = vs_ref[0, :, 0]
+        k = (k_ref[0, :, 0, :].astype(jnp.float32)
+             * ks[:, None] / bound).astype(q.dtype)
+        v = (v_ref[0, :, 0, :].astype(jnp.float32)
+             * vs[:, None] / bound).astype(q.dtype)
+        _cell_partial(q, k, v, length, start, o_ref, m_ref, l_ref,
+                      block_k=block_k, sm_scale=sm_scale, q_len=q_len,
+                      group=group)
+
+    @pl.when(start >= length)
+    def _skip():
+        _cell_skip(o_ref, m_ref, l_ref, gq, d)
+
+
+def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int,
+                  k_scale=None, v_scale=None):
     """q5 [B, q_len, KV, group, d], caches [B, max_len, KV, d],
     lens [B] int32 -> [B, KV, gq, d] f32 (unnormalized layout rows
-    r = i*group + g, already combined and normalized)."""
+    r = i*group + g, already combined and normalized).
+
+    ``k_scale``/``v_scale`` ([B, max_len, KV] f32, both or neither):
+    the caches hold int8/fp8 and each grid cell dequantizes its block in
+    the kernel prologue (same grid, same index maps — the scale column
+    rides the K/V re-point-and-skip logic)."""
+    from ..quantization.intx import format_bound
+
     B, q_len, KV, group, d = q5.shape
     max_len = kc.shape[1]
     bk = pick_block(max_len, block_k)
     nb = max_len // bk
     gq = q_len * group
+    quant = k_scale is not None
 
     def _idx_q(b, h, s, lens):
         return (b, 0, h, 0, 0)
@@ -300,20 +373,28 @@ def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int):
         last = jnp.maximum(pl.cdiv(lens[b], bk) - 1, 0)
         return (b, jnp.minimum(s, last), h, 0)
 
+    def _idx_scale(b, h, s, lens):
+        last = jnp.maximum(pl.cdiv(lens[b], bk) - 1, 0)
+        return (b, jnp.minimum(s, last), h)
+
     def _idx_out(b, h, s, lens):
         return (b, h, s, 0, 0)
 
     def _idx_stat(b, h, s, lens):
         return (b, h, s, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, q_len, 1, group, d), _idx_q),
+        pl.BlockSpec((1, bk, 1, d), _idx_kv),
+        pl.BlockSpec((1, bk, 1, d), _idx_kv),
+    ]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bk, 1), _idx_scale),
+                     pl.BlockSpec((1, bk, 1), _idx_scale)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, KV, nb),
-        in_specs=[
-            pl.BlockSpec((1, q_len, 1, group, d), _idx_q),
-            pl.BlockSpec((1, bk, 1, d), _idx_kv),
-            pl.BlockSpec((1, bk, 1, d), _idx_kv),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, 1, gq, d), _idx_out),
             pl.BlockSpec((1, 1, 1, gq, 1), _idx_stat),
@@ -321,10 +402,26 @@ def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int):
         ],
     )
 
-    def kern(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref):
-        _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                       block_k=bk, sm_scale=sm_scale, q_len=q_len,
-                       group=group)
+    if quant:
+        bound = format_bound(
+            "int8" if kc.dtype == jnp.int8 else "fp8")
+
+        def kern(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                 m_ref, l_ref):
+            _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref,
+                                 vs_ref, o_ref, m_ref, l_ref, block_k=bk,
+                                 sm_scale=sm_scale, q_len=q_len,
+                                 group=group, bound=bound)
+
+        operands = (lens.astype(jnp.int32), q5, kc, vc,
+                    k_scale.astype(jnp.float32), v_scale.astype(jnp.float32))
+    else:
+        def kern(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref):
+            _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                           l_ref, block_k=bk, sm_scale=sm_scale,
+                           q_len=q_len, group=group)
+
+        operands = (lens.astype(jnp.int32), q5, kc, vc)
 
     o_p, m_p, l_p = pl.pallas_call(
         kern,
@@ -334,7 +431,7 @@ def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int):
                    jax.ShapeDtypeStruct((B, KV, nb, gq, 1), jnp.float32)),
         interpret=_interpret(),
         **_compiler_kwargs(),
-    )(lens.astype(jnp.int32), q5, kc, vc)
+    )(*operands)
 
     # split-K combine (tiny: nb * gq * d floats per row/head): classic
     # log-sum-exp merge of the blocks' partials. Skipped blocks carry
@@ -347,8 +444,16 @@ def _flash_decode(q5, kc, vc, lens, *, sm_scale: float, block_k: int):
     return acc / jnp.maximum(l_tot, 1e-30)
 
 
+def _unwrap(x):
+    from ..core.tensor import Tensor
+
+    if x is None:
+        return None
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
 def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
-                           block_k: int = 256):
+                           block_k: int = 256, k_scale=None, v_scale=None):
     """Flash-decode attention over the static KV caches.
 
     q: [B, q_len, heads, d] (q_len <= MAX_DECODE_Q_LEN); k_cache/v_cache:
@@ -361,12 +466,21 @@ def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
     heads must be a multiple of kv_heads; query head j reads kv head
     j // (heads // kv_heads) (the repeat_kv mapping) without ever
     materializing the expansion.
+
+    QUANTIZED caches: pass the per-token-per-head absmax scales
+    ``k_scale``/``v_scale`` ([B, max_len, kv_heads] f32, the
+    ``make_kv_caches(kv_format=...)`` companions) and int8/fp8 caches —
+    each grid cell dequantizes its block in the kernel prologue, so the
+    HBM stream is the narrow one and nothing else changes.
     """
     from ..core.tensor import Tensor
     from ..ops.dispatch import apply_op
 
     is_tensor = isinstance(q, Tensor)
     pos_arr = positions._data if isinstance(positions, Tensor) else positions
+    ks_arr, vs_arr = _unwrap(k_scale), _unwrap(v_scale)
+    if (ks_arr is None) != (vs_arr is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
 
     def _f(qa, ka, va):
         B, q_len, H, d = qa.shape
@@ -380,7 +494,8 @@ def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
             pos = jnp.broadcast_to(pos, (B,))
         lens = jnp.minimum(pos + q_len, ka.shape[1])
         q5 = qa.reshape(B, q_len, KV, group, d)
-        o = _flash_decode(q5, ka, va, lens, sm_scale=scale, block_k=block_k)
+        o = _flash_decode(q5, ka, va, lens, sm_scale=scale, block_k=block_k,
+                          k_scale=ks_arr, v_scale=vs_arr)
         # [B, KV, q_len*group, d] rows r = i*group + g -> [B, q_len, H, d]
         o = o.reshape(B, KV, q_len, group, d)
         o = jnp.transpose(o, (0, 2, 1, 3, 4)).reshape(B, q_len, H, d)
@@ -391,7 +506,8 @@ def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
     return _f(jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache))
 
 
-def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
+def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
+                        k_scale=None, v_scale=None):
     """q5 [B, q_len, KV, group, d], pools [num_blocks, bs, KV, d],
     bt [B, nb] int32, lens [B] int32 -> [B, KV, gq, d] f32 (combined and
     normalized). Identical math to ``_flash_decode`` — the only change
@@ -399,11 +515,18 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
     through the scalar-prefetched block table into a physical pool
     block. Out-of-range blocks re-point at the row's LAST needed logical
     block (the same Pallas revisit-skip as the contiguous kernel), so a
-    short row costs its own length, not the table width."""
+    short row costs its own length, not the table width.
+
+    ``k_scale``/``v_scale`` ([num_blocks, bs, KV] f32): quantized pools
+    — the scale column rides the same table-indirected index map and the
+    cell dequantizes its block in the prologue."""
+    from ..quantization.intx import format_bound
+
     B, q_len, KV, group, d = q5.shape
     bs = kp.shape[1]
     nb = bt.shape[1]
     gq = q_len * group
+    quant = k_scale is not None
 
     def _idx_q(b, h, s, lens, bt):
         return (b, 0, h, 0, 0)
@@ -412,17 +535,25 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
         last = jnp.maximum(pl.cdiv(lens[b], bs) - 1, 0)
         return (bt[b, jnp.minimum(s, last)], 0, h, 0)
 
+    def _idx_scale(b, h, s, lens, bt):
+        last = jnp.maximum(pl.cdiv(lens[b], bs) - 1, 0)
+        return (bt[b, jnp.minimum(s, last)], 0, h)
+
     def _idx_out(b, h, s, lens, bt):
         return (b, h, s, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, q_len, 1, group, d), _idx_q),
+        pl.BlockSpec((1, bs, 1, d), _idx_kv),
+        pl.BlockSpec((1, bs, 1, d), _idx_kv),
+    ]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bs, 1), _idx_scale),
+                     pl.BlockSpec((1, bs, 1), _idx_scale)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, nb),
-        in_specs=[
-            pl.BlockSpec((1, q_len, 1, group, d), _idx_q),
-            pl.BlockSpec((1, bs, 1, d), _idx_kv),
-            pl.BlockSpec((1, bs, 1, d), _idx_kv),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, 1, gq, d), _idx_out),
             pl.BlockSpec((1, 1, 1, gq, 1), _idx_out),
@@ -430,13 +561,33 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
         ],
     )
 
-    def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref):
-        # bt_ref is consumed by the index maps; the cell body itself is
-        # the contiguous kernel verbatim (same lens-bounded masking)
-        del bt_ref
-        _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                       block_k=bs, sm_scale=sm_scale, q_len=q_len,
-                       group=group)
+    if quant:
+        bound = format_bound("int8" if kp.dtype == jnp.int8 else "fp8")
+
+        def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                  o_ref, m_ref, l_ref):
+            del bt_ref
+            _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref,
+                                 vs_ref, o_ref, m_ref, l_ref, block_k=bs,
+                                 sm_scale=sm_scale, q_len=q_len,
+                                 group=group, bound=bound)
+
+        operands = (lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp,
+                    vp, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+    else:
+        def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                  l_ref):
+            # bt_ref is consumed by the index maps; the cell body itself
+            # is the contiguous kernel verbatim (same lens-bounded
+            # masking)
+            del bt_ref
+            _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                           l_ref, block_k=bs, sm_scale=sm_scale,
+                           q_len=q_len, group=group)
+
+        operands = (lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp,
+                    vp)
 
     o_p, m_p, l_p = pl.pallas_call(
         _kern,
@@ -446,7 +597,7 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
                    jax.ShapeDtypeStruct((B, KV, nb, gq, 1), jnp.float32)),
         interpret=_interpret(),
         **_compiler_kwargs(),
-    )(lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp, vp)
+    )(*operands)
 
     m_tot = m_p.max(axis=2)
     alpha = jnp.exp(m_p - m_tot[:, :, None])
@@ -456,7 +607,7 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float):
 
 
 def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
-                                 sm_scale=None):
+                                 sm_scale=None, k_scale=None, v_scale=None):
     """Flash-decode attention over PAGED KV pools.
 
     q: [B, q_len, heads, d] (q_len <= MAX_PAGED_Q_LEN — the serving
@@ -468,6 +619,12 @@ def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
     ``block_table[b, j]``; ``positions``: per-row [B] int32 vector or
     scalar, same contract as ``flash_decode_attention``. Returns
     [B, q_len, heads, d] in q's dtype.
+
+    QUANTIZED pools: pass the [num_blocks, block_size, kv_heads] f32
+    absmax scale pools as ``k_scale``/``v_scale``
+    (``make_paged_kv_pools(kv_format=...)``'s ``ks``/``vs``) — dequant
+    happens in the kernel prologue, per block, behind the same
+    table-indirected index map.
     """
     from ..core.tensor import Tensor
     from ..ops.dispatch import apply_op
@@ -476,6 +633,9 @@ def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
     pos_arr = positions._data if isinstance(positions, Tensor) else positions
     bt_arr = block_table._data if isinstance(block_table, Tensor) \
         else block_table
+    ks_arr, vs_arr = _unwrap(k_scale), _unwrap(v_scale)
+    if (ks_arr is None) != (vs_arr is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
 
     def _f(qa, ka, va):
         B, q_len, H, d = qa.shape
@@ -494,7 +654,8 @@ def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
         max_len = bt.shape[1] * ka.shape[1]
         lens = jnp.minimum(pos + q_len, max_len)
         q5 = qa.reshape(B, q_len, KV, group, d)
-        o = _paged_flash_decode(q5, ka, va, bt, lens, sm_scale=scale)
+        o = _paged_flash_decode(q5, ka, va, bt, lens, sm_scale=scale,
+                                k_scale=ks_arr, v_scale=vs_arr)
         o = o.reshape(B, KV, q_len, group, d)
         o = jnp.transpose(o, (0, 2, 1, 3, 4)).reshape(B, q_len, H, d)
         return o.astype(qa.dtype)

@@ -4,17 +4,20 @@ Parity: python/paddle/quantization/ (QuantConfig, QAT, PTQ, observers,
 quanters) and paddle/nn/quant/ quanted layers.
 """
 
+from . import intx
+from .intx import pack_absmax, unpack_absmax
 from .observers import (AbsmaxObserver, BaseObserver, HistObserver,
                         MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver)
+from .ptq_serving import convert_for_serving
 from .qat import (PTQ, QAT, QuantConfig, QuantedConv2D, QuantedLinear, convert)
 from .quanters import (FakeQuanterChannelWiseAbsMax, FakeQuanterWithAbsMaxObserver,
                        fake_quant_dequant)
 
 __all__ = [
-    "QuantConfig", "QAT", "PTQ", "convert",
+    "QuantConfig", "QAT", "PTQ", "convert", "convert_for_serving",
     "QuantedLinear", "QuantedConv2D",
     "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
     "PerChannelAbsmaxObserver", "HistObserver",
     "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
-    "fake_quant_dequant",
+    "fake_quant_dequant", "intx", "pack_absmax", "unpack_absmax",
 ]

@@ -1,0 +1,121 @@
+"""Real int8/fp8 pack/unpack — the storage half of the absmax scheme.
+
+``quanters.fake_quant_dequant`` SIMULATES quantization for QAT (float
+in, float out, STE backward). These helpers are the serving-side twin:
+they actually store the narrow values (int8, or float8_e4m3 where the
+jnp dtype exists) plus the absmax scale, under the SAME convention —
+
+    q      = clip(round(x / max(scale, 1e-9) * bound), -bound, bound)
+    x_hat  = q * max(scale, 1e-9) / bound
+
+so ``unpack_absmax(pack_absmax(x, s), s) == fake_quant_dequant(x, s)``
+bit-for-bit for int8 (the round-trip parity test in
+tests/test_quantization.py pins this; QAT numerics and the quantized
+serving path can never drift apart). fp8 replaces round+clip with the
+e4m3 cast (its rounding IS the format) and bound 448 (e4m3 max finite).
+
+The KV-cache and weight-only serving paths (generation.py paged pools,
+pallas_kernels/quant_matmul.py) build on these — this module is where
+``paddle_tpu/quantization/`` finally touches a hot path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KV_FORMATS", "INT8_BOUND", "FP8_BOUND", "fp8_dtype",
+           "fp8_available", "format_bound", "format_dtype",
+           "format_itemsize", "pack_absmax", "unpack_absmax",
+           "absmax_along"]
+
+# storage formats the quantized serving paths understand; "bf16" means
+# "not quantized — store the compute dtype" and is the default
+KV_FORMATS = ("bf16", "int8", "fp8")
+
+INT8_BOUND = 127.0
+FP8_BOUND = 448.0  # float8_e4m3 max finite magnitude
+
+
+def fp8_dtype():
+    """The e4m3 jnp dtype, or None on jax builds without ml_dtypes fp8
+    (int8 is the portable floor — callers gate on this)."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_available() -> bool:
+    return fp8_dtype() is not None
+
+
+def format_bound(fmt: str) -> float:
+    if fmt == "int8":
+        return INT8_BOUND
+    if fmt == "fp8":
+        return FP8_BOUND
+    raise ValueError(f"no quantization bound for format {fmt!r} "
+                     f"(quantized formats: int8, fp8)")
+
+
+def format_dtype(fmt: str):
+    """Storage dtype for a quantized format."""
+    if fmt == "int8":
+        return jnp.int8
+    if fmt == "fp8":
+        dt = fp8_dtype()
+        if dt is None:
+            raise ValueError(
+                "kv/weight format 'fp8' needs jnp.float8_e4m3fn, which "
+                "this jax build does not expose — use 'int8' (the "
+                "portable floor, same scale convention)")
+        return dt
+    raise ValueError(f"no storage dtype for format {fmt!r}")
+
+
+def format_itemsize(fmt: str) -> int:
+    """Bytes per stored element (int8 and fp8 are both 1)."""
+    return jnp.dtype(format_dtype(fmt)).itemsize
+
+
+def absmax_along(x, axis):
+    """Absmax reduction — the scale the observers/quanters use."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
+
+
+def pack_absmax(x, scale, fmt: str = "int8"):
+    """Quantize ``x`` to the format's storage dtype given absmax
+    ``scale`` (broadcastable against x). Same clip/round convention as
+    ``fake_quant_dequant``; fp8's cast does the rounding."""
+    bound = format_bound(fmt)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    scaled = x.astype(jnp.float32) / s * bound
+    if fmt == "int8":
+        return jnp.clip(jnp.round(scaled), -bound, bound).astype(jnp.int8)
+    return jnp.clip(scaled, -bound, bound).astype(format_dtype(fmt))
+
+
+def unpack_absmax(q, scale, fmt: str = "int8", dtype=jnp.float32):
+    """Dequantize storage values back to ``dtype`` given the absmax
+    ``scale`` they were packed with."""
+    bound = format_bound(fmt)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    # q * s / bound in that order — the exact fake_quant_dequant chain,
+    # so the round-trip parity with the QAT simulator is bitwise
+    return (q.astype(jnp.float32) * s / bound).astype(dtype)
+
+
+# numpy twins for test oracles / benches (no jax dependency in refs)
+def np_pack_absmax(x, scale, fmt: str = "int8"):
+    bound = format_bound(fmt)
+    s = np.maximum(np.asarray(scale, np.float32), 1e-9)
+    scaled = np.asarray(x, np.float32) / s * bound
+    if fmt == "int8":
+        return np.clip(np.round(scaled), -bound, bound).astype(np.int8)
+    import ml_dtypes
+
+    return np.clip(scaled, -bound, bound).astype(ml_dtypes.float8_e4m3fn)
+
+
+def np_unpack_absmax(q, scale, fmt: str = "int8"):
+    bound = format_bound(fmt)
+    s = np.maximum(np.asarray(scale, np.float32), 1e-9)
+    return np.asarray(q, np.float32) * s / bound

@@ -1,0 +1,52 @@
+"""One-shot weight-only PTQ for the serving path.
+
+``convert_for_serving`` is the ``quantization.convert``-style entry that
+finally points this package at a hot path: it walks the model's decode-
+hot ``nn.Linear`` layers (q/k/v/o projections, MLP, lm_head — every
+Linear unless filtered), computes per-output-channel scales with the
+EXISTING ``PerChannelAbsmaxObserver`` (quant_axis=1: the out dim of our
+[in, out] weights), packs the weights through ``intx.pack_absmax`` (the
+same absmax convention ``fake_quant_dequant`` simulates), and installs
+``nn.quant.WeightOnlyLinear`` twins whose forward dispatches to the
+Pallas ``quant_matmul`` kernel behind ``PADDLE_TPU_QUANT_WEIGHTS``
+(XLA dequant-fusion fallback otherwise).
+
+The weight path needs no calibration data — weights are static, so one
+observer pass over each tensor IS the calibration. Activation PTQ/QAT
+stay in ``qat.py``; a QAT'd model whose fake-quant scales you trust can
+be converted here afterwards and the numerics line up by construction
+(same absmax convention end to end).
+"""
+
+from __future__ import annotations
+
+from .observers import PerChannelAbsmaxObserver
+
+__all__ = ["convert_for_serving"]
+
+
+def convert_for_serving(model, fmt: str = "int8", include=None):
+    """Replace every ``nn.Linear`` (modulo ``include(name, layer)``)
+    with a real-int8/fp8 ``WeightOnlyLinear``, scales observed per
+    output channel. Returns the model (modified in place, eval mode)."""
+    from .. import nn
+    from ..nn.quant import WeightOnlyLinear
+    from .intx import format_dtype
+
+    format_dtype(fmt)  # actionable error for unavailable fp8
+
+    def _walk(layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            qual = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, nn.Linear):
+                if include is None or include(qual, sub):
+                    ob = PerChannelAbsmaxObserver(quant_axis=1)
+                    ob.observe(sub.weight)
+                    layer._sub_layers[name] = WeightOnlyLinear.from_linear(
+                        sub, fmt=fmt, scale=ob.scales())
+            else:
+                _walk(sub, qual)
+
+    _walk(model, "")
+    model.eval()
+    return model

@@ -148,6 +148,16 @@ class ServingConfig:
       query positions through the paged kernel). Requests opt out (or
       shrink their k) per-request via ``SamplingParams.spec_k``; ignored
       without a draft model.
+    - ``kv_format``: KV block storage (paged only) — ``"bf16"`` keeps
+      the model compute dtype (default); ``"int8"``/``"fp8"`` store the
+      pool narrow with per-token-per-head absmax scale pools riding the
+      same blocks: writes quantize in the scatter epilogue, the paged
+      flash-decode kernel dequantizes in its prologue (XLA fallback at
+      the gather), roughly doubling the tokens a fixed KV HBM budget
+      holds. COW forks, prefix sharing, preemption-resume, and the
+      spec-decode lane all operate on quantized blocks unchanged. fp8
+      uses the e4m3 jnp dtype where available; int8 is the portable
+      floor.
     """
 
     max_slots: int = 4
@@ -161,12 +171,29 @@ class ServingConfig:
     prefill_chunk: int = 32
     prefix_caching: bool = True
     spec_k: int = 4
+    kv_format: str = "bf16"
 
     def __post_init__(self):
         if self.kv_mode not in ("paged", "contiguous"):
             raise ValueError(
                 f"kv_mode must be 'paged' or 'contiguous', got "
                 f"{self.kv_mode!r}")
+        from ..quantization.intx import KV_FORMATS, format_dtype
+
+        if self.kv_format not in KV_FORMATS:
+            raise ValueError(
+                f"kv_format must be one of {KV_FORMATS}, got "
+                f"{self.kv_format!r}")
+        if self.kv_format != "bf16":
+            format_dtype(self.kv_format)  # actionable fp8-missing error
+            if self.kv_mode != "paged":
+                raise ValueError(
+                    f"kv_format={self.kv_format!r} requires "
+                    f"kv_mode='paged': quantized KV lives in the block "
+                    f"pool (per-block scale companions, dequant in the "
+                    f"paged kernel prologue) — switch kv_mode to 'paged' "
+                    f"or drop kv_format (the contiguous engine is the "
+                    f"bf16 A/B baseline)")
         from ..pallas_kernels.decode_attention import MAX_SPEC_K
 
         if not 0 <= int(self.spec_k) <= MAX_SPEC_K:
@@ -384,7 +411,18 @@ class ServingEngine:
         self.pool = BlockPool(self._nblocks, bs)
         self.prefix_cache = PrefixCache(self.pool) if config.prefix_caching \
             else None
-        self._pools = make_paged_kv_pools(mcfg, self._nblocks, bs, self._dtype)
+        self._pools = make_paged_kv_pools(mcfg, self._nblocks, bs,
+                                          self._dtype, config.kv_format)
+        # the executables below round-trip the pool dicts generically so
+        # quantized pools (extra ks/vs scale arrays) ride every program
+        # — chunk, step, COW, draft, verify — without a second variant
+        pool_keys = tuple(self._pools[0].keys())
+        self._pool_keys = pool_keys
+        from ..generation import kv_cache_bytes_per_token
+        self._kv_bytes_per_token = kv_cache_bytes_per_token(
+            mcfg, config.kv_format, self._dtype)
+        _sm.kv_bytes_per_token.labels(config.kv_format).set(
+            self._kv_bytes_per_token)
         self._bt = np.zeros((B, nb), np.int32)           # host block tables
         self._slot_blocks: List[List[int]] = [[] for _ in range(B)]
         self._slot_len = [0] * B                         # host mirror of pos
@@ -401,7 +439,8 @@ class ServingEngine:
             # tables, so one host-side allocator/prefix-cache/COW
             # bookkeeping drives both models' caches
             self._dpools = make_paged_kv_pools(
-                self._dcfg, self._nblocks, bs, self._ddtype)
+                self._dcfg, self._nblocks, bs, self._ddtype,
+                config.kv_format)
             self._drun = make_cached_runner(self.draft_model)
 
         C = int(config.prefill_chunk)
@@ -417,8 +456,8 @@ class ServingEngine:
             only when ``is_last`` (traced — chunk count never retraces);
             the select itself is computed every chunk and simply unused
             until then."""
-            caches = [{"k": c["k"], "v": c["v"], "bt": bt_row,
-                       "valid": valid[None]} for c in pools]
+            caches = [dict(c, bt=bt_row, valid=valid[None])
+                      for c in pools]
             logits, newc = run(pb, ids, caches, pos0)
             last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
                                                 axis=1)[:, 0]
@@ -443,7 +482,7 @@ class ServingEngine:
                 _sel(temp[0], state["temp"][slot]))
             state["tk"] = state["tk"].at[slot].set(_sel(tk[0], state["tk"][slot]))
             state["tp"] = state["tp"].at[slot].set(_sel(tp[0], state["tp"][slot]))
-            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
             return token, pools_out, state
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
@@ -456,7 +495,7 @@ class ServingEngine:
             ``any_sampling`` cond skipping the sampler for pure-argmax
             pools, free rows pinned to pos 0. Compiles exactly once —
             occupancy, length mix, and SHARING patterns are all data."""
-            caches = [{"k": c["k"], "v": c["v"], "bt": bt} for c in pools]
+            caches = [dict(c, bt=bt) for c in pools]
             logits, newc = run(pb, state["tokens"][:, None], caches,
                                state["pos"])
             last = logits[:, 0]
@@ -473,7 +512,7 @@ class ServingEngine:
                 jnp.minimum(state["pos"] + 1, jnp.int32(config.max_len - 1)),
                 jnp.int32(0))
             state["keys"] = new_keys
-            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
             return nxt, pools_out, state
 
         @functools.partial(jax.jit, donate_argnums=(0,))
@@ -483,8 +522,8 @@ class ServingEngine:
             src/dst are traced so every fork shares the executable)."""
             out = []
             for c in pools:
-                out.append({"k": c["k"].at[dst].set(c["k"][src]),
-                            "v": c["v"].at[dst].set(c["v"][src])})
+                out.append({kk: c[kk].at[dst].set(c[kk][src])
+                            for kk in c})
             return out
 
         self._chunk_fn = _chunk
@@ -525,6 +564,7 @@ class ServingEngine:
         config = self.config
         k = self._spec_k
         drun = self._drun
+        pool_keys = self._pool_keys
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _draft(dpb, dpools, state, bt, spec_valid, any_sampling):
@@ -540,8 +580,8 @@ class ServingEngine:
             drafts = []
             cur = dpools
             for j in range(k):
-                caches = [{"k": c["k"], "v": c["v"], "bt": bt,
-                           "valid": jnp.maximum(spec_valid - j, 0)}
+                caches = [dict(c, bt=bt,
+                               valid=jnp.maximum(spec_valid - j, 0))
                           for c in cur]
                 logits, newdc = drun(dpb, tok[:, None], caches, pos + j)
                 last = logits[:, 0]
@@ -553,7 +593,7 @@ class ServingEngine:
                         state["tp"]),
                     lambda l=last: jnp.argmax(l, axis=-1).astype(jnp.int32))
                 drafts.append(tok)
-                cur = [{"k": c["k"], "v": c["v"]} for c in newdc]
+                cur = [{kk: c[kk] for kk in pool_keys} for c in newdc]
             # one write-only forward for the LAST draft token: on a
             # full accept the sequence advances past pos+k, and d_k's
             # draft KV was only ever an output — without this write the
@@ -561,11 +601,10 @@ class ServingEngine:
             # chain (accept rate halves; outputs are unaffected since
             # verify is target-authoritative). Dump-routed unless the
             # row's bundle really spans k+1 positions.
-            caches = [{"k": c["k"], "v": c["v"], "bt": bt,
-                       "valid": jnp.maximum(spec_valid - k, 0)}
+            caches = [dict(c, bt=bt, valid=jnp.maximum(spec_valid - k, 0))
                       for c in cur]
             _, newdc = drun(dpb, tok[:, None], caches, pos + k)
-            cur = [{"k": c["k"], "v": c["v"]} for c in newdc]
+            cur = [{kk: c[kk] for kk in pool_keys} for c in newdc]
             return jnp.stack(drafts, axis=1), cur
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
@@ -579,8 +618,7 @@ class ServingEngine:
             mixed spec/non-spec pools share this one executable."""
             bundle = jnp.concatenate([state["tokens"][:, None], drafts],
                                      axis=1)
-            caches = [{"k": c["k"], "v": c["v"], "bt": bt,
-                       "valid": spec_valid} for c in pools]
+            caches = [dict(c, bt=bt, valid=spec_valid) for c in pools]
             logits, newc = run(pb, bundle, caches, state["pos"])
             levels, subs = split_key_levels(state["keys"], k + 1)
             V = logits.shape[-1]
@@ -611,7 +649,7 @@ class ServingEngine:
                             jnp.int32(config.max_len - 1)),
                 jnp.int32(0))
             state["keys"] = new_keys
-            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
+            pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
             return cand, n_emit, pools_out, state
 
         @functools.partial(jax.jit, donate_argnums=(2, 3, 4))
@@ -623,10 +661,10 @@ class ServingEngine:
             block table, so prefix-cached blocks carry BOTH models' KV
             and preemption-resume re-prefills both. Select/state logic
             is the plain chunk's, verbatim."""
-            caches = [{"k": c["k"], "v": c["v"], "bt": bt_row,
-                       "valid": valid[None]} for c in pools]
-            dcaches = [{"k": c["k"], "v": c["v"], "bt": bt_row,
-                       "valid": valid[None]} for c in dpools]
+            caches = [dict(c, bt=bt_row, valid=valid[None])
+                      for c in pools]
+            dcaches = [dict(c, bt=bt_row, valid=valid[None])
+                       for c in dpools]
             logits, newc = run(pb, ids, caches, pos0)
             _, newdc = drun(dpb, ids, dcaches, pos0)
             last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
@@ -652,8 +690,8 @@ class ServingEngine:
                 _sel(temp[0], state["temp"][slot]))
             state["tk"] = state["tk"].at[slot].set(_sel(tk[0], state["tk"][slot]))
             state["tp"] = state["tp"].at[slot].set(_sel(tp[0], state["tp"][slot]))
-            pools_out = [{"k": c["k"], "v": c["v"]} for c in newc]
-            dpools_out = [{"k": c["k"], "v": c["v"]} for c in newdc]
+            pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
+            dpools_out = [{kk: c[kk] for kk in pool_keys} for c in newdc]
             return token, pools_out, dpools_out, state
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -661,11 +699,11 @@ class ServingEngine:
             """COW fork across BOTH models' pools (same block ids)."""
             out, dout = [], []
             for c in pools:
-                out.append({"k": c["k"].at[dst].set(c["k"][src]),
-                            "v": c["v"].at[dst].set(c["v"][src])})
+                out.append({kk: c[kk].at[dst].set(c[kk][src])
+                            for kk in c})
             for c in dpools:
-                dout.append({"k": c["k"].at[dst].set(c["k"][src]),
-                             "v": c["v"].at[dst].set(c["v"][src])})
+                dout.append({kk: c[kk].at[dst].set(c[kk][src])
+                             for kk in c})
             return out, dout
 
         self._draft_fn = _draft
@@ -1590,9 +1628,14 @@ class ServingEngine:
 
     def kv_block_stats(self) -> Optional[dict]:
         """Pool utilization + internal fragmentation (allocated token
-        slots the slots' sequences do not fill) — paged mode only."""
+        slots the slots' sequences do not fill) — paged mode only.
+        Carries the quantization accounting: the storage format, bytes
+        per cached token (values + scales, all layers), and the
+        capacity multiplier vs a bf16 pool of the same HBM budget."""
         if not self.paged:
             return None
+        from ..generation import kv_cache_bytes_per_token
+
         stats = self.pool.stats()
         bs = self.config.block_size
         frag = 0
@@ -1603,6 +1646,12 @@ class ServingEngine:
                 else self._slot_len[slot]
             frag += len(self._slot_blocks[slot]) * bs - used
         stats["internal_fragmentation_tokens"] = frag
+        stats["kv_format"] = self.config.kv_format
+        stats["bytes_per_token"] = self._kv_bytes_per_token
+        stats["effective_capacity_tokens"] = self.pool.usable_blocks * bs
+        bf16 = kv_cache_bytes_per_token(self._mcfg, "bf16", self._dtype)
+        stats["capacity_vs_bf16"] = round(
+            bf16 / max(1, self._kv_bytes_per_token), 3)
         return stats
 
     def debug_requests(self) -> dict:
@@ -1650,6 +1699,7 @@ class ServingEngine:
         if self.paged:
             out["block_size"] = self.config.block_size
             out["prefill_chunk"] = self.config.prefill_chunk
+            out["kv_format"] = self.config.kv_format
             out["kv_blocks"] = self.kv_block_stats()
             out["prefix_cache"] = (self.prefix_cache.stats()
                                    if self.prefix_cache is not None else None)

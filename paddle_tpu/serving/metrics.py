@@ -19,6 +19,7 @@ __all__ = [
     "kv_blocks_total", "kv_blocks_in_use", "kv_blocks_shared",
     "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
     "cow_forks_total", "preemptions_total", "prefill_chunks_total",
+    "kv_bytes_per_token",
     "ttft_summary", "tpot_summary", "queue_wait_seconds",
     "prefill_chunk_seconds", "goodput_tokens_per_second",
     "latency_digests", "spec_drafted_tokens", "spec_accepted_tokens",
@@ -84,6 +85,14 @@ preemptions_total = _m.counter(
 prefill_chunks_total = _m.counter(
     "paddle_tpu_serving_prefill_chunks_total",
     "fixed-size prefill chunks executed (chunked-prefill admission)")
+# -- quantized KV (int8/fp8 block pools) -----------------------------------
+kv_bytes_per_token = _m.gauge(
+    "paddle_tpu_kv_bytes_per_token",
+    "HBM bytes one cached token costs across all layers (K+V values "
+    "plus, for quantized formats, the per-token-per-head f32 absmax "
+    "scales) — set per engine at construction; the capacity math "
+    "bf16_bytes / fmt_bytes is the pool-size multiplier a fixed HBM "
+    "budget buys", ("format",))
 # -- speculative decoding (draft-model engines) ----------------------------
 spec_drafted_tokens = _m.counter(
     "paddle_tpu_serving_spec_drafted_tokens_total",

@@ -76,6 +76,13 @@ TPU_LANE = [
     # container — pair with benchmarks/bench_spec_decode.py for the
     # >=1.3x coupled-draft acceptance on chip
     ("test_spec_decode.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # quantized serving: int8/fp8 KV pools (dequant in the paged kernel
+    # prologue) + weight-only Pallas quant matmul; CPU-interpret-verified
+    # in the build container — this entry is the quantized kernels' first
+    # compiled run (pair with benchmarks/bench_paged_kv.py kv_format_ab
+    # for the >=1.8x fixed-budget capacity and bench_quant_matmul.py)
+    ("test_quantization_serving.py", 420,
+     {"PADDLE_TPU_FLASH_DECODE": "1", "PADDLE_TPU_QUANT_WEIGHTS": "1"}),
     *[(f"test_op_schema_sweep.py", 600,
        {"PADDLE_TPU_SWEEP_SHARD": f"{i}/8"}) for i in range(8)],
     # sampled FD-grad lane (every 16th schema incl. grads): ~2 s/op of
@@ -110,6 +117,16 @@ TPU_TOLERANCE_DELTAS = [
               "first compiled run (tests/test_paged_kv.py + "
               "benchmarks/bench_paged_kv.py for the >=1.5x concurrent-"
               "capacity acceptance at a fixed HBM budget)",
+     "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
+    {"where": "flash_decode_attention_int8 / paged_flash_decode_attention_"
+              "int8 / quant_matmul",
+     "delta": "bf16-activation-only on chip (int8/fp8 storage + bf16 "
+              "compute is the production pairing; fp32 activations swept "
+              "on CPU in interpret mode); int8 VMEM tiling wants "
+              "sublane >= 32 — small block_size pools rely on Mosaic "
+              "padding, first compiled run is this lane "
+              "(tests/test_quantization_serving.py + "
+              "benchmarks/bench_quant_matmul.py)",
      "source": "tests/test_op_schema_sweep.py _TPU_HALF_ONLY"},
     {"where": "power_to_db",
      "delta": "5e-4 vs the CPU 1e-5 oracle tolerance (TPU log/pow "
@@ -316,6 +333,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     decode_bench = _read_bench("bench_decode.json")
     paged_kv_bench = _read_bench("bench_paged_kv.json")
     spec_decode_bench = _read_bench("bench_spec_decode.json")
+    quant_bench = _read_bench("bench_quant.json")
     out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
                             "telemetry_lane.json")
     with open(out_path, "w") as fh:
@@ -330,6 +348,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
             "decode_bench": decode_bench,
             "paged_kv_bench": paged_kv_bench,
             "spec_decode_bench": spec_decode_bench,
+            "quant_bench": quant_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

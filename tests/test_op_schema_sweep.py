@@ -60,7 +60,11 @@ _TPU_HALF_ONLY = {"flash_attention", "flash_attn_varlen",
                   # same MXU contract as flash: bf16 operands / f32
                   # accumulate (production dtype); fp32 swept on CPU
                   "fused_conv_bn_train", "fused_conv_bn_eval",
-                  "flash_decode_attention", "paged_flash_decode_attention"}
+                  "flash_decode_attention", "paged_flash_decode_attention",
+                  # quantized lanes: int8/fp8 storage + bf16 compute is
+                  # the production pairing; fp32 activations swept on CPU
+                  "flash_decode_attention_int8",
+                  "paged_flash_decode_attention_int8", "quant_matmul"}
 
 
 def test_registry_is_populated():

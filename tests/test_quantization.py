@@ -192,3 +192,64 @@ class TestReviewRegressions2:
                            "model_cfg": {"hidden_size": 256, "num_layers": 2,
                                          "vocab_size": 1000, "seq_length": 128}})
         assert tuner.candidates
+
+
+class TestRealPackUnpackParity:
+    """The serving-side int8 helpers (quantization.intx) and the QAT
+    fake-quant simulator share ONE absmax convention — pinned bitwise,
+    so fake-quant QAT numerics and the quantized KV/weight serving path
+    can never drift apart."""
+
+    def test_int8_roundtrip_bitwise_matches_fake_quant(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization import intx
+
+        rng = np.random.RandomState(7)
+        x = rng.randn(128).astype("float32") * 3.0
+        scale = float(np.abs(x).max())
+        fake = Q.fake_quant_dequant(paddle.to_tensor(x), scale).numpy()
+        q = intx.pack_absmax(jnp.asarray(x), scale, "int8")
+        real = np.asarray(intx.unpack_absmax(q, scale, "int8"))
+        assert q.dtype == jnp.int8
+        assert np.array_equal(fake, real)  # bitwise, not allclose
+
+    def test_int8_roundtrip_per_channel_scales(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization import intx
+
+        rng = np.random.RandomState(8)
+        x = rng.randn(6, 16).astype("float32")
+        amax = np.abs(x).max(axis=1)
+        fake = Q.fake_quant_dequant(
+            paddle.to_tensor(x), amax, quant_bits=8, quant_axis=0).numpy()
+        q = intx.pack_absmax(jnp.asarray(x), amax[:, None], "int8")
+        real = np.asarray(intx.unpack_absmax(q, amax[:, None], "int8"))
+        assert np.array_equal(fake, real)
+
+    def test_fp8_roundtrip_error_bounded(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization import intx
+
+        if not intx.fp8_available():
+            pytest.skip("no float8_e4m3fn on this jax build")
+        rng = np.random.RandomState(9)
+        x = rng.randn(256).astype("float32")
+        scale = float(np.abs(x).max())
+        q = intx.pack_absmax(jnp.asarray(x), scale, "fp8")
+        real = np.asarray(intx.unpack_absmax(q, scale, "fp8"))
+        # e4m3: 3 mantissa bits -> relative step 2^-3; absmax scaling
+        # keeps everything in the normal range
+        assert np.abs(real - x).max() <= np.abs(x).max() / 8 + 1e-6
+
+    def test_zero_scale_is_safe(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization import intx
+
+        z = jnp.zeros(4)
+        q = intx.pack_absmax(z, 0.0, "int8")
+        assert np.array_equal(np.asarray(intx.unpack_absmax(q, 0.0, "int8")),
+                              np.zeros(4, "float32"))

@@ -1,0 +1,590 @@
+"""Quantized serving data path: int8/fp8 KV blocks + weight-only
+quantized matmul with dequant fused into the Pallas prologues.
+
+Oracles:
+- PACK/UNPACK EXACTNESS: the quantizing cache writes (contiguous and
+  paged scatter epilogues) store exactly ``intx.pack_absmax`` of the
+  step values, and the dequantizing reads (kernel prologue, XLA gather
+  fallback) return exactly ``intx.unpack_absmax`` of the store.
+- KERNEL PARITY: the dequant-prologue kernels equal the float kernels
+  fed numpy-dequantized caches (same grid, same summation order); the
+  paged and contiguous quantized kernels are bit-identical at equal
+  block split.
+- OUTPUT PARITY: engine(kv_format="int8") output is BIT-IDENTICAL to
+  ``generate(kv_format="int8")`` per request — through chunked prefill,
+  COW/prefix sharing, preemption-by-recompute, and the spec-decode lane
+  — and greedy int8 tokens equal the bf16 engine's at the pinned test
+  points (the A/B acceptance; logits move by the absmax rounding step,
+  argmax doesn't at these seeds).
+- ONE EXECUTABLE: quantization ON changes nothing about the
+  one-compile/zero-retrace invariant (scale pools are traced data).
+- WEIGHT LANE: ``quantization.convert_for_serving`` (PerChannelAbsmax
+  observer scales) + the Pallas ``quant_matmul`` dispatched behind
+  PADDLE_TPU_QUANT_WEIGHTS match the XLA dequant-fusion fallback.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import generation, serving
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                               LlamaForCausalLM)
+from paddle_tpu.observability import recompile
+from paddle_tpu.quantization import intx
+
+SEED = 4321
+
+QUANT_FORMATS = ["int8"] + (["fp8"] if intx.fp8_available() else [])
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(max_position_embeddings=256)
+    return LlamaForCausalLM(cfg), cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    paddle.seed(1)
+    cfg = GPTConfig.tiny(max_position_embeddings=256)
+    return GPTForCausalLM(cfg), cfg
+
+
+def _prompt(rng, cfg, n):
+    return rng.randint(1, cfg.vocab_size, n).astype("int32")
+
+
+def _ref(model, prompt, kv_format="bf16", **params):
+    return generation.generate(
+        model, prompt[None], kv_format=kv_format,
+        **params).numpy()[0, len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# storage: pools, writes, gathers
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedStores:
+    @pytest.mark.parametrize("fmt", QUANT_FORMATS)
+    def test_paged_pools_carry_scale_companions(self, tiny_model, fmt):
+        _, cfg = tiny_model
+        pools = generation.make_paged_kv_pools(cfg, 9, 4, jnp.float32, fmt)
+        assert len(pools) == cfg.num_hidden_layers
+        c = pools[0]
+        assert set(c) == {"k", "v", "ks", "vs"}
+        assert c["k"].dtype == intx.format_dtype(fmt)
+        assert c["ks"].shape == c["k"].shape[:3]
+        assert c["ks"].dtype == jnp.float32
+        assert generation.kv_format_of(c["k"]) == fmt
+
+    def test_bf16_pools_unchanged(self, tiny_model):
+        _, cfg = tiny_model
+        pools = generation.make_paged_kv_pools(cfg, 9, 4, jnp.float32)
+        assert set(pools[0]) == {"k", "v"}
+
+    def test_paged_write_quant_is_pack_absmax(self, tiny_model):
+        """Scatter epilogue == per-token-per-head pack_absmax of the
+        step block, scale stored alongside; gather_paged_kv_dequant ==
+        unpack_absmax of the store."""
+        _, cfg = tiny_model
+        rng = np.random.RandomState(SEED)
+        n_kv = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        pools = generation.make_paged_kv_pools(cfg, 7, 4, jnp.float32,
+                                               "int8")
+        c = pools[0]
+        new = jnp.asarray(rng.randn(2, 3, n_kv, d), jnp.float32)
+        bt = np.array([[1, 2], [3, 4]], np.int32)
+        pos = np.array([0, 2], np.int32)
+        pk, sk = generation.paged_kv_cache_write_quant(
+            c["k"], c["ks"], new, bt, pos)
+        amax = np.asarray(intx.absmax_along(new, -1))
+        qexp = np.asarray(intx.pack_absmax(new, amax[..., None], "int8"))
+        pk_np, sk_np = np.asarray(pk._data), np.asarray(sk._data)
+        for b in range(2):
+            for j in range(3):
+                t = pos[b] + j
+                phys, off = bt[b, t // 4], t % 4
+                assert np.array_equal(pk_np[phys, off], qexp[b, j])
+                assert np.array_equal(sk_np[phys, off], amax[b, j])
+        # dequantizing gather returns exactly unpack of the store
+        g = generation.gather_paged_kv_dequant(pk, sk, bt, jnp.float32)
+        exp = np.asarray(intx.unpack_absmax(pk_np, sk_np[..., None],
+                                            "int8"))
+        exp_view = exp[bt.reshape(-1)].reshape(2, 8, n_kv, d)
+        assert np.array_equal(np.asarray(g._data), exp_view)
+
+    def test_contiguous_write_quant_roundtrip(self, tiny_model):
+        _, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 1)
+        caches = generation.make_kv_caches(cfg, 2, 8, jnp.float32, "int8")
+        c = caches[0]
+        n_kv = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        new = jnp.asarray(rng.randn(2, 2, n_kv, d), jnp.float32)
+        bk, bks = generation.kv_cache_write_quant(c["k"], c["ks"], new, 3)
+        amax = np.asarray(intx.absmax_along(new, -1))
+        deq = generation.dequantize_kv_buffer(bk, bks, jnp.float32)
+        exp = np.asarray(intx.unpack_absmax(
+            np.asarray(bk._data), np.asarray(bks._data)[..., None], "int8"))
+        assert np.array_equal(np.asarray(deq._data), exp)
+        assert np.array_equal(np.asarray(bks._data)[:, 3:5], amax)
+
+    def test_kv_bytes_per_token_accounting(self, tiny_model):
+        _, cfg = tiny_model
+        n_kv = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        L = cfg.num_hidden_layers
+        bf16 = generation.kv_cache_bytes_per_token(cfg, "bf16",
+                                                   jnp.bfloat16)
+        i8 = generation.kv_cache_bytes_per_token(cfg, "int8")
+        assert bf16 == 2 * n_kv * d * 2 * L
+        assert i8 == 2 * n_kv * (d + 4) * L
+
+
+# ---------------------------------------------------------------------------
+# kernels: dequant prologue parity
+# ---------------------------------------------------------------------------
+
+
+class TestQuantKernels:
+    @pytest.fixture()
+    def kernel_on(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "1")
+
+    def _quantized_cache(self, rng, B, L, KV, d, fmt):
+        kc = jnp.asarray(rng.randn(B, L, KV, d), jnp.float32)
+        amax = intx.absmax_along(kc, -1)
+        kq = intx.pack_absmax(kc, amax[..., None], fmt)
+        return kq, amax
+
+    @pytest.mark.parametrize("fmt", QUANT_FORMATS)
+    def test_contiguous_quant_kernel_matches_dequant_oracle(
+            self, kernel_on, fmt):
+        from paddle_tpu.pallas_kernels.decode_attention import \
+            flash_decode_attention
+
+        rng = np.random.RandomState(SEED + 2)
+        B, L, KV, H, d = 2, 16, 2, 4, 8
+        q = jnp.asarray(rng.randn(B, 1, H, d), jnp.float32)
+        kq, ks = self._quantized_cache(rng, B, L, KV, d, fmt)
+        vq, vs = self._quantized_cache(rng, B, L, KV, d, fmt)
+        pos = jnp.asarray([5, 15], jnp.int32)
+        ref = flash_decode_attention(
+            q, intx.unpack_absmax(kq, ks[..., None], fmt),
+            intx.unpack_absmax(vq, vs[..., None], fmt), pos, block_k=4)
+        got = flash_decode_attention(q, kq, vq, pos, block_k=4,
+                                     k_scale=ks, v_scale=vs)
+        assert np.abs(np.asarray(ref) - np.asarray(got)).max() < 1e-5
+
+    def test_paged_quant_kernel_bit_identical_to_contiguous(
+            self, kernel_on):
+        from paddle_tpu.pallas_kernels.decode_attention import (
+            flash_decode_attention, paged_flash_decode_attention)
+
+        rng = np.random.RandomState(SEED + 3)
+        B, L, KV, H, d, bs = 2, 16, 2, 4, 8, 4
+        q = jnp.asarray(rng.randn(B, 1, H, d), jnp.float32)
+        kq, ks = self._quantized_cache(rng, B, L, KV, d, "int8")
+        vq, vs = self._quantized_cache(rng, B, L, KV, d, "int8")
+        pos = jnp.asarray([6, 13], jnp.int32)
+        contig = flash_decode_attention(q, kq, vq, pos, block_k=bs,
+                                        k_scale=ks, v_scale=vs)
+        nb = L // bs
+        bt = np.arange(1, 1 + B * nb, dtype=np.int32).reshape(B, nb)
+        kp = np.zeros((B * nb + 1, bs, KV, d), np.int8)
+        vp = np.zeros_like(kp)
+        ksp = np.zeros((B * nb + 1, bs, KV), np.float32)
+        vsp = np.zeros_like(ksp)
+        for b in range(B):
+            for j in range(nb):
+                kp[bt[b, j]] = np.asarray(kq[b, j * bs:(j + 1) * bs])
+                vp[bt[b, j]] = np.asarray(vq[b, j * bs:(j + 1) * bs])
+                ksp[bt[b, j]] = np.asarray(ks[b, j * bs:(j + 1) * bs])
+                vsp[bt[b, j]] = np.asarray(vs[b, j * bs:(j + 1) * bs])
+        paged = paged_flash_decode_attention(
+            q, jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt), pos,
+            k_scale=jnp.asarray(ksp), v_scale=jnp.asarray(vsp))
+        assert np.array_equal(np.asarray(contig), np.asarray(paged))
+
+    def test_scale_args_must_pair(self):
+        from paddle_tpu.pallas_kernels.decode_attention import \
+            flash_decode_attention
+
+        with pytest.raises(ValueError, match="both k_scale and v_scale"):
+            flash_decode_attention(
+                jnp.zeros((1, 1, 2, 4)), jnp.zeros((1, 4, 2, 4)),
+                jnp.zeros((1, 4, 2, 4)), jnp.asarray([0]),
+                k_scale=jnp.zeros((1, 4, 2)))
+
+
+# ---------------------------------------------------------------------------
+# generate(kv_format=...): the offline oracle
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedGenerate:
+    def test_int8_greedy_token_parity_llama(self, tiny_model):
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 4)
+        ids = _prompt(rng, cfg, 7)
+        assert np.array_equal(_ref(model, ids, max_new_tokens=8),
+                              _ref(model, ids, "int8", max_new_tokens=8))
+
+    def test_int8_greedy_token_parity_gpt(self, tiny_gpt):
+        model, cfg = tiny_gpt
+        rng = np.random.RandomState(SEED + 5)
+        ids = _prompt(rng, cfg, 7)
+        assert np.array_equal(_ref(model, ids, max_new_tokens=8),
+                              _ref(model, ids, "int8", max_new_tokens=8))
+
+    def test_int8_kernel_on_equals_kernel_off(self, tiny_model,
+                                              monkeypatch):
+        """Flag flips swap the Pallas prologue for the XLA dequant
+        gather — greedy outputs at the pinned point agree (both read
+        unpack_absmax of the same store)."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 6)
+        ids = _prompt(rng, cfg, 9)
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "0")
+        off = _ref(model, ids, "int8", max_new_tokens=6)
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "1")
+        on = _ref(model, ids, "int8", max_new_tokens=6)
+        assert np.array_equal(off, on)
+
+    @pytest.mark.skipif(not intx.fp8_available(),
+                        reason="no float8_e4m3fn on this jax build")
+    def test_fp8_generates_and_is_error_bounded(self, tiny_model):
+        """fp8 (3 mantissa bits) is coarser than int8 — token parity is
+        not pinned; the contract is the bounded attention error and a
+        well-formed decode."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 7)
+        ids = _prompt(rng, cfg, 7)
+        out = generation.generate(model, ids[None], max_new_tokens=8,
+                                  kv_format="fp8").numpy()
+        assert out.shape == (1, 15)
+        assert (out[:, :7] == ids).all()
+
+    def test_kv_format_validation(self, tiny_model):
+        model, cfg = tiny_model
+        ids = np.ones((1, 4), np.int32)
+        with pytest.raises(ValueError, match="kv_format"):
+            generation.generate(model, ids, kv_format="int4")
+        with pytest.raises(ValueError, match="serving engine"):
+            generation.generate(model, ids, kv_format="int8",
+                                draft_model=model)
+
+
+# ---------------------------------------------------------------------------
+# the quantized engine
+# ---------------------------------------------------------------------------
+
+
+def _mixed_workload(rng, cfg, n=4):
+    return [(_prompt(rng, cfg, 4 + 3 * i),
+             dict(max_new_tokens=5 + (i % 2), do_sample=bool(i % 2),
+                  top_k=6 if i % 2 else 0, seed=10 + i))
+            for i in range(n)]
+
+
+class TestQuantizedEngine:
+    @pytest.mark.parametrize("fmt", QUANT_FORMATS)
+    def test_engine_bit_parity_vs_generate_same_format(self, tiny_model,
+                                                       fmt):
+        """Mixed greedy/sampled requests through the int8/fp8 engine ==
+        ``generate(kv_format=...)`` token-for-token (same quantized
+        math, same key chains)."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 8)
+        wl = _mixed_workload(rng, cfg)
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                    block_size=8, kv_format=fmt,
+                                    max_queue_depth=8)
+        reqs = [eng.submit(p, **params) for p, params in wl]
+        eng.run_until_idle()
+        for req, (p, params) in zip(reqs, wl):
+            exp = _ref(model, p, fmt, **params)
+            assert np.array_equal(np.asarray(req.result(timeout=5)), exp)
+
+    def test_int8_engine_greedy_matches_bf16_engine(self, tiny_model):
+        """The A/B acceptance: greedy outputs of the quantized engine
+        equal the unquantized engine's at the pinned test point."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 9)
+        prompts = [_prompt(rng, cfg, 5 + 4 * i) for i in range(3)]
+        outs = {}
+        for fmt in ("bf16", "int8"):
+            eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                        block_size=8, kv_format=fmt,
+                                        max_queue_depth=8)
+            reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            eng.run_until_idle()
+            outs[fmt] = [np.asarray(r.result(timeout=5)) for r in reqs]
+        for a, b in zip(outs["bf16"], outs["int8"]):
+            assert np.array_equal(a, b)
+
+    def test_one_compile_zero_retrace_with_quant_on(self, tiny_model,
+                                                    monkeypatch):
+        """3 mixed waves through the int8 engine with the paged quant
+        kernel ON: exactly one serving.step compile, zero retraces —
+        scale pools are traced data like everything else."""
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "1")
+        model, cfg = tiny_model
+        before = recompile.entry_stats().get("serving.step",
+                                             {"compiles": 0, "retraces": 0})
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                    block_size=8, kv_format="int8",
+                                    max_queue_depth=16)
+        rng = np.random.RandomState(SEED + 10)
+        for wave in range(3):
+            reqs = [eng.submit(_prompt(rng, cfg, 3 + 7 * ((wave + i) % 4)),
+                               max_new_tokens=2 + (wave + i) % 3,
+                               do_sample=bool(i % 2), seed=i, top_k=5)
+                    for i in range(4)]
+            eng.run_until_idle()
+            assert all(r.status == serving.RequestStatus.COMPLETED
+                       for r in reqs)
+        after = recompile.entry_stats()["serving.step"]
+        assert after["compiles"] - before["compiles"] == 1
+        assert after["retraces"] - before["retraces"] == 0
+        assert recompile.entry_stats()["serving.prefill_chunk"][
+            "retraces"] == 0
+
+    def test_preemption_on_quantized_blocks_keeps_parity(self, tiny_model):
+        """Oversubscribed int8 pool: preemption-by-recompute releases
+        and re-prefills QUANTIZED blocks — outputs stay bit-identical
+        (requantizing the same tokens is deterministic)."""
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 11)
+        wl = [(_prompt(rng, cfg, 6), dict(max_new_tokens=24, seed=i,
+                                          do_sample=bool(i % 2), top_k=5))
+              for i in range(4)]
+        eng = serving.ServingEngine(model, max_slots=4, max_len=64,
+                                    block_size=8, num_blocks=13,
+                                    kv_format="int8", max_queue_depth=8,
+                                    prefix_caching=False)
+        reqs = [eng.submit(p, **params) for p, params in wl]
+        eng.run_until_idle(max_steps=50_000)
+        assert eng._preempt_count > 0, "pool sizing no longer preempts"
+        for req, (p, params) in zip(reqs, wl):
+            exp = _ref(model, p, "int8", **params)
+            assert np.array_equal(np.asarray(req.result(timeout=5)), exp)
+
+    def test_prefix_sharing_and_cow_on_quantized_blocks(self, tiny_model):
+        """A shared system prompt is prefilled once into QUANTIZED
+        blocks; followers adopt them (prompt_cached accounting) and COW
+        forks keep divergent decode writes off the shared copies."""
+        from paddle_tpu.serving import metrics as sm
+
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 12)
+        sys_prompt = _prompt(rng, cfg, 16)
+        prompts = [np.concatenate([sys_prompt, _prompt(rng, cfg, 4)])
+                   for _ in range(3)]
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                    block_size=8, kv_format="int8",
+                                    max_queue_depth=8)
+        cached0 = sm.tokens_total.labels("prompt_cached").value()
+        first = eng.submit(prompts[0], max_new_tokens=6)
+        eng.run_until_idle()
+        rest = [eng.submit(p, max_new_tokens=6) for p in prompts[1:]]
+        eng.run_until_idle()
+        cached = sm.tokens_total.labels("prompt_cached").value() - cached0
+        assert cached >= 2 * 16  # both followers adopted the sys prompt
+        assert eng.pool.stats()["cow_forks"] > 0
+        for req, p in zip([first] + rest, prompts):
+            exp = _ref(model, p, "int8", max_new_tokens=6)
+            assert np.array_equal(np.asarray(req.result(timeout=5)), exp)
+
+    def test_spec_engine_on_quantized_pools(self, tiny_model, monkeypatch):
+        """The spec-decode lane rides quantized pools unchanged: outputs
+        bit-identical to the plain int8 engine, draft/verify compile
+        once each."""
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "1")
+        model, cfg = tiny_model
+        draft = generation.truncated_draft(model, 1)
+        rng = np.random.RandomState(SEED + 13)
+        wl = _mixed_workload(rng, cfg)
+
+        plain = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                      block_size=8, kv_format="int8",
+                                      max_queue_depth=8)
+        p_reqs = [plain.submit(p, **params) for p, params in wl]
+        plain.run_until_idle()
+
+        eng = serving.ServingEngine(model, draft_model=draft, spec_k=3,
+                                    max_slots=2, max_len=64, block_size=8,
+                                    kv_format="int8", max_queue_depth=8)
+        before_d = recompile.entry_stats().get(
+            "serving.spec_draft", {"compiles": 0, "retraces": 0})
+        s_reqs = [eng.submit(p, **params) for p, params in wl]
+        eng.run_until_idle()
+        for a, b in zip(p_reqs, s_reqs):
+            assert np.array_equal(np.asarray(a.result(timeout=5)),
+                                  np.asarray(b.result(timeout=5)))
+        stats = eng.spec_stats()
+        assert stats["enabled"] and stats["drafted_tokens"] > 0
+        after_d = recompile.entry_stats()["serving.spec_draft"]
+        assert after_d["retraces"] - before_d["retraces"] == 0
+
+    def test_config_validation(self, tiny_model):
+        model, _ = tiny_model
+        with pytest.raises(ValueError, match="kv_format must be one of"):
+            serving.ServingConfig(kv_format="int4")
+        with pytest.raises(ValueError, match="kv_mode='paged'"):
+            serving.ServingConfig(kv_mode="contiguous", kv_format="int8")
+
+    def test_stats_carry_quant_accounting(self, tiny_model):
+        from paddle_tpu.serving import metrics as sm
+
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                    block_size=8, kv_format="int8")
+        st = eng.stats()
+        assert st["kv_format"] == "int8"
+        kb = st["kv_blocks"]
+        assert kb["kv_format"] == "int8"
+        assert kb["bytes_per_token"] == generation.kv_cache_bytes_per_token(
+            cfg, "int8")
+        assert kb["effective_capacity_tokens"] == \
+            eng.pool.usable_blocks * 8
+        assert kb["capacity_vs_bf16"] > 1.0
+        assert sm.kv_bytes_per_token.labels("int8").value() == \
+            kb["bytes_per_token"]
+
+    def test_quant_dispatch_counters(self, tiny_model, monkeypatch):
+        """The paged dispatch counts quantized hits/fallbacks under
+        quant labels (quant_* reasons)."""
+        from paddle_tpu.pallas_kernels.decode_attention import (
+            _fd_fallbacks, _fd_hits)
+
+        model, cfg = tiny_model
+        rng = np.random.RandomState(SEED + 14)
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "0")
+        falls0 = _fd_fallbacks.labels("paged_quant_disabled").value()
+        eng = serving.ServingEngine(model, max_slots=1, max_len=32,
+                                    block_size=8, kv_format="int8")
+        eng.submit(_prompt(rng, cfg, 4), max_new_tokens=2)
+        eng.run_until_idle()
+        assert _fd_fallbacks.labels("paged_quant_disabled").value() > falls0
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "1")
+        hits0 = _fd_hits.labels("llama_paged_quant").value()
+        eng2 = serving.ServingEngine(model, max_slots=1, max_len=32,
+                                     block_size=8, kv_format="int8")
+        eng2.submit(_prompt(rng, cfg, 4), max_new_tokens=2)
+        eng2.run_until_idle()
+        assert _fd_hits.labels("llama_paged_quant").value() > hits0
+
+
+# ---------------------------------------------------------------------------
+# weight-only lane: PTQ entry + Pallas quant matmul dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestWeightOnlyLane:
+    def test_convert_for_serving_uses_observer_scales(self):
+        from paddle_tpu import nn
+        from paddle_tpu.nn.quant import WeightOnlyLinear
+        from paddle_tpu.quantization import (PerChannelAbsmaxObserver,
+                                             convert_for_serving)
+
+        paddle.seed(2)
+        m = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+        w0 = m[0].weight.numpy().copy()
+        ob = PerChannelAbsmaxObserver(quant_axis=1)
+        ob.observe(paddle.to_tensor(w0))
+        expected_scale = ob.scales() / 127.0
+        convert_for_serving(m, fmt="int8")
+        wol = m[0]
+        assert isinstance(wol, WeightOnlyLinear)
+        np.testing.assert_allclose(wol.scale.numpy(), expected_scale,
+                                   rtol=1e-6)
+        # storage follows the shared pack_absmax convention
+        exp_q = np.asarray(intx.pack_absmax(
+            jnp.asarray(w0.T), ob.scales()[:, None], "int8"))
+        assert np.array_equal(wol.qweight.numpy(), exp_q)
+
+    @pytest.mark.parametrize("fmt", QUANT_FORMATS)
+    def test_quantized_llama_decodes_close_to_fp(self, fmt):
+        from paddle_tpu.quantization import convert_for_serving
+
+        paddle.seed(3)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(SEED + 15)
+        ids = paddle.to_tensor(
+            rng.randint(1, cfg.vocab_size, (2, 6)).astype("int32"))
+        with paddle.no_grad():
+            ref = m(ids).numpy()
+        convert_for_serving(m, fmt=fmt)
+        with paddle.no_grad():
+            got = m(ids).numpy()
+        tol = 0.05 if fmt == "int8" else 0.2
+        assert np.abs(got - ref).max() / np.abs(ref).max() < tol
+
+    def test_kernel_dispatch_matches_xla_fallback(self, monkeypatch):
+        from paddle_tpu.nn.quant import weight_only_linear, weight_quantize
+
+        rng = np.random.RandomState(SEED + 16)
+        w = paddle.to_tensor(rng.randn(64, 32).astype("float32"))
+        x = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+        q, s = weight_quantize(w)
+        with paddle.no_grad():
+            monkeypatch.setenv("PADDLE_TPU_QUANT_WEIGHTS", "0")
+            xla = weight_only_linear(x, q, None, s).numpy()
+            monkeypatch.setenv("PADDLE_TPU_QUANT_WEIGHTS", "1")
+            kern = weight_only_linear(x, q, None, s).numpy()
+        assert np.abs(kern - xla).max() < 1e-4
+
+    def test_quant_matmul_dispatch_counters(self, monkeypatch):
+        from paddle_tpu.nn.quant import weight_only_linear, weight_quantize
+        from paddle_tpu.pallas_kernels.quant_matmul import (_qm_fallbacks,
+                                                            _qm_hits)
+
+        rng = np.random.RandomState(SEED + 17)
+        w = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        x = paddle.to_tensor(rng.randn(2, 16).astype("float32"))
+        q, s = weight_quantize(w)
+        with paddle.no_grad():
+            monkeypatch.setenv("PADDLE_TPU_QUANT_WEIGHTS", "0")
+            f0 = _qm_fallbacks.labels("disabled").value()
+            weight_only_linear(x, q, None, s)
+            assert _qm_fallbacks.labels("disabled").value() == f0 + 1
+            monkeypatch.setenv("PADDLE_TPU_QUANT_WEIGHTS", "1")
+            h0 = _qm_hits.labels("int8").value()
+            weight_only_linear(x, q, None, s)
+            assert _qm_hits.labels("int8").value() == h0 + 1
+        # grad mode falls back loudly too
+        monkeypatch.setenv("PADDLE_TPU_QUANT_WEIGHTS", "1")
+        g0 = _qm_fallbacks.labels("grad_mode").value()
+        weight_only_linear(x, q, None, s)
+        assert _qm_fallbacks.labels("grad_mode").value() == g0 + 1
+
+    def test_quantized_weights_on_quantized_engine(self, monkeypatch):
+        """The full quantized data path: int8 weights (Pallas dequant
+        matmul) + int8 KV blocks (Pallas dequant prologue) through the
+        serving engine — outputs bit-identical to generate on the SAME
+        quantized model, one step compile."""
+        from paddle_tpu.quantization import convert_for_serving
+
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DECODE", "1")
+        monkeypatch.setenv("PADDLE_TPU_QUANT_WEIGHTS", "1")
+        paddle.seed(4)
+        cfg = LlamaConfig.tiny(max_position_embeddings=256)
+        m = convert_for_serving(LlamaForCausalLM(cfg), fmt="int8")
+        rng = np.random.RandomState(SEED + 18)
+        wl = _mixed_workload(rng, cfg, n=3)
+        eng = serving.ServingEngine(m, max_slots=2, max_len=64,
+                                    block_size=8, kv_format="int8",
+                                    max_queue_depth=8)
+        reqs = [eng.submit(p, **params) for p, params in wl]
+        eng.run_until_idle()
+        for req, (p, params) in zip(reqs, wl):
+            exp = _ref(m, p, "int8", **params)
+            assert np.array_equal(np.asarray(req.result(timeout=5)), exp)
