@@ -16,6 +16,7 @@ Two model points:
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -23,7 +24,18 @@ import numpy as np
 
 
 def _v5e_peak_flops():
-    # single source of truth shared with the auto-tuner roofline model
+    # the observability peak table (env override PADDLE_TPU_PEAK_FLOPS,
+    # per-chip specs keyed by jax's device_kind) wins when it knows the
+    # attached device; the auto-tuner's v5e default stays the fallback
+    # so MFU numbers on unknown kinds keep their historical meaning
+    try:
+        from paddle_tpu.observability.perf import peak_specs
+
+        peak = peak_specs()["peak_flops_per_s"]
+        if peak:
+            return peak
+    except Exception:
+        pass
     from paddle_tpu.distributed.auto_tuner import _HW_DEFAULTS
 
     return _HW_DEFAULTS["peak_tflops"] * 1e12
@@ -540,6 +552,29 @@ def main():
         detail["telemetry"] = _telemetry_summary()
     except Exception as e:  # noqa: BLE001 — the bench must still print
         detail["telemetry_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # the perf-regression gate's train lane reads this artifact
+    # (benchmarks/perf_baseline.json train.* entries; run_shards.py
+    # compares and fails loudly) — tok/s + MFU survive as a committed
+    # file instead of only in the driver's BENCH_* trajectory
+    try:
+        import datetime
+
+        train_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+            "bench_train.json")
+        with open(train_path, "w") as fh:
+            json.dump({
+                "bench": "llama_pretrain",
+                "platform": backend,
+                "finished": datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(timespec="seconds"),
+                "tokens_per_sec_per_chip":
+                    primary["tokens_per_sec_per_chip"],
+                "mfu": primary.get("mfu"),
+            }, fh, indent=1)
+    except Exception:  # noqa: BLE001 — artifact write must not fail the bench
+        pass
 
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",

@@ -25,6 +25,10 @@ engine's three acceptance properties while it measures:
 - request-lifecycle tracing (default-on) costs <2% tok/s: a
   tracing-off serving pass rides in the same alternating rotation and
   the A/B lands in the artifact's ``tracing`` block;
+- perf capture (default-on cost/roofline ledger) costs <2% tok/s: a
+  perf-off pass rides the same rotation into the ``perf_capture``
+  block (capture is compile-time + one entry-exit clock read — the
+  measured pass pays only the clock read);
 - SPECULATIVE on/off rides the same rotation: a draft-model engine
   (independent random draft — the adversarial accept-rate floor, so
   this is a pure correctness/overhead lane; ``bench_spec_decode.py``
@@ -55,7 +59,7 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import generation, serving
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-from paddle_tpu.observability import recompile, tracing
+from paddle_tpu.observability import perf, recompile, tracing
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
@@ -183,6 +187,7 @@ def main():
     reqs, serving_wall = None, float("inf")
     seq_wall = float("inf")
     notrace_wall = float("inf")
+    noperf_wall = float("inf")
     spec_wall = float("inf")
     for _ in range(3):
         r, w = run_serving(eng, workload)
@@ -194,6 +199,16 @@ def main():
         finally:
             tracing.enable_tracing()
         notrace_wall = min(notrace_wall, w)
+        # perf capture A/B rides the same rotation: capture is
+        # compile-time + an entry-exit clock read, so the ON lane (the
+        # default everywhere else in this bench) should be at the noise
+        # floor vs this OFF arm
+        perf.disable()
+        try:
+            _, w = run_serving(eng, workload)
+        finally:
+            perf.enable()
+        noperf_wall = min(noperf_wall, w)
         spec_r, w = run_serving(spec_eng, workload)
         spec_wall = min(spec_wall, w)
         spec_parity = spec_parity and all(
@@ -215,9 +230,12 @@ def main():
     serving_tps = n_tokens / serving_wall
     seq_tps = n_tokens / seq_wall
     notrace_tps = n_tokens / notrace_wall
+    noperf_tps = n_tokens / noperf_wall
     # tracing is default-on: its cost is the A/B acceptance number
     # (<2% tok/s; negative = within noise, tracing side won the draw)
     tracing_overhead_pct = (notrace_tps - serving_tps) / notrace_tps * 100.0
+    # perf capture is default-on too; same acceptance bound (<2%)
+    perf_overhead_pct = (noperf_tps - serving_tps) / noperf_tps * 100.0
     result = {
         "bench": "serving_vs_sequential",
         "platform": jax.default_backend(),
@@ -254,6 +272,15 @@ def main():
                 step_after["retraces"] == step_before["retraces"],
             "events_recorded": tracing.summary()["events_recorded"],
         },
+        "perf_capture": {
+            "on_tok_s": round(serving_tps, 1),
+            "off_tok_s": round(noperf_tps, 1),
+            "overhead_pct": round(perf_overhead_pct, 2),
+            "overhead_lt_2pct": bool(perf_overhead_pct < 2.0),
+            "ledger_entries": sorted(perf.ledger(prefix="serving.")),
+            "step_roofline": (perf.ledger(prefix="serving.")
+                              .get("serving.step", {}).get("roofline")),
+        },
         "spec": {
             "spec_k": 2,
             "draft": "independent random 2-layer (adversarial accept "
@@ -278,6 +305,7 @@ def main():
           and result["step_compiles_measured_pass"] == 0
           and result["step_retraces_measured_pass"] == 0
           and result["tracing"]["overhead_lt_2pct"]
+          and result["perf_capture"]["overhead_lt_2pct"]
           and spec_parity and spec_compiles == 0 and spec_retraces == 0)
     if not ok:
         print("[bench_serving] ACCEPTANCE FAILED", file=sys.stderr)

@@ -4,7 +4,13 @@ Usage: python benchmarks/xprof_top.py /tmp/trace_dir [N] [--json]
 
 ``--json`` prints one machine-readable JSON object (category totals +
 top ops) so CI can diff category totals between runs instead of parsing
-the human table.
+the human table. The JSON also carries the roofline columns from
+``paddle_tpu.observability.perf``: the device peak table in force
+(env-overridable via PADDLE_TPU_PEAK_FLOPS / PADDLE_TPU_PEAK_HBM_GBPS)
+and, for every op row whose hlo_stats carry flop/byte counts, the
+arithmetic intensity + compute-vs-bandwidth-bound classification —
+the same classifier the serving ledger publishes, so a trace summary
+and ``observability.snapshot()["perf"]`` speak one vocabulary.
 """
 import argparse
 import glob
@@ -43,14 +49,73 @@ def load(trace_dir):
     return rows
 
 
+def _peaks():
+    """The perf module's peak table (None-peaked on unknown devices);
+    the script stays usable without the package on path."""
+    try:
+        from paddle_tpu.observability import perf
+
+        return perf.peak_specs()
+    except Exception:
+        return {"device_kind": None, "peak_flops_per_s": None,
+                "peak_hbm_gbps": None,
+                "machine_balance_flops_per_byte": None,
+                "source": "unavailable (paddle_tpu not importable)"}
+
+
+def _first(row, *keys):
+    for k in keys:
+        v = row.get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def _roofline_cols(row, peaks):
+    """Intensity + roofline class for one hlo_stats row, from whichever
+    flop/byte columns this xprof version exposes; {} when the trace
+    carries neither (honest absence, never invented numbers)."""
+    flops = _first(row, "model_flops", "flops", "measured_flops")
+    nbytes = _first(row, "bytes_accessed", "memory_bytes_accessed",
+                    "bytes accessed")
+    self_us = _first(row, "total_self_time")
+    out = {}
+    if flops is not None:
+        out["flops"] = flops
+    if nbytes is not None:
+        out["bytes_accessed"] = nbytes
+    if flops is not None and nbytes is not None:
+        out["arithmetic_intensity"] = round(flops / nbytes, 3)
+        balance = peaks.get("machine_balance_flops_per_byte")
+        if balance is not None:
+            out["roofline"] = ("compute-bound"
+                               if flops / nbytes >= balance
+                               else "bandwidth-bound")
+    if self_us:
+        if flops is not None:
+            out["achieved_gflops_per_s"] = round(flops / (self_us * 1e3), 2)
+            pf = peaks.get("peak_flops_per_s")
+            if pf:
+                out["mfu"] = round(flops / (self_us * 1e-6) / pf, 4)
+        if nbytes is not None:
+            out["achieved_gbps"] = round(nbytes / (self_us * 1e3), 2)
+            pb = peaks.get("peak_hbm_gbps")
+            if pb:
+                out["hbm_bw_util"] = round(
+                    nbytes / (self_us * 1e-6) / (pb * 1e9), 4)
+    return out
+
+
 def summarize(rows, n):
     total = sum(r["total_self_time"] for r in rows)
     cats = defaultdict(float)
     for r in rows:
         cats[r["category"]] += r["total_self_time"]
     rows = sorted(rows, key=lambda r: -r["total_self_time"])
+    peaks = _peaks()
     return {
         "total_self_time_ms": round(total / 1e3, 3),
+        "peaks": peaks,
         "categories": {c: round(t / 1e3, 3)
                        for c, t in sorted(cats.items(), key=lambda kv: -kv[1])},
         "top_ops": [
@@ -58,6 +123,7 @@ def summarize(rows, n):
              "pct": round(100 * r["total_self_time"] / total, 1) if total else 0.0,
              "occurrences": r["occurrences"],
              "category": r["category"],
+             **_roofline_cols(r, peaks),
              "expression": r["hlo_op_expression"][:110].replace("\n", " ")}
             for r in rows[:n]
         ],
@@ -72,7 +138,7 @@ def main():
                     help="how many top ops to show (default 25)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON object (CI-diffable) instead of "
-                         "the table")
+                         "the table, incl. the perf roofline columns")
     args = ap.parse_args()
 
     rows = load(args.trace_dir)
@@ -92,8 +158,10 @@ def main():
         print(f"{c:<32}{t:>10.2f} ms {100*t/total if total else 0:>6.1f}%")
     print("\n-- top ops by self time --")
     for r in s["top_ops"]:
+        roof = f" [{r['roofline']}]" if "roofline" in r else ""
         print(f"{r['self_time_ms']:>9.2f} ms {r['pct']:>5.1f}%"
-              f" x{r['occurrences']:<4} {r['category']:<22} {r['expression']}")
+              f" x{r['occurrences']:<4} {r['category']:<22}"
+              f" {r['expression']}{roof}")
 
 
 if __name__ == "__main__":

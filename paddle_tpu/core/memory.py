@@ -175,6 +175,19 @@ def memory_reserved(device=None) -> int:
     return int(device_memory_stats(device).get("bytes_limit", 0))
 
 
+def memory_headroom(device=None) -> Optional[int]:
+    """``bytes_limit - bytes_in_use`` — the HBM still available to the
+    process — or ``None`` when the transport reports either side missing
+    (CPU PJRT commonly reports nothing; the observability ledger spells
+    that ``"unsupported"``). Contract: never invents a 0."""
+    stats = device_memory_stats(device)
+    limit = stats.get("bytes_limit")
+    live = stats.get("bytes_in_use")
+    if limit is None or live is None:
+        return None
+    return int(limit) - int(live)
+
+
 def host_memory_stat_current_value(stat: str = "Allocated") -> int:
     """Reference: memory/stats.h HostMemoryStatCurrentValue."""
     arena = get_host_arena()

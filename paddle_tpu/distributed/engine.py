@@ -118,6 +118,33 @@ class ShardedTrainStep:
         self._step_fn = None
         self._batch_spec = batch_spec
         self._label_spec = label_spec
+        # HBM-ledger attribution: the engine owns the two big persistent
+        # device footprints of a training process. Weakref'd so a dead
+        # engine drops out of the ledger instead of pinning its arrays.
+        import weakref
+
+        from ..observability import perf as _perf
+
+        ref = weakref.ref(self)
+
+        def _weight_bytes(ref=ref):
+            eng = ref()
+            if eng is None:
+                return None
+            return {"bytes": int(sum(v.nbytes for v in eng.params.values())
+                                 + sum(v.nbytes
+                                       for v in eng.buffers.values()))}
+
+        def _opt_bytes(ref=ref):
+            eng = ref()
+            if eng is None or eng.opt_state is None:
+                return None
+            leaves = jax.tree.leaves(eng.opt_state)
+            return {"bytes": int(sum(getattr(x, "nbytes", 0)
+                                     for x in leaves))}
+
+        _perf.register_memory_component("model_weights", _weight_bytes)
+        _perf.register_memory_component("optimizer_state", _opt_bytes)
 
     # ------------------------------------------------------------------
     def _shard_opt_state(self, state):
@@ -260,30 +287,23 @@ class ShardedTrainStep:
         """XLA's compiled-program HBM breakdown for the train step (device
         memory_stats is process-cumulative and unavailable on some PJRT
         transports). Returns dict of byte sizes: args/outputs/temps/
-        generated_code."""
-        ma = self._aot_compiled(inputs, labels).memory_analysis()
-        if ma is None:
-            return None
-        return {
-            "argument_bytes": getattr(ma, "argument_size_in_bytes", None),
-            "output_bytes": getattr(ma, "output_size_in_bytes", None),
-            "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
-            "generated_code_bytes": getattr(ma, "generated_code_size_in_bytes", None),
-        }
+        generated_code — extracted through the one shared path in
+        ``observability.perf`` (same fallbacks as the serving ledger)."""
+        from ..observability.perf import extract_memory_analysis
+
+        return extract_memory_analysis(self._aot_compiled(inputs, labels))
 
     def cost_analysis(self, inputs, labels):
         """XLA's per-execution cost model for the compiled step (flops /
         bytes accessed). Used by bench.py to compute MFU for conv models
         where the 6N-per-token LLM estimate does not apply. NOTE: for a
         GSPMD-partitioned step the numbers are PER PARTITION (one
-        device's share), matching the per-chip MFU convention."""
-        ca = self._aot_compiled(inputs, labels).cost_analysis()
-        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
-            ca = ca[0] if ca else None
-        if not ca:
-            return None
-        return {"flops": ca.get("flops"),
-                "bytes_accessed": ca.get("bytes accessed")}
+        device's share), matching the per-chip MFU convention. Extraction
+        routes through ``observability.perf`` — one cost path, one set
+        of PJRT-absent fallbacks."""
+        from ..observability.perf import extract_cost_analysis
+
+        return extract_cost_analysis(self._aot_compiled(inputs, labels))
 
     # ------------------------------------------------------------------
     def sync_weights_to_model(self):

@@ -1159,6 +1159,10 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     if stream:
         return python_token_iter()
 
+    # perf-ledger item accounting: generated tokens per entry call, so
+    # the ledger can report bytes/token and tokens/s for this entry
+    from .observability import perf as _perf
+
     with _entrypoint("generation.generate"):
         if loop_mode == "scan" and cfg.max_new_tokens > 1:
             # one span for the whole fused program: prefill + decode are
@@ -1167,13 +1171,17 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                                args={"B": B, "S": S,
                                      "N": cfg.max_new_tokens,
                                      "mode": "scan"}):
-                return Tensor(generate_program(pb, ids, key, pads))
+                out = Tensor(generate_program(pb, ids, key, pads))
+            _perf.note_entry_items("generation.generate",
+                                   B * cfg.max_new_tokens)
+            return out
 
         if cfg.eos_token_id is not None:
             # early-exit python loop: host-syncs each token (the
             # streaming path already pays that), stops once every row is
             # done, pads the tail back to N with EOS
             toks = list(python_token_iter())
+            _perf.note_entry_items("generation.generate", B * len(toks))
             gen = np.stack(toks, axis=1)
             if gen.shape[1] < cfg.max_new_tokens:
                 pad = np.full((B, cfg.max_new_tokens - gen.shape[1]),
@@ -1198,6 +1206,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                 token, caches = step(pb, token, caches, jnp.asarray(S + i - 1, jnp.int32), sub, pads)
                 out.append(token)
             gen = jnp.stack(out, axis=1)  # [B, N]
+        _perf.note_entry_items("generation.generate", B * cfg.max_new_tokens)
         return Tensor(jnp.concatenate([ids, gen], axis=1))
 
 

@@ -121,7 +121,16 @@ class StaticFunction:
 
                 self._sot = SotFunction(self._fn, _wrap_in, _unwrap_out)
                 self.uses_compiled_control_flow = False  # SOT serves calls
-            except Exception:
+            except Exception as e:
+                from ..observability import perf as _perf
+
+                if _perf.is_oom_error(e):
+                    # device allocation failure: write the OOM forensics
+                    # dump (HBM ledger + top temp-byte executables) so
+                    # the failure names its culprit, then propagate —
+                    # an OOM is never a graph break to retry around
+                    _perf.dump_oom(e)
+                    raise
                 if not self.uses_compiled_control_flow:
                     raise
                 # the control-flow rewrite produced something lax cannot

@@ -28,6 +28,12 @@ Layout:
                   host-side only), Chrome-trace + JSONL export, the
                   flight-recorder ring + crash dumps, streaming
                   latency ``Digest``s.
+- ``perf``:       per-executable cost/roofline attribution (XLA
+                  ``cost_analysis``/``memory_analysis`` captured at
+                  compile time, joined with measured entry timings
+                  into MFU / achieved GB/s / roofline class), the HBM
+                  ledger, OOM forensics dumps, and the
+                  perf-regression-gate helpers.
 
 Trace event schema (``tracing.events()`` rows / trace JSONL lines)::
 
@@ -65,7 +71,7 @@ from __future__ import annotations
 
 import time
 
-from . import exporters, metrics, recompile, telemetry, tracing
+from . import exporters, metrics, perf, recompile, telemetry, tracing
 from .exporters import (RotatingJsonlSink, parse_prometheus_text,
                         prometheus_text, resolve_sink_path,
                         start_http_server, stop_http_server,
@@ -74,6 +80,9 @@ from .metrics import (DEFAULT_BUCKETS, DEFAULT_QUANTILES, Counter, Gauge,
                       Histogram, MetricsRegistry, Summary, counter, gauge,
                       get_registry, histogram, summary)
 from .metrics import _ENABLED
+from .perf import (MEMORY_STATS_UNSUPPORTED, compare_to_baseline, dump_oom,
+                   hbm_ledger, is_oom_error, ledger, peak_specs,
+                   register_memory_component)
 from .recompile import compile_events, current_entry, entry_stats, entrypoint
 from .telemetry import StepTelemetry, memory_watermarks, step_records
 from .tracing import (Digest, chrome_trace, disable_tracing, enable_tracing,
@@ -92,12 +101,19 @@ __all__ = [
     "tracing", "span", "instant", "trace_context", "chrome_trace",
     "flight_dump", "register_state_provider", "Digest",
     "enable_tracing", "disable_tracing", "tracing_enabled",
+    "perf", "ledger", "hbm_ledger", "peak_specs", "is_oom_error",
+    "dump_oom", "compare_to_baseline", "register_memory_component",
+    "MEMORY_STATS_UNSUPPORTED",
     "snapshot", "enable", "disable", "enabled",
 ]
 
 # Recompile monitoring is the subsystem's reason to exist; subscribe as
-# soon as the package is imported so no compile goes unattributed.
+# soon as the package is imported so no compile goes unattributed. Perf
+# capture rides the same funnel (backend_compile wrapper + entrypoint
+# call hook) — compile-time + host-side only, nothing on the dispatch
+# fast path.
 recompile.install()
+perf.install()
 
 
 def enable():
@@ -145,7 +161,11 @@ def snapshot() -> dict:
       full ``stats()`` incl. block-pool accounting — one call captures
       the whole system state, no scrape needed,
     - ``tracing``: span counts per phase, buffered-event count, last
-      flight-dump path.
+      flight-dump path,
+    - ``perf``: the per-executable cost/roofline ledger (flops, bytes,
+      arithmetic intensity, MFU, roofline class), the HBM ledger
+      (subsystem byte attribution + headroom), and the device peak
+      table in force.
     """
     return {
         "ts": time.time(),
@@ -155,4 +175,5 @@ def snapshot() -> dict:
         "steps": step_records(),
         "serving": _serving_state(),
         "tracing": tracing.summary(),
+        "perf": perf.perf_snapshot(),
     }

@@ -4,7 +4,8 @@
 the wall step time (its own clock, or fed by the profiler's
 ``_Benchmark`` ips timer via ``attach_benchmark``), the PJRT device
 memory watermarks (``memory_stats()`` live/peak bytes — absent on some
-CPU transports, recorded as null), and the recompile monitor's compile
+CPU transports, recorded as an explicit ``"memory": "unsupported"``
+marker, never as 0-valued gauges), and the recompile monitor's compile
 count (per-step delta, so a mid-training retrace shows up on exactly the
 step that paid for it). Each record lands in a bounded in-process ring
 (surfaced by ``observability.snapshot()``) and, when a path is given,
@@ -71,7 +72,10 @@ def memory_watermarks() -> Tuple[Optional[int], Optional[int]]:
 def record_memory_gauges() -> Tuple[Optional[int], Optional[int]]:
     """Read the watermarks AND publish them to the device-memory gauges
     (the Profiler's profile_memory hook and StepTelemetry both use
-    this)."""
+    this). An unsupported transport — (None, None) — must NOT write
+    0-valued gauges (a dashboard would read "no memory in use"); the
+    gauges stay untouched and the JSONL stream carries the explicit
+    ``unsupported`` marker instead."""
     live, peak = memory_watermarks()
     if live is not None:
         _live_bytes.set(live)
@@ -133,8 +137,16 @@ class StepTelemetry:
             rec["num_items"] = n
         if self.record_memory:
             live, peak = record_memory_gauges()
-            rec["live_bytes"] = live
-            rec["peak_bytes"] = peak
+            if live is None and peak is None:
+                # transport reports nothing: say so explicitly instead
+                # of emitting null byte fields a downstream aggregator
+                # would coerce to 0 (poisoning min/mean over the stream)
+                from .perf import MEMORY_STATS_UNSUPPORTED
+
+                rec["memory"] = MEMORY_STATS_UNSUPPORTED
+            else:
+                rec["live_bytes"] = live
+                rec["peak_bytes"] = peak
         if extra:
             rec.update(extra)
         self._compiles_seen = compiles

@@ -387,8 +387,11 @@ class Profiler:
                 lines.append(f"{'':<40}{len(self._mem_records):>8}"
                              f"{peak * mb:>14.1f}{live * mb:>14.1f}")
             else:
+                from ..observability.perf import \
+                    PJRT_MEMORY_UNSUPPORTED_NOTE
+
                 lines.append(f"{'':<40}{len(self._mem_records):>8}"
-                             f"{'n/a (PJRT memory_stats unsupported)':>28}")
+                             f"{PJRT_MEMORY_UNSUPPORTED_NOTE:>28}")
         return "\n".join(lines)
 
     def memory_records(self) -> List[Dict]:

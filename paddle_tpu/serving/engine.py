@@ -400,6 +400,52 @@ class ServingEngine:
             self._init_paged(B, run)
         else:
             self._init_contiguous(B, run)
+        self._register_memory_components()
+
+    def _register_memory_components(self):
+        """HBM-ledger attribution (``observability.perf.hbm_ledger``):
+        the engine owns the KV pools and holds the model weights — the
+        two footprints an OOM forensics dump most needs named. Weakref'd
+        like the flight-recorder state provider; a dead engine drops
+        out instead of pinning its pools."""
+        from ..observability import perf as _perf
+
+        ref = weakref.ref(self)
+
+        def _pool_bytes(attr, ref=ref):
+            eng = ref()
+            pools = getattr(eng, attr, None) if eng is not None else None
+            if pools is None:
+                return None
+            total = int(sum(arr.nbytes for c in pools for arr in c.values()))
+            out = {"bytes": total, "kv_format": eng.config.kv_format,
+                   "bytes_per_token": eng._kv_bytes_per_token
+                   if eng.paged else None}
+            if eng.paged:
+                out["blocks"] = eng._nblocks
+            return out
+
+        def _weight_bytes(ref=ref):
+            eng = ref()
+            if eng is None:
+                return None
+            n = int(sum(v.nbytes for v in eng._pb.values()))
+            if eng.spec:
+                n += int(sum(v.nbytes for v in eng._dpb.values()))
+            return {"bytes": n}
+
+        if self.paged:
+            _perf.register_memory_component(
+                "serving_kv_pool", functools.partial(_pool_bytes, "_pools"))
+            if self.spec:
+                _perf.register_memory_component(
+                    "serving_draft_kv_pool",
+                    functools.partial(_pool_bytes, "_dpools"))
+        else:
+            _perf.register_memory_component(
+                "serving_kv_cache", functools.partial(_pool_bytes, "_caches"))
+        _perf.register_memory_component("serving_model_weights",
+                                        _weight_bytes)
 
     # -- executables: paged --------------------------------------------------
     def _init_paged(self, B: int, run):
@@ -1155,6 +1201,8 @@ class ServingEngine:
         job.done = end
         _sm.prefill_chunks_total.inc()
         _sm.tokens_total.labels("prompt").inc(end - start)
+        from ..observability import perf as _perf
+        _perf.note_entry_items("serving.prefill_chunk", end - start)
         if not is_last:
             return
         if self.prefix_cache is not None:
@@ -1277,7 +1325,16 @@ class ServingEngine:
         try:
             return self._step_impl()
         except PoolExhaustedError as e:
-            _trace.flight_dump("pool_exhausted", extra={"error": repr(e)})
+            # a pool-exhaustion escape IS an allocation failure: the dump
+            # carries the OOM forensics payload (HBM ledger + top
+            # temp-byte executables) on top of the usual state snapshot
+            from ..observability import perf as _perf
+
+            try:
+                extra = {"error": repr(e), **_perf.oom_report()}
+            except Exception:  # noqa: BLE001 — dump must not crash twice
+                extra = {"error": repr(e)}
+            _trace.flight_dump("pool_exhausted", extra=extra)
             raise
 
     def _step_impl(self) -> bool:
@@ -1373,6 +1430,8 @@ class ServingEngine:
                             {"active": len(active), "step": self._steps})
             self._steps += 1
             self._occupancy_integral += len(active)
+            from ..observability import perf as _perf
+            _perf.note_entry_items("serving.step", len(active))
 
             for i in active:
                 req = self._slot_req[i]
@@ -1451,6 +1510,12 @@ class ServingEngine:
         self._steps += 1
         self._occupancy_integral += len(active)
         self._spec_rounds += 1
+        from ..observability import perf as _perf
+        if need_draft:
+            _perf.note_entry_items("serving.spec_draft",
+                                   int((spec_valid - 1).clip(0).sum()))
+        _perf.note_entry_items("serving.spec_verify",
+                               int(n_np[active].sum()))
 
         for i in active:
             req = self._slot_req[i]
@@ -1540,8 +1605,17 @@ class ServingEngine:
             _sm.engine_unhealthy.set(1)
             # post-mortem first, while the slot/queue state still shows
             # what the engine was doing when it died (the dump's state
-            # provider reads stats() — before the requests are failed)
-            _trace.flight_dump("engine_crash", extra={"error": err})
+            # provider reads stats() — before the requests are failed).
+            # A death that looks like a device allocation failure gets
+            # the OOM forensics dump instead: same flight recorder, but
+            # the extra names the top temp-byte executable — the OOM
+            # names its culprit instead of dying with an XLA backtrace.
+            from ..observability import perf as _perf
+
+            if _perf.is_oom_error(exc):
+                _perf.dump_oom(exc)
+            else:
+                _trace.flight_dump("engine_crash", extra={"error": err})
             for slot in range(self.config.max_slots):
                 if self._slot_req[slot] is not None:
                     self._free_slot(slot, RequestStatus.FAILED, "failed",
@@ -1695,6 +1769,12 @@ class ServingEngine:
             "goodput_tokens_per_s": _sm.goodput_tokens_per_second.value(),
             "preemptions": self._preempt_count,
         }
+        # the performance ledger for this engine's executables: per-entry
+        # flops/bytes/intensity/roofline + MFU when peaks are known (the
+        # /stats block the acceptance criteria read)
+        from ..observability import perf as _perf
+        out["perf"] = {"ledger": _perf.ledger(prefix="serving."),
+                       "peaks": _perf.peak_specs()}
         out["spec"] = self.spec_stats()
         if self.paged:
             out["block_size"] = self.config.block_size

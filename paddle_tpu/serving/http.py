@@ -27,6 +27,11 @@ completions are lists of token ids.
   ``?trace=<request_id>`` filters to one request's timeline.
 - ``GET /debug/requests`` -> the live per-request state table (queued /
   running / recent-finished, with phase, KV blocks, waits, latencies).
+- ``GET /debug/memory`` -> the HBM ledger: live device bytes attributed
+  to subsystems (KV pools, model weights, executable temp/output sizes
+  from the captured memory analyses), headroom vs ``bytes_limit``
+  (``"unsupported"`` where PJRT reports nothing), plus the device peak
+  table and the per-executable roofline ledger.
 
 Backpressure maps to ``429``, invalid requests to ``400``.
 Opt-in only: nothing starts this server implicitly.
@@ -120,6 +125,15 @@ def start_serving_http_server(engine, port: int = 0, addr: str = "127.0.0.1",
                 self._json(200, _tracing.chrome_trace(trace))
             elif path == "/debug/requests":
                 self._json(200, engine.debug_requests())
+            elif path == "/debug/memory":
+                from ..observability import perf as _perf
+
+                self._json(200, {
+                    "ts": time.time(),
+                    "hbm": _perf.hbm_ledger(),
+                    "peaks": _perf.peak_specs(),
+                    "ledger": _perf.ledger(),
+                })
             else:
                 self._json(404, {"error": f"no such path {path!r}"})
 

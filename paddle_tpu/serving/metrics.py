@@ -11,8 +11,13 @@ automatically.
 from __future__ import annotations
 
 from ..observability import metrics as _m
+# the roofline gauges live in observability.perf (they cover training
+# entries too); re-exported here so the serving surface registers and
+# names every gauge its /stats + /debug/memory endpoints publish
+from ..observability.perf import hbm_bw_util_gauge, mfu_gauge
 
 __all__ = [
+    "mfu_gauge", "hbm_bw_util_gauge",
     "requests_total", "tokens_total", "queue_depth", "slots_busy",
     "slot_occupancy", "steps_total", "step_seconds", "prefill_seconds",
     "ttft_seconds", "tpot_seconds", "engine_crashes_total",

@@ -76,6 +76,11 @@ TPU_LANE = [
     # container — pair with benchmarks/bench_spec_decode.py for the
     # >=1.3x coupled-draft acceptance on chip
     ("test_spec_decode.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # perf observability: on chip the peak table resolves from the real
+    # device_kind, so MFU/roofline go from "unknown" to classified —
+    # this entry is the first run where the ledger publishes real MFU
+    # (CPU verifies capture mechanics + honesty contracts only)
+    ("test_perf.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     # quantized serving: int8/fp8 KV pools (dequant in the paged kernel
     # prologue) + weight-only Pallas quant matmul; CPU-interpret-verified
     # in the build container — this entry is the quantized kernels' first
@@ -232,9 +237,21 @@ def _summarize_snapshot(snap: dict) -> dict:
         ("prefill_chunk_s", "paddle_tpu_serving_prefill_chunk_seconds"),
     ) if (d := digest(name)) is not None and d["count"]}
 
+    # the perf ledger's lane-relevant columns: per-entry static
+    # flops/bytes + roofline class + achieved rates (entries don't sum
+    # across shards; the merge keeps the busiest shard's row per entry)
+    perf_entries = {}
+    for entry, row in (snap.get("perf", {}).get("ledger", {}) or {}).items():
+        perf_entries[entry] = {
+            k: row.get(k) for k in (
+                "flops", "bytes_accessed", "temp_bytes",
+                "arithmetic_intensity", "roofline", "mfu", "hbm_bw_util",
+                "calls", "items", "items_per_s", "bytes_per_item")}
+
     return {
         "trace_spans": dict(snap.get("tracing", {}).get("span_counts", {})),
         "serving_digests": digests,
+        "perf_entries": perf_entries,
         # pt-analysis CI trend lines: findings by rule + suppression
         # accounting (recorded by the self-clean test's analyzer run)
         "analysis_findings": {
@@ -268,11 +285,46 @@ def _summarize_snapshot(snap: dict) -> dict:
     }
 
 
-def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
+def build_perf_ledger_block(bench_dir: str, perf_entries: dict) -> tuple:
+    """The telemetry lane's ``perf_ledger`` block: the merged per-entry
+    roofline rows + the regression-gate verdict against the committed
+    ``benchmarks/perf_baseline.json``. Returns (block, rc) — rc is 1
+    when any pinned metric regressed past its tolerance (the loud
+    failure the gate exists for)."""
+    root = os.path.dirname(HERE)
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from paddle_tpu.observability import perf as _perf
+
+    fresh = _perf.collect_bench_metrics(bench_dir)
+    baseline = _perf.load_baseline(
+        os.path.join(bench_dir, "perf_baseline.json"))
+    verdict = _perf.compare_to_baseline(fresh, baseline)
+    block = {"entries": perf_entries, "bench_metrics": fresh,
+             "baseline_gate": verdict}
+    if verdict.get("failures"):
+        print("[run_shards] PERF REGRESSION GATE FAILED:", flush=True)
+        for f in verdict["failures"]:
+            print(f"[run_shards]   {f['metric']}: fresh {f['fresh']} vs "
+                  f"baseline {f['baseline']} (tol {f['rel_tol']:.0%}, "
+                  f"bound {f['bound']:.4g}, delta {f['delta_pct']}%)",
+                  flush=True)
+        print("[run_shards]   a real improvement? re-run the bench "
+              "best-of-3 and update benchmarks/perf_baseline.json with "
+              "the new number in the same commit", flush=True)
+        return block, 1
+    print(f"[run_shards] perf gate: {verdict.get('checked', 0)} metrics "
+          f"within tolerance ({len(verdict.get('skipped', []))} skipped)",
+          flush=True)
+    return block, 0
+
+
+def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
     """Merge the per-shard snapshots into benchmarks/telemetry_lane.json
     (next to tpu_lane_results.json): per-shard summaries plus summed
     totals, so the chip lane's fused-conv hit rate and compile counts
-    are auditable without re-running anything."""
+    are auditable without re-running anything. Also evaluates the
+    perf-regression gate; returns (path, gate_rc)."""
     import datetime
     import glob
     import json
@@ -281,6 +333,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     totals: dict = {"fused_conv_dispatch": {}, "flash_decode_dispatch": {},
                     "trace_spans": {}, "serving_digests": {},
                     "analysis_findings": {}, "analysis_suppressions": {},
+                    "perf_entries": {},
                     "compiles_total": 0,
                     "compile_seconds_total": 0.0, "retraces_total": 0,
                     "nan_check_trips": 0, "steps_recorded": 0}
@@ -304,6 +357,13 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
             if d["count"] > totals["serving_digests"].get(
                     k, {"count": 0})["count"]:
                 totals["serving_digests"][k] = d
+        # ledger rows don't sum either: per entry, keep the shard that
+        # called it most (its timing window is the representative one)
+        for entry, row in summary["perf_entries"].items():
+            cur = totals["perf_entries"].get(entry)
+            if cur is None or (row.get("calls") or 0) > (cur.get("calls")
+                                                         or 0):
+                totals["perf_entries"][entry] = row
         for k in ("compiles_total", "compile_seconds_total",
                   "retraces_total", "nan_check_trips", "steps_recorded"):
             totals[k] += summary[k]
@@ -334,14 +394,17 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
     paged_kv_bench = _read_bench("bench_paged_kv.json")
     spec_decode_bench = _read_bench("bench_spec_decode.json")
     quant_bench = _read_bench("bench_quant.json")
-    out_path = os.path.join(os.path.dirname(HERE), "benchmarks",
-                            "telemetry_lane.json")
+    bench_dir = os.path.join(os.path.dirname(HERE), "benchmarks")
+    perf_ledger, gate_rc = build_perf_ledger_block(
+        bench_dir, totals.pop("perf_entries"))
+    out_path = os.path.join(bench_dir, "telemetry_lane.json")
     with open(out_path, "w") as fh:
         json.dump({
             "platform": platform,
             "finished": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds"),
             "totals": totals,
+            "perf_ledger": perf_ledger,
             "shards": shards,
             "serving_bench": serving_bench,
             "checkpoint_bench": checkpoint_bench,
@@ -352,8 +415,9 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> str:
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "
-          f"{totals['fused_conv_hit_rate']})", flush=True)
-    return out_path
+          f"{totals['fused_conv_hit_rate']}, perf gate rc={gate_rc})",
+          flush=True)
+    return out_path, gate_rc
 
 
 def run_static_analysis(label: str) -> int:
@@ -423,8 +487,8 @@ def run_tpu_lane(slack: float) -> int:
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(f"[run_shards] tpu lane results -> {path} (rc={rc})", flush=True)
-    merge_telemetry_snapshots(tdump, "tpu")
-    return rc
+    _, gate_rc = merge_telemetry_snapshots(tdump, "tpu")
+    return rc | gate_rc
 
 
 def main(argv=None):
@@ -485,8 +549,8 @@ def main(argv=None):
                              f"serial {r['file']}")
     if args.enforce_dispatch:
         rc |= merge_dispatch_records(os.environ["PADDLE_TPU_DISPATCH_DUMP"])
-    merge_telemetry_snapshots(tdump, "cpu")
-    return rc
+    _, gate_rc = merge_telemetry_snapshots(tdump, "cpu")
+    return rc | gate_rc
 
 
 if __name__ == "__main__":

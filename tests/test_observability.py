@@ -532,9 +532,13 @@ class TestTelemetryLaneMerge:
             with open(f"{prefix}.{pid}.json", "w") as fh:
                 json.dump(snap(*args), fh)
 
-        out = run_shards.merge_telemetry_snapshots(prefix, "cpu")
+        out, gate_rc = run_shards.merge_telemetry_snapshots(prefix, "cpu")
+        # the fake benchmarks dir has no bench artifacts: every gate
+        # metric is skipped, never failed
+        assert gate_rc == 0
         data = json.loads(open(out).read())
         assert data["platform"] == "cpu"
+        assert data["perf_ledger"]["baseline_gate"]["ok"]
         assert len(data["shards"]) == 2
         t = data["totals"]
         assert t["fused_conv_dispatch"] == {"hit/train": 4,
