@@ -1,0 +1,282 @@
+"""Multi-replica router lane: overhead vs direct engine, and
+goodput/p99-TTFT with and without an injected replica crash.
+
+Three lanes, one deterministic staggered workload (time-scheduled
+arrivals, mixed greedy/sampled params — the continuous-batching case):
+
+- ``overhead``: the same workload through ONE engine directly vs
+  through a ``Router`` with that one engine as its only replica —
+  best-of-3 alternating passes. The router is host-side bookkeeping
+  (pick + relay + event wait), so the acceptance bar is <2% goodput
+  loss at equal load; the measured number is pinned in
+  ``perf_baseline.json`` (``router.overhead_pct``, direction lower).
+- ``goodput``: 2 replicas, no faults — fleet tok/s, goodput (deadline-
+  met tok/s), and the TTFT p50/p95/p99 tail.
+- ``crash``: the same 2-replica fleet with replica r0 killed
+  mid-decode (``ChaosEngine``, step-count-deterministic). EVERY request
+  must still complete — failover retries on r1 — with outputs
+  bit-identical to ``generation.generate`` (asserted for all requests,
+  greedy AND sampled), zero retraces on the surviving replica, and
+  amplification under the cap. The p99 TTFT with the crash quantifies
+  the failover tax.
+
+Artifact: ``benchmarks/bench_router.json``; ``tests/run_shards.py``
+folds it into ``telemetry_lane.json`` as ``router_bench`` and the perf
+gate reads ``router.tok_s`` / ``router.overhead_pct`` /
+``router.crash_completed_frac`` from it. Exit code is non-zero when a
+verdict fails. CPU numbers size the lane on the dev box; the chip lane
+reruns for real ones.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import generation, serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import recompile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (arrival_offset_s, prompt_len, params): arrivals stagger over ~0.5 s
+# so later requests land while earlier ones decode.
+WORKLOAD = [
+    (0.00, 5, dict(max_new_tokens=40)),
+    (0.00, 9, dict(max_new_tokens=32, do_sample=True, temperature=0.8,
+                   top_k=8, seed=1)),
+    (0.03, 14, dict(max_new_tokens=48)),
+    (0.06, 26, dict(max_new_tokens=24, do_sample=True, top_p=0.9, seed=2)),
+    (0.09, 7, dict(max_new_tokens=40)),
+    (0.12, 11, dict(max_new_tokens=24, do_sample=True, temperature=1.1,
+                    top_k=12, seed=3)),
+    (0.16, 19, dict(max_new_tokens=32)),
+    (0.20, 4, dict(max_new_tokens=16)),
+    (0.25, 30, dict(max_new_tokens=40, do_sample=True, top_k=64,
+                    top_p=0.95, seed=4)),
+    (0.30, 6, dict(max_new_tokens=32)),
+    (0.36, 13, dict(max_new_tokens=24, do_sample=True, temperature=0.9,
+                    top_k=6, seed=5)),
+    (0.42, 8, dict(max_new_tokens=40)),
+    (0.46, 10, dict(max_new_tokens=28)),
+    (0.50, 16, dict(max_new_tokens=32, do_sample=True, top_k=16, seed=6)),
+]
+MAX_SLOTS = 4
+MAX_LEN = 96
+DEADLINE_S = 60.0
+
+# weight-streaming-bound decode (the serving regime) but small enough
+# that six engine builds fit the lane budget
+MODEL_KW = dict(hidden_size=256, intermediate_size=512,
+                num_hidden_layers=3, num_attention_heads=8,
+                num_key_value_heads=4, vocab_size=2048)
+
+
+def make_workload(cfg):
+    rng = np.random.RandomState(42)
+    return [(at, rng.randint(1, cfg.vocab_size, n).astype(np.int32), p)
+            for at, n, p in WORKLOAD]
+
+
+def reference_outputs(model, workload):
+    return [generation.generate(model, prompt[None], **params)
+            .numpy()[0, len(prompt):]
+            for _, prompt, params in workload]
+
+
+def new_engine(model):
+    eng = serving.ServingEngine(model, max_slots=MAX_SLOTS, max_len=MAX_LEN)
+    eng.warmup()
+    return eng
+
+
+def run_workload(submit, workload):
+    """Time-scheduled submission; returns (handles, tok_s, wall_s,
+    ttft_list)."""
+    handles = []
+    t0 = time.perf_counter()
+    for at, prompt, params in workload:
+        while time.perf_counter() - t0 < at:
+            time.sleep(0.002)
+        handles.append(submit(prompt, params))
+    for h in handles:
+        try:
+            h.result(timeout=DEADLINE_S + 30)
+        except TimeoutError:
+            pass
+    wall = time.perf_counter() - t0
+    tokens = sum(len(h.output_tokens) for h in handles)
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+    return handles, tokens / wall, wall, ttfts
+
+
+def pct(values, q):
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values), q))
+
+
+def ttft_block(ttfts):
+    return {"p50_ms": round(1e3 * pct(ttfts, 50), 2),
+            "p95_ms": round(1e3 * pct(ttfts, 95), 2),
+            "p99_ms": round(1e3 * pct(ttfts, 99), 2)}
+
+
+def serving_retraces():
+    return sum(v["retraces"] for k, v in recompile.entry_stats().items()
+               if k.startswith("serving."))
+
+
+def lane_overhead(model, workload):
+    """Direct engine vs router-with-one-replica, best-of-3 alternating
+    passes over the SAME engines (steady-state: both warmed)."""
+    direct_eng = new_engine(model).start()
+    router_eng = new_engine(model)
+    router = serving.Router([router_eng], probe_interval_s=0.5)
+    router.start()
+
+    def submit_direct(prompt, params):
+        return direct_eng.submit(prompt, deadline_s=DEADLINE_S,
+                                 params=serving.SamplingParams(**params))
+
+    def submit_router(prompt, params):
+        return router.submit(prompt, deadline_s=DEADLINE_S,
+                             params=serving.SamplingParams(**params))
+
+    best = {"direct": 0.0, "router": 0.0}
+    for _ in range(3):
+        for name, submit in (("direct", submit_direct),
+                             ("router", submit_router)):
+            _, tok_s, _, _ = run_workload(submit, workload)
+            best[name] = max(best[name], tok_s)
+    overhead_pct = 100.0 * (1.0 - best["router"] / best["direct"])
+    router.stop(drain=True, timeout_s=30)
+    direct_eng.stop()
+    return {"direct_tok_s": round(best["direct"], 1),
+            "router_tok_s": round(best["router"], 1),
+            "overhead_pct": round(overhead_pct, 2),
+            "passes": 3,
+            "verdict_lt_2pct": overhead_pct < 2.0}
+
+
+def lane_goodput(model, workload, refs, crash: bool):
+    engines = [new_engine(model), new_engine(model)]
+    router = serving.Router(
+        engines, probe_interval_s=0.05, probe_failures_to_eject=2,
+        max_retries_per_request=2, unroutable_timeout_s=30.0)
+    router.start()
+    monkey = None
+    if crash:
+        # deterministic mid-run kill: r0 dies ~30 loop iterations in
+        monkey = serving.ChaosEngine(engines[0]).crash_after_steps(30)
+    retr0 = serving_retraces()
+
+    def submit(prompt, params):
+        return router.submit(prompt, deadline_s=DEADLINE_S,
+                             params=serving.SamplingParams(**params))
+
+    handles, tok_s, wall, ttfts = run_workload(submit, workload)
+    completed = [h for h in handles
+                 if h.status == serving.RequestStatus.COMPLETED]
+    lost = [h for h in handles if not h.done]
+    parity = all(
+        np.array_equal(np.asarray(h.output_tokens), ref)
+        for h, ref in zip(handles, refs)
+        if h.status == serving.RequestStatus.COMPLETED)
+    deadline_met_tokens = sum(
+        len(h.output_tokens) for h in completed
+        if h.finish_ts - h.arrival_ts <= DEADLINE_S)
+    st = router.stats()
+    out = {
+        "replicas": 2,
+        "requests": len(handles),
+        "completed": len(completed),
+        "completed_frac": round(len(completed) / len(handles), 4),
+        "silently_lost": len(lost),
+        "tok_s": round(tok_s, 1),
+        "goodput_tok_s": round(deadline_met_tokens / wall, 1),
+        "wall_s": round(wall, 3),
+        "ttft": ttft_block(ttfts),
+        "retries": sum(h.retries for h in handles),
+        "extra_attempts": st["extra_attempts"],
+        "amplification": st["amplification"],
+        "parity_vs_generate": parity,
+        "new_retraces": serving_retraces() - retr0,
+    }
+    if crash:
+        out["crash_injected"] = monkey.injected["crash"]
+        out["replica_states"] = {r["name"]: r["state"]
+                                 for r in router.replicas()}
+    router.stop(drain=True, timeout_s=30)
+    return out
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig(**MODEL_KW)
+    model = LlamaForCausalLM(cfg)
+    workload = make_workload(cfg)
+    print(f"[bench_router] model {MODEL_KW['hidden_size']}h x "
+          f"{MODEL_KW['num_hidden_layers']}L, {len(workload)} requests",
+          flush=True)
+    refs = reference_outputs(model, workload)
+
+    overhead = lane_overhead(model, workload)
+    print(f"[bench_router] overhead: direct {overhead['direct_tok_s']} "
+          f"tok/s vs router {overhead['router_tok_s']} tok/s -> "
+          f"{overhead['overhead_pct']}% (<2% verdict: "
+          f"{overhead['verdict_lt_2pct']})", flush=True)
+
+    goodput = lane_goodput(model, workload, refs, crash=False)
+    print(f"[bench_router] 2-replica goodput {goodput['goodput_tok_s']} "
+          f"tok/s, TTFT p99 {goodput['ttft']['p99_ms']} ms", flush=True)
+
+    crash = lane_goodput(model, workload, refs, crash=True)
+    print(f"[bench_router] crash lane: {crash['completed']}/"
+          f"{crash['requests']} completed (retries {crash['retries']}), "
+          f"TTFT p99 {crash['ttft']['p99_ms']} ms, parity "
+          f"{crash['parity_vs_generate']}, new retraces "
+          f"{crash['new_retraces']}", flush=True)
+
+    verdicts = {
+        "overhead_lt_2pct": overhead["verdict_lt_2pct"],
+        "no_silent_loss": goodput["silently_lost"] == 0
+        and crash["silently_lost"] == 0,
+        "crash_all_completed": crash["completed_frac"] == 1.0,
+        "crash_parity": crash["parity_vs_generate"],
+        "crash_fault_fired": crash.get("crash_injected", 0) >= 1,
+        "zero_retraces_on_survivors": crash["new_retraces"] == 0
+        and goodput["new_retraces"] == 0,
+        "amplification_bounded": crash["extra_attempts"]
+        <= 0.5 * crash["requests"] + 4,
+    }
+    out = {
+        "model": MODEL_KW,
+        "workload_requests": len(workload),
+        "max_slots": MAX_SLOTS,
+        "overhead": overhead,
+        "goodput": goodput,
+        "crash": crash,
+        "verdicts": verdicts,
+    }
+    path = os.path.join(HERE, "bench_router.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"[bench_router] -> {path}", flush=True)
+    failed = [k for k, v in verdicts.items() if not v]
+    if failed:
+        print(f"[bench_router] VERDICTS FAILED: {failed}", flush=True)
+        return 1
+    print("[bench_router] all verdicts passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,8 @@ from . import metrics as _fm
 
 __all__ = ["PreemptionHandler", "install_preemption_handler",
            "uninstall_preemption_handler", "preemption_requested",
-           "clear_preemption", "request_preemption"]
+           "clear_preemption", "request_preemption",
+           "add_preemption_listener", "remove_preemption_listener"]
 
 
 def _flight_dump(reason: str):
@@ -50,6 +51,12 @@ class PreemptionHandler:
         self._prev = {}
         self._installed = False
         self.last_signal: Optional[int] = None
+        # listeners: fn(reason_str) fired when preemption is requested
+        # (signal or programmatic). How the serving router turns SIGTERM
+        # into a graceful drain instead of a fail-all crash. Each runs
+        # try/except — a listener must never break the shutdown path,
+        # and anything slow must hop off the signal-handler thread.
+        self._listeners: list = []
 
     def install(self) -> "PreemptionHandler":
         if self._installed:
@@ -92,6 +99,24 @@ class PreemptionHandler:
         _fm.preemptions_total.labels(name).inc()
         self._event.set()
         _flight_dump(f"signal_{name}")
+        self._notify(f"signal_{name}")
+
+    def _notify(self, reason: str):
+        for fn in list(self._listeners):
+            try:
+                fn(reason)
+            except Exception:  # noqa: BLE001 — never break the shutdown path
+                pass
+
+    def add_listener(self, fn):
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     # cooperative surface ----------------------------------------------------
     @property
@@ -103,6 +128,7 @@ class PreemptionHandler:
         _fm.preemptions_total.labels("manual").inc()
         self._event.set()
         _flight_dump("preemption_requested")
+        self._notify("manual")
 
     def clear(self):
         self._event.clear()
@@ -149,3 +175,17 @@ def clear_preemption():
     h = _handler
     if h is not None:
         h.clear()
+
+
+def add_preemption_listener(fn):
+    """Register ``fn(reason)`` to fire when preemption is requested
+    (SIGTERM/SIGINT or programmatic) — the hook the serving router's
+    graceful drain rides. Installs nothing by itself; pair with
+    ``install_preemption_handler()`` for real signals."""
+    _ensure_handler().add_listener(fn)
+
+
+def remove_preemption_listener(fn):
+    h = _handler
+    if h is not None:
+        h.remove_listener(fn)

@@ -667,6 +667,10 @@ BENCH_METRIC_SOURCES = {
     "spec.best_speedup": ("bench_spec_decode.json", "best_speedup"),
     "spec.k8_occ1_tok_s": ("bench_spec_decode.json",
                            "spec_k8_coupled.by_occupancy.1.tok_s"),
+    "router.tok_s": ("bench_router.json", "goodput.tok_s"),
+    "router.overhead_pct": ("bench_router.json", "overhead.overhead_pct"),
+    "router.crash_completed_frac": ("bench_router.json",
+                                    "crash.completed_frac"),
     "train.tok_s_per_chip": ("bench_train.json", "tokens_per_sec_per_chip"),
     "train.mfu": ("bench_train.json", "mfu"),
 }

@@ -31,8 +31,8 @@ from . import metrics as _m
 
 __all__ = ["install", "installed", "entrypoint", "current_entry",
            "compile_events", "total_compiles", "entry_stats", "reset_entries",
-           "reset_warmup", "register_entry_location", "entry_location",
-           "add_call_hook", "remove_call_hook"]
+           "reset_warmup", "warmup_scope", "register_entry_location",
+           "entry_location", "add_call_hook", "remove_call_hook"]
 
 logger = logging.getLogger("paddle_tpu.observability")
 
@@ -151,6 +151,36 @@ class entrypoint:
         return False
 
 
+class warmup_scope:
+    """Mark the current thread as deliberately warming executables:
+    compiles inside the scope are counted and attributed as usual but
+    are NEVER retraces, regardless of the entry's completed-call count.
+
+    ``reset_warmup`` covers the single-engine case (a fresh engine's
+    entries start at calls == 0, so their first compiles are warmup by
+    construction), but it cannot cover a SECOND in-process engine whose
+    entries share names with one that already served calls — e.g. two
+    serving replicas both dispatching ``serving.step``. Replica N+1's
+    ``engine.warmup()`` runs inside this scope so its expected compiles
+    don't trip the retrace alarm the router's zero-retrace invariant
+    relies on. Re-entrant; thread-local (compiles run synchronously on
+    the dispatching thread)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _tls.warmup = getattr(_tls, "warmup", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.warmup -= 1
+        return False
+
+
+def _in_warmup_scope() -> bool:
+    return getattr(_tls, "warmup", 0) > 0
+
+
 def _entry_state(name: str) -> dict:
     st = _entries.get(name)
     if st is None:
@@ -179,7 +209,7 @@ def _on_duration(name: str, duration: float, **kwargs):
         st = _entry_state(entry)
         st["compiles"] += 1
         st["compile_seconds"] += duration
-        if st["calls"] >= 1:
+        if st["calls"] >= 1 and not _in_warmup_scope():
             st["retraces"] += 1
             _retraces.labels(entry).inc()
             if not st["warned"]:

@@ -37,8 +37,21 @@ static-shape decode substrate:
                   counters, TTFT/TPOT histograms in the shared
                   observability registry (registered at import so
                   scrapes always show serving state).
-- ``http``:       opt-in stdlib HTTP front end
-                  (``start_serving_http_server``).
+- ``http``:       opt-in stdlib HTTP front end (``ServingHTTPServer`` /
+                  ``start_serving_http_server``) with split /healthz 503
+                  states (crashed/draining/saturated/stalled) and
+                  digest-derived Retry-After.
+- ``router``:     multi-replica layer: ``Router`` spreads requests over
+                  N engine replicas (``LocalReplica``/``HTTPReplica``)
+                  with load-aware admission, health-gated failover
+                  (probe ejection + warmup-gated readmission),
+                  deadline-aware retries whose failover outputs are
+                  bit-identical to a single engine, optional TTFT
+                  hedging, and graceful drain.
+- ``router_http``: the router's HTTP front end (``RouterHTTPServer``)
+                  + SIGTERM -> fleet drain.
+- ``chaos``:      deterministic fault injection (``ChaosEngine``,
+                  ``ChaosReplica``) powering the router chaos suite.
 
 Quick start::
 
@@ -55,14 +68,27 @@ from __future__ import annotations
 from . import metrics  # registers the serving gauges at import
 from .block_pool import (BlockPool, BlockPoolError, PoolExhaustedError,
                          PrefixCache)
-from .engine import ServingConfig, ServingEngine
-from .http import start_serving_http_server, stop_serving_http_server
+from .chaos import ChaosEngine, ChaosError, ChaosReplica
+from .engine import (EngineDrainingError, EngineStoppedError, ServingConfig,
+                     ServingEngine)
+from .http import (ServingHTTPServer, start_serving_http_server,
+                   stop_serving_http_server)
 from .request import Request, RequestStatus, SamplingParams
+from .router import (HTTPReplica, LocalReplica, NoReplicaError, ReplicaState,
+                     Router, RouterConfig, RouterRequest)
+from .router_http import (RouterHTTPServer, install_sigterm_drain,
+                          uninstall_sigterm_drain)
 from .scheduler import QueueFullError, Scheduler
 
 __all__ = [
     "ServingConfig", "ServingEngine", "SamplingParams", "Request",
     "RequestStatus", "Scheduler", "QueueFullError",
+    "EngineStoppedError", "EngineDrainingError",
     "BlockPool", "PrefixCache", "PoolExhaustedError", "BlockPoolError",
-    "start_serving_http_server", "stop_serving_http_server",
+    "ServingHTTPServer", "start_serving_http_server",
+    "stop_serving_http_server",
+    "Router", "RouterConfig", "RouterRequest", "ReplicaState",
+    "LocalReplica", "HTTPReplica", "NoReplicaError",
+    "RouterHTTPServer", "install_sigterm_drain", "uninstall_sigterm_drain",
+    "ChaosEngine", "ChaosReplica", "ChaosError",
 ]

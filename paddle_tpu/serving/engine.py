@@ -98,7 +98,22 @@ from .block_pool import BlockPool, PoolExhaustedError, PrefixCache
 from .request import Request, RequestStatus, SamplingParams
 from .scheduler import Scheduler
 
-__all__ = ["ServingConfig", "ServingEngine"]
+__all__ = ["ServingConfig", "ServingEngine", "EngineStoppedError",
+           "EngineDrainingError"]
+
+
+class EngineStoppedError(RuntimeError):
+    """``submit()`` after ``stop()``: the engine no longer admits work.
+    Raised instead of silently enqueueing into a loop that will never
+    run again (the old behavior hung the caller's ``result()``
+    forever)."""
+
+
+class EngineDrainingError(EngineStoppedError):
+    """``submit()`` during drain: in-flight requests are finishing but
+    no new work is admitted. A router should route the request to
+    another replica; a direct caller should back off and retry once the
+    replacement replica is up."""
 
 
 def _default_buckets(max_len: int) -> tuple:
@@ -172,6 +187,12 @@ class ServingConfig:
     prefix_caching: bool = True
     spec_k: int = 4
     kv_format: str = "bf16"
+    # background loop liveness: with work pending and no step boundary
+    # for this long, /healthz flips to "stalled" (503) so a router's
+    # probes can eject a HUNG replica — a wedged device dispatch looks
+    # exactly like this, and without the detector it is invisible (the
+    # loop thread is stuck, but every state read still says "ok")
+    stall_timeout_s: float = 10.0
 
     def __post_init__(self):
         if self.kv_mode not in ("paged", "contiguous"):
@@ -373,11 +394,15 @@ class ServingEngine:
         self._occupancy_integral = 0
         self._outcomes = {}
         self._preempt_count = 0
+        self._last_progress_ts = time.perf_counter()  # stall detector
         self._step_lock = threading.RLock()
         self._wake = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._crashed: Optional[str] = None  # repr of the fatal loop error
+        self._draining = False   # no new admissions; in-flight finishing
+        self._stopped = False    # terminal: drained (or aborted) + loop down
+        self._warmed_up = False  # warmup() ran: executables AOT-compiled
         _sm.engine_unhealthy.set(0)  # a fresh engine is the healthy one
 
         # /debug/requests keeps the tail of finished requests next to the
@@ -848,6 +873,121 @@ class ServingEngine:
             _recompile.register_entry_location(f"serving.prefill[{b}]",
                                                _prefill)
 
+    # -- warmup: AOT-compile every executable before taking traffic ----------
+    def warmup(self) -> dict:
+        """Compile every executable this engine will dispatch — the
+        pool-wide decode step (or the spec draft+verify pair), the
+        ``[1, C]`` prefill chunk, and the COW fork (contiguous mode:
+        every prefill bucket + splice + step) — by running each once
+        with inert inputs: zeroed block tables route every write to the
+        reserved dump block, ``valid``/``active`` masks are all-off, and
+        ``is_last`` is False, so no slot state a future request relies
+        on is touched (free rows' tokens/keys are scratch that admission
+        rewrites anyway).
+
+        A replica that warms up before registering with the router
+        serves its FIRST request with zero compiles — the recompile
+        monitor asserts it (the warmup runs inside
+        ``recompile.warmup_scope`` so a second in-process replica's
+        expected compiles never count as retraces of the first's
+        entries). Requires an idle engine; idempotent. Returns
+        ``{"entries": [...], "compiles": n, "wall_s": t}``."""
+        t0 = time.perf_counter()
+        before = _recompile.total_compiles()
+        with self._step_lock:
+            if self.busy_slots() or self.scheduler.depth:
+                raise RuntimeError(
+                    "warmup() requires an idle engine: it dispatches "
+                    "every executable with inert (dump-block-routed) "
+                    "inputs — warm up before submitting traffic")
+            with _recompile.warmup_scope():
+                if self.paged:
+                    entries = self._warmup_paged()
+                else:
+                    entries = self._warmup_contiguous()
+            self._warmed_up = True
+        return {"entries": entries,
+                "compiles": _recompile.total_compiles() - before,
+                "wall_s": round(time.perf_counter() - t0, 4)}
+
+    def _warmup_paged(self) -> list:
+        B = self.config.max_slots
+        nb = self._bt.shape[1]
+        bt1 = jnp.zeros((1, nb), jnp.int32)
+        btB = jnp.zeros((B, nb), jnp.int32)
+        off = jnp.zeros(B, bool)
+        zero_i = jnp.asarray(0, jnp.int32)
+        chunk_args = (
+            bt1, jnp.zeros((1, self._chunk_size), jnp.int32),
+            zero_i, zero_i, zero_i, jnp.asarray(False), zero_i,
+            jax.random.PRNGKey(0), jnp.asarray([False]),
+            jnp.asarray([1.0], jnp.float32), jnp.asarray([0], jnp.int32),
+            jnp.asarray([1.0], jnp.float32))
+        entries = ["serving.prefill_chunk", "serving.cow"]
+        with _entrypoint("serving.prefill_chunk"):
+            if self.spec:
+                _, self._pools, self._dpools, self._state = \
+                    self._chunk_spec_fn(self._pb, self._dpb, self._pools,
+                                        self._dpools, self._state,
+                                        *chunk_args)
+            else:
+                _, self._pools, self._state = self._chunk_fn(
+                    self._pb, self._pools, self._state, *chunk_args)
+        if self.spec:
+            # a spec engine never traces the plain step — its decode
+            # round is the draft+verify pair
+            entries += ["serving.spec_draft", "serving.spec_verify"]
+            sv0 = jnp.zeros(B, jnp.int32)
+            with _entrypoint("serving.spec_draft"):
+                _, self._dpools = self._draft_fn(
+                    self._dpb, self._dpools, self._state, btB, sv0,
+                    jnp.asarray(False))
+            with _entrypoint("serving.spec_verify"):
+                _, _, self._pools, self._state = self._verify_fn(
+                    self._pb, self._pools, self._state, btB,
+                    self._zero_drafts, sv0, jnp.asarray(False), off)
+        else:
+            entries.append("serving.step")
+            with _entrypoint("serving.step"):
+                _, self._pools, self._state = self._step_fn(
+                    self._pb, self._pools, self._state, btB,
+                    jnp.asarray(False), off)
+        with _entrypoint("serving.cow"):
+            if self.spec:
+                self._pools, self._dpools = self._cow_spec_fn(
+                    self._pools, self._dpools, zero_i, zero_i)
+            else:
+                self._pools = self._cow_fn(self._pools, zero_i, zero_i)
+        return entries
+
+    def _warmup_contiguous(self) -> list:
+        B = self.config.max_slots
+        entries = ["serving.step"]
+        for b in self._buckets:
+            entries.append(f"serving.prefill[{b}]")
+            with _entrypoint(f"serving.prefill[{b}]"):
+                token, key, pcaches = self._prefill_fn(
+                    self._pb,
+                    jnp.full((1, b), self.config.pad_token_id, jnp.int32),
+                    jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                    jnp.asarray([False]), jnp.asarray([1.0], jnp.float32),
+                    jnp.asarray([0], jnp.int32),
+                    jnp.asarray([1.0], jnp.float32))
+                # pos0 = 0: the step pins free rows to position 0, so
+                # the scratch splice into (free) slot 0 is invisible
+                self._caches, self._state = self._splice_fn(
+                    self._caches, self._state, pcaches,
+                    jnp.asarray(0, jnp.int32), token[0],
+                    jnp.asarray(0, jnp.int32), key, jnp.asarray(False),
+                    jnp.asarray(1.0, jnp.float32),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(1.0, jnp.float32))
+        with _entrypoint("serving.step"):
+            _, self._caches, self._state = self._step_fn(
+                self._pb, self._caches, self._state, jnp.asarray(False),
+                jnp.zeros(B, bool))
+        return entries
+
     # -- submission ----------------------------------------------------------
     def submit(self, prompt, deadline_s: Optional[float] = None,
                on_token=None, params: Optional[SamplingParams] = None,
@@ -864,6 +1004,15 @@ class ServingEngine:
             raise RuntimeError(
                 f"serving engine has crashed ({self._crashed}); create a "
                 f"fresh engine — this one's decode state is gone")
+        if self._stopped:
+            raise EngineStoppedError(
+                "serving engine is stopped; submit() refused — build a "
+                "fresh engine (and warmup() it before taking traffic)")
+        if self._draining:
+            raise EngineDrainingError(
+                "serving engine is draining: in-flight requests are "
+                "finishing but no new work is admitted — route this "
+                "request to another replica")
         if params is None:
             params = SamplingParams(**sampling)
         elif sampling:
@@ -1159,6 +1308,14 @@ class ServingEngine:
         if req.cancel_requested:
             self._free_slot(slot, RequestStatus.CANCELLED, "cancelled")
             return
+        if req.deadline_ts is not None \
+                and time.perf_counter() > req.deadline_ts:
+            # the deadline can expire BETWEEN admission and the first
+            # (or any) prefill chunk — free the blocks now instead of
+            # burning chunk dispatches on a request nobody will read
+            self._free_slot(slot, RequestStatus.EXPIRED, "expired",
+                            error="deadline passed during prefill")
+            return
         C = self._chunk_size
         bs = self.config.block_size
         start = job.done
@@ -1339,6 +1496,7 @@ class ServingEngine:
 
     def _step_impl(self) -> bool:
         with self._step_lock:
+            self._last_progress_ts = time.perf_counter()
             self._admit()
             worked = False
             if self.paged:
@@ -1569,6 +1727,11 @@ class ServingEngine:
     def start(self):
         """Run the serving loop on a daemon thread (the HTTP front end
         and ``Request.result()`` consumers use this mode)."""
+        if self._stopped:
+            raise EngineStoppedError(
+                "stopped engines don't restart: the drain already "
+                "refused new work — build a fresh engine (warmup() it "
+                "before taking traffic)")
         with self._wake:
             if self._running:
                 return self
@@ -1616,20 +1779,26 @@ class ServingEngine:
                 _perf.dump_oom(exc)
             else:
                 _trace.flight_dump("engine_crash", extra={"error": err})
-            for slot in range(self.config.max_slots):
-                if self._slot_req[slot] is not None:
-                    self._free_slot(slot, RequestStatus.FAILED, "failed",
-                                    error=f"engine loop crashed: {err}")
-            while True:  # drain the queue; pop_ready finishes
-                req = self.scheduler.pop_ready()  # cancelled/expired itself
-                if req is None:
-                    break
-                req.finish(RequestStatus.FAILED,
-                           error=f"engine loop crashed: {err}")
-                _sm.requests_total.labels("failed").inc()
-                self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
+            self._fail_inflight(f"engine loop crashed: {err}")
         with self._wake:
             self._wake.notify_all()
+
+    def _fail_inflight(self, error: str):
+        """Fail every running slot and queued request with ``error`` so
+        their ``result()``/``stream()`` callers return instead of
+        hanging (crash / abort / drain-timeout paths; caller holds the
+        step lock)."""
+        for slot in range(self.config.max_slots):
+            if self._slot_req[slot] is not None:
+                self._free_slot(slot, RequestStatus.FAILED, "failed",
+                                error=error)
+        while True:  # drain the queue; pop_ready finishes
+            req = self.scheduler.pop_ready()  # cancelled/expired itself
+            if req is None:
+                break
+            req.finish(RequestStatus.FAILED, error=error)
+            _sm.requests_total.labels("failed").inc()
+            self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
 
     @property
     def crashed(self) -> Optional[str]:
@@ -1639,7 +1808,71 @@ class ServingEngine:
     def healthy(self) -> bool:
         return self._crashed is None
 
-    def stop(self):
+    @property
+    def draining(self) -> bool:
+        return self._draining and not self._stopped
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    @property
+    def warmed_up(self) -> bool:
+        return self._warmed_up
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Stop admitting new requests and let the in-flight ones finish
+        (the graceful half of ``stop()``; a router calls this before
+        taking a replica out of rotation). ``submit()`` raises
+        ``EngineDrainingError`` from the moment this is called. Returns
+        True when every in-flight request reached a terminal state on
+        its own; on ``timeout_s`` expiry the stragglers are FAILED with
+        an explicit drain-timeout error (never silently dropped) and
+        False is returned. Idempotent; a crashed engine is already
+        drained (everything was failed by the crash path)."""
+        with self._wake:
+            self._draining = True
+            self._wake.notify_all()
+        deadline = (time.perf_counter() + timeout_s
+                    if timeout_s is not None else None)
+        while self.scheduler.depth or self.busy_slots():
+            if self._crashed is not None:
+                return False  # crash path failed everything already
+            if deadline is not None and time.perf_counter() > deadline:
+                with self._step_lock:
+                    self._fail_inflight(
+                        f"drain timed out after {timeout_s}s; request "
+                        f"aborted at engine stop — retry on another "
+                        f"replica")
+                return False
+            if self._thread is None:
+                # sync engine (nobody runs the loop): drive it inline —
+                # draining blocks submits, so the backlog is finite
+                self.run_until_idle()
+            else:
+                time.sleep(0.005)
+        return True
+
+    def stop(self, abort: bool = False,
+             drain_timeout_s: Optional[float] = 30.0):
+        """Stop serving. DRAINS by default: new submits are refused
+        (``EngineDrainingError`` now, ``EngineStoppedError`` once
+        stopped), in-flight requests finish (or are explicitly FAILED
+        at ``drain_timeout_s``), then the loop stops. ``abort=True``
+        keeps the old fail-fast shutdown, minus its silent data loss:
+        every queued and running request is FAILED immediately with an
+        actionable error instead of being abandoned with ``result()``
+        hanging forever."""
+        with self._wake:
+            self._draining = True
+        if abort:
+            with self._step_lock:
+                self._fail_inflight(
+                    "engine stopped (abort=True); request aborted "
+                    "mid-flight — resubmit to another replica")
+        elif self._crashed is None:
+            self.drain(timeout_s=drain_timeout_s)
+        self._stopped = True
         self._running = False
         with self._wake:
             self._wake.notify_all()
@@ -1752,6 +1985,66 @@ class ServingEngine:
         return {"ts": time.time(), "queued": queued, "running": running,
                 "recent": recent}
 
+    def health(self) -> tuple:
+        """``(http_status, payload)`` for ``/healthz`` — and the probe
+        surface a router's health-gating reads. The 503 states are
+        DISTINCT (a saturated replica used to be indistinguishable from
+        a dead one):
+
+        - ``ok`` (200): admitting traffic.
+        - ``crashed`` (503): the decode loop died; every request was
+          failed; only a fresh engine recovers. ``crashed`` carries the
+          error repr.
+        - ``draining`` (503): no new admissions, in-flight requests
+          finishing (graceful shutdown in progress) — route elsewhere,
+          don't retry here.
+        - ``stopped`` (503): drain complete, loop down.
+        - ``saturated`` (503): alive but the admission queue is full;
+          ``retry_after_s`` (derived from the queue-wait digest's p50)
+          says when a slot is likely to free — back off, don't eject.
+        - ``stalled`` (503): the background loop has work pending but
+          hasn't reached a step boundary for ``stall_timeout_s`` — a
+          hung device dispatch; probes should treat it like a crash.
+        """
+        payload = {
+            "ts": time.time(),
+            "slots_busy": self.busy_slots(),
+            "slots_total": self.config.max_slots,
+            "queue_depth": self.scheduler.depth,
+            "max_queue_depth": self.scheduler.max_queue_depth,
+            "warmed_up": self._warmed_up,
+            "crashed": self._crashed,
+        }
+        if self.paged:
+            kv = self.kv_block_stats()
+            payload["kv_blocks_in_use"] = kv["in_use"]
+            payload["kv_blocks_total"] = kv["usable"]
+            payload["kv_blocks_shared"] = kv["shared"]
+            payload["kv_block_utilization"] = round(kv["utilization"], 4)
+        if self._crashed is not None:
+            payload["status"] = "crashed"
+            return 503, payload
+        if self._stopped:
+            payload["status"] = "stopped"
+            return 503, payload
+        if self._draining:
+            payload["status"] = "draining"
+            payload["in_flight"] = (payload["slots_busy"]
+                                    + payload["queue_depth"])
+            return 503, payload
+        stalled_s = time.perf_counter() - self._last_progress_ts
+        if self._running and stalled_s > self.config.stall_timeout_s \
+                and (payload["slots_busy"] or payload["queue_depth"]):
+            payload["status"] = "stalled"
+            payload["stalled_s"] = round(stalled_s, 3)
+            return 503, payload
+        if payload["queue_depth"] >= self.scheduler.max_queue_depth:
+            payload["status"] = "saturated"
+            payload["retry_after_s"] = _sm.queue_wait_retry_after()
+            return 503, payload
+        payload["status"] = "ok"
+        return 200, payload
+
     def stats(self) -> dict:
         out = {
             "kv_mode": self.config.kv_mode,
@@ -1765,6 +2058,10 @@ class ServingEngine:
             "running": self._running,
             "healthy": self.healthy,
             "crashed": self._crashed,
+            "draining": self.draining,
+            "stopped": self._stopped,
+            "warmed_up": self._warmed_up,
+            "max_queue_depth": self.scheduler.max_queue_depth,
             "latency_digests": _sm.latency_digests(),
             "goodput_tokens_per_s": _sm.goodput_tokens_per_second.value(),
             "preemptions": self._preempt_count,

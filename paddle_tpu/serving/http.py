@@ -17,8 +17,11 @@ completions are lists of token ids.
   with ``"stream": true`` the response
   is newline-delimited JSON, one ``{"token": id}`` line per token as it
   lands, then a final ``{"done": true, "status": ...}`` line.
-- ``GET /healthz``  -> liveness + the serving gauges
-  (slots busy/total, queue depth) as JSON.
+- ``GET /healthz``  -> ``engine.health()``: 200 only while admitting.
+  The 503 states are DISTINCT — ``crashed`` / ``draining`` / ``stopped``
+  / ``saturated`` each with their own payload, and saturated responses
+  carry a ``Retry-After`` header derived from the queue-wait digest (a
+  backed-up replica is no longer indistinguishable from a dead one).
 - ``GET /stats``    -> ``engine.stats()`` (incl. the streaming latency
   digests — TTFT/TPOT/queue-wait/prefill-chunk p50/p95/p99 — and the
   goodput gauge).
@@ -32,24 +35,36 @@ completions are lists of token ids.
   from the captured memory analyses), headroom vs ``bytes_limit``
   (``"unsupported"`` where PJRT reports nothing), plus the device peak
   table and the per-executable roofline ledger.
+- ``POST /drain``   -> graceful shutdown: stop admitting, finish
+  in-flight requests (body ``{"timeout_s": ...}`` bounds the wait;
+  stragglers are FAILED explicitly), then 200 ``{"drained": bool}``.
+  Subsequent ``/healthz`` reports ``draining``/``stopped``.
 
-Backpressure maps to ``429``, invalid requests to ``400``.
-Opt-in only: nothing starts this server implicitly.
+Backpressure maps to ``429`` (+ ``Retry-After``), invalid requests to
+``400``, draining/stopped engines to ``503``. Opt-in only: nothing
+starts this server implicitly.
+
+``ServingHTTPServer`` is the instance API — one per engine, any number
+per process (a multi-replica router fronts several). The module-level
+``start_serving_http_server``/``stop_serving_http_server`` pair keeps
+the original one-server-per-process convenience surface.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 
 from ..observability import tracing as _tracing
+from .engine import EngineStoppedError
 from .scheduler import QueueFullError
 
-__all__ = ["start_serving_http_server", "stop_serving_http_server"]
+__all__ = ["ServingHTTPServer", "start_serving_http_server",
+           "stop_serving_http_server"]
 
-_server = None
-_server_thread = None
+_default_server = None
 _server_lock = threading.Lock()
 
 
@@ -69,144 +84,185 @@ def _request_record(req) -> dict:
     }
 
 
+def retry_after_header(payload: dict) -> dict:
+    """``Retry-After`` (integer seconds, >= 1 per RFC 9110) from a
+    payload's ``retry_after_s`` hint, or no header when there is none."""
+    ra = payload.get("retry_after_s")
+    if ra is None:
+        return {}
+    return {"Retry-After": str(max(1, math.ceil(float(ra))))}
+
+
+class ServingHTTPServer:
+    """One engine's HTTP front end on a daemon thread. ``port=0`` binds
+    a free port (read it back from ``.port``); ``stop()`` shuts the
+    server down (the engine itself is stopped separately — or via
+    ``POST /drain``)."""
+
+    def __init__(self, engine, port: int = 0, addr: str = "127.0.0.1",
+                 request_timeout_s: float = 300.0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        engine.start()
+        self.engine = engine
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _json(self, code: int, payload: dict, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    code, payload = engine.health()
+                    self._json(code, payload,
+                               headers=retry_after_header(payload))
+                elif path == "/stats":
+                    self._json(200, engine.stats())
+                elif path == "/trace":
+                    # catapult JSON for chrome://tracing; ?trace=<id>
+                    # filters to one request's lanes
+                    trace = None
+                    query = self.path.partition("?")[2]
+                    for kv in query.split("&"):
+                        k, _, v = kv.partition("=")
+                        if k == "trace" and v:
+                            try:
+                                trace = int(v)
+                            except ValueError:
+                                trace = v
+                    self._json(200, _tracing.chrome_trace(trace))
+                elif path == "/debug/requests":
+                    self._json(200, engine.debug_requests())
+                elif path == "/debug/memory":
+                    from ..observability import perf as _perf
+
+                    self._json(200, {
+                        "ts": time.time(),
+                        "hbm": _perf.hbm_ledger(),
+                        "peaks": _perf.peak_specs(),
+                        "ledger": _perf.ledger(),
+                    })
+                else:
+                    self._json(404, {"error": f"no such path {path!r}"})
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                if path == "/drain":
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        timeout_s = body.get("timeout_s")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._json(400, {"error": f"bad request: {e}"})
+                        return
+                    drained = engine.drain(timeout_s=timeout_s)
+                    self._json(200, {"drained": bool(drained),
+                                     "status": engine.health()[1]["status"]})
+                    return
+                if path != "/generate":
+                    self._json(404, {"error": "POST /generate or /drain"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = body.pop("prompt")
+                    stream = bool(body.pop("stream", False))
+                    deadline_s = body.pop("deadline_s", None)
+                    if not isinstance(prompt, (list, tuple)) or not prompt:
+                        raise ValueError("prompt must be a non-empty list "
+                                         "of token ids")
+                except (ValueError, KeyError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    req = engine.submit(prompt, deadline_s=deadline_s,
+                                        **body)
+                except QueueFullError as e:
+                    # backpressure carries the same digest-derived
+                    # Retry-After hint the saturated /healthz payload does
+                    from . import metrics as _sm
+
+                    ra = _sm.queue_wait_retry_after()
+                    self._json(429, {"error": str(e), "retry_after_s": ra},
+                               headers=retry_after_header(
+                                   {"retry_after_s": ra}))
+                    return
+                except EngineStoppedError as e:
+                    self._json(503, {"error": str(e),
+                                     "status": engine.health()[1]["status"]})
+                    return
+                except (TypeError, ValueError) as e:
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                if not stream:
+                    try:
+                        req.result(timeout=request_timeout_s)
+                    except TimeoutError:
+                        req.cancel()
+                        req.result(timeout=10.0)
+                    self._json(200, _request_record(req))
+                    return
+                # streaming: newline-delimited JSON; no Content-Length,
+                # the connection close marks the end (HTTP/1.0 framing)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.end_headers()
+                try:
+                    for tok in req.stream(timeout=request_timeout_s):
+                        self.wfile.write(
+                            (json.dumps({"token": int(tok)}) + "\n").encode())
+                        self.wfile.flush()
+                except (TimeoutError, BrokenPipeError, ConnectionResetError):
+                    req.cancel()
+                done = dict(_request_record(req))
+                done["done"] = True
+                try:
+                    self.wfile.write((json.dumps(done) + "\n").encode())
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def log_message(self, *args):  # no per-request stderr chatter
+                pass
+
+        self._server = ThreadingHTTPServer((addr, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"paddle-tpu-serving-http:{self.port}", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
 def start_serving_http_server(engine, port: int = 0, addr: str = "127.0.0.1",
                               request_timeout_s: float = 300.0) -> int:
     """Serve the engine over HTTP on a daemon thread; returns the bound
     port (``port=0`` picks a free one). Starts the engine's background
-    loop if it isn't running (handlers block on ``Request.result``)."""
-    global _server, _server_thread
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-    engine.start()
-
-    class _Handler(BaseHTTPRequestHandler):
-        def _json(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def do_GET(self):
-            path = self.path.split("?")[0]
-            if path == "/healthz":
-                healthy = engine.healthy
-                payload = {
-                    "status": "ok" if healthy else "unhealthy",
-                    "ts": time.time(),
-                    "slots_busy": engine.busy_slots(),
-                    "slots_total": engine.config.max_slots,
-                    "queue_depth": engine.scheduler.depth,
-                    "crashed": engine.crashed,
-                }
-                kv = getattr(engine, "kv_block_stats", lambda: None)()
-                if kv is not None:  # paged engines: pool pressure at a
-                    payload["kv_blocks_in_use"] = kv["in_use"]   # glance
-                    payload["kv_blocks_total"] = kv["usable"]
-                    payload["kv_blocks_shared"] = kv["shared"]
-                    payload["kv_block_utilization"] = round(
-                        kv["utilization"], 4)
-                self._json(200 if healthy else 503, payload)
-            elif path == "/stats":
-                self._json(200, engine.stats())
-            elif path == "/trace":
-                # catapult JSON for chrome://tracing; ?trace=<id>
-                # filters to one request's lanes
-                trace = None
-                query = self.path.partition("?")[2]
-                for kv in query.split("&"):
-                    k, _, v = kv.partition("=")
-                    if k == "trace" and v:
-                        try:
-                            trace = int(v)
-                        except ValueError:
-                            trace = v
-                self._json(200, _tracing.chrome_trace(trace))
-            elif path == "/debug/requests":
-                self._json(200, engine.debug_requests())
-            elif path == "/debug/memory":
-                from ..observability import perf as _perf
-
-                self._json(200, {
-                    "ts": time.time(),
-                    "hbm": _perf.hbm_ledger(),
-                    "peaks": _perf.peak_specs(),
-                    "ledger": _perf.ledger(),
-                })
-            else:
-                self._json(404, {"error": f"no such path {path!r}"})
-
-        def do_POST(self):
-            if self.path.split("?")[0] != "/generate":
-                self._json(404, {"error": "POST /generate only"})
-                return
-            try:
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
-                prompt = body.pop("prompt")
-                stream = bool(body.pop("stream", False))
-                deadline_s = body.pop("deadline_s", None)
-                if not isinstance(prompt, (list, tuple)) or not prompt:
-                    raise ValueError("prompt must be a non-empty list of "
-                                     "token ids")
-            except (ValueError, KeyError, json.JSONDecodeError) as e:
-                self._json(400, {"error": f"bad request: {e}"})
-                return
-            try:
-                req = engine.submit(prompt, deadline_s=deadline_s, **body)
-            except QueueFullError as e:
-                self._json(429, {"error": str(e)})
-                return
-            except (TypeError, ValueError) as e:
-                self._json(400, {"error": f"bad request: {e}"})
-                return
-            if not stream:
-                try:
-                    req.result(timeout=request_timeout_s)
-                except TimeoutError:
-                    req.cancel()
-                    req.result(timeout=10.0)
-                self._json(200, _request_record(req))
-                return
-            # streaming: newline-delimited JSON; no Content-Length, the
-            # connection close marks the end (HTTP/1.0 framing)
-            self.send_response(200)
-            self.send_header("Content-Type", "application/jsonl")
-            self.end_headers()
-            try:
-                for tok in req.stream(timeout=request_timeout_s):
-                    self.wfile.write(
-                        (json.dumps({"token": int(tok)}) + "\n").encode())
-                    self.wfile.flush()
-            except (TimeoutError, BrokenPipeError, ConnectionResetError):
-                req.cancel()
-            done = dict(_request_record(req))
-            done["done"] = True
-            try:
-                self.wfile.write((json.dumps(done) + "\n").encode())
-            except (BrokenPipeError, ConnectionResetError):
-                pass
-
-        def log_message(self, *args):  # no per-request stderr chatter
-            pass
-
+    loop if it isn't running (handlers block on ``Request.result``).
+    One default server per process — build ``ServingHTTPServer``
+    instances directly to front several engines."""
+    global _default_server
     with _server_lock:
-        if _server is not None:
-            return _server.server_address[1]
-        _server = ThreadingHTTPServer((addr, port), _Handler)
-        _server_thread = threading.Thread(target=_server.serve_forever,
-                                          name="paddle-tpu-serving-http",
-                                          daemon=True)
-        _server_thread.start()
-        return _server.server_address[1]
+        if _default_server is not None:
+            return _default_server.port
+        _default_server = ServingHTTPServer(
+            engine, port=port, addr=addr,
+            request_timeout_s=request_timeout_s)
+        return _default_server.port
 
 
 def stop_serving_http_server():
-    global _server, _server_thread
+    global _default_server
     with _server_lock:
-        if _server is not None:
-            _server.shutdown()
-            _server.server_close()
-            _server = None
-            _server_thread = None
+        if _default_server is not None:
+            _default_server.stop()
+            _default_server = None

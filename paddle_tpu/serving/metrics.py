@@ -28,7 +28,13 @@ __all__ = [
     "ttft_summary", "tpot_summary", "queue_wait_seconds",
     "prefill_chunk_seconds", "goodput_tokens_per_second",
     "latency_digests", "spec_drafted_tokens", "spec_accepted_tokens",
-    "spec_rejected_tokens", "spec_accept_len",
+    "spec_rejected_tokens", "spec_accept_len", "queue_wait_retry_after",
+    "router_requests_total", "router_attempts_total",
+    "router_retries_total", "router_hedges_total",
+    "router_probe_failures_total", "router_ejections_total",
+    "router_readmissions_total", "router_drains_total",
+    "router_replica_healthy", "router_replica_inflight",
+    "router_unroutable_total",
 ]
 
 requests_total = _m.counter(
@@ -163,12 +169,67 @@ goodput_tokens_per_second = _m.gauge(
     "the number a load-aware router balances on (tokens delivered past "
     "a deadline are work, not goodput)")
 
+# -- multi-replica router (serving/router.py) ------------------------------
+router_requests_total = _m.counter(
+    "paddle_tpu_router_requests_total",
+    "router requests by terminal outcome", ("outcome",))
+router_attempts_total = _m.counter(
+    "paddle_tpu_router_attempts_total",
+    "replica submissions the router made (first attempts + retries + "
+    "hedges) — attempts/requests is the amplification factor the retry "
+    "cap bounds")
+router_retries_total = _m.counter(
+    "paddle_tpu_router_retries_total",
+    "requests re-submitted to another replica after their attempt died "
+    "with the replica (crash/eject/stop)")
+router_hedges_total = _m.counter(
+    "paddle_tpu_router_hedges_total",
+    "tail-latency hedges: a second replica was raced because TTFT "
+    "exceeded the digest-derived threshold")
+router_probe_failures_total = _m.counter(
+    "paddle_tpu_router_probe_failures_total",
+    "health-probe failures by reason (error/timeout/malformed/crashed)",
+    ("reason",))
+router_ejections_total = _m.counter(
+    "paddle_tpu_router_ejections_total",
+    "replicas ejected from rotation after K consecutive probe failures")
+router_readmissions_total = _m.counter(
+    "paddle_tpu_router_readmissions_total",
+    "ejected replicas re-admitted after passing the warmup probe")
+router_drains_total = _m.counter(
+    "paddle_tpu_router_drains_total",
+    "graceful replica drains initiated through the router")
+router_unroutable_total = _m.counter(
+    "paddle_tpu_router_unroutable_total",
+    "requests that found no admitting replica (all ejected/draining/"
+    "saturated) at some point in their routing loop")
+router_replica_healthy = _m.gauge(
+    "paddle_tpu_router_replica_healthy",
+    "1 while the replica is in rotation (0 = ejected/draining/stopped)",
+    ("replica",))
+router_replica_inflight = _m.gauge(
+    "paddle_tpu_router_replica_inflight",
+    "router-attributed in-flight attempts per replica", ("replica",))
+
 _DIGESTS = {
     "ttft_s": ttft_summary,
     "tpot_s": tpot_summary,
     "queue_wait_s": queue_wait_seconds,
     "prefill_chunk_s": prefill_chunk_seconds,
 }
+
+
+def queue_wait_retry_after(default: float = 1.0) -> float:
+    """Retry-After hint for saturated/backpressure responses: the
+    queue-wait digest's p50 is the best live estimate of when a slot
+    frees up (falls back to ``default`` before any sample lands)."""
+    quantiles, _total, count = queue_wait_seconds._d().snapshot()
+    if not count:
+        return default
+    p50 = quantiles.get(0.5)
+    if p50 is None:
+        return default
+    return max(round(float(p50), 3), 0.05)
 
 
 def latency_digests() -> dict:

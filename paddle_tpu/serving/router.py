@@ -1,0 +1,1215 @@
+"""Multi-replica serving router: spread requests over N ``ServingEngine``
+replicas with health-gated failover, deadline-aware retries, tail-latency
+hedging, and graceful drain.
+
+One engine process is a single point of failure: today a decode-loop
+crash fails every in-flight request with a 503 and no recovery. This is
+the layer production serving stacks put ABOVE iteration-level
+scheduling (Orca governs *inside* one engine; a vLLM-class deployment
+routes *across* engines), and it is where serving fault tolerance
+actually lives:
+
+- **Load-aware admission**: each request goes to the replica with the
+  lowest load score — router-attributed in-flight attempts, queue depth
+  and KV-pool utilization from the replica's ``/stats``, and the p95
+  TTFT digest (the PR-7 latency digests exist precisely for this
+  decision). Stats are polled with a staleness bound and a timeout; a
+  replica whose ``/stats`` hangs keeps serving on its last-known score
+  (a slow stats endpoint is not a dead replica).
+- **Health gating**: replicas are probed on ``/healthz``. ``K``
+  consecutive probe failures (error / timeout / malformed payload /
+  ``crashed`` / ``stalled``) eject the replica from rotation; an
+  ejected replica is re-admitted only after passing a WARMUP probe
+  (``status == "ok"`` and ``warmed_up`` — a replacement engine that
+  hasn't AOT-compiled its executables would pay its compiles out of the
+  first user's deadline). ``saturated`` and ``draining`` are NOT
+  failures: a backed-up replica gets a ``retry_after_s`` backoff, a
+  draining one just stops receiving new work.
+- **Deadline-aware retry**: a request whose attempt dies with its
+  replica (crash, abort, ejection mid-flight) is retried on another
+  replica with capped exponential backoff + seeded jitter. Retries are
+  idempotent because prefill restarts from the prompt and the engine's
+  PRNG chain is seed-deterministic: the new replica re-derives exactly
+  the tokens the dead one already delivered, and the relay drops the
+  replayed prefix — the caller sees each token once and the final
+  output is bit-identical to a single-engine run. Retries respect the
+  remaining deadline (a retry that cannot beat the deadline fails as
+  EXPIRED immediately), never fire for cancelled requests, and are
+  bounded per-request (``max_retries_per_request``) and globally (the
+  amplification cap: extra attempts <= cap * requests + floor — a
+  crash storm cannot melt the surviving replicas with retry traffic).
+- **Hedging** (opt-in): when a request's first token is slower than the
+  digest-derived threshold (``hedge_ttft_factor`` x the replica's p95
+  TTFT), a second replica races it; the first to deliver a token wins
+  and the loser is cancelled. Outputs are identical either way (same
+  seed => same tokens), so hedging only moves tail latency.
+- **Graceful drain**: ``drain(name)`` stops admitting to a replica and
+  lets its in-flight requests finish (``engine.stop()`` drains by
+  default now) while the router routes new traffic elsewhere —
+  vs. the fail-all crash path. ``router_http`` wires SIGTERM to
+  ``drain_all`` through the fault-tolerance preemption listener.
+
+The router talks to replicas through a small client protocol —
+``healthz() / stats() / submit() / cancel() / drain()`` — with two
+implementations: ``LocalReplica`` (in-process engine, what the tests
+and the single-host topology use) and ``HTTPReplica`` (an engine behind
+``serving.http`` in another process). ``chaos.py`` wraps the same
+protocol to inject faults; ``tests/test_router.py`` asserts the
+invariants under them: no request silently lost, greedy outputs
+bit-identical to a single-engine run, zero retraces on surviving
+replicas, retry amplification bounded.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import tracing as _trace
+from . import metrics as _sm
+from .engine import EngineStoppedError, ServingEngine
+from .request import RequestStatus, SamplingParams
+from .scheduler import QueueFullError
+
+__all__ = ["Router", "RouterConfig", "RouterRequest", "ReplicaState",
+           "LocalReplica", "HTTPReplica", "NoReplicaError"]
+
+_router_req_ids = itertools.count()
+_STOP = object()
+
+
+class NoReplicaError(RuntimeError):
+    """No replica can admit the request right now (all ejected,
+    draining, or saturated). Carries ``retry_after_s`` when the cause
+    is saturation (shed load upstream and come back)."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class ReplicaState:
+    """Router-side replica lifecycle (strings: these land in /stats
+    JSON as-is)."""
+
+    HEALTHY = "healthy"    # in rotation
+    EJECTED = "ejected"    # failed K consecutive probes; awaiting warmup
+    DRAINING = "draining"  # no new admissions; in-flight finishing
+    STOPPED = "stopped"    # drained / removed
+
+
+def _call_with_timeout(fn, timeout_s: float):
+    """Run ``fn()`` on a daemon thread, bounded by ``timeout_s``. The
+    probe/stats calls must never wedge the router on a hung replica —
+    a timed-out worker thread is abandoned (daemon) rather than
+    joined forever."""
+    box: list = []
+    done = threading.Event()
+
+    def _run():
+        try:
+            box.append(("ok", fn()))
+        except Exception as e:  # noqa: BLE001 — surfaced to the caller
+            box.append(("err", e))
+        done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="paddle-tpu-router-probe")
+    t.start()
+    if not done.wait(timeout_s):
+        raise TimeoutError(f"replica call exceeded {timeout_s}s")
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val
+
+
+# ---------------------------------------------------------------------------
+# replica clients
+# ---------------------------------------------------------------------------
+
+class LocalReplica:
+    """In-process replica: the ``ServingEngine`` driven directly. The
+    single-host topology (and the chaos suite's substrate) — same
+    decision surface as the HTTP client: ``healthz()`` returns exactly
+    the ``/healthz`` payload, ``stats()`` exactly ``/stats``."""
+
+    def __init__(self, engine: ServingEngine, name: Optional[str] = None):
+        self.engine = engine
+        self.name = name
+
+    def healthz(self) -> dict:
+        return self.engine.health()[1]
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def submit(self, prompt, deadline_s=None, on_token=None, params=None):
+        return self.engine.submit(prompt, deadline_s=deadline_s,
+                                  on_token=on_token, params=params)
+
+    def cancel(self, handle):
+        self.engine.cancel(handle)
+
+    def warmup(self) -> dict:
+        return self.engine.warmup()
+
+    def start(self):
+        self.engine.start()
+
+    def drain(self, timeout_s: Optional[float] = None):
+        self.engine.stop(drain_timeout_s=timeout_s)
+
+
+class _HTTPAttempt:
+    """Request-handle shim over a streaming ``POST /generate``: a
+    daemon thread reads the NDJSON token lines and mirrors the
+    ``Request`` surface the router's await loop uses (``done`` /
+    ``status`` / ``output_tokens`` / ``error`` / ``result()``)."""
+
+    def __init__(self, url: str, body: dict, on_token, timeout_s: float):
+        self.output_tokens: List[int] = []
+        self.status = RequestStatus.RUNNING
+        self.error: Optional[str] = None
+        self._done = threading.Event()
+        self._on_token = on_token
+        self._resp = None
+        self._cancelled = False
+        req = urllib.request.Request(
+            url, data=json.dumps(dict(body, stream=True)).encode(),
+            headers={"Content-Type": "application/json"})
+        self._thread = threading.Thread(
+            target=self._consume, args=(req, timeout_s), daemon=True,
+            name="paddle-tpu-router-http-attempt")
+        self._thread.start()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _finish(self, status, error=None):
+        if not self._done.is_set():
+            self.status = status
+            self.error = error
+            self._done.set()
+
+    def _consume(self, req, timeout_s):
+        try:
+            self._resp = urllib.request.urlopen(req, timeout=timeout_s)
+            for line in self._resp:
+                rec = json.loads(line)
+                if "token" in rec:
+                    self.output_tokens.append(int(rec["token"]))
+                    if self._on_token is not None:
+                        try:
+                            self._on_token(self, rec["token"])
+                        except Exception:  # noqa: BLE001 — consumer bug
+                            pass
+                elif rec.get("done"):
+                    self._finish(rec.get("status", RequestStatus.FAILED),
+                                 rec.get("error"))
+                    return
+            self._finish(RequestStatus.FAILED, "stream ended without a "
+                                               "done record")
+        except Exception as e:  # noqa: BLE001 — connection-level failure
+            if self._cancelled:
+                self._finish(RequestStatus.CANCELLED)
+            else:
+                self._finish(RequestStatus.FAILED, repr(e))
+
+    def cancel(self):
+        self._cancelled = True
+        resp = self._resp
+        if resp is not None:
+            try:
+                resp.close()  # server handler sees the broken pipe
+            except Exception:  # noqa: BLE001
+                pass
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("HTTP attempt not finished")
+        return list(self.output_tokens)
+
+
+class HTTPReplica:
+    """A replica behind ``serving.http`` (``ServingHTTPServer``) in
+    another process — or another port of this one. Probes hit
+    ``GET /healthz`` (503 payloads are read, not treated as transport
+    errors: a saturated/draining replica is alive), submissions stream
+    ``POST /generate``, drain posts ``/drain``."""
+
+    def __init__(self, base_url: str, name: Optional[str] = None,
+                 timeout_s: float = 5.0, request_timeout_s: float = 300.0):
+        self.base_url = base_url.rstrip("/")
+        self.name = name
+        self.timeout_s = timeout_s
+        self.request_timeout_s = request_timeout_s
+
+    def _get(self, path: str) -> dict:
+        try:
+            with urllib.request.urlopen(self.base_url + path,
+                                        timeout=self.timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read())  # 503 payloads carry the status
+
+    def healthz(self) -> dict:
+        return self._get("/healthz")
+
+    def stats(self) -> dict:
+        return self._get("/stats")
+
+    def submit(self, prompt, deadline_s=None, on_token=None, params=None):
+        p = params or SamplingParams()
+        body = {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+                "max_new_tokens": p.max_new_tokens,
+                "do_sample": p.do_sample, "temperature": p.temperature,
+                "top_k": p.top_k, "top_p": p.top_p,
+                "eos_token_id": p.eos_token_id, "seed": p.seed,
+                "spec_k": p.spec_k, "deadline_s": deadline_s}
+        return _HTTPAttempt(self.base_url + "/generate", body, on_token,
+                            self.request_timeout_s)
+
+    def cancel(self, handle):
+        handle.cancel()
+
+    def drain(self, timeout_s: Optional[float] = None):
+        req = urllib.request.Request(
+            self.base_url + "/drain",
+            data=json.dumps({"timeout_s": timeout_s}).encode(),
+            headers={"Content-Type": "application/json"})
+        wait = (timeout_s + self.timeout_s) if timeout_s is not None \
+            else self.request_timeout_s
+        with urllib.request.urlopen(req, timeout=wait) as resp:
+            return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RouterConfig:
+    """Router knobs. Defaults are sized for the in-process test/bench
+    topology; a real deployment mostly raises the timeouts."""
+
+    # health gating
+    probe_failures_to_eject: int = 3   # K consecutive failures -> eject
+    probe_interval_s: float = 0.2      # background prober cadence
+    probe_timeout_s: float = 1.0
+    readmit_probes: int = 1            # consecutive ok probes to re-admit
+    # load-aware admission
+    stats_refresh_s: float = 0.25      # staleness bound on cached /stats
+    stats_timeout_s: float = 1.0
+    w_inflight: float = 1.0            # score weights (lower score wins)
+    w_queue: float = 1.0
+    w_util: float = 1.0
+    w_ttft: float = 0.5
+    # deadline-aware retry
+    max_retries_per_request: int = 2
+    retry_backoff_base_s: float = 0.02
+    retry_backoff_max_s: float = 0.5
+    retry_jitter: float = 0.25         # +- fraction of the delay
+    retry_amplification_cap: float = 0.5   # extra attempts <= cap*requests
+    retry_amplification_floor: int = 4     # ... + floor (small-N slack)
+    # tail-latency hedging
+    hedge: bool = False
+    hedge_ttft_factor: float = 4.0     # threshold = factor * replica p95
+    hedge_min_wait_s: float = 0.25
+    # routing-loop bounds
+    unroutable_timeout_s: float = 5.0  # no admitting replica for this long
+    drain_timeout_s: Optional[float] = 30.0
+    auto_warmup: bool = True           # warm local replicas at registration
+    seed: int = 0                      # retry-jitter PRNG (deterministic)
+
+    def __post_init__(self):
+        if self.probe_failures_to_eject < 1:
+            raise ValueError("probe_failures_to_eject must be >= 1: a "
+                             "replica cannot be ejected on zero evidence")
+        if self.max_retries_per_request < 0:
+            raise ValueError("max_retries_per_request must be >= 0")
+        if self.retry_amplification_cap < 0:
+            raise ValueError("retry_amplification_cap must be >= 0")
+
+
+@dataclass
+class _Load:
+    """Last-known load snapshot of one replica (from /stats)."""
+
+    ts: float = 0.0
+    queue_depth: int = 0
+    max_queue_depth: int = 1
+    slots_busy: int = 0
+    slots: int = 1
+    util: float = 0.0
+    ttft_p95: Optional[float] = None
+    stale: bool = False
+
+
+class _Replica:
+    """Router-side handle: client + health state + load cache."""
+
+    def __init__(self, name: str, client):
+        self.name = name
+        self.client = client
+        self.state = ReplicaState.HEALTHY
+        self.consecutive_probe_failures = 0
+        self.ok_streak = 0
+        self.inflight = 0
+        self.saturated_until = 0.0
+        self.load = _Load()
+        self.attempts = 0
+        self.probe_failures = 0
+        self.submit_failures = 0
+        self.stats_errors = 0
+        self.ejections = 0
+        self.last_probe: Optional[dict] = None
+
+    def row(self) -> dict:
+        return {
+            "name": self.name, "state": self.state,
+            "inflight": self.inflight, "attempts": self.attempts,
+            "consecutive_probe_failures": self.consecutive_probe_failures,
+            "probe_failures": self.probe_failures,
+            "submit_failures": self.submit_failures,
+            "stats_errors": self.stats_errors,
+            "ejections": self.ejections,
+            "saturated": self.saturated_until > time.perf_counter(),
+            "load": {
+                "queue_depth": self.load.queue_depth,
+                "slots_busy": self.load.slots_busy,
+                "slots": self.load.slots,
+                "util": round(self.load.util, 4),
+                "ttft_p95": self.load.ttft_p95,
+                "stale": self.load.stale,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# the caller-facing handle
+# ---------------------------------------------------------------------------
+
+class RouterRequest:
+    """One routed request: survives replica failover. The caller-facing
+    surface mirrors ``Request`` (``result()`` / ``stream()`` /
+    ``cancel()`` / TTFT/TPOT), but tokens arrive through the router's
+    relay, which guarantees EXACTLY-ONCE delivery across retries and
+    hedges: a retried attempt re-derives the already-delivered prefix
+    (deterministic PRNG chain) and the relay drops it; a superseded
+    attempt's callbacks are dropped entirely — ``on_token`` never fires
+    for a replica the request failed away from."""
+
+    def __init__(self, prompt, params: SamplingParams,
+                 deadline_s: Optional[float], on_token):
+        self.id = next(_router_req_ids)
+        self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        self.params = params
+        self.arrival_ts = time.perf_counter()
+        self.deadline_ts = (self.arrival_ts + deadline_s
+                            if deadline_s is not None else None)
+        self.on_token = on_token
+
+        self.status = RequestStatus.QUEUED
+        self.error: Optional[str] = None
+        self.output_tokens: List[int] = []
+        self.replica: Optional[str] = None   # current/winning replica
+        self.attempts: List[dict] = []       # routing history
+        self.retries = 0
+        self.hedged = False
+        self.first_token_ts: Optional[float] = None
+        self.last_token_ts: Optional[float] = None
+        self.finish_ts: Optional[float] = None
+        self.cancel_requested = False
+
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._stream_q: "queue.Queue" = queue.Queue()
+        # attempt generations: the relay delivers only tokens of the
+        # CURRENT generation, and only past the already-delivered count
+        self._gen_iter = itertools.count(1)
+        self._current_gen: Optional[int] = None
+        self._hedge_gen: Optional[int] = None
+        self._gen_counts: Dict[int, int] = {}
+        self._root = _trace.begin_span(
+            "router.request", cat="router", trace=f"router/{self.id}",
+            args={"prompt_len": int(self.prompt.shape[0]),
+                  "max_new_tokens": params.max_new_tokens})
+
+    # -- deadline ------------------------------------------------------------
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_ts is None:
+            return None
+        return self.deadline_ts - time.perf_counter()
+
+    # -- relay (engine threads) ----------------------------------------------
+    def _on_attempt_token(self, gen: int, replica: str, token: int):
+        deliver = False
+        with self._lock:
+            self._gen_counts[gen] = self._gen_counts.get(gen, 0) + 1
+            idx = self._gen_counts[gen] - 1
+            if gen == self._hedge_gen and not self.output_tokens \
+                    and self._current_gen != gen:
+                # hedge race: first token wins the request
+                self._current_gen = gen
+            if gen == self._current_gen and not self._done.is_set() \
+                    and idx >= len(self.output_tokens):
+                now = time.perf_counter()
+                self.output_tokens.append(int(token))
+                if self.first_token_ts is None:
+                    self.first_token_ts = now
+                self.last_token_ts = now
+                self.replica = replica
+                deliver = True
+        if deliver:
+            self._stream_q.put(int(token))
+            if self.on_token is not None:
+                try:
+                    self.on_token(self, int(token))
+                except Exception:  # noqa: BLE001 — consumer callback bug
+                    pass
+
+    def _set_current(self, gen: Optional[int]):
+        with self._lock:
+            self._current_gen = gen
+
+    def _next_gen(self) -> int:
+        return next(self._gen_iter)
+
+    # -- terminal ------------------------------------------------------------
+    def finish(self, status: str, error: Optional[str] = None):
+        with self._lock:
+            if self.status in RequestStatus.FINAL:
+                return
+            self.status = status
+            self.error = error
+            self.finish_ts = time.perf_counter()
+        _sm.router_requests_total.labels(status).inc()
+        _trace.instant(status, cat="router", trace=f"router/{self.id}",
+                       args={"generated": len(self.output_tokens),
+                             **({"error": error} if error else {})})
+        _trace.end_span(self._root, args={"status": status,
+                                          "retries": self.retries})
+        self._stream_q.put(_STOP)
+        self._done.set()
+
+    # -- caller side ---------------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self):
+        self.cancel_requested = True
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"router request {self.id} not finished within {timeout}s "
+                f"(status={self.status})")
+        return list(self.output_tokens)
+
+    def stream(self, timeout: Optional[float] = None):
+        while True:
+            item = self._stream_q.get(timeout=timeout)
+            if item is _STOP:
+                return
+            yield item
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.arrival_ts
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        if self.first_token_ts is None or self.last_token_ts is None:
+            return None
+        n = len(self.output_tokens) - 1
+        if n <= 0:
+            return None
+        return (self.last_token_ts - self.first_token_ts) / n
+
+    def debug_row(self) -> dict:
+        return {
+            "request_id": self.id, "status": self.status,
+            "replica": self.replica,
+            "generated": len(self.output_tokens),
+            "retries": self.retries, "hedged": self.hedged,
+            "attempts": list(self.attempts),
+            "ttft_s": self.ttft_s, "tpot_s": self.tpot_s,
+            "error": self.error,
+        }
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class Router:
+    """See the module docstring. Construct over replica clients (or
+    bare ``ServingEngine``s, wrapped into ``LocalReplica``), then
+    ``submit()`` — each request is driven by its own daemon thread
+    through route -> attempt -> (retry/hedge) -> terminal. ``start()``
+    runs the background prober; tests drive ``probe_once()`` manually
+    for determinism."""
+
+    def __init__(self, replicas, config: Optional[RouterConfig] = None,
+                 **overrides):
+        if config is None:
+            config = RouterConfig(**overrides)
+        elif overrides:
+            raise ValueError("pass RouterConfig OR keyword overrides, "
+                             "not both")
+        self.config = config
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._rng = random.Random(config.seed)
+        self._rng_lock = threading.Lock()
+        self._rr_counter = itertools.count()
+        self._requests = 0
+        self._extra_attempts = 0   # retries + hedges (amplification)
+        self._outcomes: Dict[str, int] = {}
+        self._drivers: List[threading.Thread] = []
+        self._running = False
+        self._prober: Optional[threading.Thread] = None
+        self._recent: List[RouterRequest] = []
+        for i, rep in enumerate(replicas):
+            self.add_replica(rep, name=getattr(rep, "name", None) or f"r{i}")
+        ref = weakref.ref(self)
+        _trace.register_state_provider(
+            "serving_router",
+            lambda ref=ref: (ref().stats() if ref() is not None else None))
+
+    # -- replica registry ----------------------------------------------------
+    def add_replica(self, client, name: Optional[str] = None):
+        """Register a replica (a client, or a bare engine). Local
+        replicas are warmed up at registration (``auto_warmup``) and
+        their background loop is started — a replica that enters
+        rotation cold would pay its executable compiles out of the
+        first routed request's deadline."""
+        if isinstance(client, ServingEngine):
+            client = LocalReplica(client)
+        name = name or getattr(client, "name", None) \
+            or f"r{len(self._replicas)}"
+        client.name = name
+        if self.config.auto_warmup and hasattr(client, "warmup"):
+            try:
+                warmed = bool(client.healthz().get("warmed_up"))
+            except Exception:  # noqa: BLE001 — probe decides later
+                warmed = True
+            if not warmed:
+                client.warmup()
+        if hasattr(client, "start"):
+            client.start()
+        rep = _Replica(name, client)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"duplicate replica name {name!r}")
+            self._replicas[name] = rep
+        _sm.router_replica_healthy.labels(name).set(1)
+        _trace.instant("replica_added", cat="router", args={"replica": name})
+        return rep
+
+    def remove_replica(self, name: str):
+        with self._lock:
+            rep = self._replicas.pop(name, None)
+        if rep is not None:
+            rep.state = ReplicaState.STOPPED
+            _sm.router_replica_healthy.labels(name).set(0)
+
+    def replicas(self) -> List[dict]:
+        with self._lock:
+            return [r.row() for r in self._replicas.values()]
+
+    def _rep_list(self) -> List[_Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -- health probing ------------------------------------------------------
+    def probe_once(self):
+        """One probe round over every replica (the background prober's
+        body; tests call it directly for determinism)."""
+        for rep in self._rep_list():
+            if rep.state in (ReplicaState.DRAINING, ReplicaState.STOPPED):
+                continue
+            self._probe(rep)
+
+    def _probe(self, rep: _Replica):
+        cfg = self.config
+        try:
+            payload = _call_with_timeout(rep.client.healthz,
+                                         cfg.probe_timeout_s)
+        except TimeoutError:
+            return self._probe_failed(rep, "timeout")
+        except Exception:  # noqa: BLE001 — any transport/client error
+            return self._probe_failed(rep, "error")
+        if not isinstance(payload, dict) \
+                or not isinstance(payload.get("status"), str):
+            return self._probe_failed(rep, "malformed")
+        rep.last_probe = payload
+        status = payload["status"]
+        if status == "ok":
+            return self._probe_ok(rep, payload)
+        if status == "saturated":
+            # alive, just backed up: not a failure, but back off
+            rep.saturated_until = time.perf_counter() + float(
+                payload.get("retry_after_s") or 1.0)
+            return self._probe_ok(rep, payload)
+        if status in ("draining", "stopped"):
+            # the replica is going away on its own terms
+            if rep.state != ReplicaState.STOPPED:
+                rep.state = (ReplicaState.DRAINING if status == "draining"
+                             else ReplicaState.STOPPED)
+                _sm.router_replica_healthy.labels(rep.name).set(0)
+            return None
+        if status in ("crashed", "stalled"):
+            return self._probe_failed(rep, status)
+        return self._probe_failed(rep, "malformed")
+
+    def _probe_failed(self, rep: _Replica, reason: str):
+        rep.probe_failures += 1
+        rep.consecutive_probe_failures += 1
+        rep.ok_streak = 0
+        _sm.router_probe_failures_total.labels(reason).inc()
+        if rep.state == ReplicaState.HEALTHY \
+                and rep.consecutive_probe_failures \
+                >= self.config.probe_failures_to_eject:
+            rep.state = ReplicaState.EJECTED
+            rep.ejections += 1
+            _sm.router_ejections_total.inc()
+            _sm.router_replica_healthy.labels(rep.name).set(0)
+            _trace.instant("replica_ejected", cat="router",
+                           args={"replica": rep.name, "reason": reason})
+
+    def _probe_ok(self, rep: _Replica, payload: dict):
+        rep.consecutive_probe_failures = 0
+        if rep.state != ReplicaState.EJECTED:
+            return
+        # readmission is gated on the WARMUP probe: an engine that
+        # reports ok but hasn't AOT-compiled would pay its compiles out
+        # of the first routed request's deadline
+        if not payload.get("warmed_up", True):
+            rep.ok_streak = 0
+            return
+        rep.ok_streak += 1
+        if rep.ok_streak >= self.config.readmit_probes:
+            rep.state = ReplicaState.HEALTHY
+            rep.ok_streak = 0
+            _sm.router_readmissions_total.inc()
+            _sm.router_replica_healthy.labels(rep.name).set(1)
+            _trace.instant("replica_readmitted", cat="router",
+                           args={"replica": rep.name})
+
+    # -- load-aware pick -----------------------------------------------------
+    def _refresh_load(self, rep: _Replica, now: float):
+        if now - rep.load.ts <= self.config.stats_refresh_s:
+            return
+        rep.load.ts = now  # claim the refresh window even on failure
+        try:
+            st = _call_with_timeout(rep.client.stats,
+                                    self.config.stats_timeout_s)
+        except Exception:  # noqa: BLE001 — slow/broken stats != dead
+            rep.stats_errors += 1
+            rep.load.stale = True
+            return
+        try:
+            ld = rep.load
+            ld.queue_depth = int(st.get("queue_depth", 0))
+            ld.max_queue_depth = max(1, int(st.get("max_queue_depth", 1)))
+            ld.slots_busy = int(st.get("slots_busy", 0))
+            ld.slots = max(1, int(st.get("slots", 1)))
+            kv = st.get("kv_blocks") or {}
+            ld.util = float(kv.get("utilization",
+                                   ld.slots_busy / ld.slots))
+            dig = (st.get("latency_digests") or {}).get("ttft_s") or {}
+            ld.ttft_p95 = dig.get("p95")
+            ld.stale = False
+        except (TypeError, ValueError):
+            rep.stats_errors += 1
+            rep.load.stale = True
+
+    def _score(self, rep: _Replica, ttft_norm: float) -> float:
+        cfg = self.config
+        ld = rep.load
+        return (cfg.w_inflight * rep.inflight / ld.slots
+                + cfg.w_queue * ld.queue_depth / ld.max_queue_depth
+                + cfg.w_util * ld.util
+                + cfg.w_ttft * ttft_norm)
+
+    def _pick(self, exclude=()) -> tuple:
+        """(replica, reason): the lowest-score admitting replica, or
+        (None, why-not)."""
+        now = time.perf_counter()
+        cands = []
+        saturated = False
+        for rep in self._rep_list():
+            if rep.state != ReplicaState.HEALTHY or rep.name in exclude:
+                continue
+            if rep.saturated_until > now:
+                saturated = True
+                continue
+            self._refresh_load(rep, now)
+            cands.append(rep)
+        if not cands:
+            return None, ("saturated" if saturated else "no_healthy_replica")
+        p95s = [r.load.ttft_p95 for r in cands if r.load.ttft_p95]
+        max_p95 = max(p95s) if p95s else None
+
+        def key(rep):
+            tn = (rep.load.ttft_p95 / max_p95
+                  if max_p95 and rep.load.ttft_p95 else 0.0)
+            return (self._score(rep, tn), rep.inflight,
+                    next(self._rr_counter))
+
+        return min(cands, key=key), "ok"
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt, deadline_s: Optional[float] = None,
+               on_token=None, params: Optional[SamplingParams] = None,
+               **sampling) -> RouterRequest:
+        """Route one request; returns its handle immediately (a daemon
+        driver thread owns the route/retry/hedge loop). The same
+        surface as ``ServingEngine.submit`` — outputs for a given
+        prompt + seed are bit-identical to a single engine's, whatever
+        failover happened along the way."""
+        if params is None:
+            params = SamplingParams(**sampling)
+        elif sampling:
+            raise ValueError("pass params OR sampling kwargs, not both")
+        with self._lock:
+            have_any = any(r.state != ReplicaState.STOPPED
+                           for r in self._replicas.values())
+        if not have_any:
+            raise NoReplicaError(
+                "router has no live replicas (none registered, or all "
+                "drained/stopped) — add_replica() a warmed engine first")
+        rr = RouterRequest(prompt, params, deadline_s, on_token)
+        with self._lock:
+            self._requests += 1
+        t = threading.Thread(target=self._drive, args=(rr,), daemon=True,
+                             name=f"paddle-tpu-router-req-{rr.id}")
+        t.start()
+        return rr
+
+    # -- the per-request driver ----------------------------------------------
+    def _drive(self, rr: RouterRequest):
+        cfg = self.config
+        exclude: Dict[str, float] = {}
+        unroutable_since: Optional[float] = None
+        while True:
+            if rr.cancel_requested:
+                return rr.finish(RequestStatus.CANCELLED)
+            rem = rr.remaining_s()
+            if rem is not None and rem <= 0:
+                return rr.finish(RequestStatus.EXPIRED,
+                                 error="deadline passed while routing")
+            rep, why = self._pick(exclude)
+            if rep is None:
+                _sm.router_unroutable_total.inc()
+                if unroutable_since is None:
+                    unroutable_since = time.perf_counter()
+                elif time.perf_counter() - unroutable_since \
+                        > cfg.unroutable_timeout_s:
+                    return rr.finish(
+                        RequestStatus.FAILED,
+                        error=f"no admitting replica for "
+                              f"{cfg.unroutable_timeout_s}s ({why}) — "
+                              f"all replicas ejected, draining, or "
+                              f"saturated")
+                exclude.clear()  # reconsider everyone next round
+                time.sleep(0.02)
+                continue
+            gen, handle, record = self._submit_attempt(rr, rep, hedge=False)
+            if handle is None:
+                if rr.done:
+                    return  # fatal (bad request): finished inside
+                # a refused submit does NOT reset the unroutable clock:
+                # a fleet of replicas that all refuse must time out, not
+                # loop forever between pick and refusal
+                if unroutable_since is None:
+                    unroutable_since = time.perf_counter()
+                exclude[rep.name] = time.perf_counter()
+                continue
+            unroutable_since = None
+            outcome = self._await(rr, rep, gen, handle, record)
+            if outcome in ("done", "cancelled", "expired"):
+                return
+            # retriable: the attempt died with its replica
+            exclude[rep.name] = time.perf_counter()
+            if rr.cancel_requested:
+                return rr.finish(RequestStatus.CANCELLED)
+            ok, why_not = self._may_retry(rr)
+            if not ok:
+                return rr.finish(
+                    RequestStatus.FAILED,
+                    error=f"attempt on replica {rep.name!r} failed and "
+                          f"{why_not}; last error: {record.get('error')}")
+            with self._lock:
+                self._extra_attempts += 1
+            rr.retries += 1
+            _sm.router_retries_total.inc()
+            _trace.instant("retry", cat="router", trace=f"router/{rr.id}",
+                           args={"n": rr.retries, "from": rep.name})
+            if not self._retry_backoff(rr):
+                return  # finished EXPIRED inside
+
+    def _submit_attempt(self, rr: RouterRequest, rep: _Replica,
+                        hedge: bool) -> tuple:
+        """(gen, handle, attempt_record); handle None = not submitted
+        (rejected/refused, record says why — or ``rr`` finished for a
+        caller error no replica can fix)."""
+        gen = rr._next_gen()
+        if hedge:
+            with rr._lock:
+                rr._hedge_gen = gen
+        else:
+            rr._set_current(gen)
+
+        def relay(_inner, tok, rr=rr, gen=gen, name=rep.name):
+            rr._on_attempt_token(gen, name, tok)
+
+        rem = rr.remaining_s()
+        record = {"replica": rep.name, "outcome": "submitted",
+                  "hedge": hedge, "error": None}
+        rr.attempts.append(record)
+        try:
+            handle = rep.client.submit(rr.prompt, deadline_s=rem,
+                                       on_token=relay, params=rr.params)
+        except QueueFullError as e:
+            rep.saturated_until = time.perf_counter() + \
+                _sm.queue_wait_retry_after()
+            record.update(outcome="rejected", error=str(e))
+            return gen, None, record
+        except (EngineStoppedError, RuntimeError) as e:
+            # crashed / draining / stopped replica: routing failure,
+            # probes will eject it — try elsewhere now
+            rep.submit_failures += 1
+            record.update(outcome="refused", error=repr(e))
+            return gen, None, record
+        except (TypeError, ValueError) as e:
+            # caller error (bad prompt/params): no replica can help
+            record.update(outcome="bad_request", error=repr(e))
+            rr.finish(RequestStatus.FAILED, error=f"bad request: {e}")
+            return gen, None, record
+        rep.attempts += 1
+        rep.inflight += 1
+        _sm.router_attempts_total.inc()
+        _sm.router_replica_inflight.labels(rep.name).set(rep.inflight)
+        rr.status = RequestStatus.RUNNING
+        _trace.instant("routed", cat="router", trace=f"router/{rr.id}",
+                       args={"replica": rep.name, "hedge": hedge})
+        return gen, handle, record
+
+    def _release_attempt(self, rep: _Replica):
+        rep.inflight = max(0, rep.inflight - 1)
+        _sm.router_replica_inflight.labels(rep.name).set(rep.inflight)
+
+    def _abandon(self, rr: RouterRequest, item, reason: str):
+        """Detach + cancel an attempt the request is moving away from:
+        its relay generation is no longer current, so even if the
+        replica keeps decoding (a hung step that later resumes), its
+        ``on_token`` pushes are dropped — the caller never sees a
+        token from a replica the request failed away from."""
+        rep, _gen, handle, record = item
+        try:
+            rep.client.cancel(handle)
+        except Exception:  # noqa: BLE001 — dead replica: nothing to cancel
+            pass
+        record["outcome"] = reason
+        self._release_attempt(rep)
+
+    def _await(self, rr: RouterRequest, rep: _Replica, gen: int,
+               handle, record: dict) -> str:
+        """Wait out one attempt; returns "done" | "cancelled" |
+        "expired" | "retriable". Handles hedging: the watch set grows
+        to two attempts and the first token decides the winner."""
+        cfg = self.config
+        att_t0 = time.perf_counter()
+        watch = [(rep, gen, handle, record)]
+        hedged_here = False
+        while True:
+            # terminal checks the replicas can't make for us
+            if rr.cancel_requested:
+                for item in watch:
+                    self._abandon(rr, item, "cancelled")
+                rr.finish(RequestStatus.CANCELLED)
+                return "cancelled"
+            rem = rr.remaining_s()
+            if rem is not None and rem <= -0.05:
+                # the replica enforces the same deadline; the slack only
+                # covers a replica too wedged to expire it itself
+                for item in watch:
+                    self._abandon(rr, item, "expired")
+                rr.finish(RequestStatus.EXPIRED,
+                          error="deadline passed during decode")
+                return "expired"
+            # finished attempts
+            for item in list(watch):
+                r, g, h, rec = item
+                if not h.done:
+                    continue
+                watch.remove(item)
+                self._release_attempt(r)
+                with rr._lock:
+                    is_current = (g == rr._current_gen)
+                if not is_current:
+                    # superseded (lost hedge / abandoned): bookkeeping
+                    # only — its tokens were dropped by the relay
+                    rec["outcome"] = ("hedge_lost"
+                                      if h.status == RequestStatus.COMPLETED
+                                      else "stale_" + h.status)
+                    rec["error"] = h.error
+                    continue
+                if h.status == RequestStatus.COMPLETED:
+                    rec["outcome"] = "completed"
+                    for other in watch:  # hedge loser still running
+                        self._abandon(rr, other, "hedge_lost")
+                    rr.replica = r.name
+                    rr.finish(RequestStatus.COMPLETED)
+                    return "done"
+                if h.status == RequestStatus.EXPIRED:
+                    rec["outcome"] = "expired"
+                    for other in watch:
+                        self._abandon(rr, other, "expired")
+                    rr.finish(RequestStatus.EXPIRED,
+                              error=h.error or "deadline passed")
+                    return "expired"
+                if h.status == RequestStatus.CANCELLED \
+                        and rr.cancel_requested:
+                    rec["outcome"] = "cancelled"
+                    rr.finish(RequestStatus.CANCELLED)
+                    return "cancelled"
+                # FAILED / REJECTED / engine-side cancel we didn't ask
+                # for: the attempt died with its replica -> retriable
+                rec["outcome"] = "failed"
+                rec["error"] = h.error
+                if watch:
+                    # a hedge is still racing: promote it to current
+                    r2, g2, _h2, _rec2 = watch[0]
+                    rr._set_current(g2)
+                    rep = r2
+                    continue
+                return "retriable"
+            if not watch:
+                return "retriable"
+            # replica ejected/stopped under a live attempt (hang or
+            # crash the probe saw first): abandon and fail over
+            for item in list(watch):
+                r, g, h, rec = item
+                if r.state in (ReplicaState.EJECTED, ReplicaState.STOPPED):
+                    watch.remove(item)
+                    with rr._lock:
+                        lost_current = (g == rr._current_gen)
+                        if lost_current:
+                            rr._current_gen = None
+                    self._abandon(rr, item, "replica_lost")
+                    rec["error"] = f"replica {r.name!r} {r.state} with " \
+                                   f"the attempt in flight"
+                    if lost_current and watch:
+                        r2, g2, _h2, _rec2 = watch[0]
+                        rr._set_current(g2)
+                        rep = r2
+            if not watch:
+                return "retriable"
+            # hedging: first token slower than the digest-derived
+            # threshold -> race a second replica
+            if cfg.hedge and not hedged_here and not rr.output_tokens \
+                    and len(watch) == 1:
+                p95 = watch[0][0].load.ttft_p95
+                threshold = max(cfg.hedge_min_wait_s,
+                                cfg.hedge_ttft_factor * p95 if p95 else 0.0)
+                if time.perf_counter() - att_t0 > threshold:
+                    hedged_here = True
+                    cand, _why = self._pick(exclude=(watch[0][0].name,))
+                    if cand is not None:
+                        g2, h2, rec2 = self._submit_attempt(
+                            rr, cand, hedge=True)
+                        if h2 is not None:
+                            rr.hedged = True
+                            with self._lock:
+                                self._extra_attempts += 1
+                            _sm.router_hedges_total.inc()
+                            _trace.instant(
+                                "hedged", cat="router",
+                                trace=f"router/{rr.id}",
+                                args={"to": cand.name,
+                                      "from": watch[0][0].name})
+                            watch.append((cand, g2, h2, rec2))
+            # once a hedge race is decided (first token), cancel the
+            # loser immediately instead of letting it decode to the end
+            if len(watch) > 1 and rr.output_tokens:
+                with rr._lock:
+                    cur = rr._current_gen
+                for item in list(watch):
+                    if item[1] != cur:
+                        watch.remove(item)
+                        self._abandon(rr, item, "hedge_lost")
+            # block on the primary's completion event when it has one
+            # (push wake-up); fall back to a short poll slice
+            ev = getattr(watch[0][2], "_done", None)
+            if ev is not None:
+                ev.wait(0.01)
+            else:
+                time.sleep(0.005)
+
+    # -- retry policy --------------------------------------------------------
+    def _may_retry(self, rr: RouterRequest) -> tuple:
+        cfg = self.config
+        if rr.cancel_requested:
+            return False, "the request was cancelled (cancelled requests " \
+                          "are never retried)"
+        if rr.retries >= cfg.max_retries_per_request:
+            return False, (f"its retry budget is exhausted "
+                           f"({cfg.max_retries_per_request} retries)")
+        with self._lock:
+            cap = (cfg.retry_amplification_cap * max(1, self._requests)
+                   + cfg.retry_amplification_floor)
+            if self._extra_attempts + 1 > cap:
+                return False, (
+                    f"the global retry-amplification cap is exhausted "
+                    f"({self._extra_attempts} extra attempts vs cap "
+                    f"{cap:.1f} = {cfg.retry_amplification_cap} x "
+                    f"{self._requests} requests + "
+                    f"{cfg.retry_amplification_floor}) — a failure storm "
+                    f"must shed load, not multiply it")
+        return True, ""
+
+    def _retry_backoff(self, rr: RouterRequest) -> bool:
+        """Capped exponential backoff with seeded jitter, bounded by
+        the remaining deadline. Returns False (after finishing the
+        request EXPIRED) when the deadline cannot survive the wait."""
+        cfg = self.config
+        delay = min(cfg.retry_backoff_base_s * (2 ** (rr.retries - 1)),
+                    cfg.retry_backoff_max_s)
+        with self._rng_lock:
+            delay *= 1.0 + cfg.retry_jitter * self._rng.uniform(-1.0, 1.0)
+        delay = max(delay, 0.0)
+        rem = rr.remaining_s()
+        if rem is not None and rem <= delay:
+            rr.finish(RequestStatus.EXPIRED,
+                      error=f"deadline would pass during retry backoff "
+                            f"({delay:.3f}s wait, {max(rem, 0):.3f}s left)")
+            return False
+        end = time.perf_counter() + delay
+        while time.perf_counter() < end:
+            if rr.cancel_requested:
+                rr.finish(RequestStatus.CANCELLED)
+                return False
+            time.sleep(min(0.01, max(end - time.perf_counter(), 0)))
+        return True
+
+    # -- drain / lifecycle ---------------------------------------------------
+    def drain(self, name: str, timeout_s: Optional[float] = None,
+              wait: bool = True):
+        """Gracefully take a replica out of rotation: stop routing to
+        it immediately, let its in-flight requests finish (the
+        engine-side drain), then mark it stopped. New traffic keeps
+        flowing to the other replicas the whole time."""
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            raise KeyError(f"no replica named {name!r}")
+        rep.state = ReplicaState.DRAINING
+        _sm.router_replica_healthy.labels(name).set(0)
+        _sm.router_drains_total.inc()
+        _trace.instant("replica_draining", cat="router",
+                       args={"replica": name})
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.config.drain_timeout_s
+
+        def _do():
+            try:
+                rep.client.drain(timeout_s)
+            except Exception:  # noqa: BLE001 — a dead replica is drained
+                pass
+            rep.state = ReplicaState.STOPPED
+
+        if wait:
+            _do()
+        else:
+            threading.Thread(target=_do, daemon=True,
+                             name=f"paddle-tpu-router-drain-{name}").start()
+
+    def drain_all(self, timeout_s: Optional[float] = None):
+        """Drain every replica concurrently (the SIGTERM path)."""
+        names = [r.name for r in self._rep_list()
+                 if r.state in (ReplicaState.HEALTHY, ReplicaState.EJECTED)]
+        threads = [threading.Thread(target=self.drain,
+                                    args=(n, timeout_s), daemon=True)
+                   for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def start(self):
+        """Run the background prober (health gating without manual
+        ``probe_once()`` calls)."""
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="paddle-tpu-router-prober",
+                                        daemon=True)
+        self._prober.start()
+        return self
+
+    def _probe_loop(self):
+        while self._running:
+            self.probe_once()
+            time.sleep(self.config.probe_interval_s)
+
+    def stop(self, drain: bool = False,
+             timeout_s: Optional[float] = None):
+        """Stop the prober; ``drain=True`` also drains every replica
+        (graceful full shutdown)."""
+        self._running = False
+        if self._prober is not None:
+            self._prober.join(timeout=max(1.0,
+                                          self.config.probe_interval_s * 4))
+            self._prober = None
+        if drain:
+            self.drain_all(timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            requests = self._requests
+            extra = self._extra_attempts
+        return {
+            "replicas": self.replicas(),
+            "requests": requests,
+            "extra_attempts": extra,
+            "amplification": round(1.0 + extra / requests, 4)
+            if requests else None,
+            "config": {
+                "probe_failures_to_eject":
+                    self.config.probe_failures_to_eject,
+                "max_retries_per_request":
+                    self.config.max_retries_per_request,
+                "retry_amplification_cap":
+                    self.config.retry_amplification_cap,
+                "hedge": self.config.hedge,
+            },
+        }

@@ -76,6 +76,12 @@ TPU_LANE = [
     # container — pair with benchmarks/bench_spec_decode.py for the
     # >=1.3x coupled-draft acceptance on chip
     ("test_spec_decode.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # multi-replica router + chaos suite: host-side by design, but the
+    # warmup-zero-compile, zero-retrace-on-survivors, and bit-identical
+    # failover invariants deserve one compiled run (remote-PJRT crash/
+    # drain timing differs from CPU; pair with benchmarks/bench_router.py
+    # for the <2% router-overhead acceptance)
+    ("test_router.py", 600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     # perf observability: on chip the peak table resolves from the real
     # device_kind, so MFU/roofline go from "unknown" to classified —
     # this entry is the first run where the ledger publishes real MFU
@@ -394,6 +400,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
     paged_kv_bench = _read_bench("bench_paged_kv.json")
     spec_decode_bench = _read_bench("bench_spec_decode.json")
     quant_bench = _read_bench("bench_quant.json")
+    router_bench = _read_bench("bench_router.json")
     bench_dir = os.path.join(os.path.dirname(HERE), "benchmarks")
     perf_ledger, gate_rc = build_perf_ledger_block(
         bench_dir, totals.pop("perf_entries"))
@@ -412,6 +419,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
             "paged_kv_bench": paged_kv_bench,
             "spec_decode_bench": spec_decode_bench,
             "quant_bench": quant_bench,
+            "router_bench": router_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

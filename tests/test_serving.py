@@ -14,7 +14,9 @@ Oracles:
 """
 
 import json
+import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -379,3 +381,297 @@ class TestServingMetrics:
         for r in reqs:
             assert r.ttft_s is not None and r.ttft_s >= 0
             assert r.tpot_s is not None and r.tpot_s >= 0
+
+
+class TestWarmup:
+    """engine.warmup(): AOT-compile every executable before traffic —
+    first request after warmup triggers ZERO compiles (the fast-replica-
+    boot contract the multi-replica router relies on)."""
+
+    def _serving_compiles(self):
+        return {k: v["compiles"] for k, v in recompile.entry_stats().items()
+                if k.startswith("serving.")}
+
+    def test_paged_warmup_zero_compiles_on_first_traffic(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        assert not eng.warmed_up
+        info = eng.warmup()
+        assert eng.warmed_up
+        assert set(info["entries"]) == {"serving.step",
+                                        "serving.prefill_chunk",
+                                        "serving.cow"}
+        assert info["compiles"] >= 3
+        before = self._serving_compiles()
+        rng = np.random.RandomState(61)
+        p = _prompt(rng, cfg, 5)
+        req = eng.submit(p, max_new_tokens=6)
+        eng.run_until_idle()
+        assert req.status == serving.RequestStatus.COMPLETED
+        ref = generation.generate(model, p[None],
+                                  max_new_tokens=6).numpy()[0, 5:]
+        np.testing.assert_array_equal(np.asarray(req.result(1.0)), ref)
+        assert self._serving_compiles() == before  # zero compiles
+        # /healthz surfaces warmed_up
+        assert eng.health()[1]["warmed_up"] is True
+
+    def test_contiguous_warmup_covers_every_bucket(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                    kv_mode="contiguous")
+        info = eng.warmup()
+        assert "serving.step" in info["entries"]
+        assert any(e.startswith("serving.prefill[") for e in info["entries"])
+        before = self._serving_compiles()
+        rng = np.random.RandomState(62)
+        reqs = [eng.submit(_prompt(rng, cfg, n), max_new_tokens=3)
+                for n in (4, 20, 40)]  # one request per bucket
+        eng.run_until_idle()
+        assert all(r.status == serving.RequestStatus.COMPLETED for r in reqs)
+        assert self._serving_compiles() == before
+
+    def test_warmup_requires_idle_engine(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64)
+        rng = np.random.RandomState(63)
+        eng.submit(_prompt(rng, cfg, 4), max_new_tokens=2)
+        with pytest.raises(RuntimeError, match="idle"):
+            eng.warmup()
+        eng.run_until_idle()
+        eng.warmup()  # idle again: fine (and idempotent)
+        eng.warmup()
+
+
+class TestStopDrain:
+    """stop() drains by default: in-flight requests finish, new submits
+    raise, nothing is silently abandoned. stop(abort=True) keeps the
+    fail-fast shutdown but fails in-flight requests EXPLICITLY."""
+
+    def test_stop_drains_inflight_to_completion(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        rng = np.random.RandomState(67)
+        eng.start()
+        reqs = [eng.submit(_prompt(rng, cfg, 4 + i), max_new_tokens=10)
+                for i in range(4)]
+        time.sleep(0.05)
+        eng.stop()  # default: drain
+        assert all(r.status == serving.RequestStatus.COMPLETED
+                   for r in reqs), [r.status for r in reqs]
+        assert eng.stopped
+        with pytest.raises(serving.EngineStoppedError, match="stopped"):
+            eng.submit([1, 2, 3])
+        with pytest.raises(serving.EngineStoppedError):
+            eng.start()
+
+    def test_stop_abort_fails_inflight_explicitly(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64,
+                                    max_queue_depth=8)
+        rng = np.random.RandomState(68)
+        eng.start()
+        reqs = [eng.submit(_prompt(rng, cfg, 4), max_new_tokens=40)
+                for _ in range(3)]
+        time.sleep(0.05)
+        eng.stop(abort=True)
+        for r in reqs:
+            r.result(timeout=5.0)  # returns — never hangs
+            assert r.status in (serving.RequestStatus.FAILED,
+                                serving.RequestStatus.COMPLETED)
+        aborted = [r for r in reqs if r.status == serving.RequestStatus.FAILED]
+        assert aborted and all("abort" in r.error for r in aborted)
+
+    def test_sync_engine_stop_drains_inline(self, tiny_model):
+        """A never-started engine drains by driving the loop inline."""
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        rng = np.random.RandomState(69)
+        reqs = [eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)
+                for _ in range(3)]
+        eng.stop()
+        assert all(r.status == serving.RequestStatus.COMPLETED for r in reqs)
+
+    def test_drain_reports_and_submit_raises_while_draining(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64)
+        rng = np.random.RandomState(70)
+        eng.start()
+        req = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=20)
+        t = threading.Thread(target=eng.drain, daemon=True)
+        t.start()
+        # while draining: 503 payload distinguishes it, submit refused
+        deadline = time.monotonic() + 10
+        while not eng.draining and time.monotonic() < deadline:
+            time.sleep(0.002)
+        if not req.done:  # drain still in progress: check the surface
+            code, payload = eng.health()
+            assert code == 503 and payload["status"] == "draining"
+            with pytest.raises(serving.EngineDrainingError, match="draining"):
+                eng.submit([1, 2, 3])
+        t.join(timeout=30)
+        assert req.status == serving.RequestStatus.COMPLETED
+        eng.stop()
+
+    def test_drain_timeout_fails_stragglers_explicitly(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64)
+        monkey = serving.ChaosEngine(eng).hang_after_steps(1)
+        rng = np.random.RandomState(71)
+        eng.start()
+        req = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=20)
+        t0 = time.monotonic()
+        while monkey.injected["hang"] == 0 and time.monotonic() - t0 < 20:
+            time.sleep(0.005)
+        assert eng.drain(timeout_s=0.2) is False
+        req.result(timeout=5.0)  # returns with the explicit error
+        assert req.status == serving.RequestStatus.FAILED
+        assert "drain timed out" in req.error
+        monkey.release()
+        eng.stop(abort=True)
+
+
+class TestHealthStates:
+    """/healthz 503 semantics split: crashed / draining / stopped /
+    saturated / stalled are DISTINCT, and saturated carries a
+    digest-derived Retry-After."""
+
+    def test_saturated_is_distinct_and_carries_retry_after(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64,
+                                    max_queue_depth=2)
+        rng = np.random.RandomState(72)
+        code, payload = eng.health()
+        assert (code, payload["status"]) == (200, "ok")
+        # sync engine (nobody admits): fill the queue to the brim
+        for _ in range(2):
+            eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)
+        code, payload = eng.health()
+        assert (code, payload["status"]) == (503, "saturated")
+        assert payload["retry_after_s"] > 0
+        assert payload["crashed"] is None  # ...and NOT dead
+        eng.run_until_idle()
+        assert eng.health()[0] == 200
+
+    def test_crashed_is_distinct(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64)
+        monkey = serving.ChaosEngine(eng).crash_after_steps(0)
+        rng = np.random.RandomState(73)
+        req = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)
+        eng.start()  # first loop step hits the armed crash
+        req.result(timeout=20.0)
+        assert req.status == serving.RequestStatus.FAILED
+        code, payload = eng.health()
+        assert (code, payload["status"]) == (503, "crashed")
+        assert "chaos" in payload["crashed"]
+        from paddle_tpu.serving import metrics as sm
+        sm.engine_unhealthy.set(0)  # reset for later tests
+
+    def test_stalled_is_distinct(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64,
+                                    stall_timeout_s=0.15)
+        monkey = serving.ChaosEngine(eng).hang_after_steps(1)
+        rng = np.random.RandomState(74)
+        eng.start()
+        req = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=10)
+        t0 = time.monotonic()
+        while eng.health()[1]["status"] != "stalled":
+            time.sleep(0.02)
+            assert time.monotonic() - t0 < 20, eng.health()[1]["status"]
+        monkey.release()
+        req.result(timeout=30.0)
+        assert req.status == serving.RequestStatus.COMPLETED
+        assert eng.health()[0] == 200  # recovery clears the stall
+        eng.stop()
+
+    def test_http_429_carries_retry_after(self, tiny_model):
+        """Backpressure over HTTP: 429 + Retry-After header (satellite:
+        saturation is no longer indistinguishable from death)."""
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64,
+                                    max_queue_depth=1)
+        monkey = serving.ChaosEngine(eng).hang_after_steps(0)  # hold queue
+        port = serving.ServingHTTPServer(eng, port=0)
+        rng = np.random.RandomState(75)
+        try:
+            srv = port
+            body = lambda: json.dumps(
+                {"prompt": [int(t) for t in _prompt(rng, cfg, 4)],
+                 "max_new_tokens": 4, "stream": True}).encode()
+            # 1 queued (the hung loop never admits) + 1 = full
+            for _ in range(2):
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        f"http://127.0.0.1:{srv.port}/generate",
+                        data=body()), timeout=2)
+                except Exception:
+                    pass  # streaming responses park; queue is the point
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/generate", data=body()),
+                    timeout=10)
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            # /healthz agrees: saturated, with the hint in the payload
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            payload = json.loads(ei.value.read())
+            assert payload["status"] == "saturated"
+        finally:
+            monkey.release()
+            srv.stop()
+            eng.stop(abort=True)
+
+
+class TestDeadlineCancelRacesEngine:
+    """The engine-level deadline/cancel races the router relies on."""
+
+    def test_deadline_between_admission_and_first_chunk(self, tiny_model):
+        """Deadline expires AFTER admission claimed blocks but BEFORE
+        the next prefill chunk: the request expires with an explicit
+        error and its blocks are freed (multi-chunk prompt, driven
+        step-by-step)."""
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=1, max_len=64,
+                                    prefill_chunk=8)
+        rng = np.random.RandomState(76)
+        p = _prompt(rng, cfg, 30)  # 4 chunks of 8
+        req = eng.submit(p, max_new_tokens=4, deadline_s=0.05)
+        eng.step()  # admission + chunk 1 (deadline still alive)
+        assert req.status == serving.RequestStatus.RUNNING
+        time.sleep(0.1)  # the deadline passes mid-prefill
+        eng.step()
+        assert req.status == serving.RequestStatus.EXPIRED
+        assert "prefill" in req.error
+        assert eng.busy_slots() == 0
+        assert eng.pool.free_blocks == eng.pool.usable_blocks  # no leak
+
+    def test_cancel_during_preemption_recompute(self, tiny_model):
+        """Cancel delivered while the request sits REQUEUED for
+        preemption-recompute: it finishes CANCELLED at the next
+        admission pass, its already-delivered tokens stay as-is, and
+        nothing is ever re-delivered."""
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=128,
+                                    num_blocks=9)
+        rng = np.random.RandomState(77)
+        ra = eng.submit(_prompt(rng, cfg, 30), max_new_tokens=40)
+        rb = eng.submit(_prompt(rng, cfg, 30), max_new_tokens=40)
+        # run until b is decoding, then preempt it (the pool-pressure
+        # path) and cancel it while it waits for recompute
+        for _ in range(200):
+            eng.step()
+            if rb.slot is not None and eng._decoding[rb.slot]:
+                break
+        assert rb.slot is not None
+        eng._preempt(rb.slot)
+        assert rb.status == serving.RequestStatus.QUEUED
+        delivered = list(rb.output_tokens)
+        rb.cancel()
+        eng.run_until_idle(max_steps=5000)
+        assert rb.status == serving.RequestStatus.CANCELLED
+        assert list(rb.output_tokens) == delivered  # nothing re-delivered
+        assert ra.status == serving.RequestStatus.COMPLETED
