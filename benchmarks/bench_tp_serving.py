@@ -1,0 +1,207 @@
+"""Tensor-parallel serving lane: tp=1 vs tp=2/tp=4 — throughput,
+per-chip HBM residency, and bit-parity verdicts.
+
+One deterministic mixed greedy/sampled workload through three engines
+built from the SAME model at ``tp=1``, ``tp=2``, ``tp=4`` (the host
+mesh: 8 virtual XLA:CPU devices on the dev box, a real slice on chip):
+
+- ``tok_s``: wall-clock decode throughput per lane, best-of-3 passes
+  over a warmed engine. On CPU the collectives are memcpy-priced, so
+  tp>1 runs near (or below) tp=1 — the pinned number is a regression
+  fence for the sharded executables' dispatch overhead, not a speedup
+  claim; the chip lane measures the real scaling.
+- ``per-chip HBM``: weight and KV-pool bytes per device from the HBM
+  ledger (weights report their exact sharded residency via
+  ``Array.sharding.shard_shape``; KV pools divide by tp on the kv-heads
+  axis). The verdict pins the POINT of TP — per-chip weight residency
+  at tp=2 must be under 60% of the tp=1 footprint (Megatron shards the
+  matmul weights; norms/rope tables replicate).
+- ``parity``: every tp=2/tp=4 token stream must be bit-identical to
+  its tp=1 twin (greedy AND sampled) — failure flips the exit code.
+- ``zero retraces`` across the passes, and warmup() covering the first
+  request's compiles, same bars as the router/spec lanes.
+
+Artifact: ``benchmarks/bench_tp.json``; ``tests/run_shards.py`` folds
+it into ``telemetry_lane.json`` as ``tp_bench`` and the perf gate reads
+``tp.tp2_tok_s`` / ``tp.parity`` / ``tp.weight_hbm_frac_tp2`` from it
+(pinned in ``perf_baseline.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import perf, recompile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (prompt_len, params) — mixed lengths + greedy/sampled, enough tokens
+# that decode dominates the wall clock
+WORKLOAD = [
+    (5, dict(max_new_tokens=40)),
+    (9, dict(max_new_tokens=32, do_sample=True, temperature=0.8,
+             top_k=8, seed=1)),
+    (14, dict(max_new_tokens=48)),
+    (26, dict(max_new_tokens=24, do_sample=True, top_p=0.9, seed=2)),
+    (7, dict(max_new_tokens=40)),
+    (11, dict(max_new_tokens=24, do_sample=True, temperature=1.1,
+              top_k=12, seed=3)),
+    (19, dict(max_new_tokens=32)),
+    (30, dict(max_new_tokens=40, do_sample=True, top_k=64, top_p=0.95,
+              seed=4)),
+]
+MAX_SLOTS = 4
+MAX_LEN = 96
+TP_DEGREES = (1, 2, 4)
+PASSES = 3
+
+# weight-streaming-bound decode; kv heads divide by 4 so tp=4 shards
+# the pools (same sizing as bench_router's model)
+MODEL_KW = dict(hidden_size=256, intermediate_size=512,
+                num_hidden_layers=3, num_attention_heads=8,
+                num_key_value_heads=4, vocab_size=2048)
+
+
+def make_workload(cfg):
+    rng = np.random.RandomState(42)
+    return [(rng.randint(1, cfg.vocab_size, n).astype(np.int32), p)
+            for n, p in WORKLOAD]
+
+
+def serving_retraces():
+    return sum(v["retraces"] for k, v in recompile.entry_stats().items()
+               if k.startswith("serving."))
+
+
+def hbm_components():
+    comps = perf.hbm_ledger()["components"]
+    out = {}
+    for name in ("serving_model_weights", "serving_kv_pool"):
+        c = comps.get(name) or {}
+        out[name] = {"bytes": c.get("bytes"),
+                     "bytes_per_device": c.get("bytes_per_device",
+                                               c.get("bytes"))}
+    return out
+
+
+def run_lane(model, workload, tp):
+    eng = serving.ServingEngine(model, max_slots=MAX_SLOTS,
+                                max_len=MAX_LEN, tp=tp)
+    winfo = eng.warmup()
+    retr0 = serving_retraces()
+    compiles0 = recompile.total_compiles()
+
+    outputs = None
+    best_tok_s = 0.0
+    for _ in range(PASSES):
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, params=serving.SamplingParams(**params))
+                for p, params in workload]
+        eng.run_until_idle(max_steps=50000)
+        wall = time.perf_counter() - t0
+        outs = [np.asarray(r.result(timeout=5.0)) for r in reqs]
+        if outputs is None:
+            outputs = outs
+        tokens = sum(len(o) for o in outs)
+        best_tok_s = max(best_tok_s, tokens / wall)
+
+    hbm = hbm_components()
+    lane = {
+        "tp": tp,
+        "tok_s": round(best_tok_s, 1),
+        "warmup_compiles": winfo["compiles"],
+        "warmup_wall_s": winfo["wall_s"],
+        "post_warmup_compiles": recompile.total_compiles() - compiles0,
+        "new_retraces": serving_retraces() - retr0,
+        "weight_bytes": hbm["serving_model_weights"]["bytes"],
+        "weight_bytes_per_device":
+            hbm["serving_model_weights"]["bytes_per_device"],
+        "kv_bytes": hbm["serving_kv_pool"]["bytes"],
+        "kv_bytes_per_device": hbm["serving_kv_pool"]["bytes_per_device"],
+    }
+    return lane, outputs
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig(**MODEL_KW)
+    model = LlamaForCausalLM(cfg)
+    workload = make_workload(cfg)
+    print(f"[bench_tp] model {MODEL_KW['hidden_size']}h x "
+          f"{MODEL_KW['num_hidden_layers']}L, {len(workload)} requests, "
+          f"tp degrees {TP_DEGREES}", flush=True)
+
+    lanes, outputs = {}, {}
+    for tp in TP_DEGREES:
+        lane, outs = run_lane(model, workload, tp)
+        lanes[f"tp{tp}"], outputs[tp] = lane, outs
+        print(f"[bench_tp] tp={tp}: {lane['tok_s']} tok/s, "
+              f"weights/chip {lane['weight_bytes_per_device']}B, "
+              f"kv/chip {lane['kv_bytes_per_device']}B, warmup "
+              f"{lane['warmup_compiles']} compiles "
+              f"({lane['warmup_wall_s']}s)", flush=True)
+
+    parity = {
+        f"tp{tp}": all(np.array_equal(a, b)
+                       for a, b in zip(outputs[1], outputs[tp]))
+        for tp in TP_DEGREES if tp != 1}
+    w1 = lanes["tp1"]["weight_bytes"]
+    for tp in TP_DEGREES[1:]:
+        lanes[f"tp{tp}"]["weight_bytes_per_device_frac"] = round(
+            lanes[f"tp{tp}"]["weight_bytes_per_device"] / w1, 4)
+
+    verdicts = {
+        "parity_bitwise": all(parity.values()),
+        # the POINT of TP: per-chip weight residency shrinks (matmul
+        # weights shard 1/tp; norms/rope replicate)
+        "tp2_weight_frac_lt_0p6":
+            lanes["tp2"]["weight_bytes_per_device_frac"] < 0.6,
+        "tp4_weight_frac_lt_0p35":
+            lanes["tp4"]["weight_bytes_per_device_frac"] < 0.35,
+        "kv_divides_by_tp": all(
+            lanes[f"tp{tp}"]["kv_bytes_per_device"]
+            == lanes[f"tp{tp}"]["kv_bytes"] // tp
+            for tp in TP_DEGREES[1:]),
+        "zero_retraces": all(l["new_retraces"] == 0
+                             for l in lanes.values()),
+        "warmup_covers_first_request": all(
+            l["post_warmup_compiles"] == 0 for l in lanes.values()),
+    }
+    print(f"[bench_tp] parity {parity}, verdicts "
+          f"{ {k: v for k, v in verdicts.items() if not v} or 'all pass' }",
+          flush=True)
+
+    out = {
+        "model": MODEL_KW,
+        "workload_requests": len(workload),
+        "max_slots": MAX_SLOTS,
+        "passes": PASSES,
+        "lanes": lanes,
+        "parity": {k: float(v) for k, v in parity.items()},
+        "parity_all": float(all(parity.values())),
+        "verdicts": verdicts,
+    }
+    path = os.path.join(HERE, "bench_tp.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"[bench_tp] -> {path}", flush=True)
+    failed = [k for k, v in verdicts.items() if not v]
+    if failed:
+        print(f"[bench_tp] VERDICTS FAILED: {failed}", flush=True)
+        return 1
+    print("[bench_tp] all verdicts passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -284,9 +284,10 @@ def _apply_suppressions(ctx: ModuleContext,
 
 def _module_passes():
     # imported lazily so core stays importable from the pass modules
-    from . import locks, pallas_checks, prng, trace_safety
+    from . import locks, pallas_checks, prng, sharding_checks, trace_safety
 
-    return [trace_safety.run, prng.run, pallas_checks.run, locks.run]
+    return [trace_safety.run, prng.run, pallas_checks.run, locks.run,
+            sharding_checks.run]
 
 
 def analyze_project(sources: Sequence[Tuple[str, str]],

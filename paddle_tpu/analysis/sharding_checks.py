@@ -1,0 +1,218 @@
+"""Sharding-safety pass: jit applications that capture mesh-sharded
+arrays without declaring shardings.
+
+The one-compile invariant of the tensor-parallel serving path
+(``distributed/partition.py``) rests on every jitted executable carrying
+EXPLICIT ``in_shardings``/``out_shardings``: when a jit is left to infer
+layouts, GSPMD may pick an output sharding that differs from the input
+layout of the next call, and the round-tripped pytree (KV pools, decode
+state) silently retraces on call two — or worse, the compiler inserts
+an all-gather that replicates the tensor a ``shard_params`` call just
+paid to split.
+
+This pass anchors on what is *textually sharded* in a module: names
+bound from ``jax.device_put(x, NamedSharding(...))`` (directly or
+through a name that holds a ``NamedSharding``), and names bound from
+the partition layer's placement helpers (``shard_params``,
+``shard_kv_pools``). A ``jax.jit`` application — decorator, wrapping
+call, or ``functools.partial`` — that can read one of those names as a
+free variable and declares no ``in_shardings`` (and does not delegate
+to ``shard_map`` / ``tp_jit`` internally) is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, ModuleContext, ProjectContext, RULES, register_rule
+
+register_rule(
+    "jit-sharded-capture", "sharding",
+    "jax.jit on a function that closes over a mesh-sharded array "
+    "(device_put with a NamedSharding, or shard_params/shard_kv_pools "
+    "output) without explicit in_shardings — GSPMD silently re-lays-out "
+    "the capture (all-gather) and round-tripped outputs can retrace",
+    "declare in_shardings/out_shardings on the jit (or route it through "
+    "distributed.partition.tp_jit / shard_map, which carry them)")
+
+# the partition layer's placement helpers: their outputs are sharded by
+# construction
+_PLACEMENT_HELPERS = ("shard_params", "shard_kv_pools")
+
+# wrappers that carry shardings themselves — a jitted fn delegating to
+# one of these is doing the right thing
+_SHARDING_AWARE = ("shard_map", "tp_jit", "pjit")
+
+
+def _named_sharding_names(ctx: ModuleContext) -> Set[str]:
+    """Names bound to a ``NamedSharding(...)`` (or ``PositionalSharding``)
+    construction anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = ctx.call_name(node.value)
+            if name and name.split(".")[-1] in ("NamedSharding",
+                                                "PositionalSharding"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _is_sharding_expr(ctx: ModuleContext, node: ast.AST,
+                      sharding_names: Set[str]) -> bool:
+    """Does this expression produce (or hold) a NamedSharding?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = ctx.call_name(sub)
+            if name and name.split(".")[-1] in ("NamedSharding",
+                                                "PositionalSharding"):
+                return True
+        if isinstance(sub, ast.Name) and sub.id in sharding_names:
+            return True
+    return False
+
+
+def _sharded_names(ctx: ModuleContext) -> Dict[str, int]:
+    """name -> binding line for every name assigned from a sharded
+    placement: ``device_put(x, <sharding>)`` or a partition-layer
+    helper. Tuple unpacking follows the helper's contract (the placed
+    tree is the FIRST element of shard_params/shard_kv_pools)."""
+    sharding_names = _named_sharding_names(ctx)
+    out: Dict[str, int] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        name = ctx.call_name(call)
+        placed_targets: List[ast.AST] = []
+        if name and name.endswith("device_put") and len(call.args) >= 2 \
+                and _is_sharding_expr(ctx, call.args[1], sharding_names):
+            placed_targets = list(node.targets)
+        elif name and name.split(".")[-1] in _PLACEMENT_HELPERS:
+            for t in node.targets:
+                if isinstance(t, ast.Tuple) and t.elts:
+                    placed_targets.append(t.elts[0])
+                else:
+                    placed_targets.append(t)
+        for t in placed_targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = node.lineno
+    # dict/list comprehensions over device_put with a sharding:
+    # ``{k: jax.device_put(v, sh[k]) for ...}``
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if isinstance(node.value, (ast.DictComp, ast.ListComp)):
+            body = node.value.value if isinstance(node.value, ast.DictComp) \
+                else node.value.elt
+            if isinstance(body, ast.Call):
+                name = ctx.call_name(body)
+                if name and name.endswith("device_put") \
+                        and len(body.args) >= 2:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = node.lineno
+    return out
+
+
+def _jit_call_has_shardings(call: ast.Call) -> bool:
+    return any(kw.arg in ("in_shardings", "out_shardings")
+               for kw in call.keywords)
+
+
+def _jit_sites(ctx: ModuleContext):
+    """Yield ``(fn_def, site_node, has_shardings)`` for every textual
+    jit application in the module: decorators, ``jax.jit(fn, ...)``
+    wrapping calls, and ``functools.partial(jax.jit, ...)`` decorators."""
+    fns = [n for n in ast.walk(ctx.tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for fn in fns:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    for fn in fns:
+        for dec in fn.decorator_list:
+            name = ctx.dotted_name(dec)
+            if name and name.endswith("jax.jit"):
+                yield fn, dec, False  # bare @jax.jit: no shardings
+                continue
+            if isinstance(dec, ast.Call):
+                cname = ctx.call_name(dec)
+                if cname and cname.endswith("jax.jit"):
+                    yield fn, dec, _jit_call_has_shardings(dec)
+                elif cname and cname.endswith("functools.partial") \
+                        and dec.args:
+                    inner = ctx.dotted_name(dec.args[0])
+                    if inner and inner.endswith("jax.jit"):
+                        yield fn, dec, _jit_call_has_shardings(dec)
+
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        name = ctx.call_name(call)
+        if not (name and name.endswith("jax.jit")):
+            continue
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name):
+                for fn in by_name.get(arg.id, []):
+                    yield fn, call, _jit_call_has_shardings(call)
+
+
+def _free_reads(ctx: ModuleContext, fn: ast.FunctionDef) -> Set[str]:
+    """Names ``fn`` reads but does not bind (params, local stores,
+    nested defs) — its closure surface."""
+    bound: Set[str] = {fn.name}
+    args = fn.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            bound.add(node.name)
+    return {n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            and n.id not in bound}
+
+
+def _delegates_sharding(ctx: ModuleContext, fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = ctx.call_name(node)
+            if name and name.split(".")[-1] in _SHARDING_AWARE:
+                return True
+    return False
+
+
+def run(ctx: ModuleContext, project: ProjectContext) -> List[Finding]:
+    sharded = _sharded_names(ctx)
+    if not sharded:
+        return []
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for fn, site, has_shardings in _jit_sites(ctx):
+        if has_shardings or id(fn) in seen:
+            continue
+        captured = sorted(_free_reads(ctx, fn) & set(sharded))
+        if not captured:
+            continue
+        if _delegates_sharding(ctx, fn):
+            continue
+        seen.add(id(fn))
+        binds = ", ".join(f"'{n}' (bound line {sharded[n]})"
+                          for n in captured)
+        findings.append(Finding(
+            ctx.filename, site.lineno, site.col_offset,
+            "jit-sharded-capture",
+            f"jax.jit on '{fn.name}' captures mesh-sharded {binds} "
+            f"without explicit in_shardings",
+            RULES["jit-sharded-capture"].hint))
+    return findings

@@ -1,0 +1,306 @@
+"""Rule-driven tensor-parallel partitioning: one regex table per
+architecture maps parameter names to PartitionSpecs, and the serving /
+generate executables run under ``jit`` with explicit shardings over a
+1-D ``"tp"`` mesh.
+
+This is the declarative successor to the two ad-hoc sharding surfaces
+that grew underneath it:
+
+- ``models.llama.llama_shard_fn`` hand-matched substrings per layer —
+  its Megatron layout (column q/k/v/gate/up, row o/down, vocab
+  embeddings) is now DERIVED from ``LLAMA_PARTITION_RULES`` so the
+  training-side shard_fn and the serving-side partition layer cannot
+  drift apart.
+- ``distributed.auto_shard`` derives the same pairing from weight
+  provenance; its decisions are cross-checked against these tables in
+  ``tests/test_tp_serving.py``.
+
+Layout reminder (this repo's ``nn.Linear`` stores weight as
+``[in_features, out_features]``):
+
+- column-parallel (q/k/v/gate/up/fc_in): shard the OUT dim -> weight
+  ``PS(None, "tp")``, bias ``PS("tp")`` — each shard owns whole heads.
+- row-parallel (o/down/fc_out): shard the IN dim -> weight
+  ``PS("tp", None)``, bias replicated (added once, after the psum).
+- vocab-parallel: embedding tables shard rows ``PS("tp", None)``;
+  ``lm_head`` shards the logits dim ``PS(None, "tp")``.
+- everything else (norms, rope tables, positions) replicates — the
+  catch-all ``.*`` rule, so ``match_partition_rules`` never raises on a
+  model these tables know.
+
+KV pools/caches shard on the KV-HEADS axis (axis 2 of
+``[num_blocks, block_size, n_kv, d]`` pools and ``[B, max_len, n_kv,
+d]`` contiguous caches; their absmax scale companions drop the trailing
+dim). The paged flash-decode grid is already per-kv-head and the
+host-side BlockPool/block tables are head-agnostic, so ONE allocator /
+prefix cache / block table drives every shard and preemption/COW/
+prefix-sharing logic needs no change.
+
+``tp_jit`` is the executable wrapper: explicit ``in_shardings`` AND
+``out_shardings`` (round-tripped trees keep identical layouts, so the
+one-compile/zero-retrace invariant survives sharding), plus a
+trace-time context (``tp_active``) the Pallas decode dispatch consults
+— a ``pallas_call`` cannot be partitioned by GSPMD, so under tp>1 the
+attention falls back to the XLA gather path, which partitions cleanly
+on the kv-head axis.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as PS
+
+__all__ = [
+    "TP_AXIS", "LLAMA_PARTITION_RULES", "GPT_PARTITION_RULES",
+    "match_partition_rules", "partition_rules_for", "tp_mesh",
+    "validate_tp", "shard_params", "kv_cache_spec", "shard_kv_pools",
+    "replicated", "tp_jit", "tp_context", "tp_active", "active_tp_mesh",
+    "maybe_constrain_heads",
+]
+
+TP_AXIS = "tp"
+
+
+def _rules(axis: str, table):
+    return tuple((pat, spec_fn(axis)) for pat, spec_fn in table)
+
+
+# Each table row is (regex, axis -> PartitionSpec). Names are matched
+# with '/' separators (``a.b.weight`` -> ``a/b/weight``), searched not
+# anchored — the SNIPPETS.md [2] / fmengine convention.
+_LLAMA_TABLE = (
+    # attention + MLP column-parallel (fused projections column-shard
+    # too: the concatenated out dim splits per partition and GSPMD
+    # reshards the post-matmul q/k/v slices — same note as
+    # llama_shard_fn)
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj|qkv_proj|gate_up_proj)/weight$",
+     lambda ax: PS(None, ax)),
+    (r"(o_proj|down_proj)/weight$", lambda ax: PS(ax, None)),
+    (r"embed_tokens/weight$", lambda ax: PS(ax, None)),
+    (r"lm_head/weight$", lambda ax: PS(None, ax)),
+    (r".*", lambda ax: PS()),
+)
+
+_GPT_TABLE = (
+    (r"attn/(q_proj|k_proj|v_proj)/weight$", lambda ax: PS(None, ax)),
+    (r"attn/(q_proj|k_proj|v_proj)/bias$", lambda ax: PS(ax)),
+    (r"attn/out_proj/weight$", lambda ax: PS(ax, None)),
+    (r"fc_in/weight$", lambda ax: PS(None, ax)),
+    (r"fc_in/bias$", lambda ax: PS(ax)),
+    (r"fc_out/weight$", lambda ax: PS(ax, None)),
+    (r"wte/weight$", lambda ax: PS(ax, None)),
+    (r"lm_head/weight$", lambda ax: PS(None, ax)),
+    # out_proj/fc_out biases (row-parallel: add once after the psum),
+    # wpe, layernorms: replicated
+    (r".*", lambda ax: PS()),
+)
+
+
+def LLAMA_PARTITION_RULES(axis: str = TP_AXIS):
+    """Megatron layout for the llama family as (regex, spec) rows."""
+    return _rules(axis, _LLAMA_TABLE)
+
+
+def GPT_PARTITION_RULES(axis: str = TP_AXIS):
+    """Megatron layout for the GPT family (biased Linears)."""
+    return _rules(axis, _GPT_TABLE)
+
+
+_RULES_BY_ARCH = {"llama": LLAMA_PARTITION_RULES, "gpt": GPT_PARTITION_RULES}
+
+
+def partition_rules_for(model_or_name, axis: str = TP_AXIS):
+    """Rule table for a model instance (``LlamaForCausalLM`` /
+    ``GPTForCausalLM``) or an architecture name (``"llama"``/``"gpt"``)."""
+    if isinstance(model_or_name, str):
+        name = model_or_name.lower()
+    else:
+        name = type(model_or_name).__name__.lower()
+    for arch, rules in _RULES_BY_ARCH.items():
+        if arch in name:
+            return rules(axis)
+    raise ValueError(
+        f"no partition rule table for {model_or_name!r}: known "
+        f"architectures are {sorted(_RULES_BY_ARCH)} — add a rule table "
+        f"to distributed/partition.py (a regex -> PartitionSpec list "
+        f"ending in a catch-all) to serve this model with tp > 1")
+
+
+def match_partition_rules(rules, params) -> Dict[str, PS]:
+    """Map a flat ``{name: array}`` dict through ``(regex, spec)`` rules.
+
+    The FIRST rule whose regex ``search``es the '/'-normalized name
+    wins; scalars (ndim 0) always replicate. Raises with the offending
+    name when no rule matches — end tables with ``(".*", PS())`` to
+    declare "everything else replicates" explicitly."""
+    out: Dict[str, PS] = {}
+    for name, value in params.items():
+        path = name.replace(".", "/")
+        if getattr(value, "ndim", 0) == 0:
+            out[name] = PS()
+            continue
+        for pat, spec in rules:
+            if re.search(pat, path):
+                out[name] = spec
+                break
+        else:
+            raise ValueError(
+                f"partition rule not found for param: {name} — add a "
+                f"matching rule (or a catch-all '.*' -> PS()) to the "
+                f"architecture's table in distributed/partition.py")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mesh + validation
+# ---------------------------------------------------------------------------
+
+def tp_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D tensor-parallel mesh over the first ``tp`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices but only {len(devices)} are "
+            f"visible — lower tp, or (CPU tests) raise "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count")
+    return Mesh(np.asarray(devices[:tp]), (TP_AXIS,))
+
+
+def validate_tp(model_config, tp: int, what: str = "model") -> None:
+    """Divisibility preflight for a tp-sharded decoder: every sharded
+    axis must split evenly or GSPMD would need uneven partitions (which
+    ``NamedSharding`` rejects at dispatch with an opaque error — this
+    raises the actionable one)."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if tp == 1:
+        return
+    checks = (
+        ("num_attention_heads", int(model_config.num_attention_heads)),
+        ("num_key_value_heads", int(model_config.num_key_value_heads)),
+        ("intermediate_size", int(model_config.intermediate_size)),
+        ("vocab_size", int(model_config.vocab_size)),
+    )
+    for field_name, value in checks:
+        if value % tp:
+            raise ValueError(
+                f"tp={tp} does not divide the {what}'s {field_name} "
+                f"({value}): attention shards whole (kv-)heads, the MLP "
+                f"shards intermediate columns, and the embedding/lm_head "
+                f"shard the vocab — pick tp from the common divisors or "
+                f"resize the {what}")
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PS())
+
+
+def shard_params(params: Dict[str, object], mesh: Mesh, rules
+                 ) -> Tuple[Dict[str, object], Dict[str, NamedSharding]]:
+    """``device_put`` every param/buffer with its rule-matched sharding.
+    Returns (sharded dict, {name: NamedSharding}) — the shardings feed
+    the executables' ``in_shardings`` so arrays and programs agree."""
+    specs = match_partition_rules(rules, params)
+    shardings = {name: NamedSharding(mesh, spec)
+                 for name, spec in specs.items()}
+    placed = {name: jax.device_put(value, shardings[name])
+              for name, value in params.items()}
+    return placed, shardings
+
+
+def kv_cache_spec(ndim: int) -> PS:
+    """KV-heads-axis spec for cache arrays: values ``[.., .., n_kv, d]``
+    shard axis 2; absmax scale companions ``[.., .., n_kv]`` likewise
+    (their kv-head axis is last)."""
+    if ndim == 4:
+        return PS(None, None, TP_AXIS, None)
+    if ndim == 3:
+        return PS(None, None, TP_AXIS)
+    raise ValueError(
+        f"KV cache arrays are rank 3 (scales) or 4 (values), got rank "
+        f"{ndim} — non-cache arrays have no kv-heads axis to shard")
+
+
+def shard_kv_pools(pools, mesh: Mesh):
+    """Place per-layer pool/cache dicts on the mesh, kv-heads sharded.
+    Returns (placed pools, matching per-layer sharding dicts)."""
+    shardings = [{k: NamedSharding(mesh, kv_cache_spec(v.ndim))
+                  for k, v in layer.items()} for layer in pools]
+    placed = [{k: jax.device_put(v, sh[k]) for k, v in layer.items()}
+              for layer, sh in zip(pools, shardings)]
+    return placed, shardings
+
+
+# ---------------------------------------------------------------------------
+# trace-time TP context (Pallas dispatch gate + activation constraints)
+# ---------------------------------------------------------------------------
+
+_ACTIVE = threading.local()
+
+
+@contextmanager
+def tp_context(tp: int, mesh: Optional[Mesh]):
+    prev = (getattr(_ACTIVE, "tp", 1), getattr(_ACTIVE, "mesh", None))
+    _ACTIVE.tp, _ACTIVE.mesh = int(tp), mesh
+    try:
+        yield
+    finally:
+        _ACTIVE.tp, _ACTIVE.mesh = prev
+
+
+def tp_active() -> int:
+    """The tp degree of the executable currently tracing (1 outside any
+    ``tp_context``). Python-side: under jit this is read at trace time
+    only, so it must be set around the traced call — ``tp_jit`` does."""
+    return getattr(_ACTIVE, "tp", 1)
+
+
+def active_tp_mesh() -> Optional[Mesh]:
+    return getattr(_ACTIVE, "mesh", None)
+
+
+def maybe_constrain_heads(x):
+    """``with_sharding_constraint`` pinning the heads axis of a
+    ``[b, s, heads, d]`` activation to the active TP mesh — a no-op at
+    tp=1. Called from the model attention forwards so GSPMD keeps
+    per-head compute local to the shard that owns those heads instead
+    of drifting to full replication through the reshapes."""
+    tp = tp_active()
+    mesh = active_tp_mesh()
+    if tp <= 1 or mesh is None:
+        return x
+    sh = NamedSharding(mesh, PS(None, None, TP_AXIS, None))
+    data = getattr(x, "_data", None)
+    if data is not None:  # core.Tensor wrapper
+        return x.__class__(jax.lax.with_sharding_constraint(data, sh))
+    return jax.lax.with_sharding_constraint(x, sh)
+
+
+def tp_jit(fn, *, tp: int, mesh: Mesh, in_shardings, out_shardings,
+           donate_argnums=()):
+    """``jax.jit`` with explicit shardings plus the trace-time TP
+    context. Round-tripped pytrees (pools, state) MUST use the same
+    shardings on both sides so the compiled signature is a fixpoint —
+    otherwise call 2 sees different input layouts than call 1 and the
+    one-compile invariant dies."""
+    jf = jax.jit(fn, in_shardings=in_shardings,
+                 out_shardings=out_shardings,
+                 donate_argnums=donate_argnums)
+
+    @functools.wraps(fn)
+    def call(*args):
+        with tp_context(tp, mesh):
+            return jf(*args)
+
+    call._tp_jitted = jf
+    return call

@@ -928,7 +928,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
              eos_token_id: Optional[int] = None, seed: int = 0,
              loop_mode: str = "scan", pad_token_id: Optional[int] = None,
              stream: bool = False, draft_model=None, spec_k: int = 4,
-             kv_format: str = "bf16"):
+             kv_format: str = "bf16", tp: int = 1):
     """Generate continuations for ``input_ids`` [B, S]; returns [B, S+N].
 
     Greedy by default; sampling with temperature/top-k/top-p when
@@ -974,7 +974,18 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     at the tiny-model test points match bf16 token-for-token (pinned in
     tests/test_quantization_serving.py); logits move by the absmax
     rounding step. Not supported with ``draft_model`` here — the
-    serving engine's spec lane runs on quantized pools instead."""
+    serving engine's spec lane runs on quantized pools instead.
+
+    ``tp=N`` runs the whole generate tensor-parallel over the first N
+    devices (the offline oracle for the serving engine's tp lane): the
+    params are rule-sharded Megatron-style via
+    ``distributed.partition.partition_rules_for(model)``, the KV caches
+    shard on the kv-heads axis, and the executables compile with
+    explicit shardings. Token outputs are bit-identical to tp=1 at the
+    test points (logits agree to psum reduction order). The params are
+    re-placed on the mesh each call — an oracle path, not a serving
+    path. Not supported with ``draft_model`` (the engine's spec lane is
+    the sharded one)."""
     cfg = GenerationConfig(max_new_tokens, do_sample, temperature, top_k, top_p,
                            eos_token_id, seed)
     from .quantization.intx import KV_FORMATS
@@ -992,6 +1003,13 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                 "generate — run speculative decoding on the serving "
                 "engine (ServingConfig.kv_format), whose draft/verify "
                 "lane operates on quantized pools")
+    tp = int(tp)
+    if tp > 1 and draft_model is not None:
+        raise ValueError(
+            "tp > 1 is not supported with draft_model in offline "
+            "generate — run speculative decoding on the serving engine "
+            "(ServingConfig(tp=N, ...) with draft_model), whose "
+            "draft/verify executables compile over the TP mesh")
     ids, pad_lens = _normalize_prompts(input_ids, pad_token_id)
     ragged = pad_lens is not None
     B, S = ids.shape
@@ -1066,22 +1084,46 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                cfg.top_k, cfg.top_p,
                cfg.eos_token_id if loop_mode == "scan" else None, loop_mode,
                ragged, flash_decode_enabled(), kv_format,
-               quant_matmul_enabled())
+               quant_matmul_enabled(), tp)
+
+    # tensor-parallel oracle path: rule-shard the params over a tp-mesh
+    # and compile the executables with explicit shardings (the same
+    # fixpoint discipline as the serving engine's tp executables — see
+    # distributed/partition.py)
+    tp_mesh_obj = None
+    if tp > 1:
+        from .distributed import partition as _partition
+
+        _partition.validate_tp(config, tp)
+        tp_mesh_obj = _partition.tp_mesh(tp)
+        _tp_rules = _partition.partition_rules_for(model)
+        _rep = _partition.replicated(tp_mesh_obj)
+        from jax.sharding import NamedSharding as _NS
+
+        _pb_sh = {
+            name: _NS(tp_mesh_obj, spec)
+            for name, spec in _partition.match_partition_rules(
+                _tp_rules, {**params, **buffers}).items()}
+        _ckeys = {"k": 4, "v": 4}
+        if kv_format != "bf16":
+            _ckeys.update({"ks": 3, "vs": 3})
+        _cache_sh = [
+            {kk: _NS(tp_mesh_obj, _partition.kv_cache_spec(nd))
+             for kk, nd in _ckeys.items()}
+            for _ in range(config.num_hidden_layers)]
+
     cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
     if gen_key not in cache_store:
 
-        @jax.jit
         def prefill(pb, ids, caches, pads):
             logits, caches = run(pb, ids, caches, 0, pads)
             return logits[:, -1], caches
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
         def step(pb, token, caches, pos, key, pads):
             logits, caches = run(pb, token[:, None], caches, pos, pads)
             nxt = _select_token(logits[:, 0], cfg, key)
             return nxt, caches
 
-        @jax.jit
         def generate_program(pb, ids, key, pads):
             """The WHOLE generate as ONE program: cache init + prefill +
             first-token select + (N-1)-step ``lax.scan`` decode + EOS
@@ -1111,10 +1153,37 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                 gen = _mask_after_eos(gen, cfg.eos_token_id)
             return jnp.concatenate([ids, gen], axis=1)
 
+        if tp > 1:
+            # explicit in/out shardings on every executable keep the
+            # KV-cache layouts a fixpoint across calls (one compile per
+            # gen_key, same as tp=1)
+            prefill = _partition.tp_jit(
+                prefill, tp=tp, mesh=tp_mesh_obj,
+                in_shardings=(_pb_sh, _rep, _cache_sh, _rep),
+                out_shardings=(_rep, _cache_sh))
+            step = _partition.tp_jit(
+                step, tp=tp, mesh=tp_mesh_obj,
+                in_shardings=(_pb_sh, _rep, _cache_sh, _rep, _rep, _rep),
+                out_shardings=(_rep, _cache_sh),
+                donate_argnums=(2,))
+            generate_program = _partition.tp_jit(
+                generate_program, tp=tp, mesh=tp_mesh_obj,
+                in_shardings=(_pb_sh, _rep, _rep, _rep),
+                out_shardings=_rep)
+        else:
+            prefill = jax.jit(prefill)
+            step = jax.jit(step, donate_argnums=(2,))
+            generate_program = jax.jit(generate_program)
+
         cache_store[gen_key] = (prefill, step, generate_program)
     prefill, step, generate_program = cache_store[gen_key]
 
     pb = {**params, **buffers}
+    if tp > 1:
+        pb = {name: jax.device_put(v, _pb_sh[name])
+              for name, v in pb.items()}
+        from .observability import perf as _perf_mesh
+        _perf_mesh.note_entry_mesh("generation.generate", {"tp": tp})
     key = jax.random.PRNGKey(cfg.seed)
     pads = jnp.asarray(pad_lens) if ragged else None
 

@@ -66,6 +66,13 @@ class GPTBlock(nn.Layer):
         q = self.attn.q_proj(h).reshape([b, s, nh, hd])
         k = self.attn.k_proj(h).reshape([b, s, nh, hd])
         v = self.attn.v_proj(h).reshape([b, s, nh, hd])
+        # under a tp>1 trace, pin [b, s, heads, d] activations to the
+        # heads axis so GSPMD keeps column-parallel outputs where the
+        # q/k/v weight shards put them (no-op at tp=1)
+        from ..distributed.partition import maybe_constrain_heads
+
+        q, k, v = (maybe_constrain_heads(q), maybe_constrain_heads(k),
+                   maybe_constrain_heads(v))
         new_cache = None
         use_flash_decode = False
         paged_cache = isinstance(kv_cache, dict) and "bt" in kv_cache

@@ -176,6 +176,13 @@ class LlamaAttention(nn.Layer):
             q = self.q_proj(hidden_states).reshape([b, s, self.num_heads, self.head_dim])
             k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
             v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, self.head_dim])
+        # under a tp>1 trace, pin [b, s, heads, d] activations to the
+        # heads axis so GSPMD keeps column-parallel outputs where the
+        # q/k/v weight shards put them (no-op at tp=1)
+        from ..distributed.partition import maybe_constrain_heads
+
+        q, k, v = (maybe_constrain_heads(q), maybe_constrain_heads(k),
+                   maybe_constrain_heads(v))
         q, k = apply_rotary_pos_emb(q, k, cos_tab, sin_tab, position_offset)
 
         static_cache = isinstance(kv_cache, dict)

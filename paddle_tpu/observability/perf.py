@@ -247,6 +247,7 @@ def _new_rec() -> dict:
         "temp_bytes": None, "generated_code_bytes": None,
         "compiles_captured": 0, "captured_ts": None,
         "calls": 0, "total_time_s": 0.0, "items": 0,
+        "mesh": None,
         "recent": deque(maxlen=_TIMING_WINDOW),
     }
 
@@ -307,6 +308,19 @@ def _on_entry_call(entry: str, dt_s: float):
     rec["calls"] += 1
     rec["total_time_s"] += dt_s
     rec["recent"].append(dt_s)
+
+
+def note_entry_mesh(entry: str, axes: Dict[str, int]):
+    """Tag ``entry`` as compiled over a device mesh (e.g. ``{"tp": 2}``).
+
+    XLA's cost/memory analysis is captured from the PARTITIONED module,
+    so a tagged entry's flops/bytes — and the MFU/roofline derived from
+    them against the single-chip peaks — are PER-DEVICE numbers; the
+    tag records the mesh so ledger readers can aggregate (multiply by
+    the axis product) instead of misreading a tp=4 step as one chip's
+    work. Owners call this once at executable build (the serving engine
+    does for every ``serving.*`` entry when ``tp > 1``)."""
+    _rec(entry)["mesh"] = {k: int(v) for k, v in axes.items()}
 
 
 def note_entry_items(entry: str, n: int):
@@ -449,6 +463,18 @@ def ledger_entry(entry: str, peaks: Optional[dict] = None,
             "calls", "total_time_s", "items")}
     mean_t = (sum(recent) / len(recent)) if recent else None
     flops, nbytes = row["flops"], row["bytes_accessed"]
+    # mesh-tagged entries (note_entry_mesh): the captured analysis is
+    # the partitioned module's, so flops/bytes/MFU below are PER-DEVICE;
+    # mesh_flops/mesh_bytes_accessed give the whole-mesh totals
+    mesh = rec.get("mesh")
+    row["mesh"] = dict(mesh) if mesh else None
+    if mesh:
+        ndev = 1
+        for v in mesh.values():
+            ndev *= int(v)
+        row["mesh_devices"] = ndev
+        row["mesh_flops"] = flops * ndev if flops else None
+        row["mesh_bytes_accessed"] = nbytes * ndev if nbytes else None
     row["mean_time_s"] = mean_t
     row["arithmetic_intensity"] = (
         flops / nbytes if flops and nbytes else None)
@@ -671,6 +697,10 @@ BENCH_METRIC_SOURCES = {
     "router.overhead_pct": ("bench_router.json", "overhead.overhead_pct"),
     "router.crash_completed_frac": ("bench_router.json",
                                     "crash.completed_frac"),
+    "tp.tp2_tok_s": ("bench_tp.json", "lanes.tp2.tok_s"),
+    "tp.parity": ("bench_tp.json", "parity_all"),
+    "tp.weight_hbm_frac_tp2": ("bench_tp.json",
+                               "lanes.tp2.weight_bytes_per_device_frac"),
     "train.tok_s_per_chip": ("bench_train.json", "tokens_per_sec_per_chip"),
     "train.mfu": ("bench_train.json", "mfu"),
 }

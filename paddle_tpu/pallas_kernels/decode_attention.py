@@ -109,6 +109,17 @@ def flash_decode_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _tp_sharded() -> bool:
+    """True while tracing inside a tensor-parallel executable
+    (``distributed.partition.tp_context``). ``pallas_call`` cannot be
+    partitioned by GSPMD, so a kernel hit inside a tp>1 ``shard_map``-
+    free jit would force XLA to gather the full sharded KV onto every
+    device; declining here keeps the kv-head-sharded gather fallback."""
+    from ..distributed.partition import tp_active
+
+    return tp_active() > 1
+
+
 def decode_dispatch(model: str, *, q_len: int, has_mask: bool,
                     dtype, quantized: bool = False) -> bool:
     """The decode-path dispatch decision for one attention layer call:
@@ -126,6 +137,10 @@ def decode_dispatch(model: str, *, q_len: int, has_mask: bool,
         reason = "disabled"
     elif not _HAS_TPU_PALLAS:  # pragma: no cover — jax without pallas.tpu
         reason = "no_tpu_pallas"
+    elif _tp_sharded():
+        # pallas_call can't be partitioned by GSPMD; the XLA gather
+        # fallback shards cleanly on the kv-heads axis instead
+        reason = "tp_sharded"
     elif has_mask:
         # caller brought its own attention mask (ragged left-padded
         # prompts): the kernel's masking is position-derived only
@@ -165,6 +180,8 @@ def paged_decode_dispatch(model: str, *, q_len: int, has_mask: bool,
         reason = "disabled"
     elif not _HAS_TPU_PALLAS:  # pragma: no cover — jax without pallas.tpu
         reason = "no_tpu_pallas"
+    elif _tp_sharded():
+        reason = "tp_sharded"
     elif has_mask:
         reason = "external_mask"
     elif q_len > MAX_PAGED_Q_LEN:

@@ -173,6 +173,13 @@ class ServingConfig:
       spec-decode lane all operate on quantized blocks unchanged. fp8
       uses the e4m3 jnp dtype where available; int8 is the portable
       floor.
+    - ``tp``: tensor-parallel degree — shard ONE model (and its KV
+      pools, on the kv-heads axis) across ``tp`` devices via the
+      ``distributed/partition.py`` rule tables; every executable runs
+      under jit with explicit shardings over the TP mesh. Outputs are
+      bit-identical to the tp=1 engine (greedy and sampled, spec and
+      preemption lanes included); requires ``kv_mode="paged"`` and a
+      model whose heads/kv-heads/intermediate/vocab divide by tp.
     """
 
     max_slots: int = 4
@@ -187,6 +194,13 @@ class ServingConfig:
     prefix_caching: bool = True
     spec_k: int = 4
     kv_format: str = "bf16"
+    # tensor parallelism: shard ONE model over `tp` chips (Megatron
+    # layout via distributed/partition.py rule tables; KV pools shard on
+    # the kv-heads axis). Host-side scheduling/paging is tp-agnostic —
+    # one allocator/prefix-cache/block-table drives every shard — and
+    # outputs stay bit-identical to the tp=1 engine. Divisibility
+    # against the model's heads/vocab is validated at engine build.
+    tp: int = 1
     # background loop liveness: with work pending and no step boundary
     # for this long, /healthz flips to "stalled" (503) so a router's
     # probes can eject a HUNG replica — a wedged device dispatch looks
@@ -225,6 +239,14 @@ class ServingConfig:
                 f"MAX_PAGED_Q_LEN = {MAX_SPEC_K + 1} — shrink spec_k (draft "
                 f"win saturates long before that) or raise MAX_PAGED_Q_LEN "
                 f"with the kernel's block budget in mind")
+        if int(self.tp) < 1:
+            raise ValueError(f"tp ({self.tp}) must be >= 1")
+        if int(self.tp) > 1 and self.kv_mode != "paged":
+            raise ValueError(
+                f"tp={self.tp} requires kv_mode='paged': tensor-parallel "
+                f"serving shards the block pools on the kv-heads axis — "
+                f"switch kv_mode to 'paged' (the contiguous engine is the "
+                f"single-chip A/B baseline)")
         if self.kv_mode == "paged":
             if self.block_size < 1 or self.max_len % self.block_size:
                 raise ValueError(
@@ -384,6 +406,31 @@ class ServingEngine:
             "tk": jnp.zeros(B, jnp.int32),
             "tp": jnp.ones(B, jnp.float32),
         }
+        # tensor parallelism: rule-shard the params over the TP mesh and
+        # pin the per-slot state replicated — the executables then run
+        # under jit with explicit in/out shardings (see _init_paged), so
+        # GSPMD inserts the Megatron collectives and the host-side
+        # scheduler/paging logic below never notices the mesh.
+        self._tp = int(config.tp)
+        self._tp_mesh = None
+        self._tp_pb_sh = self._tp_dpb_sh = None
+        if self._tp > 1:
+            from ..distributed import partition as _partition
+            _partition.validate_tp(mcfg, self._tp)
+            self._tp_mesh = _partition.tp_mesh(self._tp)
+            self._pb, self._tp_pb_sh = _partition.shard_params(
+                self._pb, self._tp_mesh,
+                _partition.partition_rules_for(model))
+            if self.spec:
+                _partition.validate_tp(self._dcfg, self._tp,
+                                       what="draft model")
+                self._dpb, self._tp_dpb_sh = _partition.shard_params(
+                    self._dpb, self._tp_mesh,
+                    _partition.partition_rules_for(draft_model))
+            rep = _partition.replicated(self._tp_mesh)
+            self._state = {k: jax.device_put(v, rep)
+                           for k, v in self._state.items()}
+
         self._slot_req: List[Optional[Request]] = [None] * B
         self._slot_sampling = [False] * B  # host mirror for the step cond
         self._decoding = [False] * B       # past prefill, in the step batch
@@ -448,6 +495,11 @@ class ServingEngine:
                    if eng.paged else None}
             if eng.paged:
                 out["blocks"] = eng._nblocks
+            if eng._tp > 1:
+                # jax .nbytes is the GLOBAL logical size; the pools
+                # shard on the kv-heads axis, so each chip holds 1/tp
+                out["tp"] = eng._tp
+                out["bytes_per_device"] = total // eng._tp
             return out
 
         def _weight_bytes(ref=ref):
@@ -457,7 +509,23 @@ class ServingEngine:
             n = int(sum(v.nbytes for v in eng._pb.values()))
             if eng.spec:
                 n += int(sum(v.nbytes for v in eng._dpb.values()))
-            return {"bytes": n}
+            out = {"bytes": n}
+            if eng._tp > 1:
+                # Megatron-sharded matmul weights split 1/tp; norms/rope
+                # replicate — report the exact per-device residency from
+                # the arrays' own shardings, not a naive division
+                per_dev = 0
+                for pb in ((eng._pb, eng._dpb) if eng.spec else (eng._pb,)):
+                    for v in pb.values():
+                        try:
+                            shard = v.sharding.shard_shape(v.shape)
+                            per_dev += int(np.prod(shard, dtype=np.int64)
+                                           * v.dtype.itemsize)
+                        except Exception:
+                            per_dev += int(v.nbytes)
+                out["tp"] = eng._tp
+                out["bytes_per_device"] = per_dev
+            return out
 
         if self.paged:
             _perf.register_memory_component(
@@ -484,6 +552,11 @@ class ServingEngine:
             else None
         self._pools = make_paged_kv_pools(mcfg, self._nblocks, bs,
                                           self._dtype, config.kv_format)
+        tpm = self._tp_mesh
+        if tpm is not None:
+            from ..distributed import partition as _partition
+            self._pools, self._tp_pool_sh = _partition.shard_kv_pools(
+                self._pools, tpm)
         # the executables below round-trip the pool dicts generically so
         # quantized pools (extra ks/vs scale arrays) ride every program
         # — chunk, step, COW, draft, verify — without a second variant
@@ -512,11 +585,40 @@ class ServingEngine:
             self._dpools = make_paged_kv_pools(
                 self._dcfg, self._nblocks, bs, self._ddtype,
                 config.kv_format)
+            if tpm is not None:
+                self._dpools, self._tp_dpool_sh = _partition.shard_kv_pools(
+                    self._dpools, tpm)
             self._drun = make_cached_runner(self.draft_model)
 
         C = int(config.prefill_chunk)
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        # executable wrapper: plain jit at tp=1; at tp>1 jit with
+        # EXPLICIT in/out shardings — round-tripped trees (pools, state)
+        # keep identical layouts on both sides so the compiled signature
+        # is a fixpoint and the one-compile invariant survives sharding
+        # — plus the trace-time tp context the Pallas decode dispatch
+        # consults (a pallas_call cannot be GSPMD-partitioned; under
+        # tp>1 attention takes the XLA gather path, which shards
+        # cleanly on the kv-heads axis).
+        if tpm is None:
+            rep = pb_sh = pool_sh = state_sh = None
+
+            def _wrap(fn, donate, in_s, out_s):
+                return jax.jit(fn, donate_argnums=donate)
+        else:
+            rep = _partition.replicated(tpm)
+            pb_sh = self._tp_pb_sh
+            pool_sh = self._tp_pool_sh
+            state_sh = {k: rep for k in self._state}
+
+            def _wrap(fn, donate, in_s, out_s):
+                return _partition.tp_jit(
+                    fn, tp=self._tp, mesh=tpm, in_shardings=in_s,
+                    out_shardings=out_s, donate_argnums=donate)
+        self._tp_rep = rep
+        self._tp_state_sh = state_sh
+        self._tp_wrap = _wrap
+
         def _chunk(pb, pools, state, bt_row, ids, pos0, valid, slot, is_last,
                    last_idx, key, ds, temp, tk, tp):
             """ONE fixed-shape prefill chunk: forward ``ids`` [1, C] at
@@ -556,7 +658,10 @@ class ServingEngine:
             pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
             return token, pools_out, state
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        _chunk = _wrap(_chunk, (1, 2),
+                       (pb_sh, pool_sh, state_sh) + (rep,) * 12,
+                       (rep, pool_sh, state_sh))
+
         def _step(pb, pools, state, bt, any_sampling, active):
             """ONE decode iteration for the whole slot pool, reading and
             writing KV through the traced block tables ``bt`` [B, nb]
@@ -586,7 +691,10 @@ class ServingEngine:
             pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
             return nxt, pools_out, state
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        _step = _wrap(_step, (1, 2),
+                      (pb_sh, pool_sh, state_sh, rep, rep, rep),
+                      (rep, pool_sh, state_sh))
+
         def _cow(pools, src, dst):
             """Copy-on-write fork: duplicate physical block ``src`` into
             ``dst`` across every layer's K and V pool (one dispatch;
@@ -596,6 +704,8 @@ class ServingEngine:
                 out.append({kk: c[kk].at[dst].set(c[kk][src])
                             for kk in c})
             return out
+
+        _cow = _wrap(_cow, (0,), (pool_sh, rep, rep), pool_sh)
 
         self._chunk_fn = _chunk
         self._step_fn = _step
@@ -607,6 +717,14 @@ class ServingEngine:
         _recompile.register_entry_location("serving.cow", _cow)
         if self.spec:
             self._init_spec(B, run)
+        if self._tp > 1:
+            # per-shard perf-ledger rows: the sharded executables'
+            # cost_analysis is captured from the PARTITIONED module, so
+            # flops/bytes/MFU are already per-device — the mesh tag makes
+            # that explicit in /stats and the roofline ledger
+            from ..observability import perf as _perf
+            for e in warm:
+                _perf.note_entry_mesh(e, {"tp": self._tp})
 
     # -- executables: speculative lane (paged only) --------------------------
     def _init_spec(self, B: int, run):
@@ -636,8 +754,13 @@ class ServingEngine:
         k = self._spec_k
         drun = self._drun
         pool_keys = self._pool_keys
+        _wrap = self._tp_wrap
+        rep = self._tp_rep
+        pb_sh, dpb_sh = self._tp_pb_sh, self._tp_dpb_sh
+        pool_sh = getattr(self, "_tp_pool_sh", None)
+        dpool_sh = getattr(self, "_tp_dpool_sh", None)
+        state_sh = self._tp_state_sh
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
         def _draft(dpb, dpools, state, bt, spec_valid, any_sampling):
             """k cached draft forwards proposing the bundle's draft
             tokens. ``spec_valid`` [B] is each row's live bundle width:
@@ -678,7 +801,10 @@ class ServingEngine:
             cur = [{kk: c[kk] for kk in pool_keys} for c in newdc]
             return jnp.stack(drafts, axis=1), cur
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        _draft = _wrap(_draft, (1,),
+                       (dpb_sh, dpool_sh, state_sh, rep, rep, rep),
+                       (rep, dpool_sh))
+
         def _verify(pb, pools, state, bt, drafts, spec_valid, any_sampling,
                     active):
             """ONE target forward over the [B, k+1] bundle (the paged
@@ -723,7 +849,10 @@ class ServingEngine:
             pools_out = [{kk: c[kk] for kk in pool_keys} for c in newc]
             return cand, n_emit, pools_out, state
 
-        @functools.partial(jax.jit, donate_argnums=(2, 3, 4))
+        _verify = _wrap(_verify, (1, 2),
+                        (pb_sh, pool_sh, state_sh, rep, rep, rep, rep, rep),
+                        (rep, rep, pool_sh, state_sh))
+
         def _chunk_spec(pb, dpb, pools, dpools, state, bt_row, ids, pos0,
                         valid, slot, is_last, last_idx, key, ds, temp, tk,
                         tp):
@@ -765,7 +894,11 @@ class ServingEngine:
             dpools_out = [{kk: c[kk] for kk in pool_keys} for c in newdc]
             return token, pools_out, dpools_out, state
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        _chunk_spec = _wrap(
+            _chunk_spec, (2, 3, 4),
+            (pb_sh, dpb_sh, pool_sh, dpool_sh, state_sh) + (rep,) * 12,
+            (rep, pool_sh, dpool_sh, state_sh))
+
         def _cow_spec(pools, dpools, src, dst):
             """COW fork across BOTH models' pools (same block ids)."""
             out, dout = [], []
@@ -776,6 +909,10 @@ class ServingEngine:
                 dout.append({kk: c[kk].at[dst].set(c[kk][src])
                              for kk in c})
             return out, dout
+
+        _cow_spec = _wrap(_cow_spec, (0, 1),
+                          (pool_sh, dpool_sh, rep, rep),
+                          (pool_sh, dpool_sh))
 
         self._draft_fn = _draft
         self._verify_fn = _verify
@@ -2065,6 +2202,7 @@ class ServingEngine:
             "latency_digests": _sm.latency_digests(),
             "goodput_tokens_per_s": _sm.goodput_tokens_per_second.value(),
             "preemptions": self._preempt_count,
+            "tp": self._tp,
         }
         # the performance ledger for this engine's executables: per-entry
         # flops/bytes/intensity/roofline + MFU when peaks are known (the

@@ -878,7 +878,7 @@ class Router:
         else:
             rr._set_current(gen)
 
-        def relay(_inner, tok, rr=rr, gen=gen, name=rep.name):
+        def _relay(_inner, tok, rr=rr, gen=gen, name=rep.name):
             rr._on_attempt_token(gen, name, tok)
 
         rem = rr.remaining_s()
@@ -887,7 +887,7 @@ class Router:
         rr.attempts.append(record)
         try:
             handle = rep.client.submit(rr.prompt, deadline_s=rem,
-                                       on_token=relay, params=rr.params)
+                                       on_token=_relay, params=rr.params)
         except QueueFullError as e:
             rep.saturated_until = time.perf_counter() + \
                 _sm.queue_wait_retry_after()

@@ -82,6 +82,13 @@ TPU_LANE = [
     # drain timing differs from CPU; pair with benchmarks/bench_router.py
     # for the <2% router-overhead acceptance)
     ("test_router.py", 600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # tensor-parallel serving: tp=2/4 bit-parity + one-compile + warmup
+    # invariants need a multi-device mesh — the single-chip tunnel has
+    # one device, so this shard stays on the virtual CPU mesh (the
+    # lane's standing shard_map discipline, see header note); pair with
+    # benchmarks/bench_tp_serving.py for the per-chip HBM acceptance on
+    # a real pod slice
+    ("test_tp_serving.py", 600, {"PADDLE_TPU_TEST_PLATFORM": "cpu"}),
     # perf observability: on chip the peak table resolves from the real
     # device_kind, so MFU/roofline go from "unknown" to classified —
     # this entry is the first run where the ledger publishes real MFU
@@ -401,6 +408,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
     spec_decode_bench = _read_bench("bench_spec_decode.json")
     quant_bench = _read_bench("bench_quant.json")
     router_bench = _read_bench("bench_router.json")
+    tp_bench = _read_bench("bench_tp.json")
     bench_dir = os.path.join(os.path.dirname(HERE), "benchmarks")
     perf_ledger, gate_rc = build_perf_ledger_block(
         bench_dir, totals.pop("perf_entries"))
@@ -420,6 +428,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
             "spec_decode_bench": spec_decode_bench,
             "quant_bench": quant_bench,
             "router_bench": router_bench,
+            "tp_bench": tp_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

@@ -464,6 +464,100 @@ class TestPallas:
 
 
 # ---------------------------------------------------------------------------
+# sharding family
+# ---------------------------------------------------------------------------
+
+class TestShardingCapture:
+    def test_jit_captures_device_put_sharded(self):
+        rules, res = rules_of("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def build(mesh, w, x):
+                sh = NamedSharding(mesh, PartitionSpec(None, "tp"))
+                w = jax.device_put(w, sh)
+
+                @jax.jit
+                def apply(x):
+                    return x @ w
+
+                return apply(x)
+        """)
+        assert rules == ["jit-sharded-capture"]
+        assert "'w'" in res.findings[0].message
+
+    def test_jit_captures_shard_params_output(self):
+        rules, _ = rules_of("""
+            import jax
+            from paddle_tpu.distributed.partition import shard_params
+
+            def build(params, mesh, rules, x):
+                pb, pb_sh = shard_params(params, mesh, rules)
+                step = jax.jit(lambda: None)
+
+                def fwd(x):
+                    return run(pb, x)
+
+                fwd = jax.jit(fwd)
+                return fwd(x)
+        """)
+        assert "jit-sharded-capture" in rules
+
+    def test_explicit_in_shardings_not_flagged(self):
+        rules, _ = rules_of("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def build(mesh, w, x, w_sh):
+                w = jax.device_put(w, NamedSharding(mesh, PartitionSpec("tp")))
+
+                def apply(x):
+                    return x @ w
+
+                apply = jax.jit(apply, in_shardings=(w_sh,),
+                                out_shardings=None)
+                return apply(x)
+        """)
+        assert rules == []
+
+    def test_sharded_as_argument_not_flagged(self):
+        # the sharded tree is PASSED IN, not captured — jit sees its
+        # committed sharding through the argument, nothing to declare
+        rules, _ = rules_of("""
+            import jax
+            from paddle_tpu.distributed.partition import shard_params
+
+            def build(params, mesh, rules, x):
+                pb, _ = shard_params(params, mesh, rules)
+
+                @jax.jit
+                def fwd(pb, x):
+                    return run(pb, x)
+
+                return fwd(pb, x)
+        """)
+        assert rules == []
+
+    def test_shard_map_delegation_not_flagged(self):
+        rules, _ = rules_of("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def build(mesh, w, x, specs):
+                w = jax.device_put(w, NamedSharding(mesh, PartitionSpec("tp")))
+
+                @jax.jit
+                def fwd(x):
+                    return shard_map(lambda x: x @ w, mesh,
+                                     in_specs=specs, out_specs=specs)(x)
+
+                return fwd(x)
+        """)
+        assert rules == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -552,7 +646,8 @@ class TestCli:
     def test_list_rules_covers_all_families(self, capsys):
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for family in ("trace-safety", "prng", "locks", "pallas", "meta"):
+        for family in ("trace-safety", "prng", "locks", "pallas",
+                       "sharding", "meta"):
             assert f"[{family}]" in out
 
     def test_unknown_rule_filter_rejected(self, capsys):

@@ -32,6 +32,7 @@ ALLOWED = {
     "analysis.trace_safety.run.project": _INTERFACE,
     "analysis.prng.run.project": _INTERFACE,
     "analysis.pallas_checks.run.project": _INTERFACE,
+    "analysis.sharding_checks.run.project": _INTERFACE,
     # -- custom-vjp aux index inputs: consumed by the BACKWARD rule, so
     # the forward body never reads them (moe permutation formulation)
     "distributed.moe.moe_dispatch_perm.inv_idx":
