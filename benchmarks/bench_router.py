@@ -10,6 +10,14 @@ arrivals, mixed greedy/sampled params — the continuous-batching case):
   (pick + relay + event wait), so the acceptance bar is <2% goodput
   loss at equal load; the measured number is pinned in
   ``perf_baseline.json`` (``router.overhead_pct``, direction lower).
+- ``fleet_overhead``: the fleet observability plane's tax — one-replica
+  router with the plane ON (trace propagation, metric federation, SLO
+  tracking, straggler scan — the default) vs ``fleet_observability=
+  False``, best-of-3 alternating; the ON passes also exercise the
+  federated exposition and SLO report. Bars: <2% goodput delta
+  (``router.fleet_overhead_pct`` pinned in ``perf_baseline.json``) and
+  ZERO retraces — the plane is host-side bookkeeping, it must never
+  touch the compiled surface.
 - ``goodput``: 2 replicas, no faults — fleet tok/s, goodput (deadline-
   met tok/s), and the TTFT p50/p95/p99 tail.
 - ``crash``: the same 2-replica fleet with replica r0 killed
@@ -166,6 +174,55 @@ def lane_overhead(model, workload):
             "verdict_lt_2pct": overhead_pct < 2.0}
 
 
+def lane_fleet_overhead(model, workload):
+    """Fleet-observability-plane tax: the same workload through two
+    single-replica routers, one with the plane ON (trace propagation +
+    metric federation + SLO tracking + straggler scan — the default)
+    and one with ``fleet_observability=False``. Best-of-3 alternating
+    passes; the ON pass also hits the federated exposition and the SLO
+    report mid-run so the scrape/render path is in the measured window,
+    not idle. Acceptance: <2% goodput delta and zero retraces (the
+    plane is host-side bookkeeping — it must never touch the compiled
+    surface)."""
+    eng_on = new_engine(model)
+    eng_off = new_engine(model)
+    router_on = serving.Router([eng_on], probe_interval_s=0.5)
+    router_off = serving.Router(
+        [eng_off], serving.RouterConfig(probe_interval_s=0.5,
+                                        fleet_observability=False))
+    router_on.start()
+    router_off.start()
+
+    def make_submit(router):
+        def submit(prompt, params):
+            return router.submit(prompt, deadline_s=DEADLINE_S,
+                                 params=serving.SamplingParams(**params))
+        return submit
+
+    retr0 = serving_retraces()
+    best = {"on": 0.0, "off": 0.0}
+    for _ in range(3):
+        for name, router in (("off", router_off), ("on", router_on)):
+            _, tok_s, _, _ = run_workload(make_submit(router), workload)
+            if name == "on":
+                # the consumer side of the plane, inside the window
+                router.federated_metrics_text()
+                router.slo_report()
+            best[name] = max(best[name], tok_s)
+    new_retraces = serving_retraces() - retr0
+    overhead_pct = 100.0 * (1.0 - best["on"] / best["off"])
+    fed = router_on.stats()["fleet"]["federation"]
+    router_on.stop(drain=True, timeout_s=30)
+    router_off.stop(drain=True, timeout_s=30)
+    return {"on_tok_s": round(best["on"], 1),
+            "off_tok_s": round(best["off"], 1),
+            "overhead_pct": round(overhead_pct, 2),
+            "passes": 3,
+            "fleet_scrapes": fed.get("scrapes", 0),
+            "new_retraces": new_retraces,
+            "verdict_lt_2pct": overhead_pct < 2.0}
+
+
 def lane_goodput(model, workload, refs, crash: bool):
     engines = [new_engine(model), new_engine(model)]
     router = serving.Router(
@@ -234,6 +291,12 @@ def main():
           f"{overhead['overhead_pct']}% (<2% verdict: "
           f"{overhead['verdict_lt_2pct']})", flush=True)
 
+    fleet = lane_fleet_overhead(model, workload)
+    print(f"[bench_router] fleet plane: off {fleet['off_tok_s']} tok/s "
+          f"vs on {fleet['on_tok_s']} tok/s -> {fleet['overhead_pct']}% "
+          f"(<2% verdict: {fleet['verdict_lt_2pct']}, new retraces "
+          f"{fleet['new_retraces']})", flush=True)
+
     goodput = lane_goodput(model, workload, refs, crash=False)
     print(f"[bench_router] 2-replica goodput {goodput['goodput_tok_s']} "
           f"tok/s, TTFT p99 {goodput['ttft']['p99_ms']} ms", flush=True)
@@ -247,6 +310,8 @@ def main():
 
     verdicts = {
         "overhead_lt_2pct": overhead["verdict_lt_2pct"],
+        "fleet_overhead_lt_2pct": fleet["verdict_lt_2pct"],
+        "fleet_zero_retraces": fleet["new_retraces"] == 0,
         "no_silent_loss": goodput["silently_lost"] == 0
         and crash["silently_lost"] == 0,
         "crash_all_completed": crash["completed_frac"] == 1.0,
@@ -262,6 +327,7 @@ def main():
         "workload_requests": len(workload),
         "max_slots": MAX_SLOTS,
         "overhead": overhead,
+        "fleet_overhead": fleet,
         "goodput": goodput,
         "crash": crash,
         "verdicts": verdicts,

@@ -34,6 +34,11 @@ Layout:
                   into MFU / achieved GB/s / roofline class), the HBM
                   ledger, OOM forensics dumps, and the
                   perf-regression-gate helpers.
+- ``fleet``:      the fleet observability plane — traceparent
+                  propagation helpers + catapult merge, the router-side
+                  metric-federation aggregator, SLO burn-rate tracking
+                  (``SLOConfig``/``SLOTracker``), and the robust
+                  MAD straggler score.
 
 Trace event schema (``tracing.events()`` rows / trace JSONL lines)::
 
@@ -71,11 +76,15 @@ from __future__ import annotations
 
 import time
 
-from . import exporters, metrics, perf, recompile, telemetry, tracing
+from . import exporters, fleet, metrics, perf, recompile, telemetry, tracing
 from .exporters import (RotatingJsonlSink, parse_prometheus_text,
-                        prometheus_text, resolve_sink_path,
+                        prometheus_text, render_families,
+                        resolve_sink_path,
                         start_http_server, stop_http_server,
                         write_jsonl_snapshot)
+from .fleet import (FleetMetricsAggregator, SLOConfig, SLOTracker,
+                    attempt_trace_id, format_traceparent, mad_zscores,
+                    merge_catapult, parse_traceparent)
 from .metrics import (DEFAULT_BUCKETS, DEFAULT_QUANTILES, Counter, Gauge,
                       Histogram, MetricsRegistry, Summary, counter, gauge,
                       get_registry, histogram, summary)
@@ -93,7 +102,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Summary", "MetricsRegistry",
     "DEFAULT_BUCKETS", "DEFAULT_QUANTILES",
     "counter", "gauge", "histogram", "summary", "get_registry",
-    "prometheus_text", "parse_prometheus_text", "write_jsonl_snapshot",
+    "prometheus_text", "parse_prometheus_text", "render_families",
+    "write_jsonl_snapshot",
     "start_http_server", "stop_http_server",
     "RotatingJsonlSink", "resolve_sink_path",
     "entrypoint", "current_entry", "compile_events", "entry_stats",
@@ -104,6 +114,9 @@ __all__ = [
     "perf", "ledger", "hbm_ledger", "peak_specs", "is_oom_error",
     "dump_oom", "compare_to_baseline", "register_memory_component",
     "MEMORY_STATS_UNSUPPORTED",
+    "fleet", "FleetMetricsAggregator", "SLOConfig", "SLOTracker",
+    "attempt_trace_id", "format_traceparent", "parse_traceparent",
+    "mad_zscores", "merge_catapult",
     "snapshot", "enable", "disable", "enabled",
 ]
 

@@ -29,7 +29,8 @@ from typing import Dict, List, Optional
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = [
-    "prometheus_text", "parse_prometheus_text", "write_jsonl_snapshot",
+    "prometheus_text", "parse_prometheus_text", "render_families",
+    "write_jsonl_snapshot",
     "start_http_server", "stop_http_server",
     "RotatingJsonlSink", "resolve_sink_path",
 ]
@@ -234,6 +235,27 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
         families[family]["samples"].append(
             {"series": name, "labels": labels, "value": value})
     return families
+
+
+def render_families(families: Dict[str, dict]) -> str:
+    """Inverse of ``parse_prometheus_text``: render a family dict back
+    to exposition text. Families are emitted name-sorted with their
+    ``# HELP``/``# TYPE`` headers (so the declared kind — notably
+    ``summary`` — survives a parse → render → parse round trip);
+    samples keep their insertion order and any ``_bucket``/``_sum``/
+    ``_count`` suffixes already baked into ``series``. This is the
+    fleet-federation writer: the router parses each replica's
+    exposition, relabels/rolls up, and renders the union with this."""
+    lines: List[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam.get('type') or 'untyped'}")
+        for s in fam.get("samples", ()):
+            lines.append(f"{s['series']}{_fmt_labels(s.get('labels', {}))}"
+                         f" {_fmt_value(s['value'])}")
+    return "\n".join(lines) + "\n"
 
 
 def write_jsonl_snapshot(path: str, registry: Optional[MetricsRegistry] = None,

@@ -695,6 +695,8 @@ BENCH_METRIC_SOURCES = {
                            "spec_k8_coupled.by_occupancy.1.tok_s"),
     "router.tok_s": ("bench_router.json", "goodput.tok_s"),
     "router.overhead_pct": ("bench_router.json", "overhead.overhead_pct"),
+    "router.fleet_overhead_pct": ("bench_router.json",
+                                  "fleet_overhead.overhead_pct"),
     "router.crash_completed_frac": ("bench_router.json",
                                     "crash.completed_frac"),
     "tp.tp2_tok_s": ("bench_tp.json", "lanes.tp2.tok_s"),

@@ -230,7 +230,8 @@ class ChaosReplica:
             raise ChaosError("chaos: stats endpoint exploded")
         return self.inner.stats()
 
-    def submit(self, prompt, deadline_s=None, on_token=None, params=None):
+    def submit(self, prompt, deadline_s=None, on_token=None, params=None,
+               trace_id=None):
         with self._lock:
             fail, exc = self._reject_submits, self._reject_exc
             if fail > 0:
@@ -240,8 +241,24 @@ class ChaosReplica:
             if exc == "queue":
                 raise QueueFullError("chaos: queue full")
             raise PoolExhaustedError("chaos: pool exhausted")
+        if trace_id is not None:
+            # fleet trace propagation passes through chaos untouched —
+            # the merged failover trace is exactly what the chaos
+            # suite's crash lanes need to be debuggable
+            return self.inner.submit(prompt, deadline_s=deadline_s,
+                                     on_token=on_token, params=params,
+                                     trace_id=trace_id)
         return self.inner.submit(prompt, deadline_s=deadline_s,
                                  on_token=on_token, params=params)
+
+    def __getattr__(self, name):
+        # fleet extensions (metrics_text / trace_events) and any future
+        # optional protocol methods delegate to the inner client — and
+        # stay ABSENT when the inner client lacks them, so the router's
+        # hasattr gating sees the truth through the chaos wrapper
+        if name in ("metrics_text", "trace_events"):
+            return getattr(self.inner, name)
+        raise AttributeError(name)
 
     def cancel(self, handle):
         return self.inner.cancel(handle)

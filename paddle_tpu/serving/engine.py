@@ -1467,7 +1467,7 @@ class ServingEngine:
         # the request is the active trace during its chunk, so an XLA
         # compile fired here (the one serving.prefill_chunk warmup, or a
         # would-be-retrace bug) lands in this request's timeline
-        with _trace.trace_context(req.id), \
+        with _trace.trace_context(req.trace), \
                 _entrypoint("serving.prefill_chunk"):
             chunk_args = (
                 jnp.asarray(self._bt[slot:slot + 1]),
@@ -1488,7 +1488,7 @@ class ServingEngine:
                 token, self._pools, self._state = self._chunk_fn(
                     self._pb, self._pools, self._state, *chunk_args)
         tc1 = time.perf_counter_ns()
-        _trace.complete("prefill_chunk", "request", req.id, tc0, tc1 - tc0,
+        _trace.complete("prefill_chunk", "request", req.trace, tc0, tc1 - tc0,
                         {"slot": slot, "start": start, "end": end,
                          "last": is_last})
         _sm.prefill_chunk_seconds.observe((tc1 - tc0) / 1e9)
@@ -1534,7 +1534,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         req.slot = slot
         self._note_admission(req, t0)
-        with _trace.trace_context(req.id), \
+        with _trace.trace_context(req.trace), \
                 _entrypoint(f"serving.prefill[{Lb}]"):
             token, key, pcaches = self._prefill_fn(
                 self._pb, jnp.asarray(ids), jnp.asarray(L - 1, jnp.int32),

@@ -27,7 +27,17 @@ completions are lists of token ids.
   goodput gauge).
 - ``GET /trace``    -> the request-lifecycle trace as Chrome-trace
   (catapult) JSON — save it and load in chrome://tracing / Perfetto;
-  ``?trace=<request_id>`` filters to one request's timeline.
+  ``?trace=<request_id>`` filters to one request's timeline (the
+  router passes its propagated attempt trace id here to fetch an
+  attempt's replica-side events for the merged fleet trace).
+- ``GET /metrics``  -> Prometheus text exposition of this replica's
+  registry — the scrape target of the router's metric federation.
+
+``POST /generate`` honors a W3C-traceparent-style header
+(``00-<32hex>-<16hex>-<2hex>``): a valid header makes the request's
+span tree record under the propagated trace id so the router can join
+it into one fleet trace; malformed or absent headers are ignored (fresh
+local trace) — never a 400/500.
 - ``GET /debug/requests`` -> the live per-request state table (queued /
   running / recent-finished, with phase, KV blocks, waits, latencies).
 - ``GET /debug/memory`` -> the HBM ledger: live device bytes attributed
@@ -57,6 +67,7 @@ import math
 import threading
 import time
 
+from ..observability import fleet as _fleet
 from ..observability import tracing as _tracing
 from .engine import EngineStoppedError
 from .scheduler import QueueFullError
@@ -138,6 +149,19 @@ class ServingHTTPServer:
                             except ValueError:
                                 trace = v
                     self._json(200, _tracing.chrome_trace(trace))
+                elif path == "/metrics":
+                    # Prometheus exposition for this replica — the
+                    # router's federation aggregator scrapes it
+                    from ..observability import exporters as _exp
+
+                    body = _exp.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif path == "/debug/requests":
                     self._json(200, engine.debug_requests())
                 elif path == "/debug/memory":
@@ -181,9 +205,24 @@ class ServingHTTPServer:
                 except (ValueError, KeyError, json.JSONDecodeError) as e:
                     self._json(400, {"error": f"bad request: {e}"})
                     return
+                # fleet trace propagation: a VALID traceparent header
+                # makes the replica-side request adopt the propagated
+                # trace id (the Request is constructed on this handler
+                # thread inside submit, under the context). Anything
+                # malformed parses to None — a fresh local trace, never
+                # a 400/500; a hostile header must not cost the caller
+                # their request.
+                prop = _fleet.parse_traceparent(
+                    self.headers.get(_fleet.TRACEPARENT_HEADER))
                 try:
-                    req = engine.submit(prompt, deadline_s=deadline_s,
-                                        **body)
+                    if prop is not None:
+                        with _tracing.trace_context(prop):
+                            req = engine.submit(prompt,
+                                                deadline_s=deadline_s,
+                                                **body)
+                    else:
+                        req = engine.submit(prompt, deadline_s=deadline_s,
+                                            **body)
                 except QueueFullError as e:
                     # backpressure carries the same digest-derived
                     # Retry-After hint the saturated /healthz payload does

@@ -35,6 +35,7 @@ __all__ = [
     "router_readmissions_total", "router_drains_total",
     "router_replica_healthy", "router_replica_inflight",
     "router_unroutable_total",
+    "router_stragglers_total", "router_replica_straggler",
 ]
 
 requests_total = _m.counter(
@@ -210,6 +211,16 @@ router_replica_healthy = _m.gauge(
 router_replica_inflight = _m.gauge(
     "paddle_tpu_router_replica_inflight",
     "router-attributed in-flight attempts per replica", ("replica",))
+router_stragglers_total = _m.counter(
+    "paddle_tpu_router_stragglers_total",
+    "straggler flag transitions: a replica's TPOT p50 crossed the "
+    "robust-MAD deviation threshold vs the fleet median (detection, "
+    "not ejection — the replica stays in rotation)")
+router_replica_straggler = _m.gauge(
+    "paddle_tpu_router_replica_straggler",
+    "1 while the replica's decode cadence is a robust-MAD outlier vs "
+    "the fleet median (optionally fed into the admission score via "
+    "RouterConfig.straggler_penalty)", ("replica",))
 
 _DIGESTS = {
     "ttft_s": ttft_summary,

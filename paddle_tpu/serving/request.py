@@ -81,6 +81,14 @@ class Request:
                  deadline_s: Optional[float] = None,
                  on_token: Optional[Callable[["Request", int], None]] = None):
         self.id = next(_ids)
+        # trace identity: a propagated cross-process trace id (the
+        # router's attempt id, carried by the traceparent header or the
+        # thread-local trace_context at submit) when one is active on
+        # the constructing thread, else the local request id — so a
+        # replica-side span tree joins the fleet trace when there is
+        # one and stays self-contained when there isn't
+        _ctx = _tracing.current_trace()
+        self.trace = _ctx if _ctx is not None else self.id
         self.prompt = prompt  # np.int32 [L]
         self.params = params
         self.arrival_ts = time.perf_counter()
@@ -116,7 +124,7 @@ class Request:
         # a complete, nesting-consistent trace
         ts0 = int(self.arrival_ts * 1e9)
         self._root_span = _tracing.begin_span(
-            "request", cat="request", trace=self.id,
+            "request", cat="request", trace=self.trace,
             args={"prompt_len": int(prompt.shape[0]),
                   "max_new_tokens": params.max_new_tokens,
                   "do_sample": params.do_sample}, ts_ns=ts0)
@@ -136,7 +144,7 @@ class Request:
         name: re-beginning an open span is a no-op."""
         if name not in self._open_spans:
             self._open_spans[name] = _tracing.begin_span(
-                name, cat="request", trace=self.id, args=args or None,
+                name, cat="request", trace=self.trace, args=args or None,
                 ts_ns=ts_ns)
 
     def _tr_end(self, name: str, **args):
@@ -145,7 +153,7 @@ class Request:
             _tracing.end_span(sp, args=args or None)
 
     def _tr_event(self, name: str, ts_ns: Optional[int] = None, **args):
-        _tracing.instant(name, cat="request", trace=self.id,
+        _tracing.instant(name, cat="request", trace=self.trace,
                          args=args or None, ts_ns=ts_ns)
 
     # -- engine side ---------------------------------------------------------
@@ -242,6 +250,7 @@ class Request:
         now = time.perf_counter()
         return {
             "request_id": self.id,
+            "trace": self.trace,
             "status": self.status,
             "slot": self.slot,
             "prompt_len": int(self.prompt.shape[0]),

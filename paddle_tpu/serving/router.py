@@ -49,8 +49,22 @@ actually lives:
   vs. the fail-all crash path. ``router_http`` wires SIGTERM to
   ``drain_all`` through the fault-tolerance preemption listener.
 
+- **Fleet observability plane** (``observability/fleet.py``, gated by
+  ``RouterConfig.fleet_observability``): every attempt carries a
+  deterministic propagated trace id (traceparent header over HTTP,
+  thread-local ``trace_context`` in-process) so the replica-side span
+  tree joins the router's trace — ``merged_trace(request_id)`` fetches
+  each attempt's events back and renders ONE multi-swimlane catapult
+  file; replica ``/metrics`` are scraped on the stats cadence into a
+  federation aggregator (``federated_metrics_text()``, relabeled
+  ``replica=<name>`` + ``replica="fleet"`` roll-ups); terminal
+  requests feed multi-window SLO burn rates (``slo_report()``); and
+  per-replica TPOT deviation (robust MAD) flags stragglers in
+  ``/replicas`` — optionally penalized in the admission score.
+
 The router talks to replicas through a small client protocol —
-``healthz() / stats() / submit() / cancel() / drain()`` — with two
+``healthz() / stats() / submit() / cancel() / drain()`` (plus the
+optional fleet extensions ``metrics_text() / trace_events()``) — with two
 implementations: ``LocalReplica`` (in-process engine, what the tests
 and the single-host topology use) and ``HTTPReplica`` (an engine behind
 ``serving.http`` in another process). ``chaos.py`` wraps the same
@@ -76,6 +90,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import exporters as _exporters
+from ..observability import fleet as _fleet
 from ..observability import tracing as _trace
 from . import metrics as _sm
 from .engine import EngineStoppedError, ServingEngine
@@ -155,12 +171,35 @@ class LocalReplica:
     def stats(self) -> dict:
         return self.engine.stats()
 
-    def submit(self, prompt, deadline_s=None, on_token=None, params=None):
+    def submit(self, prompt, deadline_s=None, on_token=None, params=None,
+               trace_id=None):
+        if trace_id is not None:
+            # fleet trace propagation, in-process flavor: the Request is
+            # constructed on this thread inside engine.submit and adopts
+            # the context — same join the traceparent header buys HTTP
+            with _trace.trace_context(trace_id):
+                return self.engine.submit(prompt, deadline_s=deadline_s,
+                                          on_token=on_token, params=params)
         return self.engine.submit(prompt, deadline_s=deadline_s,
                                   on_token=on_token, params=params)
 
     def cancel(self, handle):
         self.engine.cancel(handle)
+
+    def metrics_text(self) -> str:
+        """This replica's Prometheus exposition (the federation scrape
+        target). In-process replicas share one registry, so every
+        LocalReplica of a process returns the same text — the federated
+        roll-ups then multiply shared series by the replica count;
+        real isolation needs the HTTP topology (one process each)."""
+        return _exporters.prometheus_text()
+
+    def trace_events(self, trace_id) -> dict:
+        """Chrome-trace JSON for one propagated trace id — the
+        replica-side half of a router attempt's merged fleet trace.
+        Works even after this replica's engine crashed: the tracing
+        ring is in-process state, not engine state."""
+        return _trace.chrome_trace(trace_id)
 
     def warmup(self) -> dict:
         return self.engine.warmup()
@@ -178,7 +217,8 @@ class _HTTPAttempt:
     ``Request`` surface the router's await loop uses (``done`` /
     ``status`` / ``output_tokens`` / ``error`` / ``result()``)."""
 
-    def __init__(self, url: str, body: dict, on_token, timeout_s: float):
+    def __init__(self, url: str, body: dict, on_token, timeout_s: float,
+                 headers: Optional[Dict[str, str]] = None):
         self.output_tokens: List[int] = []
         self.status = RequestStatus.RUNNING
         self.error: Optional[str] = None
@@ -188,7 +228,7 @@ class _HTTPAttempt:
         self._cancelled = False
         req = urllib.request.Request(
             url, data=json.dumps(dict(body, stream=True)).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json", **(headers or {})})
         self._thread = threading.Thread(
             target=self._consume, args=(req, timeout_s), daemon=True,
             name="paddle-tpu-router-http-attempt")
@@ -271,7 +311,21 @@ class HTTPReplica:
     def stats(self) -> dict:
         return self._get("/stats")
 
-    def submit(self, prompt, deadline_s=None, on_token=None, params=None):
+    def metrics_text(self) -> str:
+        """Raw ``GET /metrics`` text (Prometheus exposition — not
+        JSON-decoded like ``_get``)."""
+        with urllib.request.urlopen(self.base_url + "/metrics",
+                                    timeout=self.timeout_s) as resp:
+            return resp.read().decode("utf-8")
+
+    def trace_events(self, trace_id) -> dict:
+        """``GET /trace?trace=<propagated id>`` — the id is hex+dash,
+        URL-safe as-is, and non-integer so the replica serves it as a
+        string trace lane."""
+        return self._get(f"/trace?trace={trace_id}")
+
+    def submit(self, prompt, deadline_s=None, on_token=None, params=None,
+               trace_id=None):
         p = params or SamplingParams()
         body = {"prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
                 "max_new_tokens": p.max_new_tokens,
@@ -279,8 +333,13 @@ class HTTPReplica:
                 "top_k": p.top_k, "top_p": p.top_p,
                 "eos_token_id": p.eos_token_id, "seed": p.seed,
                 "spec_k": p.spec_k, "deadline_s": deadline_s}
+        headers = {}
+        if trace_id is not None:
+            tp = _fleet.traceparent_of(trace_id)
+            if tp is not None:
+                headers[_fleet.TRACEPARENT_HEADER] = tp
         return _HTTPAttempt(self.base_url + "/generate", body, on_token,
-                            self.request_timeout_s)
+                            self.request_timeout_s, headers=headers)
 
     def cancel(self, handle):
         handle.cancel()
@@ -333,6 +392,18 @@ class RouterConfig:
     drain_timeout_s: Optional[float] = 30.0
     auto_warmup: bool = True           # warm local replicas at registration
     seed: int = 0                      # retry-jitter PRNG (deterministic)
+    # fleet observability plane (observability/fleet.py): the master
+    # switch gates trace propagation, /metrics federation scrapes, SLO
+    # observation, and straggler detection — the bench A/B lever
+    fleet_observability: bool = True
+    slo: Optional["_fleet.SLOConfig"] = None  # None -> SLOConfig()
+    straggler_detection: bool = True
+    straggler_mad_threshold: float = 3.5  # Iglewicz-Hoaglin convention
+    straggler_min_replicas: int = 3    # below this the median is the fleet
+    # admission-score penalty added while a replica is flagged straggler
+    # (0.0 = detect-and-report only, never shed load)
+    straggler_penalty: float = 0.0
+    recent_requests: int = 256         # merged-trace lookup registry cap
 
     def __post_init__(self):
         if self.probe_failures_to_eject < 1:
@@ -342,6 +413,13 @@ class RouterConfig:
             raise ValueError("max_retries_per_request must be >= 0")
         if self.retry_amplification_cap < 0:
             raise ValueError("retry_amplification_cap must be >= 0")
+        if self.straggler_mad_threshold <= 0:
+            raise ValueError("straggler_mad_threshold must be > 0")
+        if self.straggler_penalty < 0:
+            raise ValueError("straggler_penalty must be >= 0 (a negative "
+                             "penalty would ATTRACT load to stragglers)")
+        if self.recent_requests < 1:
+            raise ValueError("recent_requests must be >= 1")
 
 
 @dataclass
@@ -355,6 +433,7 @@ class _Load:
     slots: int = 1
     util: float = 0.0
     ttft_p95: Optional[float] = None
+    tpot_p50: Optional[float] = None   # straggler-detection input
     stale: bool = False
 
 
@@ -376,6 +455,7 @@ class _Replica:
         self.stats_errors = 0
         self.ejections = 0
         self.last_probe: Optional[dict] = None
+        self.straggler = False         # robust-MAD TPOT outlier flag
 
     def row(self) -> dict:
         return {
@@ -387,12 +467,14 @@ class _Replica:
             "stats_errors": self.stats_errors,
             "ejections": self.ejections,
             "saturated": self.saturated_until > time.perf_counter(),
+            "straggler": self.straggler,
             "load": {
                 "queue_depth": self.load.queue_depth,
                 "slots_busy": self.load.slots_busy,
                 "slots": self.load.slots,
                 "util": round(self.load.util, 4),
                 "ttft_p95": self.load.ttft_p95,
+                "tpot_p50": self.load.tpot_p50,
                 "stale": self.load.stale,
             },
         }
@@ -447,6 +529,13 @@ class RouterRequest:
             "router.request", cat="router", trace=f"router/{self.id}",
             args={"prompt_len": int(self.prompt.shape[0]),
                   "max_new_tokens": params.max_new_tokens})
+        # fleet plane: one router.attempt span per submitted attempt
+        # (distinct per retry/hedge), closed at whichever resolution
+        # site fires first — finish() sweeps any survivors so the trace
+        # is always nesting-complete; _observer (the router's SLO hook)
+        # runs once at the terminal transition
+        self._attempt_spans: Dict[int, object] = {}
+        self._observer = None
 
     # -- deadline ------------------------------------------------------------
     def remaining_s(self) -> Optional[float]:
@@ -488,6 +577,22 @@ class RouterRequest:
     def _next_gen(self) -> int:
         return next(self._gen_iter)
 
+    # -- fleet attempt spans -------------------------------------------------
+    def _begin_attempt(self, gen: int, replica: str, hedge: bool,
+                       trace_id: Optional[str]):
+        sp = _trace.begin_span(
+            "router.attempt", cat="router", trace=f"router/{self.id}",
+            args={"gen": gen, "replica": replica, "hedge": hedge,
+                  **({"trace_id": trace_id} if trace_id else {})})
+        with self._lock:
+            self._attempt_spans[gen] = sp
+
+    def _end_attempt(self, gen: int, outcome: str):
+        with self._lock:
+            sp = self._attempt_spans.pop(gen, None)
+        if sp is not None:
+            _trace.end_span(sp, args={"outcome": outcome})
+
     # -- terminal ------------------------------------------------------------
     def finish(self, status: str, error: Optional[str] = None):
         with self._lock:
@@ -500,8 +605,17 @@ class RouterRequest:
         _trace.instant(status, cat="router", trace=f"router/{self.id}",
                        args={"generated": len(self.output_tokens),
                              **({"error": error} if error else {})})
+        # close any attempt span still open (e.g. an in-flight attempt
+        # at cancel/expire) before the root so children stay inside it
+        for gen in list(self._attempt_spans):
+            self._end_attempt(gen, status)
         _trace.end_span(self._root, args={"status": status,
                                           "retries": self.retries})
+        if self._observer is not None:
+            try:
+                self._observer(self)
+            except Exception:  # noqa: BLE001 — SLO accounting must never
+                pass           # block a terminal transition
         self._stream_q.put(_STOP)
         self._done.set()
 
@@ -585,13 +699,24 @@ class Router:
         self._drivers: List[threading.Thread] = []
         self._running = False
         self._prober: Optional[threading.Thread] = None
-        self._recent: List[RouterRequest] = []
+        # bounded id -> RouterRequest registry: the merged-trace lookup
+        # (GET /trace?request=<id> on router_http) needs the attempt
+        # history after the caller's handle is gone
+        self._recent: "Dict[int, RouterRequest]" = {}
+        self.fleet_enabled = config.fleet_observability
+        self._aggregator = _fleet.FleetMetricsAggregator()
+        self._slo = _fleet.SLOTracker(config.slo or _fleet.SLOConfig())
+        self._stragglers_flagged = 0
         for i, rep in enumerate(replicas):
             self.add_replica(rep, name=getattr(rep, "name", None) or f"r{i}")
         ref = weakref.ref(self)
         _trace.register_state_provider(
             "serving_router",
             lambda ref=ref: (ref().stats() if ref() is not None else None))
+        _trace.register_state_provider(
+            "serving_fleet",
+            lambda ref=ref: (ref()._fleet_state()
+                             if ref() is not None else None))
 
     # -- replica registry ----------------------------------------------------
     def add_replica(self, client, name: Optional[str] = None):
@@ -629,6 +754,7 @@ class Router:
         if rep is not None:
             rep.state = ReplicaState.STOPPED
             _sm.router_replica_healthy.labels(name).set(0)
+            self._aggregator.forget(name)
 
     def replicas(self) -> List[dict]:
         with self._lock:
@@ -641,11 +767,14 @@ class Router:
     # -- health probing ------------------------------------------------------
     def probe_once(self):
         """One probe round over every replica (the background prober's
-        body; tests call it directly for determinism)."""
+        body; tests call it directly for determinism). Straggler
+        detection rides the probe cadence — deterministic for tests,
+        and the flags update even when no traffic is flowing."""
         for rep in self._rep_list():
             if rep.state in (ReplicaState.DRAINING, ReplicaState.STOPPED):
                 continue
             self._probe(rep)
+        self.update_stragglers()
 
     def _probe(self, rep: _Replica):
         cfg = self.config
@@ -734,12 +863,36 @@ class Router:
             kv = st.get("kv_blocks") or {}
             ld.util = float(kv.get("utilization",
                                    ld.slots_busy / ld.slots))
-            dig = (st.get("latency_digests") or {}).get("ttft_s") or {}
+            digests = st.get("latency_digests") or {}
+            dig = digests.get("ttft_s") or {}
             ld.ttft_p95 = dig.get("p95")
+            ld.tpot_p50 = (digests.get("tpot_s") or {}).get("p50")
             ld.stale = False
         except (TypeError, ValueError):
             rep.stats_errors += 1
             rep.load.stale = True
+        # federation rides the same staleness-bounded cadence: the
+        # metrics scrape never adds a second timer or failure mode
+        if self.fleet_enabled:
+            self._scrape_metrics(rep, now)
+
+    def _scrape_metrics(self, rep: _Replica, now: float):
+        """Scrape one replica's /metrics into the federation aggregator
+        — timeout-guarded like /stats, staleness-bounded by the same
+        refresh knob. A hung or failing scrape marks the replica's
+        series stale (last-known values keep serving); it NEVER ejects:
+        only /healthz probes decide rotation."""
+        fn = getattr(rep.client, "metrics_text", None)
+        if fn is None:  # chaos fakes / minimal clients: nothing to scrape
+            return
+        if not self._aggregator.should_scrape(rep.name, now,
+                                              self.config.stats_refresh_s):
+            return
+        try:
+            text = _call_with_timeout(fn, self.config.stats_timeout_s)
+            self._aggregator.update(rep.name, text, now)
+        except Exception:  # noqa: BLE001 — slow/broken scrape != dead
+            self._aggregator.mark_stale(rep.name)
 
     def _score(self, rep: _Replica, ttft_norm: float) -> float:
         cfg = self.config
@@ -747,7 +900,8 @@ class Router:
         return (cfg.w_inflight * rep.inflight / ld.slots
                 + cfg.w_queue * ld.queue_depth / ld.max_queue_depth
                 + cfg.w_util * ld.util
-                + cfg.w_ttft * ttft_norm)
+                + cfg.w_ttft * ttft_norm
+                + (cfg.straggler_penalty if rep.straggler else 0.0))
 
     def _pick(self, exclude=()) -> tuple:
         """(replica, reason): the lowest-score admitting replica, or
@@ -797,12 +951,26 @@ class Router:
                 "router has no live replicas (none registered, or all "
                 "drained/stopped) — add_replica() a warmed engine first")
         rr = RouterRequest(prompt, params, deadline_s, on_token)
+        if self.fleet_enabled:
+            rr._observer = self._observe_slo
         with self._lock:
             self._requests += 1
+            self._recent[rr.id] = rr
+            while len(self._recent) > self.config.recent_requests:
+                self._recent.pop(next(iter(self._recent)))
         t = threading.Thread(target=self._drive, args=(rr,), daemon=True,
                              name=f"paddle-tpu-router-req-{rr.id}")
         t.start()
         return rr
+
+    def _observe_slo(self, rr: RouterRequest):
+        """SLO observation at a request's terminal transition (the
+        ``RouterRequest._observer`` hook). COMPLETED means completed
+        within any deadline — EXPIRED is its own terminal state — so
+        COMPLETED is exactly the goodput-good event."""
+        self._slo.observe(rr.status, rr.ttft_s,
+                          met_deadline=(rr.status
+                                        == RequestStatus.COMPLETED))
 
     # -- the per-request driver ----------------------------------------------
     def _drive(self, rr: RouterRequest):
@@ -882,12 +1050,33 @@ class Router:
             rr._on_attempt_token(gen, name, tok)
 
         rem = rr.remaining_s()
+        # fleet trace propagation: each attempt (retry/hedge included)
+        # gets a DISTINCT deterministic trace id — the replica-side span
+        # tree records under it and the merged catapult file shows one
+        # swimlane per attempt
+        tid = (_fleet.attempt_trace_id(rr.id, gen)
+               if self.fleet_enabled else None)
         record = {"replica": rep.name, "outcome": "submitted",
-                  "hedge": hedge, "error": None}
+                  "hedge": hedge, "error": None, "trace_id": tid}
         rr.attempts.append(record)
         try:
-            handle = rep.client.submit(rr.prompt, deadline_s=rem,
-                                       on_token=_relay, params=rr.params)
+            if tid is not None:
+                try:
+                    handle = rep.client.submit(
+                        rr.prompt, deadline_s=rem, on_token=_relay,
+                        params=rr.params, trace_id=tid)
+                except TypeError:
+                    # pre-fleet client (no trace_id kwarg): submit
+                    # without propagation rather than failing the
+                    # request over an observability feature
+                    record["trace_id"] = tid = None
+                    handle = rep.client.submit(
+                        rr.prompt, deadline_s=rem, on_token=_relay,
+                        params=rr.params)
+            else:
+                handle = rep.client.submit(rr.prompt, deadline_s=rem,
+                                           on_token=_relay,
+                                           params=rr.params)
         except QueueFullError as e:
             rep.saturated_until = time.perf_counter() + \
                 _sm.queue_wait_retry_after()
@@ -909,6 +1098,7 @@ class Router:
         _sm.router_attempts_total.inc()
         _sm.router_replica_inflight.labels(rep.name).set(rep.inflight)
         rr.status = RequestStatus.RUNNING
+        rr._begin_attempt(gen, rep.name, hedge, tid)
         _trace.instant("routed", cat="router", trace=f"router/{rr.id}",
                        args={"replica": rep.name, "hedge": hedge})
         return gen, handle, record
@@ -923,12 +1113,13 @@ class Router:
         replica keeps decoding (a hung step that later resumes), its
         ``on_token`` pushes are dropped — the caller never sees a
         token from a replica the request failed away from."""
-        rep, _gen, handle, record = item
+        rep, gen, handle, record = item
         try:
             rep.client.cancel(handle)
         except Exception:  # noqa: BLE001 — dead replica: nothing to cancel
             pass
         record["outcome"] = reason
+        rr._end_attempt(gen, reason)
         self._release_attempt(rep)
 
     def _await(self, rr: RouterRequest, rep: _Replica, gen: int,
@@ -963,6 +1154,7 @@ class Router:
                     continue
                 watch.remove(item)
                 self._release_attempt(r)
+                rr._end_attempt(g, h.status)
                 with rr._lock:
                     is_current = (g == rr._current_gen)
                 if not is_current:
@@ -1111,6 +1303,116 @@ class Router:
             time.sleep(min(0.01, max(end - time.perf_counter(), 0)))
         return True
 
+    # -- fleet observability plane -------------------------------------------
+    def update_stragglers(self):
+        """Recompute per-replica straggler flags: robust modified
+        z-score (MAD) of each healthy replica's TPOT p50 against the
+        fleet, one-sided (only SLOW outliers are stragglers — an
+        unusually fast replica is a gift, not a fault). Flag
+        transitions emit a trace instant and bump the counter;
+        detection never ejects — at most it adds the configured
+        admission-score penalty."""
+        cfg = self.config
+        if not (self.fleet_enabled and cfg.straggler_detection):
+            return
+        now = time.perf_counter()
+        healthy = [r for r in self._rep_list()
+                   if r.state == ReplicaState.HEALTHY]
+        for rep in healthy:
+            self._refresh_load(rep, now)
+        sampled = [r for r in healthy if r.load.tpot_p50 is not None]
+        if len(sampled) < cfg.straggler_min_replicas:
+            for rep in healthy:
+                self._set_straggler(rep, False)
+            return
+        zs = _fleet.mad_zscores([r.load.tpot_p50 for r in sampled])
+        flagged = {r.name for r, z in zip(sampled, zs)
+                   if z > cfg.straggler_mad_threshold}
+        for rep in healthy:
+            self._set_straggler(rep, rep.name in flagged)
+
+    def _set_straggler(self, rep: _Replica, flag: bool):
+        if flag and not rep.straggler:
+            self._stragglers_flagged += 1
+            _sm.router_stragglers_total.inc()
+            _trace.instant("replica_straggler", cat="router",
+                           args={"replica": rep.name,
+                                 "tpot_p50": rep.load.tpot_p50})
+        elif rep.straggler and not flag:
+            _trace.instant("replica_recovered", cat="router",
+                           args={"replica": rep.name})
+        rep.straggler = flag
+        _sm.router_replica_straggler.labels(rep.name).set(1 if flag else 0)
+
+    def federated_metrics_text(self) -> str:
+        """The fleet's federated Prometheus exposition (router
+        ``GET /metrics``): every replica's series under a
+        ``replica=<name>`` label plus ``replica="fleet"`` roll-ups.
+        Refreshes due scrapes first (staleness-bounded, timeout-
+        guarded) so the endpoint works with no traffic flowing."""
+        if self.fleet_enabled:
+            now = time.perf_counter()
+            for rep in self._rep_list():
+                if rep.state == ReplicaState.STOPPED:
+                    continue
+                self._scrape_metrics(rep, now)
+        return self._aggregator.render()
+
+    def slo_report(self) -> dict:
+        """The fleet SLO verdict (router ``GET /slo``): per-objective
+        multi-window burn rates and ok/breach flags."""
+        return self._slo.report()
+
+    def merged_trace(self, request_id: int) -> Optional[dict]:
+        """ONE catapult file for one routed request: the router's own
+        lane plus each attempt's replica-side span tree, fetched by the
+        attempt's propagated trace id and merged side by side — a
+        crash-failover request renders attempt 1 on the dead replica
+        and attempt 2 on the survivor. None for an unknown/evicted id.
+        Attempt fetches are timeout-guarded; an unreachable replica
+        costs its lane, not the merge."""
+        with self._lock:
+            rr = self._recent.get(request_id)
+        if rr is None:
+            return None
+        parts = [(f"router request {request_id}",
+                  _trace.chrome_trace(f"router/{request_id}"))]
+        for i, att in enumerate(list(rr.attempts), 1):
+            tid = att.get("trace_id")
+            if not tid:
+                continue
+            with self._lock:
+                rep = self._replicas.get(att.get("replica"))
+            fn = getattr(rep.client, "trace_events", None) \
+                if rep is not None else None
+            if fn is None:
+                continue
+            try:
+                events = _call_with_timeout(
+                    lambda fn=fn, tid=tid: fn(tid),
+                    self.config.stats_timeout_s)
+            except Exception:  # noqa: BLE001 — lane lost, merge survives
+                continue
+            if not (events or {}).get("traceEvents"):
+                continue  # refused/rejected attempt: nothing replica-side
+            parts.append(
+                (f"attempt {i} [{att.get('replica')}]"
+                 f"{' (hedge)' if att.get('hedge') else ''}", events))
+        return _fleet.merge_catapult(parts)
+
+    def _fleet_state(self) -> Optional[dict]:
+        """Flight-recorder state provider: the fleet plane's view in
+        crash dumps / ``observability.snapshot()``."""
+        if not self.fleet_enabled:
+            return None
+        return {
+            "slo": self._slo.report(),
+            "federation": self._aggregator.stats(),
+            "stragglers": {r.name: r.straggler
+                           for r in self._rep_list()},
+            "stragglers_flagged": self._stragglers_flagged,
+        }
+
     # -- drain / lifecycle ---------------------------------------------------
     def drain(self, name: str, timeout_s: Optional[float] = None,
               wait: bool = True):
@@ -1203,6 +1505,12 @@ class Router:
             "extra_attempts": extra,
             "amplification": round(1.0 + extra / requests, 4)
             if requests else None,
+            "fleet": {
+                "enabled": self.fleet_enabled,
+                "federation": self._aggregator.stats(),
+                "stragglers_flagged": self._stragglers_flagged,
+                "slo_observed": self._slo.observed,
+            },
             "config": {
                 "probe_failures_to_eject":
                     self.config.probe_failures_to_eject,
@@ -1211,5 +1519,6 @@ class Router:
                 "retry_amplification_cap":
                     self.config.retry_amplification_cap,
                 "hedge": self.config.hedge,
+                "straggler_penalty": self.config.straggler_penalty,
             },
         }

@@ -15,7 +15,19 @@ fans out over many).
   states ride along.
 - ``GET /stats`` — ``router.stats()`` (replica table, amplification,
   outcome counts).
-- ``GET /replicas`` — just the replica table.
+- ``GET /replicas`` — just the replica table (incl. the ``straggler``
+  flag per replica).
+- ``GET /metrics`` — the FEDERATED Prometheus exposition: every
+  replica's series relabeled ``replica=<name>`` plus ``replica="fleet"``
+  roll-ups (summed counters/histograms, count-weighted merged summary
+  digests, fleet goodput). Scrapes are staleness-bounded and
+  timeout-guarded; a hung replica serves last-known series flagged by
+  ``paddle_tpu_fleet_scrape_stale``.
+- ``GET /slo`` — the fleet SLO verdict: per-objective (availability /
+  goodput / ttft_p95) multi-window burn rates with ok/breach flags.
+- ``GET /trace?request=<id>`` — ONE merged catapult file for a routed
+  request: the router's lane + each attempt's replica-side swimlane
+  (fetched by the propagated trace id), 404 for unknown/evicted ids.
 - ``POST /drain`` — body ``{"replica": name}`` drains one replica,
   ``{}`` drains ALL (graceful fleet shutdown); non-blocking, poll
   ``/replicas``.
@@ -113,6 +125,36 @@ class RouterHTTPServer:
                     self._json(200, router.stats())
                 elif path == "/replicas":
                     self._json(200, {"replicas": router.replicas()})
+                elif path == "/metrics":
+                    body = router.federated_metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/slo":
+                    self._json(200, router.slo_report())
+                elif path == "/trace":
+                    req_id = None
+                    for kv in self.path.partition("?")[2].split("&"):
+                        k, _, v = kv.partition("=")
+                        if k == "request" and v:
+                            try:
+                                req_id = int(v)
+                            except ValueError:
+                                pass
+                    if req_id is None:
+                        self._json(400, {"error": "GET /trace?request=<id>"})
+                        return
+                    merged = router.merged_trace(req_id)
+                    if merged is None:
+                        self._json(404, {"error": f"no routed request "
+                                                  f"{req_id} in the recent "
+                                                  f"registry"})
+                        return
+                    self._json(200, merged)
                 else:
                     self._json(404, {"error": f"no such path {path!r}"})
 

@@ -82,6 +82,12 @@ TPU_LANE = [
     # drain timing differs from CPU; pair with benchmarks/bench_router.py
     # for the <2% router-overhead acceptance)
     ("test_router.py", 600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # fleet observability plane: trace propagation / federation / SLO /
+    # straggler detection are host-side, but the joined-trace and
+    # zero-retrace-with-the-plane-on assertions deserve one compiled
+    # run; the telemetry merge's fleet_obs block records the evidence
+    # on BOTH lanes
+    ("test_fleet_obs.py", 420, {}),
     # tensor-parallel serving: tp=2/4 bit-parity + one-compile + warmup
     # invariants need a multi-device mesh — the single-chip tunnel has
     # one device, so this shard stays on the virtual CPU mesh (the
@@ -261,9 +267,29 @@ def _summarize_snapshot(snap: dict) -> dict:
                 "arithmetic_intensity", "roofline", "mfu", "hbm_bw_util",
                 "calls", "items", "items_per_s", "bytes_per_item")}
 
+    # fleet observability plane (router federation / SLO / stragglers):
+    # per-shard evidence the plane ran — scrape outcomes, federated
+    # series high-water mark, per-objective SLO verdicts + burn rates,
+    # straggler flag transitions
+    fleet_obs = {
+        "scrapes": {"/".join(s["labels"].values()) or "total": int(s["value"])
+                    for s in series("paddle_tpu_fleet_scrapes_total")},
+        "federated_series": int(max(
+            (s["value"] for s in series("paddle_tpu_fleet_federated_series")),
+            default=0)),
+        "slo_ok": {s["labels"].get("objective", "?"): bool(s["value"])
+                   for s in series("paddle_tpu_slo_ok")},
+        "slo_burn": {"/".join(s["labels"].values()): round(float(s["value"]),
+                                                           4)
+                     for s in series("paddle_tpu_slo_burn_rate")},
+        "stragglers_total": int(sum(
+            s["value"] for s in series("paddle_tpu_router_stragglers_total"))),
+    }
+
     return {
         "trace_spans": dict(snap.get("tracing", {}).get("span_counts", {})),
         "serving_digests": digests,
+        "fleet_obs": fleet_obs,
         "perf_entries": perf_entries,
         # pt-analysis CI trend lines: findings by rule + suppression
         # accounting (recorded by the self-clean test's analyzer run)
@@ -345,6 +371,9 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
     shards = []
     totals: dict = {"fused_conv_dispatch": {}, "flash_decode_dispatch": {},
                     "trace_spans": {}, "serving_digests": {},
+                    "fleet_obs": {"scrapes": {}, "federated_series": 0,
+                                  "slo_ok": {}, "slo_burn": {},
+                                  "stragglers_total": 0},
                     "analysis_findings": {}, "analysis_suppressions": {},
                     "perf_entries": {},
                     "compiles_total": 0,
@@ -370,6 +399,20 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
             if d["count"] > totals["serving_digests"].get(
                     k, {"count": 0})["count"]:
                 totals["serving_digests"][k] = d
+        # fleet plane: sum scrape/straggler counters, keep the
+        # high-water federated-series mark, AND the SLO verdicts (a
+        # breach in ANY shard is a lane breach), keep the WORST burn
+        # rate per objective/window
+        fo, tfo = summary["fleet_obs"], totals["fleet_obs"]
+        for k, v in fo["scrapes"].items():
+            tfo["scrapes"][k] = tfo["scrapes"].get(k, 0) + v
+        tfo["federated_series"] = max(tfo["federated_series"],
+                                      fo["federated_series"])
+        for obj, ok in fo["slo_ok"].items():
+            tfo["slo_ok"][obj] = tfo["slo_ok"].get(obj, True) and ok
+        for k, burn in fo["slo_burn"].items():
+            tfo["slo_burn"][k] = max(tfo["slo_burn"].get(k, 0.0), burn)
+        tfo["stragglers_total"] += fo["stragglers_total"]
         # ledger rows don't sum either: per entry, keep the shard that
         # called it most (its timing window is the representative one)
         for entry, row in summary["perf_entries"].items():
@@ -388,6 +431,13 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
                 if k.startswith("fallback/"))
     totals["fused_conv_hit_rate"] = (
         round(hits / (hits + falls), 4) if hits + falls else None)
+    # the cross-process join in one line: router-side lanes
+    # (router.request/router.attempt) next to the replica-side request
+    # spans they propagate into — nonzero on both sides means joined
+    # traces were actually exercised this lane (CPU and TPU alike)
+    totals["fleet_obs"]["joined_trace_spans"] = {
+        name: totals["trace_spans"].get(name, 0)
+        for name in ("router.request", "router.attempt", "request")}
     # fold the most recent serving bench artifact (if any) into the lane
     # so one file carries the full telemetry story: compile counts,
     # fused-conv hit rate, AND the continuous-batching numbers

@@ -1,0 +1,581 @@
+"""Fleet observability plane (paddle_tpu/observability/fleet.py +
+serving/router.py wiring): cross-process trace propagation, metric
+federation, SLO burn-rate tracking, and straggler detection.
+
+The contracts asserted here:
+
+- TRACEPARENT IS HOSTILE-INPUT SAFE: any malformed header value parses
+  to None (fresh local trace) — parse_traceparent never raises, and
+  per-attempt trace ids are deterministic and distinct per retry/hedge.
+- FEDERATION NEVER LIES: every replica series comes back under its
+  ``replica=<name>`` label (pre-existing ``replica`` labels survive as
+  ``exported_replica``), roll-ups sum only what summing is truthful
+  for, the Summary kind survives a render -> parse round trip, and no
+  two federated samples collide on (series, labels).
+- STALENESS IS VISIBLE, NEVER AN EJECTION: a hung /metrics scrape
+  leaves the replica in rotation serving last-known series flagged by
+  ``paddle_tpu_fleet_scrape_stale``.
+- SLO BREACH NEEDS BOTH WINDOWS: the fast window alone (a blip) never
+  flips an objective to breached; cancelled requests and TTFT-less
+  failures are excluded per the documented rules.
+- STRAGGLER DETECTION IS RELATIVE AND ONE-SIDED: robust-MAD on TPOT
+  p50 vs the fleet median flags slow outliers only, needs a minimum
+  fleet size, and at most penalizes the admission score — it never
+  ejects.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import fleet, tracing
+from paddle_tpu.observability.exporters import parse_prometheus_text
+
+SEED = 1234
+
+
+# ---------------------------------------------------------------------------
+# trace propagation
+# ---------------------------------------------------------------------------
+
+class TestTraceparent:
+    def test_attempt_ids_distinct_and_deterministic(self):
+        # router attempt generations count from 1 (itertools.count(1));
+        # a zero half would be lifted to 1 (all-zero ids are invalid in
+        # traceparent), so the real domain stays collision-free
+        ids = {fleet.attempt_trace_id(rid, gen)
+               for rid in range(5) for gen in range(1, 5)}
+        assert len(ids) == 20  # every (request, attempt) pair distinct
+        assert fleet.attempt_trace_id(7, 2) == fleet.attempt_trace_id(7, 2)
+        t, p = fleet.attempt_trace_id(7, 2).split("-")
+        assert len(t) == 32 and len(p) == 16
+
+    def test_round_trip(self):
+        tid = fleet.attempt_trace_id(41, 3)
+        header = fleet.traceparent_of(tid)
+        assert header.startswith("00-") and header.endswith("-01")
+        assert fleet.parse_traceparent(header) == tid
+
+    def test_traceparent_of_rejects_non_propagated_shapes(self):
+        for bad in ("abc", "a-b", "a-b-c", 123, None, "x" * 49):
+            assert fleet.traceparent_of(bad) is None
+
+    def test_malformed_headers_parse_to_none_never_raise(self):
+        t32, p16 = "ab" * 16, "cd" * 8
+        hostile = [
+            None, 123, b"00-x-y-01", [], {}, "", " ", "garbage",
+            "00", "00-", "00-%s" % t32, f"00-{t32}-{p16}",       # few fields
+            f"00-{t32}-{p16}-01-extra",                           # many fields
+            f"01-{t32}-{p16}-01",                                 # bad version
+            f"00-{t32.upper()}-{p16}-01",                         # uppercase
+            f"00-{t32[:-1]}z-{p16}-01",                           # non-hex
+            f"00-{t32[:-2]}-{p16}-01",                            # short trace
+            f"00-{t32}-{p16[:-2]}-01",                            # short parent
+            f"00-{'0' * 32}-{p16}-01",                            # zero trace
+            f"00-{t32}-{'0' * 16}-01",                            # zero parent
+            f"00-{t32}-{p16}-1",                                  # short flags
+            f"00-{t32}-{p16}-zz",                                 # non-hex flag
+            "\x00\xff" * 40, "0" * 4096,
+        ]
+        for h in hostile:
+            assert fleet.parse_traceparent(h) is None, h
+
+    def test_valid_flags_variants_accepted(self):
+        t32, p16 = "ab" * 16, "cd" * 8
+        for flags in ("00", "01", "ff"):
+            assert fleet.parse_traceparent(
+                f"00-{t32}-{p16}-{flags}") == f"{t32}-{p16}"
+
+
+class TestMergeCatapult:
+    def test_lanes_get_distinct_pids_and_labels(self):
+        a = {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 77, "tid": 0,
+             "args": {"name": "orig"}},
+            {"name": "s", "ph": "X", "pid": 77, "tid": 1, "ts": 0,
+             "dur": 5, "cat": "c", "args": {}}]}
+        b = {"traceEvents": [
+            {"name": "t", "ph": "X", "pid": 99, "tid": 2, "ts": 1,
+             "dur": 2, "cat": "c", "args": {}}]}  # no process_name at all
+        merged = fleet.merge_catapult([("router", a), ("attempt 1 [r0]", b)])
+        assert merged["displayTimeUnit"] == "ms"
+        text = json.dumps(merged)            # must be loadable JSON
+        assert json.loads(text) == merged
+        names = {ev["pid"]: ev["args"]["name"]
+                 for ev in merged["traceEvents"]
+                 if ev.get("ph") == "M" and ev["name"] == "process_name"}
+        assert names == {0: "router", 1: "attempt 1 [r0]"}
+        # every event landed in its part's lane, original pids gone
+        assert {ev["pid"] for ev in merged["traceEvents"]} == {0, 1}
+
+    def test_duplicate_process_names_deduped(self):
+        part = {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+             "args": {"name": "a"}},
+            {"name": "process_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "b"}}]}
+        merged = fleet.merge_catapult([("lane", part)])
+        metas = [ev for ev in merged["traceEvents"]
+                 if ev.get("ph") == "M" and ev["name"] == "process_name"]
+        assert len(metas) == 1 and metas[0]["args"]["name"] == "lane"
+
+    def test_inputs_not_mutated(self):
+        ev = {"name": "s", "ph": "X", "pid": 5, "tid": 1, "ts": 0, "dur": 1}
+        part = {"traceEvents": [ev]}
+        fleet.merge_catapult([("lane", part)])
+        assert ev["pid"] == 5
+
+
+# ---------------------------------------------------------------------------
+# straggler scoring (the robust statistic itself)
+# ---------------------------------------------------------------------------
+
+class TestMadZscores:
+    def test_empty_and_identical(self):
+        assert fleet.mad_zscores([]) == []
+        assert fleet.mad_zscores([3.0, 3.0, 3.0]) == [0.0, 0.0, 0.0]
+
+    def test_twins_and_one_straggler_uses_meanad_fallback(self):
+        # MAD degenerates to 0 here (the common fleet shape); the
+        # mean-AD fallback must still isolate the outlier
+        zs = fleet.mad_zscores([1.0, 1.0, 1.0, 1.0, 10.0])
+        assert zs[-1] > 3.5
+        assert all(abs(z) < 1.0 for z in zs[:-1])
+
+    def test_spread_values_use_mad(self):
+        zs = fleet.mad_zscores([1.0, 1.1, 0.9, 1.05, 0.95, 8.0])
+        assert zs[-1] > 3.5
+        assert all(abs(z) < 3.5 for z in zs[:-1])
+
+    def test_fast_outlier_scores_negative(self):
+        # one-sided consumers ignore fast replicas: their z is negative
+        zs = fleet.mad_zscores([1.0, 1.0, 1.0, 1.0, 0.1])
+        assert zs[-1] < 0
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate tracking
+# ---------------------------------------------------------------------------
+
+def _tracker(**kw):
+    kw.setdefault("fast_window_s", 1.0)
+    kw.setdefault("slow_window_s", 10.0)
+    clock = {"t": 1000.0}
+    tr = fleet.SLOTracker(fleet.SLOConfig(**kw),
+                          clock=lambda: clock["t"])
+    return tr, clock
+
+
+class TestSLOTracker:
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            fleet.SLOConfig(availability=1.0)   # no budget to burn
+        with pytest.raises(ValueError):
+            fleet.SLOConfig(goodput_floor=0.0)
+        with pytest.raises(ValueError):
+            fleet.SLOConfig(fast_window_s=60.0, slow_window_s=30.0)
+
+    def test_all_good_is_ok(self):
+        tr, _ = _tracker()
+        for _ in range(20):
+            tr.observe("completed", ttft_s=0.01, met_deadline=True)
+        rep = tr.report()
+        assert rep["ok"] and rep["observed"] == 20
+        for obj in rep["objectives"].values():
+            assert obj["ok"]
+            assert obj["windows"]["fast"]["burn_rate"] == 0.0
+
+    def test_breach_requires_both_windows(self):
+        tr, clock = _tracker()
+        # 1000 good observations early in the slow window keep the
+        # slow burn under threshold...
+        for _ in range(1000):
+            tr.observe("completed", ttft_s=0.01, met_deadline=True)
+        clock["t"] += 9.5
+        # ...then a fast-window failure blip: fast burns hot, slow
+        # doesn't — the multi-window rule must NOT page
+        for _ in range(5):
+            tr.observe("failed", ttft_s=None, met_deadline=False)
+        rep = tr.report()
+        avail = rep["objectives"]["availability"]
+        assert avail["windows"]["fast"]["burn_rate"] \
+            >= tr.config.fast_burn_threshold
+        assert avail["windows"]["slow"]["burn_rate"] \
+            < tr.config.slow_burn_threshold
+        assert avail["ok"] and rep["ok"]
+
+    def test_sustained_failures_breach(self):
+        tr, _ = _tracker()
+        for _ in range(20):
+            tr.observe("failed", ttft_s=None, met_deadline=False)
+        rep = tr.report()
+        assert not rep["ok"]
+        assert not rep["objectives"]["availability"]["ok"]
+        assert not rep["objectives"]["goodput"]["ok"]
+        # no request ever produced a first token: the TTFT objective
+        # has nothing to judge (total 0) — excluded, not breached
+        ttft = rep["objectives"]["ttft_p95"]
+        assert ttft["ok"]
+        assert ttft["windows"]["fast"]["total"] == 0
+
+    def test_cancelled_excluded_everywhere(self):
+        tr, _ = _tracker()
+        for _ in range(10):
+            tr.observe("cancelled", ttft_s=None, met_deadline=False)
+        rep = tr.report()
+        assert rep["observed"] == 0 and rep["ok"]
+
+    def test_ttft_bound_judged_against_config(self):
+        tr, _ = _tracker(ttft_p95_s=0.1)
+        for _ in range(10):
+            tr.observe("completed", ttft_s=5.0, met_deadline=True)
+        rep = tr.report()
+        assert not rep["objectives"]["ttft_p95"]["ok"]
+        assert rep["objectives"]["availability"]["ok"]
+
+    def test_gauges_published(self):
+        tr, _ = _tracker()
+        tr.observe("completed", ttft_s=0.01, met_deadline=True)
+        tr.report()
+        text = paddle.observability.prometheus_text()
+        assert "paddle_tpu_slo_burn_rate" in text
+        assert 'paddle_tpu_slo_ok{objective="availability"}' in text
+
+
+# ---------------------------------------------------------------------------
+# metric federation
+# ---------------------------------------------------------------------------
+
+def _exposition(reqs, goodput, util, p50, count):
+    """A synthetic replica /metrics exposition exercising every family
+    kind the roll-up logic branches on."""
+    return f"""\
+# HELP paddle_tpu_serving_requests_total serving requests by outcome
+# TYPE paddle_tpu_serving_requests_total counter
+paddle_tpu_serving_requests_total{{outcome="completed"}} {reqs}
+# TYPE paddle_tpu_serving_goodput_tokens_per_second gauge
+paddle_tpu_serving_goodput_tokens_per_second {goodput}
+# TYPE paddle_tpu_serving_slot_occupancy gauge
+paddle_tpu_serving_slot_occupancy {util}
+# TYPE paddle_tpu_serving_ttft_seconds histogram
+paddle_tpu_serving_ttft_seconds_bucket{{le="0.1"}} {count}
+paddle_tpu_serving_ttft_seconds_bucket{{le="+Inf"}} {count}
+paddle_tpu_serving_ttft_seconds_sum {p50 * count}
+paddle_tpu_serving_ttft_seconds_count {count}
+# TYPE paddle_tpu_serving_tpot_summary_seconds summary
+paddle_tpu_serving_tpot_summary_seconds{{quantile="0.5"}} {p50}
+paddle_tpu_serving_tpot_summary_seconds_sum {p50 * count}
+paddle_tpu_serving_tpot_summary_seconds_count {count}
+# TYPE paddle_tpu_router_replica_healthy gauge
+paddle_tpu_router_replica_healthy{{replica="inner"}} 1
+"""
+
+
+class TestFederation:
+    def _agg(self):
+        agg = fleet.FleetMetricsAggregator()
+        agg.update("r0", _exposition(10, 100.0, 0.5, 0.010, 10), now=1.0)
+        agg.update("r1", _exposition(30, 300.0, 0.9, 0.030, 30), now=1.0)
+        return agg
+
+    def test_relabel_and_no_collisions(self):
+        fams = self._agg().federated_families()
+        reqs = fams["paddle_tpu_serving_requests_total"]["samples"]
+        by_rep = {s["labels"]["replica"]: s["value"] for s in reqs}
+        assert by_rep == {"r0": 10.0, "r1": 30.0, "fleet": 40.0}
+        # pre-existing replica label survives as exported_replica
+        healthy = fams["paddle_tpu_router_replica_healthy"]["samples"]
+        inner = [s for s in healthy
+                 if s["labels"].get("exported_replica") == "inner"]
+        assert {s["labels"]["replica"] for s in inner} == {"r0", "r1"}
+        # the federation invariant: no two samples collide
+        seen = set()
+        for fam in fams.values():
+            for s in fam["samples"]:
+                key = (s["series"], tuple(sorted(s["labels"].items())))
+                assert key not in seen, key
+                seen.add(key)
+
+    def test_rollups_sum_only_what_is_truthful(self):
+        fams = self._agg().federated_families()
+
+        def fleet_samples(name):
+            return [s for s in fams[name]["samples"]
+                    if s["labels"].get("replica") == fleet.FLEET_REPLICA_LABEL]
+
+        # counters and histogram buckets sum
+        assert fleet_samples(
+            "paddle_tpu_serving_requests_total")[0]["value"] == 40.0
+        buckets = {s["labels"]["le"]: s["value"] for s in fleet_samples(
+            "paddle_tpu_serving_ttft_seconds")
+            if s["series"].endswith("_bucket")}
+        assert buckets == {"0.1": 40.0, "+Inf": 40.0}
+        # goodput (a rate) sums; occupancy (a utilization) must NOT
+        assert fleet_samples(
+            "paddle_tpu_serving_goodput_tokens_per_second")[0][
+                "value"] == 400.0
+        assert fleet_samples("paddle_tpu_serving_slot_occupancy") == []
+
+    def test_summary_merge_is_count_weighted(self):
+        fams = self._agg().federated_families()
+        rolled = {s["series"]: s for s in
+                  fams["paddle_tpu_serving_tpot_summary_seconds"]["samples"]
+                  if s["labels"].get("replica") == fleet.FLEET_REPLICA_LABEL
+                  and s["labels"].get("quantile") == "0.5"
+                  or (s["labels"].get("replica") == fleet.FLEET_REPLICA_LABEL
+                      and s["series"].endswith(("_sum", "_count")))}
+        # (0.010*10 + 0.030*30) / 40 = 0.025 — the busy replica
+        # dominates, an idle one can't average it away
+        q50 = rolled["paddle_tpu_serving_tpot_summary_seconds"]["value"]
+        assert q50 == pytest.approx(0.025)
+        assert rolled["paddle_tpu_serving_tpot_summary_seconds_count"][
+            "value"] == 40.0
+
+    def test_render_round_trip_preserves_kinds(self):
+        agg = self._agg()
+        text = agg.render()
+        back = parse_prometheus_text(text)
+        assert back["paddle_tpu_serving_tpot_summary_seconds"][
+            "type"] == "summary"
+        assert back["paddle_tpu_serving_ttft_seconds"]["type"] == "histogram"
+        assert back["paddle_tpu_serving_requests_total"]["type"] == "counter"
+        # quantile/label values survive the round trip
+        q = [s for s in
+             back["paddle_tpu_serving_tpot_summary_seconds"]["samples"]
+             if s["labels"] == {"replica": "fleet", "quantile": "0.5"}]
+        assert q and q[0]["value"] == pytest.approx(0.025)
+        # scrape-health families ride along
+        assert "paddle_tpu_fleet_scrape_age_seconds" in back
+        assert "paddle_tpu_fleet_scrape_stale" in back
+
+    def test_staleness_keeps_last_known_series(self):
+        agg = self._agg()
+        agg.mark_stale("r1")
+        back = parse_prometheus_text(agg.render())
+        stale = {s["labels"]["replica"]: s["value"] for s in
+                 back["paddle_tpu_fleet_scrape_stale"]["samples"]}
+        assert stale == {"r0": 0, "r1": 1}
+        # r1's series still serve (last-known values)
+        reqs = {s["labels"]["replica"]: s["value"] for s in
+                back["paddle_tpu_serving_requests_total"]["samples"]}
+        assert reqs["r1"] == 30.0
+
+    def test_should_scrape_claims_window_even_on_failure(self):
+        agg = fleet.FleetMetricsAggregator()
+        assert agg.should_scrape("r0", now=10.0, refresh_s=1.0)
+        # the window is claimed whether or not an update follows — a
+        # hung replica is retried on the cadence, not hammered
+        assert not agg.should_scrape("r0", now=10.5, refresh_s=1.0)
+        assert agg.should_scrape("r0", now=11.5, refresh_s=1.0)
+
+    def test_forget_removes_replica(self):
+        agg = self._agg()
+        agg.forget("r0")
+        fams = agg.federated_families()
+        reps = {s["labels"]["replica"] for s in
+                fams["paddle_tpu_serving_requests_total"]["samples"]}
+        assert reps == {"r1", "fleet"}
+
+
+# ---------------------------------------------------------------------------
+# router wiring over fake clients (no engines: pure control plane)
+# ---------------------------------------------------------------------------
+
+class _FakeReplica:
+    """Minimal replica client: healthy, constant load, synthetic
+    exposition. No submit — these tests never route traffic."""
+
+    def __init__(self, name, tpot_p50=0.01, hang_metrics_s=0.0):
+        self.name = name
+        self.tpot_p50 = tpot_p50
+        self.hang_metrics_s = hang_metrics_s
+
+    def healthz(self):
+        return {"status": "ok", "warmed_up": True}
+
+    def stats(self):
+        return {"queue_depth": 0, "max_queue_depth": 8, "slots_busy": 0,
+                "slots": 2, "kv_blocks": {"utilization": 0.0},
+                "latency_digests": {"ttft_s": {"p95": 0.05},
+                                    "tpot_s": {"p50": self.tpot_p50}}}
+
+    def metrics_text(self):
+        if self.hang_metrics_s:
+            time.sleep(self.hang_metrics_s)
+        return _exposition(5, 50.0, 0.1, self.tpot_p50, 5)
+
+
+def _fake_router(fakes, **cfg):
+    cfg.setdefault("stats_refresh_s", 0.0)
+    cfg.setdefault("stats_timeout_s", 2.0)
+    cfg.setdefault("auto_warmup", False)
+    return serving.Router(fakes, serving.RouterConfig(**cfg))
+
+
+class TestRouterFederation:
+    def test_federated_endpoint_covers_every_replica(self):
+        router = _fake_router([_FakeReplica("a"), _FakeReplica("b")])
+        back = parse_prometheus_text(router.federated_metrics_text())
+        reps = {s["labels"]["replica"] for s in
+                back["paddle_tpu_serving_requests_total"]["samples"]}
+        assert reps == {"a", "b", "fleet"}
+        st = router.stats()["fleet"]
+        assert st["enabled"]
+        assert st["federation"]["scrapes"] >= 2
+
+    def test_hung_scrape_marks_stale_never_ejects(self):
+        hung = _FakeReplica("hung", hang_metrics_s=1.0)
+        router = _fake_router([_FakeReplica("ok"), hung],
+                              stats_timeout_s=0.05)
+        # first pass seeds "ok" and times out on "hung"
+        router.federated_metrics_text()
+        t0 = time.monotonic()
+        while router._aggregator.scrape_errors == 0:
+            time.sleep(0.01)
+            assert time.monotonic() - t0 < 10
+        router.probe_once()
+        states = {r["name"]: r["state"] for r in router.replicas()}
+        assert states == {"ok": "healthy", "hung": "healthy"}
+        back = parse_prometheus_text(router.federated_metrics_text())
+        stale = {s["labels"]["replica"]: s["value"] for s in
+                 back["paddle_tpu_fleet_scrape_stale"]["samples"]}
+        assert stale["ok"] == 0
+        # "hung" either never landed a scrape (absent) or is stale
+        assert stale.get("hung", 1) in (0, 1)
+        assert router._aggregator.scrape_errors >= 1
+
+    def test_disabled_plane_scrapes_nothing(self):
+        router = _fake_router([_FakeReplica("a")],
+                              fleet_observability=False)
+        router.probe_once()
+        assert router.stats()["fleet"]["enabled"] is False
+        assert router._aggregator.scrapes == 0
+
+
+class TestStragglerDetection:
+    def test_slow_outlier_flagged_and_counted(self):
+        fakes = [_FakeReplica(f"r{i}", tpot_p50=0.01) for i in range(4)]
+        fakes.append(_FakeReplica("slow", tpot_p50=0.1))
+        router = _fake_router(fakes)
+        flagged0 = router.stats()["fleet"]["stragglers_flagged"]
+        router.probe_once()
+        rows = {r["name"]: r for r in router.replicas()}
+        assert rows["slow"]["straggler"] is True
+        assert all(not rows[f"r{i}"]["straggler"] for i in range(4))
+        assert router.stats()["fleet"]["stragglers_flagged"] == flagged0 + 1
+        # recovery clears the flag (falling edge, no second count)
+        rows2 = {}
+        for rep in router._rep_list():
+            rep.load.ts = 0.0  # force a stats refresh
+        fakes[-1].tpot_p50 = 0.01
+        router.probe_once()
+        rows2 = {r["name"]: r for r in router.replicas()}
+        assert rows2["slow"]["straggler"] is False
+        assert router.stats()["fleet"]["stragglers_flagged"] == flagged0 + 1
+
+    def test_fast_outlier_not_flagged(self):
+        fakes = [_FakeReplica(f"r{i}", tpot_p50=0.01) for i in range(4)]
+        fakes.append(_FakeReplica("fast", tpot_p50=0.001))
+        router = _fake_router(fakes)
+        router.probe_once()
+        assert not any(r["straggler"] for r in router.replicas())
+
+    def test_min_fleet_size_guard(self):
+        # 2 replicas can't produce a meaningful MAD verdict: no flags
+        router = _fake_router([_FakeReplica("a", tpot_p50=0.01),
+                               _FakeReplica("b", tpot_p50=0.5)])
+        router.probe_once()
+        assert not any(r["straggler"] for r in router.replicas())
+
+    def test_penalty_moves_admission_score_only_when_configured(self):
+        fakes = [_FakeReplica(f"r{i}", tpot_p50=0.01) for i in range(4)]
+        fakes.append(_FakeReplica("slow", tpot_p50=0.1))
+        router = _fake_router(fakes, straggler_penalty=5.0)
+        router.probe_once()
+        reps = {r.name: r for r in router._rep_list()}
+        assert reps["slow"].straggler
+        delta = router._score(reps["slow"], 0.0) \
+            - router._score(reps["r0"], 0.0)
+        assert delta == pytest.approx(5.0)
+        # default config: detection without penalty — scores equal
+        router2 = _fake_router(fakes)
+        router2.probe_once()
+        reps2 = {r.name: r for r in router2._rep_list()}
+        assert router2._score(reps2["slow"], 0.0) \
+            == pytest.approx(router2._score(reps2["r0"], 0.0))
+
+    def test_detection_can_be_disabled(self):
+        fakes = [_FakeReplica(f"r{i}", tpot_p50=0.01) for i in range(4)]
+        fakes.append(_FakeReplica("slow", tpot_p50=0.1))
+        router = _fake_router(fakes, straggler_detection=False)
+        router.probe_once()
+        assert not any(r["straggler"] for r in router.replicas())
+
+
+# ---------------------------------------------------------------------------
+# end to end over a real engine (LocalReplica thread-local propagation)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+class TestLocalPropagation:
+    def test_request_adopts_propagated_trace(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        eng.warmup()
+        eng.start()
+        try:
+            rng = np.random.RandomState(SEED)
+            prompt = rng.randint(1, cfg.vocab_size, 6).astype("int32")
+            tid = fleet.attempt_trace_id(12345, 1)
+            with tracing.trace_context(tid):
+                req = eng.submit(prompt, max_new_tokens=4)
+            assert req.trace == tid
+            req.result(timeout=60.0)
+            names = {e["name"] for e in tracing.events(trace=tid)}
+            assert "request" in names  # the root span joined the id
+            # no context: the request traces under its own local id
+            req2 = eng.submit(prompt, max_new_tokens=2)
+            assert req2.trace == req2.id
+            req2.result(timeout=60.0)
+        finally:
+            eng.stop()
+
+    def test_router_merged_trace_single_attempt(self, tiny_model):
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        eng.warmup()
+        router = serving.Router([eng])
+        try:
+            rng = np.random.RandomState(SEED)
+            prompt = rng.randint(1, cfg.vocab_size, 6).astype("int32")
+            rr = router.submit(prompt, max_new_tokens=4)
+            rr.result(timeout=60.0)
+            assert rr.status == serving.RequestStatus.COMPLETED
+            merged = router.merged_trace(rr.id)
+            assert merged is not None
+            json.loads(json.dumps(merged))
+            lanes = [ev["args"]["name"] for ev in merged["traceEvents"]
+                     if ev.get("ph") == "M"
+                     and ev["name"] == "process_name"]
+            assert f"router request {rr.id}" in lanes
+            assert any(l.startswith("attempt 1 ") for l in lanes)
+            spans = {ev["name"] for ev in merged["traceEvents"]
+                     if ev.get("ph") == "X"}
+            assert {"router.request", "router.attempt",
+                    "request"} <= spans
+            assert router.merged_trace(10 ** 9) is None  # unknown id
+            # SLO tracker saw the terminal request
+            assert router.slo_report()["observed"] >= 1
+        finally:
+            router.stop()

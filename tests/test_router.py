@@ -209,6 +209,66 @@ class TestCrashFailover:
         finally:
             router.stop(drain=True, timeout_s=10)
 
+    def test_crash_failover_merged_trace(self, tiny_model):
+        """The fleet-trace acceptance: kill r0 mid-decode, then ask the
+        router for ONE merged catapult file of a displaced request. It
+        must carry the router's own lane plus a swimlane per attempt —
+        attempt 1 on the dead replica, attempt 2 on the survivor — as
+        loadable JSON with attempt spans nested inside the root span."""
+        model, cfg = tiny_model
+        e1, e2 = _engine(model), _engine(model)
+        cfgr = serving.RouterConfig(probe_failures_to_eject=2,
+                                    max_retries_per_request=2,
+                                    unroutable_timeout_s=10.0)
+        router = serving.Router([e1, e2], cfgr)
+        monkey = serving.ChaosEngine(e1).crash_after_steps(2)
+        rng = np.random.RandomState(SEED + 21)
+        prompts = [_prompt(rng, cfg, 4 + i) for i in range(6)]
+        try:
+            rrs = [router.submit(p, max_new_tokens=8) for p in prompts]
+            _drive(router, rrs)
+            assert monkey.injected["crash"] == 1
+            assert all(rr.status == serving.RequestStatus.COMPLETED
+                       for rr in rrs)
+            displaced = [rr for rr in rrs if rr.retries >= 1]
+            assert displaced  # the crash took someone's first attempt
+            rr = displaced[0]
+            merged = router.merged_trace(rr.id)
+            assert merged is not None
+            merged = json.loads(json.dumps(merged))  # loadable JSON
+            lanes = {ev["args"]["name"]: ev["pid"]
+                     for ev in merged["traceEvents"]
+                     if ev.get("ph") == "M"
+                     and ev["name"] == "process_name"}
+            # router lane + one swimlane per attempt
+            assert f"router request {rr.id}" in lanes
+            attempt_lanes = [n for n in lanes if n.startswith("attempt ")]
+            assert len(attempt_lanes) >= 2
+            assert any("[r0]" in n for n in attempt_lanes)
+            assert any("[r1]" in n for n in attempt_lanes)
+            # each attempt lane carries the replica-side request span
+            by_pid = {}
+            for ev in merged["traceEvents"]:
+                if ev.get("ph") == "X":
+                    by_pid.setdefault(ev["pid"], []).append(ev)
+            for name in attempt_lanes:
+                spans = {e["name"] for e in by_pid.get(lanes[name], [])}
+                assert "request" in spans, (name, spans)
+            # monotonic nesting on the router lane: every attempt span
+            # sits inside the root router.request interval
+            rl = by_pid[lanes[f"router request {rr.id}"]]
+            root = next(e for e in rl if e["name"] == "router.request")
+            attempts = [e for e in rl if e["name"] == "router.attempt"]
+            assert len(attempts) == rr.retries + 1
+            for a in attempts:
+                assert a["ts"] >= root["ts"]
+                assert a["ts"] + a["dur"] <= root["ts"] + root["dur"]
+            # attempt trace ids are distinct per retry (one swimlane
+            # each, never merged into one)
+            assert len(set(attempt_lanes)) == len(attempt_lanes)
+        finally:
+            router.stop(drain=True, timeout_s=10)
+
     def test_all_replicas_dead_fails_explicitly(self, tiny_model):
         """One replica, crashed: the request fails with an actionable
         routing error (bounded by unroutable_timeout_s) — it does NOT
@@ -676,6 +736,94 @@ class TestRouterHTTP:
             np.testing.assert_array_equal(np.asarray(rr.result(1.0)), ref)
             assert rr.replica == "remote0"
         finally:
+            esrv.stop()
+            eng.stop()
+            router.stop()
+
+    def test_fleet_endpoints(self, tiny_model):
+        """Router GET /metrics federates every replica's series under
+        replica=<name> labels plus replica="fleet" roll-ups; GET /slo
+        reports the burn-rate verdict; GET /trace?request= returns the
+        merged catapult file (404 for unknown ids, 400 without one)."""
+        from paddle_tpu.observability.exporters import parse_prometheus_text
+
+        model, cfg = tiny_model
+        e1, e2 = _engine(model), _engine(model)
+        router = serving.Router([e1, e2], stats_refresh_s=0.05)
+        srv = serving.RouterHTTPServer(router, port=0)
+        base = f"http://127.0.0.1:{srv.port}"
+        rng = np.random.RandomState(SEED + 22)
+        p = _prompt(rng, cfg, 5)
+        try:
+            body = json.dumps({"prompt": [int(t) for t in p],
+                               "max_new_tokens": 6}).encode()
+            rec = json.loads(urllib.request.urlopen(
+                urllib.request.Request(f"{base}/generate", data=body),
+                timeout=60).read())
+            assert rec["status"] == "completed"
+            time.sleep(0.1)  # let the staleness window lapse
+
+            resp = urllib.request.urlopen(f"{base}/metrics", timeout=10)
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            fams = parse_prometheus_text(resp.read().decode())
+            reqs = fams["paddle_tpu_serving_requests_total"]["samples"]
+            reps = {s["labels"].get("replica") for s in reqs}
+            assert {"r0", "r1", "fleet"} <= reps
+            assert "paddle_tpu_fleet_scrape_age_seconds" in fams
+
+            slo = json.loads(urllib.request.urlopen(
+                f"{base}/slo", timeout=10).read())
+            assert slo["ok"] is True and slo["observed"] >= 1
+            assert set(slo["objectives"]) == {"availability", "goodput",
+                                              "ttft_p95"}
+
+            merged = json.loads(urllib.request.urlopen(
+                f"{base}/trace?request={rec['request_id']}",
+                timeout=10).read())
+            lanes = [ev["args"]["name"] for ev in merged["traceEvents"]
+                     if ev.get("ph") == "M"
+                     and ev["name"] == "process_name"]
+            assert f"router request {rec['request_id']}" in lanes
+            assert any(n.startswith("attempt 1 ") for n in lanes)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/trace?request=999999",
+                                       timeout=10)
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/trace", timeout=10)
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+            router.stop(drain=True, timeout_s=10)
+
+    def test_hostile_traceparent_never_errors(self, tiny_model):
+        """Malformed traceparent headers on the routed /generate path
+        cost nothing: the request completes 200 with a fresh local
+        trace — never a 400/500."""
+        model, cfg = tiny_model
+        eng = _engine(model)
+        esrv = serving.ServingHTTPServer(eng, port=0)
+        hr = serving.HTTPReplica(f"http://127.0.0.1:{esrv.port}",
+                                 name="remote0")
+        router = serving.Router([hr])
+        srv = serving.RouterHTTPServer(router, port=0)
+        rng = np.random.RandomState(SEED + 23)
+        p = _prompt(rng, cfg, 4)
+        hostile = ["", "garbage", "00-zz-11-01", "00-" + "0" * 32 + "-"
+                   + "0" * 16 + "-01", "01-" + "ab" * 16 + "-" + "cd" * 8
+                   + "-01", "x" * 512]
+        try:
+            for header in hostile:
+                body = json.dumps({"prompt": [int(t) for t in p],
+                                   "max_new_tokens": 2}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/generate", data=body,
+                    headers={"traceparent": header})
+                resp = urllib.request.urlopen(req, timeout=60)
+                assert resp.status == 200
+                assert json.loads(resp.read())["status"] == "completed"
+        finally:
+            srv.stop()
             esrv.stop()
             eng.stop()
             router.stop()

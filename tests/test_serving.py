@@ -308,6 +308,74 @@ class TestHTTPFrontends:
             serving.stop_serving_http_server()
             eng.stop()
 
+    def test_traceparent_propagation_and_metrics(self, tiny_model):
+        """A valid traceparent header lands the request's span tree
+        under the propagated trace id (the router's merge depends on
+        it); GET /metrics serves a parseable Prometheus exposition —
+        the scrape target of the router's federation."""
+        from paddle_tpu.observability import fleet, tracing
+        from paddle_tpu.observability.exporters import parse_prometheus_text
+
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        rng = np.random.RandomState(59)
+        p = _prompt(rng, cfg, 5)
+        srv = serving.ServingHTTPServer(eng, port=0)
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            tid = fleet.attempt_trace_id(4242, 1)
+            body = json.dumps({"prompt": [int(t) for t in p],
+                               "max_new_tokens": 4}).encode()
+            rec = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/generate", data=body,
+                    headers={"traceparent": fleet.traceparent_of(tid)}),
+                timeout=60).read())
+            assert rec["status"] == "completed"
+            names = {e["name"] for e in tracing.events(trace=tid)}
+            assert "request" in names  # replica spans joined the id
+
+            resp = urllib.request.urlopen(f"{base}/metrics", timeout=10)
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            fams = parse_prometheus_text(resp.read().decode())
+            assert "paddle_tpu_serving_requests_total" in fams
+            assert fams["paddle_tpu_serving_ttft_summary_seconds"][
+                "type"] == "summary"
+        finally:
+            srv.stop()
+            eng.stop()
+
+    def test_hostile_traceparent_ignored_never_4xx5xx(self, tiny_model):
+        """Malformed traceparent headers are ignored (fresh local
+        trace): the request still completes 200 — a hostile header must
+        never cost the caller their request."""
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        rng = np.random.RandomState(61)
+        p = _prompt(rng, cfg, 4)
+        srv = serving.ServingHTTPServer(eng, port=0)
+        hostile = ["", " ", "garbage", "00", "00-", "00-ab-cd-01",
+                   "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+                   "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",
+                   "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",
+                   "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",
+                   "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extra",
+                   "\x01\x02bin", "0" * 2048]
+        try:
+            for header in hostile:
+                body = json.dumps({"prompt": [int(t) for t in p],
+                                   "max_new_tokens": 2}).encode()
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{srv.port}/generate", data=body,
+                        headers={"traceparent": header}),
+                    timeout=60)
+                assert resp.status == 200, header
+                assert json.loads(resp.read())["status"] == "completed"
+        finally:
+            srv.stop()
+            eng.stop()
+
     def test_serving_http_stream(self, tiny_model):
         model, cfg = tiny_model
         eng = serving.ServingEngine(model, max_slots=1, max_len=64)
