@@ -1,0 +1,294 @@
+"""Hierarchical-KV tier lane: recompute-elimination A/B + restart.
+
+Three workloads, each run with the host tier OFF and ON against the
+SAME (deliberately small) device block pool, so prefix-cache eviction
+pressure is real and the tier is what decides whether evicted work is
+recomputed or re-admitted:
+
+1. **Long conversation** — the tentpole claim (the CachedAttention /
+   Mooncake workload). One multi-turn conversation whose context grows
+   every turn; between turns the prefix cache is LRU-rolled (the
+   deterministic stand-in for the tenant traffic that evicts idle
+   conversations in production). Tier OFF, every turn re-prefills the
+   entire history; tier ON, the evicted blocks demote to host RAM and
+   the next turn re-admits them via the jitted splice. The bench
+   measures RECOMPUTE prefill tokens per turn — computed tokens (the
+   engine's ``prompt`` counter; cached/tier-readmitted tokens never
+   hit it) minus the turn's genuinely-new tokens (last reply + new
+   user turn), which no tier can eliminate — and asserts the tier
+   eliminates **>= 80%** of the recompute, at bit parity (greedy and
+   sampled) with the tier-off outputs.
+2. **Many tenants** — N tenants with private system prefixes take
+   turns; the pool only holds a few of them at once. Same metric, same
+   parity oracle: the tier turns tenant-return recompute into
+   re-admission.
+3. **Restart** — a conversation runs, the engine stops (drain flushes
+   the host tier through the atomic-commit disk store), a NEW engine on
+   the same ``kv_tier_path`` continues it: the follow-up turn re-admits
+   from DISK and its output bit-matches the uninterrupted run.
+
+The exit code enforces parity on every lane, the >= 80% long-
+conversation saving, >0 disk readmits after restart, and ZERO retraces
+of the four serving executables (step / prefill_chunk / kv_demote /
+kv_splice) across all lanes.
+
+Artifact: ``benchmarks/bench_kv_tier.json``; ``tests/run_shards.py``
+folds it into ``telemetry_lane.json`` as the ``kv_tier_bench`` block
+(both lanes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import recompile
+from paddle_tpu.serving import metrics as _sm
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+MODEL_KW = dict(hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, vocab_size=1024,
+                max_position_embeddings=256)
+
+MAX_LEN = 224
+BLOCK_SIZE = 8
+# small on purpose: ~2 conversations' worth of blocks, so filler
+# traffic between turns ALWAYS evicts the conversation's prefix
+NUM_BLOCKS = 56
+HOST_BLOCKS = 512           # the host tier holds everything evicted
+
+TURNS = 5
+TURN_USER_TOKENS = 12       # new user tokens per turn
+TURN_REPLY_TOKENS = 8       # generated reply folded into the context
+
+TENANTS = 8
+TENANT_PREFIX = 56
+TENANT_TURNS = 2
+SEED = 20240806
+
+
+def _engine(model, *, tier, path=None, num_blocks=NUM_BLOCKS):
+    eng = serving.ServingEngine(
+        model, max_slots=4, max_len=MAX_LEN, block_size=BLOCK_SIZE,
+        num_blocks=num_blocks, kv_tier=tier, kv_tier_path=path,
+        kv_tier_host_blocks=HOST_BLOCKS)
+    eng.warmup()
+    return eng
+
+
+def _counters():
+    return {
+        "prompt": _sm.tokens_total.labels("prompt").value(),
+        "cached": _sm.tokens_total.labels("prompt_cached").value(),
+        "tier": _sm.tokens_total.labels("prompt_tier").value(),
+    }
+
+
+def _delta(before):
+    after = _counters()
+    return {k: after[k] - before[k] for k in before}
+
+
+def _run(eng, prompt, *, sampled, seed, max_new):
+    params = dict(max_new_tokens=max_new, seed=seed)
+    if sampled:
+        params.update(do_sample=True, temperature=0.8, top_k=16)
+    req = eng.submit(np.asarray(prompt, np.int32), **params)
+    eng.run_until_idle(max_steps=20000)
+    assert req.status == serving.RequestStatus.COMPLETED, req.status
+    return list(np.asarray(req.result(timeout=10.0)))
+
+
+def run_long_conversation(model, *, tier, path=None):
+    eng = _engine(model, tier=tier, path=path)
+    rng = np.random.RandomState(SEED)
+    ctx = list(rng.randint(1, MODEL_KW["vocab_size"], 24))
+    before = _counters()
+    outs = []
+    computed, recompute = 0.0, 0.0
+    t0 = time.perf_counter()
+    prev_len = 0
+    for turn in range(TURNS):
+        ctx += list(rng.randint(1, MODEL_KW["vocab_size"],
+                                TURN_USER_TOKENS))
+        t_before = _counters()
+        reply = _run(eng, ctx, sampled=bool(turn % 2), seed=turn,
+                     max_new=TURN_REPLY_TOKENS)
+        turn_computed = _delta(t_before)["prompt"]
+        new_tokens = len(ctx) - prev_len  # last reply + this user turn
+        computed += turn_computed
+        recompute += max(0.0, turn_computed - new_tokens)
+        prev_len = len(ctx)
+        outs.append(reply)
+        ctx += reply
+        # roll the LRU cache: what production tenant churn does between
+        # a conversation's turns (tier off: the work is gone; tier on:
+        # every evicted block demotes through the on_evict hook)
+        eng.prefix_cache.evict(eng.pool.num_blocks)
+    wall = time.perf_counter() - t0
+    toks = _delta(before)
+    st = eng.stats()
+    eng.stop()
+    return {
+        "tier": tier,
+        "turns": TURNS,
+        "wall_s": round(wall, 3),
+        "prefill_tokens_computed": toks["prompt"],
+        "recompute_prefill_tokens": recompute,
+        "prefix_cached_tokens": toks["cached"],
+        "tier_readmitted_tokens": toks["tier"],
+        "kv_tier": st.get("kv_tier"),
+    }, outs, ctx
+
+
+def run_many_tenants(model, *, tier):
+    eng = _engine(model, tier=tier)
+    rng = np.random.RandomState(SEED + 1)
+    prefixes = [list(rng.randint(1, MODEL_KW["vocab_size"], TENANT_PREFIX))
+                for _ in range(TENANTS)]
+    before = _counters()
+    outs = []
+    t0 = time.perf_counter()
+    for rnd in range(TENANT_TURNS):
+        for t, pfx in enumerate(prefixes):
+            tail = list(rng.randint(1, MODEL_KW["vocab_size"], 6))
+            outs.append(_run(eng, pfx + tail, sampled=bool(t % 2),
+                             seed=rnd * TENANTS + t, max_new=6))
+    wall = time.perf_counter() - t0
+    toks = _delta(before)
+    st = eng.stats()
+    eng.stop()
+    return {
+        "tier": tier,
+        "tenants": TENANTS,
+        "rounds": TENANT_TURNS,
+        "wall_s": round(wall, 3),
+        "prefill_tokens_computed": toks["prompt"],
+        "prefix_cached_tokens": toks["cached"],
+        "tier_readmitted_tokens": toks["tier"],
+        "kv_tier": st.get("kv_tier"),
+    }, outs
+
+
+def run_restart(model, tmp):
+    """Conversation -> stop (disk flush) -> NEW engine, same path ->
+    the follow-up turn re-admits from disk; output bit-matches the
+    same turn on an uninterrupted engine."""
+    rng = np.random.RandomState(SEED + 2)
+    ctx = list(rng.randint(1, MODEL_KW["vocab_size"], 40))
+    follow = list(rng.randint(1, MODEL_KW["vocab_size"], 8))
+
+    # uninterrupted reference (tier off: pure recompute semantics)
+    eng = _engine(model, tier=False)
+    _run(eng, ctx, sampled=False, seed=0, max_new=6)
+    ref = _run(eng, ctx + follow, sampled=True, seed=1, max_new=8)
+    eng.stop()
+
+    path = os.path.join(tmp, "tier")
+    eng1 = _engine(model, tier=True, path=path)
+    _run(eng1, ctx, sampled=False, seed=0, max_new=6)
+    eng1.stop()                       # drain flush -> committed entries
+
+    eng2 = _engine(model, tier=True, path=path)
+    before = _counters()
+    out = _run(eng2, ctx + follow, sampled=True, seed=1, max_new=8)
+    toks = _delta(before)
+    st = eng2.stats()["kv_tier"]
+    eng2.stop()
+    return {
+        "disk_entries_found": st["disk"]["entries"],
+        "disk_loads": st["disk"]["loads"],
+        "tier_readmitted_tokens": toks["tier"],
+        "prefill_tokens_computed": toks["prompt"],
+        "parity": out == ref,
+    }
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(**MODEL_KW)
+    model = LlamaForCausalLM(cfg)
+
+    stats0 = {k: dict(v) for k, v in recompile.entry_stats().items()}
+
+    lc_off, lc_outs_off, _ = run_long_conversation(model, tier=False)
+    lc_on, lc_outs_on, _ = run_long_conversation(model, tier=True)
+    mt_off, mt_outs_off = run_many_tenants(model, tier=False)
+    mt_on, mt_outs_on = run_many_tenants(model, tier=True)
+    tmp = tempfile.mkdtemp(prefix="bench_kv_tier_")
+    try:
+        restart = run_restart(model, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    saved_lc = 1.0 - (lc_on["recompute_prefill_tokens"]
+                      / max(1.0, lc_off["recompute_prefill_tokens"]))
+    saved_mt = 1.0 - (mt_on["prefill_tokens_computed"]
+                      / max(1, mt_off["prefill_tokens_computed"]))
+    speedup_lc = lc_off["wall_s"] / max(1e-9, lc_on["wall_s"])
+
+    stats1 = recompile.entry_stats()
+    retraces = {
+        name: stats1[name]["retraces"]
+        - stats0.get(name, {}).get("retraces", 0)
+        for name in ("serving.step", "serving.prefill_chunk",
+                     "serving.kv_demote", "serving.kv_splice")
+        if name in stats1}
+
+    verdicts = {
+        "longconv_saved_ge_80pct": saved_lc >= 0.80,
+        "parity_longconv": lc_outs_off == lc_outs_on,
+        "parity_many_tenant": mt_outs_off == mt_outs_on,
+        "restart_parity": restart["parity"],
+        "restart_disk_readmit": restart["disk_loads"] > 0
+        and restart["tier_readmitted_tokens"] > 0,
+        "zero_retrace": all(v == 0 for v in retraces.values())
+        and "serving.kv_splice" in retraces,
+    }
+    result = {
+        "bench": "kv_tier",
+        "platform": jax.default_backend(),
+        "model": {"family": "llama", **MODEL_KW},
+        "pool": {"num_blocks": NUM_BLOCKS, "block_size": BLOCK_SIZE,
+                 "host_blocks": HOST_BLOCKS},
+        "long_conversation": {
+            "off": lc_off, "on": lc_on,
+            "saved_frac": round(saved_lc, 4),
+            "readmit_speedup": round(speedup_lc, 3)},
+        "many_tenant": {
+            "off": mt_off, "on": mt_on,
+            "saved_frac": round(saved_mt, 4)},
+        "restart": restart,
+        "retraces": retraces,
+        "parity_all": bool(verdicts["parity_longconv"]
+                           and verdicts["parity_many_tenant"]
+                           and verdicts["restart_parity"]),
+        "verdicts": verdicts,
+    }
+    path = os.path.join(HERE, "bench_kv_tier.json")
+    with open(path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result, indent=1))
+    print(f"[bench_kv_tier] artifact -> {path}")
+    ok = all(verdicts.values())
+    if not ok:
+        print("[bench_kv_tier] ACCEPTANCE FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,13 +46,23 @@ except ImportError:  # older jax: no jax.memory module. The in-jit
         Host = TransferToMemoryKind("pinned_host")
 from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
 
-__all__ = ["HostOffloadAdamW", "host_sharding", "supports_inline_transfers"]
+__all__ = ["HostOffloadAdamW", "host_sharding", "host_memory_kind",
+           "device_memory_kind", "supports_inline_transfers"]
 
 
-def _host_memory_kind() -> str:
-    """"pinned_host" where the backend exposes it (TPU; newer CPU jax),
-    else the device's host-most kind (older XLA:CPU only advertises
-    "unpinned_host" — functionally the same host residency for tests)."""
+def host_memory_kind() -> str:
+    """The backend's host-RAM memory kind for ``device_put`` /
+    ``with_memory_kind`` placement — the public discovery helper for
+    anything that parks arrays in host memory next to the device
+    (optimizer-state offload here; the serving KV tier's pinned-host
+    residency planning).
+
+    Returns ``"pinned_host"`` where the backend exposes it (TPU; newer
+    CPU jax). Backends without it degrade rather than fail: older
+    XLA:CPU only advertises ``"unpinned_host"`` (functionally the same
+    host residency), and a backend with a single memory space falls all
+    the way back to the device's default kind — so the helper always
+    returns a placeable kind, never raises."""
     kinds = [m.kind for m in jax.devices()[0].addressable_memories()]
     if "pinned_host" in kinds:
         return "pinned_host"
@@ -62,11 +72,19 @@ def _host_memory_kind() -> str:
     return jax.devices()[0].default_memory().kind
 
 
-def _device_memory_kind() -> str:
-    """"device" where the backend has distinct device memory (TPU);
-    older XLA:CPU has a single host memory — use its default kind."""
+def device_memory_kind() -> str:
+    """The backend's fast (HBM) memory kind — ``"device"`` where the
+    backend has distinct device memory (TPU). On single-memory-space
+    backends (older XLA:CPU) this equals :func:`host_memory_kind`'s
+    fallback: both name the one default space, which is what makes the
+    offload paths no-op-safe there."""
     kinds = [m.kind for m in jax.devices()[0].addressable_memories()]
     return "device" if "device" in kinds else jax.devices()[0].default_memory().kind
+
+
+# internal/back-compat aliases (sharding.py and older callers)
+_host_memory_kind = host_memory_kind
+_device_memory_kind = device_memory_kind
 
 
 def host_sharding(sharding=None):

@@ -699,6 +699,11 @@ BENCH_METRIC_SOURCES = {
                                   "fleet_overhead.overhead_pct"),
     "router.crash_completed_frac": ("bench_router.json",
                                     "crash.completed_frac"),
+    "kv_tier.saved_frac_longconv": ("bench_kv_tier.json",
+                                    "long_conversation.saved_frac"),
+    "kv_tier.readmit_speedup": ("bench_kv_tier.json",
+                                "long_conversation.readmit_speedup"),
+    "kv_tier.parity": ("bench_kv_tier.json", "parity_all"),
     "tp.tp2_tok_s": ("bench_tp.json", "lanes.tp2.tok_s"),
     "tp.parity": ("bench_tp.json", "parity_all"),
     "tp.weight_hbm_frac_tp2": ("bench_tp.json",

@@ -26,7 +26,18 @@ static-shape decode substrate:
                   moves throughput.
 - ``block_pool``: host-side KV block allocator (free list + refcounts,
                   exhaustion/double-free errors, fragmentation stats)
-                  and the exact-prefix LRU cache behind prefix sharing.
+                  and the exact-prefix LRU cache behind prefix sharing
+                  (eviction-callback hook feeding the tier below).
+- ``kv_tier``:    hierarchical KV under the block pool
+                  (``ServingConfig(kv_tier=True)``): prefix-cache
+                  eviction victims and preempted requests' blocks
+                  demote device->host instead of being freed, a
+                  returning prefix re-admits via one jitted host->HBM
+                  splice instead of prefill chunks (cost model:
+                  transfer bytes vs the perf ledger's measured
+                  recompute rate), and an optional disk tier
+                  (``kv_tier_path``) persists the prefix cache across
+                  engine restarts with atomic-commit crash safety.
 - ``scheduler``:  FCFS admission, max-queue-depth backpressure
                   (``QueueFullError``), deadlines, cancellation,
                   front-of-queue requeue for preempted requests.
@@ -73,6 +84,7 @@ from .engine import (EngineDrainingError, EngineStoppedError, ServingConfig,
                      ServingEngine)
 from .http import (ServingHTTPServer, start_serving_http_server,
                    stop_serving_http_server)
+from .kv_tier import DiskPrefixStore, KVTier, TierCostModel
 from .request import Request, RequestStatus, SamplingParams
 from .router import (HTTPReplica, LocalReplica, NoReplicaError, ReplicaState,
                      Router, RouterConfig, RouterRequest)
@@ -85,6 +97,7 @@ __all__ = [
     "RequestStatus", "Scheduler", "QueueFullError",
     "EngineStoppedError", "EngineDrainingError",
     "BlockPool", "PrefixCache", "PoolExhaustedError", "BlockPoolError",
+    "KVTier", "TierCostModel", "DiskPrefixStore",
     "ServingHTTPServer", "start_serving_http_server",
     "stop_serving_http_server",
     "Router", "RouterConfig", "RouterRequest", "ReplicaState",

@@ -238,6 +238,14 @@ class PrefixCache:
         self._lock = threading.Lock()
         self.hits = 0       # block-level hit/miss tallies (also metrics)
         self.misses = 0
+        # Eviction hook: called as on_evict(key, block_id, covered_end)
+        # BEFORE the block is released, returning "demoted" when it
+        # copied the KV somewhere (the hierarchical host tier rides
+        # this, serving/kv_tier.py) or "dropped" to free outright. None
+        # (the default) keeps the legacy drop-on-evict behavior. A
+        # raising hook counts as "dropped": eviction must reclaim
+        # blocks even when the tier misbehaves.
+        self.on_evict = None
 
     @staticmethod
     def _key(tokens: np.ndarray, end: int) -> bytes:
@@ -317,12 +325,22 @@ class PrefixCache:
             for key in list(self._map.keys()):
                 if freed >= n:
                     break
-                bid, _ = self._map[key]
+                bid, end = self._map[key]
                 if self.pool.ref(bid) == 1:  # cache holds the only ref
                     del self._map[key]
+                    outcome = "dropped"
+                    if self.on_evict is not None:
+                        # the block is still live (our ref) — the hook
+                        # may copy it device->host before the decref
+                        # below hands it back to the pool
+                        try:
+                            if self.on_evict(key, bid, end) == "demoted":
+                                outcome = "demoted"
+                        except Exception:  # noqa: BLE001 — see __init__
+                            pass
                     self.pool.decref(bid)
                     freed += 1
-                    _sm.prefix_cache_evictions.inc()
+                    _sm.prefix_cache_evictions.labels(outcome).inc()
         return freed
 
     def forget(self, block_id: int) -> None:
@@ -333,6 +351,13 @@ class PrefixCache:
                         if b == block_id]:
                 del self._map[key]
                 self.pool.decref(block_id)
+
+    def entries(self) -> List[Tuple[bytes, int, int]]:
+        """Consistent ``(key, block_id, covered_end)`` snapshot in LRU
+        order (oldest first) — the drain-time tier flush walks this to
+        persist every still-cached prefix."""
+        with self._lock:
+            return [(k, bid, end) for k, (bid, end) in self._map.items()]
 
     def stats(self) -> dict:
         with self._lock:

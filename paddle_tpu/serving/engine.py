@@ -76,6 +76,7 @@ it.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 import weakref
@@ -94,7 +95,9 @@ from ..observability import recompile as _recompile
 from ..observability import tracing as _trace
 from ..observability.recompile import entrypoint as _entrypoint
 from . import metrics as _sm
-from .block_pool import BlockPool, PoolExhaustedError, PrefixCache
+from .block_pool import (DUMP_BLOCK, BlockPool, PoolExhaustedError,
+                         PrefixCache)
+from .kv_tier import DiskPrefixStore, KVTier, TierCostModel
 from .request import Request, RequestStatus, SamplingParams
 from .scheduler import Scheduler
 
@@ -180,6 +183,19 @@ class ServingConfig:
       bit-identical to the tp=1 engine (greedy and sampled, spec and
       preemption lanes included); requires ``kv_mode="paged"`` and a
       model whose heads/kv-heads/intermediate/vocab divide by tp.
+    - ``kv_tier``: hierarchical KV (``serving/kv_tier.py``) — prefix-
+      cache eviction victims and preempted requests' blocks DEMOTE to a
+      host-RAM tier (device->host at quantized width) instead of being
+      freed, and a returning prefix re-admits via one jitted host->HBM
+      block splice instead of prefill chunks. Defaults from the
+      ``PADDLE_TPU_KV_TIER`` env var ("1" enables); requires paged mode
+      with prefix caching. Outputs stay bit-identical tier-on vs
+      tier-off. ``kv_tier_host_blocks`` caps host residency (LRU);
+      ``kv_tier_path`` (env ``PADDLE_TPU_KV_TIER_PATH``) adds the
+      crash-safe disk tier below host, making cached prefixes persist
+      across engine restarts; ``kv_tier_host_gbps`` (env
+      ``PADDLE_TPU_KV_TIER_HOST_GBPS``) and ``kv_tier_safety`` feed the
+      demote-vs-drop / readmit-vs-recompute cost model.
     """
 
     max_slots: int = 4
@@ -207,6 +223,14 @@ class ServingConfig:
     # exactly like this, and without the detector it is invisible (the
     # loop thread is stuck, but every state read still says "ok")
     stall_timeout_s: float = 10.0
+    # hierarchical KV tiers (host RAM + optional persistent disk under
+    # the block pool); None resolves from the environment in
+    # __post_init__ so a deployment can flip the tier on without code
+    kv_tier: Optional[bool] = None
+    kv_tier_host_blocks: int = 256
+    kv_tier_path: Optional[str] = None
+    kv_tier_host_gbps: Optional[float] = None
+    kv_tier_safety: float = 1.5
 
     def __post_init__(self):
         if self.kv_mode not in ("paged", "contiguous"):
@@ -263,6 +287,38 @@ class ServingConfig:
                     f"num_blocks ({self.num_blocks}) must be >= 2: block 0 "
                     f"is the reserved dump block, so at least one usable "
                     f"block is needed")
+        # hierarchical KV: env-resolved defaults, then validation
+        if self.kv_tier is None:
+            self.kv_tier = os.environ.get("PADDLE_TPU_KV_TIER", "") \
+                not in ("", "0", "false", "False")
+        self.kv_tier = bool(self.kv_tier)
+        if self.kv_tier_path is None:
+            self.kv_tier_path = \
+                os.environ.get("PADDLE_TPU_KV_TIER_PATH") or None
+        if self.kv_tier_host_gbps is None:
+            self.kv_tier_host_gbps = float(
+                os.environ.get("PADDLE_TPU_KV_TIER_HOST_GBPS", "12.0"))
+        if self.kv_tier:
+            if self.kv_mode != "paged":
+                raise ValueError(
+                    "kv_tier=True requires kv_mode='paged': the host/disk "
+                    "tiers hold demoted POOL BLOCKS and re-admit them "
+                    "through the block tables — switch kv_mode to 'paged' "
+                    "or drop kv_tier")
+            if not self.prefix_caching:
+                raise ValueError(
+                    "kv_tier=True requires prefix_caching=True: tier "
+                    "entries are keyed by the prefix cache's exact-token "
+                    "keys and re-admission extends prefix matches — "
+                    "enable prefix_caching or drop kv_tier")
+            if self.kv_tier_host_blocks < 1:
+                raise ValueError(
+                    f"kv_tier_host_blocks ({self.kv_tier_host_blocks}) "
+                    f"must be >= 1")
+            if self.kv_tier_host_gbps <= 0 or self.kv_tier_safety <= 0:
+                raise ValueError(
+                    f"kv_tier_host_gbps ({self.kv_tier_host_gbps}) and "
+                    f"kv_tier_safety ({self.kv_tier_safety}) must be > 0")
 
     def validate_draft(self, model_config, draft_config):
         """Speculative-lane compatibility checks between the target and
@@ -468,6 +524,7 @@ class ServingEngine:
         run = make_cached_runner(model)
         self._run = run
 
+        self._tier: Optional[KVTier] = None  # set by _init_paged(kv_tier)
         if self.paged:
             self._init_paged(B, run)
         else:
@@ -576,6 +633,8 @@ class ServingEngine:
         warm = ["serving.step", "serving.prefill_chunk", "serving.cow"]
         if self.spec:
             warm += ["serving.spec_draft", "serving.spec_verify"]
+        if config.kv_tier:
+            warm += ["serving.kv_demote", "serving.kv_splice"]
         _recompile.reset_warmup(*warm)
         if self.spec:
             # the draft model's KV pools mirror the target's block
@@ -715,6 +774,8 @@ class ServingEngine:
         _recompile.register_entry_location("serving.step", _step)
         _recompile.register_entry_location("serving.prefill_chunk", _chunk)
         _recompile.register_entry_location("serving.cow", _cow)
+        if config.kv_tier:
+            self._init_kv_tier(pool_keys, _wrap, rep, pool_sh)
         if self.spec:
             self._init_spec(B, run)
         if self._tp > 1:
@@ -725,6 +786,210 @@ class ServingEngine:
             from ..observability import perf as _perf
             for e in warm:
                 _perf.note_entry_mesh(e, {"tp": self._tp})
+
+    # -- hierarchical KV: host/disk tiers under the pool ---------------------
+    def _init_kv_tier(self, pool_keys, _wrap, rep, pool_sh):
+        """Two more one-compile executables plus the host-side tier
+        state machine (``serving/kv_tier.py``):
+
+        - ``serving.kv_demote``: gather ONE block's rows out of every
+          pool (target + draft + int8/fp8 scale companions) — the
+          device half of a device->host demotion. ``src`` is traced, so
+          every demotion shares the executable.
+        - ``serving.kv_splice``: scatter a demoted block's payload back
+          into pool block ``dst`` (donated pools, traced ``dst``) — the
+          re-admission that replaces that block's prefill chunks.
+
+        Both run under jit with the same explicit-sharding wrapper as
+        the other executables at tp>1 (payloads replicate; the pool
+        sides keep the kv-heads sharding), so the zero-retrace
+        invariant holds with tiering ON.
+        """
+        config = self.config
+        spec = self.spec
+        dpool_sh = self._tp_dpool_sh if (spec and rep is not None) else None
+
+        if spec:
+            def _kv_extract(pools, dpools, src):
+                return ([{kk: c[kk][src] for kk in pool_keys}
+                         for c in pools],
+                        [{kk: c[kk][src] for kk in c} for c in dpools])
+
+            def _kv_splice(pools, dpools, pay, dpay, dst):
+                return ([{kk: c[kk].at[dst].set(pay[li][kk])
+                          for kk in c} for li, c in enumerate(pools)],
+                        [{kk: c[kk].at[dst].set(dpay[li][kk])
+                          for kk in c} for li, c in enumerate(dpools)])
+
+            ex_t = [{kk: rep for kk in pool_keys} for _ in self._pools]
+            ex_d = [{kk: rep for kk in c} for c in self._dpools]
+            _kv_extract = _wrap(_kv_extract, (),
+                                (pool_sh, dpool_sh, rep), (ex_t, ex_d))
+            _kv_splice = _wrap(_kv_splice, (0, 1),
+                               (pool_sh, dpool_sh, ex_t, ex_d, rep),
+                               (pool_sh, dpool_sh))
+        else:
+            def _kv_extract(pools, src):
+                return [{kk: c[kk][src] for kk in pool_keys}
+                        for c in pools]
+
+            def _kv_splice(pools, pay, dst):
+                return [{kk: c[kk].at[dst].set(pay[li][kk]) for kk in c}
+                        for li, c in enumerate(pools)]
+
+            ex_t = [{kk: rep for kk in pool_keys} for _ in self._pools]
+            _kv_extract = _wrap(_kv_extract, (), (pool_sh, rep), ex_t)
+            _kv_splice = _wrap(_kv_splice, (0,), (pool_sh, ex_t, rep),
+                               pool_sh)
+        self._kv_extract_fn = _kv_extract
+        self._kv_splice_fn = _kv_splice
+        _recompile.register_entry_location("serving.kv_demote", _kv_extract)
+        _recompile.register_entry_location("serving.kv_splice", _kv_splice)
+
+        # host bytes one demoted block costs (per-block rows across all
+        # pools at quantized width) — the cost model's transfer size
+        blk = sum(
+            int(np.prod(c[kk].shape[1:], dtype=np.int64))
+            * c[kk].dtype.itemsize
+            for c in self._pools for kk in c)
+        if spec:
+            blk += sum(
+                int(np.prod(c[kk].shape[1:], dtype=np.int64))
+                * c[kk].dtype.itemsize
+                for c in self._dpools for kk in c)
+        self._tier_block_bytes = int(blk)
+
+        def _prefill_rate():
+            from ..observability import perf as _perf
+            row = _perf.ledger_entry("serving.prefill_chunk")
+            return row.get("items_per_s") if row else None
+
+        cost = TierCostModel(host_gbps=config.kv_tier_host_gbps,
+                             safety=config.kv_tier_safety,
+                             prefill_rate_fn=_prefill_rate)
+        disk = None
+        if config.kv_tier_path:
+            # re-admitting a foreign engine's bytes would be silent
+            # corruption — the fingerprint pins everything that shapes
+            # a block's payload or its interpretation
+            disk = DiskPrefixStore(config.kv_tier_path, fingerprint={
+                "kv_format": config.kv_format,
+                "block_size": config.block_size,
+                "bytes_per_token": self._kv_bytes_per_token,
+                "dtype": str(np.dtype(self._dtype)),
+                "spec": spec,
+                "layers": int(self._mcfg.num_hidden_layers),
+            })
+        self._tier = KVTier(host_blocks=config.kv_tier_host_blocks,
+                            block_size=config.block_size, cost=cost,
+                            disk=disk)
+        self.prefix_cache.on_evict = self._on_prefix_evict
+
+    def _tier_extract(self, bid: int) -> dict:
+        """Device->host copy of block ``bid``'s rows across every pool,
+        as the tier's flat ``{"<layer>/<pool-key>": ndarray}`` payload
+        (draft-model rows under ``d<layer>/``)."""
+        t0 = time.perf_counter_ns()
+        src = jnp.asarray(bid, jnp.int32)
+        with _entrypoint("serving.kv_demote"):
+            if self.spec:
+                t, d = self._kv_extract_fn(self._pools, self._dpools, src)
+            else:
+                t, d = self._kv_extract_fn(self._pools, src), None
+        payload = {}
+        for li, c in enumerate(jax.device_get(t)):
+            for kk, arr in c.items():
+                payload[f"{li}/{kk}"] = np.asarray(arr)
+        if d is not None:
+            for li, c in enumerate(jax.device_get(d)):
+                for kk, arr in c.items():
+                    payload[f"d{li}/{kk}"] = np.asarray(arr)
+        t1 = time.perf_counter_ns()
+        _trace.complete("kv_demote", "engine", None, t0, t1 - t0,
+                        {"block": bid})
+        return payload
+
+    def _tier_splice(self, bid: int, payload: dict):
+        """Scatter a demoted payload back into pool block ``bid`` (the
+        host->HBM re-admission; one jitted dispatch)."""
+        t0 = time.perf_counter_ns()
+        dst = jnp.asarray(bid, jnp.int32)
+        pay = [{kk: jnp.asarray(payload[f"{li}/{kk}"])
+                for kk in self._pool_keys}
+               for li in range(len(self._pools))]
+        with _entrypoint("serving.kv_splice"):
+            if self.spec:
+                dkeys = tuple(self._dpools[0].keys())
+                dpay = [{kk: jnp.asarray(payload[f"d{li}/{kk}"])
+                         for kk in dkeys}
+                        for li in range(len(self._dpools))]
+                self._pools, self._dpools = self._kv_splice_fn(
+                    self._pools, self._dpools, pay, dpay, dst)
+            else:
+                self._pools = self._kv_splice_fn(self._pools, pay, dst)
+        t1 = time.perf_counter_ns()
+        _trace.complete("kv_splice", "engine", None, t0, t1 - t0,
+                        {"block": bid})
+
+    def _on_prefix_evict(self, key: bytes, bid: int, end: int) -> str:
+        """PrefixCache eviction hook: copy the victim block down to the
+        host tier when the cost model says the transfer beats the
+        recompute it saves; the cache frees the device block either
+        way."""
+        tier = self._tier
+        if tier is None:
+            return "dropped"
+        if not tier.cost.should_demote(tier.tokens_in_block(end),
+                                       self._tier_block_bytes):
+            return "dropped"
+        tier.put(key, end, self._tier_extract(bid), reason="evict")
+        return "demoted"
+
+    def _demote_slot_blocks(self, slot: int, tokens: np.ndarray,
+                            covered: int):
+        """Preemption-side demotion: the victim slot's PRIVATE blocks
+        (nobody else references them — shared ones survive in the
+        prefix cache) demote to the host tier before ``_clear_slot``
+        frees them, so the preempted request's resume prefill re-admits
+        instead of recomputing."""
+        tier = self._tier
+        if tier is None or covered <= 0:
+            return
+        bs = self.config.block_size
+        for i, bid in enumerate(self._slot_blocks[slot]):
+            end = min((i + 1) * bs, covered)
+            if end <= i * bs:
+                break
+            if self.pool.ref(bid) != 1:
+                continue
+            key = tier.key_of(tokens, end)
+            if tier.has(key):
+                continue
+            if not tier.cost.should_demote(tier.tokens_in_block(end),
+                                           self._tier_block_bytes):
+                continue
+            tier.put(key, end, self._tier_extract(bid), reason="preempt")
+
+    def _flush_tier(self):
+        """Drain-time persistence sweep (the restart contract): every
+        still-cached prefix demotes to the host tier, then the whole
+        host tier commits to the disk store. Best-effort — shutdown
+        must never wedge on a full disk."""
+        tier = self._tier
+        if tier is None or tier.disk is None or self.prefix_cache is None:
+            return
+        try:
+            for key, bid, end in self.prefix_cache.entries():
+                if not tier.has(key):
+                    tier.put(key, end, self._tier_extract(bid),
+                             reason="flush")
+            n = tier.flush()
+            _trace.instant("kv_tier_flush", cat="engine",
+                           args={"committed": n})
+        except Exception as e:  # noqa: BLE001 — see docstring
+            import warnings
+            warnings.warn(f"kv_tier: drain-time flush failed "
+                          f"(persistence skipped): {e!r}")
 
     # -- executables: speculative lane (paged only) --------------------------
     def _init_spec(self, B: int, run):
@@ -1095,6 +1360,12 @@ class ServingEngine:
                     self._pools, self._dpools, zero_i, zero_i)
             else:
                 self._pools = self._cow_fn(self._pools, zero_i, zero_i)
+        if self._tier is not None:
+            # inert tier round trip: extract the dump block's rows and
+            # splice the same payload back into it (dump content is
+            # never meaningfully read) — compiles both tier executables
+            entries += ["serving.kv_demote", "serving.kv_splice"]
+            self._tier_splice(DUMP_BLOCK, self._tier_extract(DUMP_BLOCK))
         return entries
 
     def _warmup_contiguous(self) -> list:
@@ -1326,6 +1597,7 @@ class ServingEngine:
         if job is not None:
             # mid-prefill: nothing delivered yet; restart the same job
             req._resume = (job.tokens, job.key, job.skip)
+            self._demote_slot_blocks(slot, job.tokens, job.done)
         else:
             g = len(req.output_tokens)  # >= 1: prefill delivered one
             key = jax.random.PRNGKey(req.params.seed)
@@ -1335,6 +1607,9 @@ class ServingEngine:
                 [req.prompt,
                  np.asarray(req.output_tokens[:g - 1], np.int32)])
             req._resume = (tokens, key, 1)
+            # the resume prefill recomputes exactly tokens[:_slot_len];
+            # demoting the private blocks now lets it re-admit them
+            self._demote_slot_blocks(slot, tokens, self._slot_len[slot])
         req.slot = None
         req.preempt_count += 1
         # whichever lifecycle span is open (prefill or decode) ends at
@@ -1395,14 +1670,71 @@ class ServingEngine:
         matched_tok, mblocks = 0, []
         if self.prefix_cache is not None:
             matched_tok, mblocks = self.prefix_cache.match(tokens, total - 1)
+        # hierarchical KV re-admission: extend the prefix-cache match
+        # through the host/disk tiers — each hit allocates a fresh
+        # block and SPLICES the demoted payload back instead of running
+        # that block's prefill chunks. A partial tier entry is always
+        # the last extension.
+        covered, tier_blocks, tier_tok = matched_tok, [], 0
+        if self._tier is not None and covered % bs and mblocks:
+            # partial-tail upgrade: the cache match ended mid-block, but
+            # the tier may hold a LONGER demoted copy of that same
+            # block (a preempted request's COW fork demotes keyed at
+            # the boundary). Swap the partial cache block for a spliced
+            # tier block — this is what re-aligns coverage so the
+            # aligned loop below can keep extending through the
+            # preempted request's decode blocks. The entry must end
+            # inside the SAME block: a longer key's payload would be
+            # the next block, not a replacement for this one.
+            ceil = ((covered // bs) + 1) * bs
+            ent = self._tier.match_next(tokens, covered,
+                                        min(ceil, total - 1))
+            if ent is not None and self._tier.cost.should_readmit(
+                    ent[0] - covered, self._tier_block_bytes):
+                end, payload, src = ent
+                try:
+                    nid = self._reclaim_alloc(1, slot,
+                                              allow_preempt=False)[0]
+                except PoolExhaustedError:
+                    nid = None
+                if nid is not None:
+                    self._tier_splice(nid, payload)
+                    self.pool.decref(mblocks.pop())  # drop partial tail
+                    matched_tok = (matched_tok // bs) * bs
+                    tier_blocks.append(nid)
+                    tier_tok += end - covered
+                    _sm.kv_tier_readmitted_blocks.labels(src).inc()
+                    covered = end
+        if self._tier is not None and covered % bs == 0:
+            while covered < total - 1:
+                ent = self._tier.match_next(tokens, covered, total - 1)
+                if ent is None:
+                    break
+                end, payload, src = ent
+                if not self._tier.cost.should_readmit(
+                        end - covered, self._tier_block_bytes):
+                    break
+                try:
+                    nid = self._reclaim_alloc(1, slot,
+                                              allow_preempt=False)[0]
+                except PoolExhaustedError:
+                    break  # re-admit what fits; prefill does the rest
+                self._tier_splice(nid, payload)
+                tier_blocks.append(nid)
+                tier_tok += end - covered
+                _sm.kv_tier_readmitted_blocks.labels(src).inc()
+                covered = end
+                if end % bs:
+                    break
         try:
-            fresh = self._reclaim_alloc(n_blocks - len(mblocks), slot,
-                                        allow_preempt=False)
+            fresh = self._reclaim_alloc(
+                n_blocks - len(mblocks) - len(tier_blocks), slot,
+                allow_preempt=False)
         except PoolExhaustedError:
             # admission retries later — the resume state MUST survive
             # this attempt, or a requeued preempted request would
             # restart as fresh and re-deliver its tokens
-            for b in mblocks:
+            for b in mblocks + tier_blocks:
                 self.pool.decref(b)
             raise
         req._resume = None  # consumed only once admission is certain
@@ -1417,7 +1749,13 @@ class ServingEngine:
                               tokens=matched_tok)
             else:
                 req._tr_event("prefix_cache_miss", blocks=n_blocks)
-        blocks = mblocks + fresh
+        if tier_tok:
+            self._tier.note_readmit(len(tier_blocks), tier_tok)
+            _sm.kv_tier_readmitted_tokens.inc(tier_tok)
+            _sm.tokens_total.labels("prompt_tier").inc(tier_tok)
+            req._tr_event("kv_tier_readmit", blocks=len(tier_blocks),
+                          tokens=tier_tok)
+        blocks = mblocks + tier_blocks + fresh
         self._slot_blocks[slot] = blocks
         self._bt[slot, :] = 0
         self._bt[slot, :len(blocks)] = blocks
@@ -1431,7 +1769,7 @@ class ServingEngine:
         self._note_admission(req, time.perf_counter(),
                              resumed=resume is not None)
         self._jobs[slot] = _PrefillJob(req=req, tokens=tokens, total=total,
-                                       done=matched_tok, key=key, skip=skip)
+                                       done=covered, key=key, skip=skip)
         self._update_occupancy_gauges()
 
     def _advance_prefill(self, slot: int):
@@ -2009,6 +2347,12 @@ class ServingEngine:
                     "mid-flight — resubmit to another replica")
         elif self._crashed is None:
             self.drain(timeout_s=drain_timeout_s)
+        if self.paged and self._crashed is None:
+            # persist the prefix cache across the restart (disk tier)
+            # BEFORE the terminal flip: the engine is drained, so the
+            # pool blocks are stable under the step lock
+            with self._step_lock:
+                self._flush_tier()
         self._stopped = True
         self._running = False
         with self._wake:
@@ -2218,6 +2562,8 @@ class ServingEngine:
             out["kv_blocks"] = self.kv_block_stats()
             out["prefix_cache"] = (self.prefix_cache.stats()
                                    if self.prefix_cache is not None else None)
+            out["kv_tier"] = (self._tier.stats()
+                              if self._tier is not None else None)
             out["requests"] = [
                 {"request_id": r.id, "slot": slot,
                  "tokens_in_cache": (self._jobs[slot].done

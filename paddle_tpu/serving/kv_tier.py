@@ -1,0 +1,480 @@
+"""Hierarchical KV cache tiers below the device block pool: a host-RAM
+block tier and a crash-safe persistent (disk) prefix store.
+
+HBM used to be the ONLY KV tier, so pool pressure meant preemption-by-
+recompute and every ``PrefixCache`` eviction threw shared work away.
+CachedAttention (USENIX ATC '24) and Mooncake (Qin et al. 2024) show
+that a host/disk hierarchy turns multi-turn and many-tenant workloads
+from recompute-bound into transfer-bound — the trade this module costs
+out with the perf ledger's measured prefill rate:
+
+- **Host tier** (``KVTier``): an LRU map of demoted KV blocks in host
+  RAM, keyed by the SAME exact-token-prefix bytes ``PrefixCache`` uses
+  (``prompt[:end].tobytes()`` — so a hit guarantees the block's tokens
+  AND its entire left context match). Cold blocks arrive from the
+  engine's demote paths (prefix-cache eviction victims, preempted
+  requests' private blocks, drain-time flush); a returning prefix
+  *re-admits* via one jitted host→HBM block splice instead of a prefill
+  chunk. Payloads are the block's raw pool rows at quantized width —
+  for int8/fp8 pools the narrow values AND their f32 scale companions
+  ride together, and for spec engines the draft model's rows do too —
+  so a device→host→device round trip is bit-exact and re-admission
+  preserves output parity.
+- **Cost model** (``TierCostModel``): demote-vs-drop and
+  readmit-vs-recompute decided from recompute-tokens × the ledger's
+  measured prefill tokens/s vs transfer bytes / host-link bandwidth.
+  Until the ledger has a measured rate the model defaults to
+  demote/readmit (block-granularity transfers are orders of magnitude
+  cheaper than recompute on every measured configuration — the
+  CachedAttention finding), but the decision is recounted once real
+  rates land.
+- **Disk tier** (``DiskPrefixStore``): host-LRU spill victims and the
+  drain-time flush persist under ``kv_tier_path`` using the checkpoint
+  atomic-commit machinery (``distributed/checkpoint/atomic.py``):
+  every entry is written to a ``.tmp-*`` scratch dir, fsynced, given a
+  sha256-digest ``COMMITTED`` marker, and ``os.replace``-renamed into
+  place — a kill at ANY byte of a spill leaves only an ignorable
+  orphan, never a half-visible entry. Restart scans re-admit ONLY
+  committed entries; digest mismatches and foreign configurations are
+  skipped with a counted warning.
+
+The module is host-side only (numpy + files): the ENGINE owns the two
+jitted device programs (``serving.kv_demote`` extract /
+``serving.kv_splice`` re-admit) and calls down with materialized
+payloads, which keeps this state machine unit-testable without a
+device and keeps the one-compile invariant where it is enforced.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..distributed.checkpoint import atomic as _atomic
+from . import metrics as _sm
+
+__all__ = ["KVTier", "TierCostModel", "DiskPrefixStore",
+           "payload_nbytes"]
+
+
+def payload_nbytes(payload: Dict[str, np.ndarray]) -> int:
+    """Host bytes one demoted block costs (values + quant scales +
+    draft-model rows — everything that must move to re-admit it)."""
+    return int(sum(a.nbytes for a in payload.values()))
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """``np.dtype`` from its persisted name, including the ml_dtypes
+    extension types numpy can't parse (``bfloat16``, ``float8_*``)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class TierCostModel:
+    """Demote-vs-drop / readmit-vs-recompute from measured rates.
+
+    Recomputing ``t`` tokens costs ``t / prefill_rate`` seconds (the
+    perf ledger's measured ``serving.prefill_chunk`` items/s); moving
+    ``b`` bytes over the host link costs ``b / bandwidth``. A tier
+    operation is worth it when the transfer (scaled by ``safety``, the
+    dispatch-overhead fudge) beats the recompute it saves. Before any
+    rate is measured the model says yes — at block granularity the
+    transfer is ~100x cheaper than recompute on every configuration we
+    measured, so the conservative default is to keep the work.
+    """
+
+    def __init__(self, host_gbps: float = 12.0, safety: float = 1.5,
+                 prefill_rate_fn: Optional[Callable[[], Optional[float]]]
+                 = None):
+        if host_gbps <= 0:
+            raise ValueError(f"host_gbps must be > 0, got {host_gbps}")
+        if safety <= 0:
+            raise ValueError(f"safety must be > 0, got {safety}")
+        self.host_bytes_per_s = float(host_gbps) * 1e9
+        self.safety = float(safety)
+        self._prefill_rate_fn = prefill_rate_fn
+        self.decisions = {"demote": 0, "drop": 0, "readmit": 0,
+                          "recompute": 0}
+
+    def prefill_tokens_per_s(self) -> Optional[float]:
+        if self._prefill_rate_fn is None:
+            return None
+        try:
+            rate = self._prefill_rate_fn()
+        except Exception:  # noqa: BLE001 — a ledger hiccup never decides
+            return None
+        return float(rate) if rate and rate > 0 else None
+
+    def transfer_s(self, n_bytes: int) -> float:
+        return n_bytes / self.host_bytes_per_s
+
+    def recompute_s(self, tokens: int) -> Optional[float]:
+        rate = self.prefill_tokens_per_s()
+        return tokens / rate if rate else None
+
+    def _worth_it(self, tokens: int, n_bytes: int) -> bool:
+        recompute = self.recompute_s(tokens)
+        if recompute is None:
+            return True  # unmeasured: keep the work (see class doc)
+        return self.transfer_s(n_bytes) * self.safety < recompute
+
+    def should_demote(self, tokens: int, n_bytes: int) -> bool:
+        ok = self._worth_it(tokens, n_bytes)
+        self.decisions["demote" if ok else "drop"] += 1
+        return ok
+
+    def should_readmit(self, tokens: int, n_bytes: int) -> bool:
+        ok = self._worth_it(tokens, n_bytes)
+        self.decisions["readmit" if ok else "recompute"] += 1
+        return ok
+
+    def snapshot(self) -> dict:
+        return {"host_gbps": self.host_bytes_per_s / 1e9,
+                "safety": self.safety,
+                "prefill_tokens_per_s": self.prefill_tokens_per_s(),
+                "decisions": dict(self.decisions)}
+
+
+class DiskPrefixStore:
+    """Crash-safe persistent prefix entries under one directory.
+
+    One committed subdirectory per entry (``e_<sha256(key)[:32]>``)
+    holding ``key.bin`` (the exact prefix-key bytes), ``meta.json``
+    (covered end, array specs, and the engine configuration
+    fingerprint), and one raw ``a<i>.bin`` per payload array. Writes go
+    through :func:`atomic.atomic_write` — digest marker + fsync +
+    atomic rename — so a SIGKILL at any stage of a spill leaves only a
+    ``.tmp-*`` orphan the startup sweep deletes. Reads deep-verify the
+    digests and skip (with a counted warning) anything corrupt,
+    uncommitted, or written by a different engine configuration.
+    """
+
+    # pt-analysis lock discipline: the in-memory index and tallies are
+    # only touched under self._lock; the filesystem protocol itself is
+    # process-atomic (commit = one rename)
+    GUARDED_BY = {
+        "_index": "_lock",
+        "loads": "_lock",
+        "spills": "_lock",
+        "corrupt_skipped": "_lock",
+        "incompatible_skipped": "_lock",
+    }
+
+    def __init__(self, path: str, fingerprint: dict):
+        self.path = os.path.abspath(path)
+        self.fingerprint = dict(fingerprint)
+        os.makedirs(self.path, exist_ok=True)
+        self._lock = threading.Lock()
+        # key bytes -> (covered_end, entry dir name); committed-only
+        self._index: Dict[bytes, Tuple[int, str]] = {}
+        self.loads = 0
+        self.spills = 0
+        self.corrupt_skipped = 0
+        self.incompatible_skipped = 0
+        _atomic.cleanup_stale_tmp(self.path)
+        self._scan()
+
+    @staticmethod
+    def _entry_dir(key: bytes) -> str:
+        return "e_" + hashlib.sha256(key).hexdigest()[:32]
+
+    def _scan(self):
+        """Build the index from COMMITTED entries only: a dir without a
+        valid marker (kill mid-spill) or with a foreign fingerprint is
+        skipped — counted, warned, never trusted."""
+        with self._lock:
+            for name in sorted(os.listdir(self.path)):
+                if not name.startswith("e_") or ".tmp-" in name \
+                        or ".old-" in name:
+                    continue
+                p = os.path.join(self.path, name)
+                if not os.path.isdir(p):
+                    continue
+                try:
+                    _atomic.read_marker(p)  # committed? (deep at load)
+                    with open(os.path.join(p, "meta.json")) as fh:
+                        meta = json.load(fh)
+                    if meta.get("fingerprint") != self.fingerprint:
+                        self.incompatible_skipped += 1
+                        _sm.kv_tier_disk_skipped.labels(
+                            "incompatible").inc()
+                        continue
+                    with open(os.path.join(p, "key.bin"), "rb") as fh:
+                        key = fh.read()
+                    self._index[key] = (int(meta["end"]), name)
+                except (_atomic.CheckpointCorruptError, OSError,
+                        ValueError, KeyError) as e:
+                    self.corrupt_skipped += 1
+                    _sm.kv_tier_disk_skipped.labels("corrupt").inc()
+                    warnings.warn(
+                        f"kv_tier: skipping uncommitted/corrupt spill "
+                        f"entry {p!r}: {e}")
+            _sm.kv_tier_disk_entries.set(len(self._index))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def end_for(self, key: bytes) -> Optional[int]:
+        with self._lock:
+            ent = self._index.get(key)
+            return ent[0] if ent is not None else None
+
+    def put(self, key: bytes, end: int,
+            payload: Dict[str, np.ndarray]) -> bool:
+        """Atomically persist one entry; idempotent (an already-
+        committed key is left alone — its content is identical by the
+        exact-prefix keying). Returns True when a commit happened."""
+        with self._lock:
+            if key in self._index:
+                return False
+        final = os.path.join(self.path, self._entry_dir(key))
+        names = sorted(payload.keys())
+        meta = {"end": int(end), "fingerprint": self.fingerprint,
+                "arrays": [{"name": n, "file": f"a{i}.bin",
+                            "dtype": str(payload[n].dtype.name),
+                            "shape": list(payload[n].shape)}
+                           for i, n in enumerate(names)]}
+        with _atomic.atomic_write(final, extra_marker={"end": int(end)}) \
+                as tmp:
+            with open(os.path.join(tmp, "key.bin"), "wb") as fh:
+                fh.write(key)
+            with open(os.path.join(tmp, "meta.json"), "w") as fh:
+                json.dump(meta, fh, indent=1)
+            for i, n in enumerate(names):
+                with open(os.path.join(tmp, f"a{i}.bin"), "wb") as fh:
+                    fh.write(np.ascontiguousarray(payload[n]).tobytes())
+        with self._lock:
+            self._index[key] = (int(end), self._entry_dir(key))
+            self.spills += 1
+            _sm.kv_tier_spills.inc()
+            _sm.kv_tier_disk_entries.set(len(self._index))
+        return True
+
+    def get(self, key: bytes) \
+            -> Optional[Tuple[int, Dict[str, np.ndarray]]]:
+        """Load one committed entry (deep digest verification). A
+        corrupt entry is dropped from the index with a counted warning
+        and None is returned — the caller falls back to recompute."""
+        with self._lock:
+            ent = self._index.get(key)
+        if ent is None:
+            return None
+        end, name = ent
+        p = os.path.join(self.path, name)
+        try:
+            _atomic.verify_checkpoint(p, deep=True)
+            with open(os.path.join(p, "meta.json")) as fh:
+                meta = json.load(fh)
+            with open(os.path.join(p, "key.bin"), "rb") as fh:
+                if fh.read() != key:
+                    raise ValueError("key bytes mismatch (hash collision "
+                                     "or foreign entry)")
+            payload: Dict[str, np.ndarray] = {}
+            for spec in meta["arrays"]:
+                with open(os.path.join(p, spec["file"]), "rb") as fh:
+                    buf = fh.read()
+                arr = np.frombuffer(buf, dtype=_resolve_dtype(
+                    spec["dtype"])).reshape(spec["shape"])
+                payload[spec["name"]] = arr
+            with self._lock:
+                self.loads += 1
+                _sm.kv_tier_disk_loads.inc()
+            return int(meta["end"]), payload
+        except (_atomic.CheckpointCorruptError, OSError, ValueError,
+                KeyError) as e:
+            with self._lock:
+                self._index.pop(key, None)
+                self.corrupt_skipped += 1
+                _sm.kv_tier_disk_skipped.labels("corrupt").inc()
+                _sm.kv_tier_disk_entries.set(len(self._index))
+            warnings.warn(
+                f"kv_tier: corrupt spill entry {p!r} skipped "
+                f"(falling back to prefill recompute): {e}")
+            return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"path": self.path, "entries": len(self._index),
+                    "spills": self.spills, "loads": self.loads,
+                    "corrupt_skipped": self.corrupt_skipped,
+                    "incompatible_skipped": self.incompatible_skipped}
+
+
+class KVTier:
+    """The host-RAM block tier + its disk spill, one state machine.
+
+    Entries are ``key -> (covered_end, payload)`` in LRU order, at most
+    ``host_blocks`` of them resident (each entry is exactly one KV
+    block). Inserts beyond capacity evict the LRU entry: it spills to
+    the disk store when one is configured and the cost model approves,
+    else it is dropped. Lookups promote disk hits back into the host
+    map so a hot prefix pays the file read once.
+
+    Key space is ``PrefixCache``'s: the int32 token prefix's raw bytes,
+    so tier hits compose with (and extend past) prefix-cache hits
+    during admission without any translation.
+    """
+
+    GUARDED_BY = {
+        "_host": "_lock",
+        "_host_bytes": "_lock",
+        "demoted": "_lock",
+        "dropped": "_lock",
+        "readmitted_blocks": "_lock",
+        "readmitted_tokens": "_lock",
+    }
+
+    def __init__(self, *, host_blocks: int, block_size: int,
+                 cost: TierCostModel,
+                 disk: Optional[DiskPrefixStore] = None):
+        if host_blocks < 1:
+            raise ValueError(f"host_blocks must be >= 1, got {host_blocks}")
+        self.host_blocks = int(host_blocks)
+        self.block_size = int(block_size)
+        self.cost = cost
+        self.disk = disk
+        self._lock = threading.Lock()
+        # key -> (end, payload); ordered for LRU (oldest first)
+        self._host: "OrderedDict[bytes, Tuple[int, Dict[str, np.ndarray]]]" \
+            = OrderedDict()
+        self._host_bytes = 0
+        self.demoted = 0
+        self.dropped = 0
+        self.readmitted_blocks = 0
+        self.readmitted_tokens = 0
+
+    @staticmethod
+    def key_of(tokens: np.ndarray, end: int) -> bytes:
+        """The shared prefix-key convention (``PrefixCache._key``)."""
+        return np.ascontiguousarray(tokens[:end], dtype=np.int32).tobytes()
+
+    def tokens_in_block(self, end: int) -> int:
+        """Tokens the entry's (last) block actually covers — what a
+        re-admission saves from the prefill."""
+        return end - ((end - 1) // self.block_size) * self.block_size
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._host)
+
+    def has(self, key: bytes) -> bool:
+        with self._lock:
+            if key in self._host:
+                return True
+        return self.disk is not None and self.disk.end_for(key) is not None
+
+    # -- demotion --------------------------------------------------------
+    def put(self, key: bytes, end: int, payload: Dict[str, np.ndarray],
+            reason: str = "evict") -> None:
+        """Admit one demoted block into the host tier (LRU refresh if
+        already present). ``reason`` labels the demotion counter
+        (``evict`` / ``preempt`` / ``flush``)."""
+        spill = []
+        with self._lock:
+            if key in self._host:
+                self._host.move_to_end(key)
+                self._host[key] = (int(end), payload)
+                return
+            self._host[key] = (int(end), payload)
+            self._host_bytes += payload_nbytes(payload)
+            self.demoted += 1
+            while len(self._host) > self.host_blocks:
+                vkey, (vend, vpayload) = self._host.popitem(last=False)
+                self._host_bytes -= payload_nbytes(vpayload)
+                spill.append((vkey, vend, vpayload))
+            self._set_gauges()
+        _sm.kv_tier_demoted_blocks.labels(reason).inc()
+        for vkey, vend, vpayload in spill:
+            self._spill_or_drop(vkey, vend, vpayload)
+
+    def _spill_or_drop(self, key: bytes, end: int,
+                       payload: Dict[str, np.ndarray]) -> None:
+        if self.disk is not None and self.cost.should_demote(
+                self.tokens_in_block(end), payload_nbytes(payload)):
+            self.disk.put(key, end, payload)
+        else:
+            with self._lock:
+                self.dropped += 1
+
+    # -- re-admission ----------------------------------------------------
+    def lookup(self, key: bytes) \
+            -> Optional[Tuple[int, Dict[str, np.ndarray], str]]:
+        """``(end, payload, source)`` — host hit (LRU refresh) or disk
+        load (promoted into the host map so a hot prefix pays the file
+        read once); None on miss."""
+        with self._lock:
+            ent = self._host.get(key)
+            if ent is not None:
+                self._host.move_to_end(key)
+                return ent[0], ent[1], "host"
+        if self.disk is None:
+            return None
+        ent = self.disk.get(key)
+        if ent is None:
+            return None
+        self.put(key, ent[0], ent[1], reason="promote")
+        return ent[0], ent[1], "disk"
+
+    def match_next(self, tokens: np.ndarray, covered: int, limit: int) \
+            -> Optional[Tuple[int, Dict[str, np.ndarray], str]]:
+        """The longest tier entry extending coverage past ``covered``
+        (at most one block, at most ``limit`` tokens) — the same
+        longest-span-first walk ``PrefixCache.match`` does, continued
+        into the lower tiers."""
+        top = min(covered + self.block_size, limit)
+        for end in range(top, covered, -1):
+            ent = self.lookup(self.key_of(tokens, end))
+            if ent is not None:
+                return ent
+        return None
+
+    def note_readmit(self, blocks: int, tokens: int) -> None:
+        with self._lock:
+            self.readmitted_blocks += blocks
+            self.readmitted_tokens += tokens
+
+    # -- flush / stats ---------------------------------------------------
+    def flush(self) -> int:
+        """Persist every host-resident entry to the disk store (drain/
+        stop path — the persistence contract across engine restarts).
+        Returns the number of entries newly committed."""
+        if self.disk is None:
+            return 0
+        with self._lock:
+            entries = list(self._host.items())
+        n = 0
+        for key, (end, payload) in entries:
+            if self.disk.put(key, end, payload):
+                n += 1
+        return n
+
+    def _set_gauges(self):  # holds-lock: _lock
+        _sm.kv_tier_host_blocks.set(len(self._host))
+        _sm.kv_tier_host_bytes.set(self._host_bytes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "host_entries": len(self._host),
+                "host_capacity": self.host_blocks,
+                "host_bytes": self._host_bytes,
+                "demoted_blocks": self.demoted,
+                "dropped_blocks": self.dropped,
+                "readmitted_blocks": self.readmitted_blocks,
+                "readmitted_tokens": self.readmitted_tokens,
+            }
+        out["cost_model"] = self.cost.snapshot()
+        out["disk"] = self.disk.stats() if self.disk is not None else None
+        return out

@@ -25,6 +25,10 @@ __all__ = [
     "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
     "cow_forks_total", "preemptions_total", "prefill_chunks_total",
     "kv_bytes_per_token",
+    "kv_tier_demoted_blocks", "kv_tier_readmitted_blocks",
+    "kv_tier_readmitted_tokens", "kv_tier_spills", "kv_tier_disk_loads",
+    "kv_tier_disk_skipped", "kv_tier_host_blocks", "kv_tier_host_bytes",
+    "kv_tier_disk_entries",
     "ttft_summary", "tpot_summary", "queue_wait_seconds",
     "prefill_chunk_seconds", "goodput_tokens_per_second",
     "latency_digests", "spec_drafted_tokens", "spec_accepted_tokens",
@@ -86,7 +90,10 @@ prefix_cache_misses = _m.counter(
     "prompt KV blocks that had to be prefilled (no cached prefix)")
 prefix_cache_evictions = _m.counter(
     "paddle_tpu_prefix_cache_evictions_total",
-    "prefix-cache entries dropped (LRU) to reclaim pool blocks")
+    "prefix-cache entries evicted (LRU) to reclaim pool blocks, by what "
+    "happened to the KV: 'demoted' = copied down to the host tier, "
+    "'dropped' = freed outright (no tier, or the cost model said "
+    "recompute is cheaper)", ("outcome",))
 cow_forks_total = _m.counter(
     "paddle_tpu_serving_cow_forks_total",
     "copy-on-write forks: first divergent write into a shared KV block")
@@ -97,6 +104,48 @@ preemptions_total = _m.counter(
 prefill_chunks_total = _m.counter(
     "paddle_tpu_serving_prefill_chunks_total",
     "fixed-size prefill chunks executed (chunked-prefill admission)")
+# -- hierarchical KV tiers (serving/kv_tier.py: host RAM + disk) -----------
+kv_tier_demoted_blocks = _m.counter(
+    "paddle_tpu_kv_tier_demoted_blocks_total",
+    "KV blocks demoted device->host instead of freed, by trigger "
+    "('evict' = prefix-cache LRU victim, 'preempt' = preempted "
+    "request's private blocks, 'flush' = drain-time persistence "
+    "sweep, 'promote' = disk entry pulled back into host RAM)",
+    ("reason",))
+kv_tier_readmitted_blocks = _m.counter(
+    "paddle_tpu_kv_tier_readmitted_blocks_total",
+    "demoted KV blocks spliced host->HBM at admission instead of "
+    "recomputed, by source tier", ("src",))
+kv_tier_readmitted_tokens = _m.counter(
+    "paddle_tpu_kv_tier_readmitted_tokens_total",
+    "prompt tokens whose prefill was skipped because their block was "
+    "re-admitted from a lower tier (the recompute work the hierarchy "
+    "saved)")
+kv_tier_spills = _m.counter(
+    "paddle_tpu_kv_tier_spills_total",
+    "tier entries committed to the persistent disk store (host-LRU "
+    "spill victims + drain-time flush; each one an atomic-commit "
+    "write)")
+kv_tier_disk_loads = _m.counter(
+    "paddle_tpu_kv_tier_disk_loads_total",
+    "tier entries loaded (deep-verified) from the persistent disk "
+    "store")
+kv_tier_disk_skipped = _m.counter(
+    "paddle_tpu_kv_tier_disk_skipped_total",
+    "persisted spill entries refused at scan or load: 'corrupt' = "
+    "uncommitted / digest-mismatch (kill-mid-spill debris), "
+    "'incompatible' = written by a different engine configuration "
+    "(fingerprint mismatch)", ("reason",))
+kv_tier_host_blocks = _m.gauge(
+    "paddle_tpu_kv_tier_host_blocks",
+    "KV blocks currently resident in the host-RAM tier")
+kv_tier_host_bytes = _m.gauge(
+    "paddle_tpu_kv_tier_host_bytes",
+    "host RAM the resident tier entries occupy (values + quant scales "
+    "+ draft-model rows, at quantized width)")
+kv_tier_disk_entries = _m.gauge(
+    "paddle_tpu_kv_tier_disk_entries",
+    "committed entries in the persistent disk tier")
 # -- quantized KV (int8/fp8 block pools) -----------------------------------
 kv_bytes_per_token = _m.gauge(
     "paddle_tpu_kv_bytes_per_token",

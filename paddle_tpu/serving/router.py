@@ -434,7 +434,8 @@ class _Load:
     util: float = 0.0
     ttft_p95: Optional[float] = None
     tpot_p50: Optional[float] = None   # straggler-detection input
-    stale: bool = False
+    kv_tier: Optional[dict] = None     # hierarchical-KV tier state, for
+    stale: bool = False                # cache-aware routing to read
 
 
 class _Replica:
@@ -475,6 +476,7 @@ class _Replica:
                 "util": round(self.load.util, 4),
                 "ttft_p95": self.load.ttft_p95,
                 "tpot_p50": self.load.tpot_p50,
+                "kv_tier": self.load.kv_tier,
                 "stale": self.load.stale,
             },
         }
@@ -867,6 +869,7 @@ class Router:
             dig = digests.get("ttft_s") or {}
             ld.ttft_p95 = dig.get("p95")
             ld.tpot_p50 = (digests.get("tpot_s") or {}).get("p50")
+            ld.kv_tier = st.get("kv_tier")
             ld.stale = False
         except (TypeError, ValueError):
             rep.stats_errors += 1

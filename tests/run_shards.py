@@ -95,6 +95,13 @@ TPU_LANE = [
     # benchmarks/bench_tp_serving.py for the per-chip HBM acceptance on
     # a real pod slice
     ("test_tp_serving.py", 600, {"PADDLE_TPU_TEST_PLATFORM": "cpu"}),
+    # hierarchical KV tier: demote/readmit parity, the kill-mid-spill
+    # matrix, and the disk-restart re-admission are host-side, but the
+    # jitted demote/splice pair and the zero-retrace-with-tiering-on
+    # invariant deserve one compiled run where device->host copies are
+    # real DMAs; pair with benchmarks/bench_kv_tier.py for the >=80%
+    # recompute-elimination acceptance
+    ("test_kv_tier.py", 600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     # perf observability: on chip the peak table resolves from the real
     # device_kind, so MFU/roofline go from "unknown" to classified —
     # this entry is the first run where the ledger publishes real MFU
@@ -459,6 +466,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
     quant_bench = _read_bench("bench_quant.json")
     router_bench = _read_bench("bench_router.json")
     tp_bench = _read_bench("bench_tp.json")
+    kv_tier_bench = _read_bench("bench_kv_tier.json")
     bench_dir = os.path.join(os.path.dirname(HERE), "benchmarks")
     perf_ledger, gate_rc = build_perf_ledger_block(
         bench_dir, totals.pop("perf_entries"))
@@ -479,6 +487,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
             "quant_bench": quant_bench,
             "router_bench": router_bench,
             "tp_bench": tp_bench,
+            "kv_tier_bench": kv_tier_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

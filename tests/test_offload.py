@@ -155,3 +155,31 @@ def test_group_sharded_offload_survives_checkpoint_restore():
     l1 = one_step()
     l2 = one_step()
     assert np.isfinite(l1) and l2 < l1 + 1e-3
+
+
+def test_public_memory_kind_helpers_cpu_fallback():
+    """The public discovery helpers (satellite of the KV-tier PR: the
+    tier's host-residency planning calls these) never raise on a
+    backend without pinned_host — they degrade to a host-ish or the
+    default memory kind, and host_sharding() composes with whatever
+    they return."""
+    import jax
+
+    from paddle_tpu.distributed import offload
+
+    hk = offload.host_memory_kind()
+    dk = offload.device_memory_kind()
+    assert isinstance(hk, str) and hk
+    assert isinstance(dk, str) and dk
+    advertised = {m.kind for m in jax.devices()[0].addressable_memories()}
+    if "pinned_host" in advertised:
+        assert hk == "pinned_host"
+    else:
+        # CPU-only fallback: a host-ish kind or the one default space
+        assert "host" in hk or hk == jax.devices()[0].default_memory().kind
+    assert offload.host_sharding().memory_kind == hk
+    # the deprecated underscore aliases stay importable and identical
+    assert offload._host_memory_kind is offload.host_memory_kind
+    assert offload._device_memory_kind is offload.device_memory_kind
+    if jax.default_backend() == "cpu":
+        assert offload.supports_inline_transfers() is False
