@@ -1,0 +1,432 @@
+"""Self-healing + overload-control lane: supervisor tax on the normal
+path, DAGOR-style priority shedding under 2x oversubscription, and the
+poison-request crash-loop bill.
+
+Three lanes, deterministic workloads:
+
+- ``overhead``: the same staggered workload through a bare
+  ``ServingEngine`` vs an ``EngineSupervisor`` wrapping an identical
+  engine — best-of-3 alternating passes. The supervisor's normal-path
+  cost is one fingerprint hash + one lock hop per submit and a crash
+  hook that never fires, so the acceptance bar is <2% throughput loss;
+  the measured number is pinned in ``perf_baseline.json``
+  (``overload.supervisor_overhead_pct``, direction lower).
+- ``overload``: one engine, oversubscribed. An interactive stream
+  (staggered, deadlined) rides alongside a CLOSED-LOOP batch flood — a
+  hammering submitter that keeps the admission queue full for the
+  whole window, whatever the host's decode speed. Three passes:
+  UNCONTENDED (interactive alone — the goodput baseline), UNCONTROLLED
+  (the flood submitted at the same priority class: interactive
+  arrivals bounce off the full FCFS queue and the survivors' TTFT tail
+  stretches), CONTROLLED (the flood submitted as ``priority="batch"``:
+  the scheduler sheds batch work to admit interactive arrivals).
+  Acceptance: controlled interactive goodput >= 80% of the uncontended
+  baseline while the uncontrolled pass visibly degrades.
+- ``poison``: 1 poison request + innocents over a 2-supervised-replica
+  router (``SupervisedChaos`` keeps the fingerprint fault armed across
+  warm restarts). Acceptance: the fleet pays at most
+  ``quarantine_crashes`` restarts, the poison fails terminally with the
+  quarantine marker, EVERY innocent completes bit-identical to
+  ``generation.generate`` (``poison.innocent_completed_frac`` pinned at
+  exactly 1.0 in ``perf_baseline.json``), zero retraces.
+
+Artifact: ``benchmarks/bench_overload.json``; ``tests/run_shards.py``
+folds it into ``telemetry_lane.json`` as ``overload_bench`` and the
+perf gate reads ``overload.supervisor_overhead_pct`` /
+``overload.innocent_completed_frac`` from it. Exit code is non-zero
+when a verdict fails. CPU numbers size the lane on the dev box; the
+chip lane reruns for real ones.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import generation, serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import recompile
+from paddle_tpu.serving.supervisor import POISON_MARKER
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+MAX_SLOTS = 4
+MAX_LEN = 96
+MODEL_KW = dict(hidden_size=256, intermediate_size=512,
+                num_hidden_layers=3, num_attention_heads=8,
+                num_key_value_heads=4, vocab_size=2048)
+
+# the supervisor-overhead workload (same shape as bench_router's):
+# staggered arrivals, mixed greedy/sampled
+OVERHEAD_WORKLOAD = [
+    (0.00, 5, dict(max_new_tokens=40)),
+    (0.00, 9, dict(max_new_tokens=32, do_sample=True, temperature=0.8,
+                   top_k=8, seed=1)),
+    (0.03, 14, dict(max_new_tokens=48)),
+    (0.06, 26, dict(max_new_tokens=24, do_sample=True, top_p=0.9, seed=2)),
+    (0.10, 7, dict(max_new_tokens=40)),
+    (0.14, 11, dict(max_new_tokens=24, do_sample=True, temperature=1.1,
+                    top_k=12, seed=3)),
+    (0.18, 19, dict(max_new_tokens=32)),
+    (0.22, 4, dict(max_new_tokens=16)),
+    (0.28, 6, dict(max_new_tokens=32)),
+    (0.34, 10, dict(max_new_tokens=28)),
+]
+
+# overload lane: an interactive stream + a CLOSED-LOOP batch flood — a
+# hammering submitter that refills the queue the moment anything
+# drains, so the engine runs oversubscribed for the whole interactive
+# window no matter how fast the host decodes (an open-loop arrival
+# rate would have to be tuned per machine). The two contended passes
+# differ ONLY in the flood's priority class.
+INTERACTIVE_N = 8
+FLOOD_TOKENS = 48
+INTERACTIVE_DEADLINE_S = 20.0
+MAX_QUEUE_DEPTH = 8
+GOODPUT_FLOOR_FRAC = 0.80
+
+
+def _prompts(cfg, seed, spec):
+    rng = np.random.RandomState(seed)
+    return [(at, rng.randint(1, cfg.vocab_size, n).astype(np.int32), p)
+            for at, n, p in spec]
+
+
+def serving_retraces():
+    return sum(v["retraces"] for k, v in recompile.entry_stats().items()
+               if k.startswith("serving."))
+
+
+def pct(values, q):
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values), q))
+
+
+def run_workload(submit, workload, timeout_s=90.0):
+    """Time-scheduled submission; rejected submits (shed/backpressure)
+    are counted, not fatal — that is the overload contract."""
+    handles, rejected = [], 0
+    t0 = time.perf_counter()
+    for at, prompt, params in workload:
+        while time.perf_counter() - t0 < at:
+            time.sleep(0.002)
+        try:
+            handles.append(submit(prompt, params))
+        except serving.QueueFullError:
+            rejected += 1
+    for h in handles:
+        try:
+            h.result(timeout=timeout_s)
+        except TimeoutError:
+            pass
+    wall = time.perf_counter() - t0
+    return handles, rejected, wall
+
+
+# ---------------------------------------------------------------------------
+# lane 1: supervisor overhead on the normal path
+# ---------------------------------------------------------------------------
+
+def lane_overhead(model, workload):
+    direct = serving.ServingEngine(model, max_slots=MAX_SLOTS,
+                                   max_len=MAX_LEN)
+    direct.warmup()
+    direct.start()
+    sup = serving.EngineSupervisor(model, max_slots=MAX_SLOTS,
+                                   max_len=MAX_LEN)
+    sup.warmup()
+    sup.start()
+
+    def make_submit(eng):
+        def submit(prompt, params):
+            return eng.submit(prompt,
+                              params=serving.SamplingParams(**params))
+        return submit
+
+    best = {"direct": 0.0, "supervised": 0.0}
+    for _ in range(3):
+        for name, eng in (("direct", direct), ("supervised", sup)):
+            handles, _, wall = run_workload(make_submit(eng), workload)
+            tok_s = sum(len(h.output_tokens) for h in handles) / wall
+            best[name] = max(best[name], tok_s)
+    overhead_pct = 100.0 * (1.0 - best["supervised"] / best["direct"])
+    assert sup.restarts == 0  # the normal path never restarted
+    direct.stop()
+    sup.stop()
+    return {"direct_tok_s": round(best["direct"], 1),
+            "supervised_tok_s": round(best["supervised"], 1),
+            "overhead_pct": round(overhead_pct, 2),
+            "passes": 3,
+            "verdict_lt_2pct": overhead_pct < 2.0}
+
+
+# ---------------------------------------------------------------------------
+# lane 2: 2x oversubscription, shed vs drown
+# ---------------------------------------------------------------------------
+
+def _interactive_pass(eng, cfg, flood_priority, contended):
+    """One pass: optional closed-loop flood (at ``flood_priority``) +
+    the staggered interactive stream. Returns the interactive-side
+    scorecard."""
+    stop = threading.Event()
+    flood_stats = {"admitted": 0, "bounced": 0}
+
+    def flood_loop():
+        rng = np.random.RandomState(13)
+        params = dict(max_new_tokens=FLOOD_TOKENS)
+        if flood_priority is not None:
+            params["priority"] = flood_priority
+        while not stop.is_set():
+            p = rng.randint(1, cfg.vocab_size,
+                            6 + flood_stats["admitted"] % 5)
+            try:
+                eng.submit(p.astype(np.int32),
+                           params=serving.SamplingParams(**params))
+                flood_stats["admitted"] += 1
+            except serving.QueueFullError:
+                flood_stats["bounced"] += 1
+                time.sleep(0.002)
+
+    flooder = None
+    if contended:
+        flooder = threading.Thread(target=flood_loop, daemon=True,
+                                   name="bench-overload-flood")
+        flooder.start()
+        time.sleep(0.1)  # the flood owns the queue before traffic lands
+
+    rng = np.random.RandomState(7)
+    inter_handles, rejected = [], 0
+    t0 = time.perf_counter()
+    for i in range(INTERACTIVE_N):
+        while time.perf_counter() - t0 < 0.15 + 0.25 * i:
+            time.sleep(0.002)
+        try:
+            inter_handles.append(eng.submit(
+                rng.randint(1, cfg.vocab_size,
+                            5 + (i % 4)).astype(np.int32),
+                deadline_s=INTERACTIVE_DEADLINE_S,
+                params=serving.SamplingParams(max_new_tokens=24)))
+        except serving.QueueFullError:
+            # the uncontrolled arm's failure mode: a same-class flood
+            # leaves no room to shed, so interactive work bounces
+            rejected += 1
+    for h in inter_handles:
+        try:
+            h.result(timeout=60.0)
+        except TimeoutError:
+            pass
+    wall = time.perf_counter() - t0
+    stop.set()
+    if flooder is not None:
+        flooder.join(timeout=5.0)
+    completed = [h for h in inter_handles
+                 if h.status == serving.RequestStatus.COMPLETED]
+    good_tokens = sum(len(h.output_tokens) for h in completed)
+    ttfts = [h.ttft_s for h in inter_handles if h.ttft_s is not None]
+    return {
+        "interactive_submitted": INTERACTIVE_N,
+        "interactive_admitted": len(inter_handles),
+        "interactive_rejected": rejected,
+        "interactive_completed": len(completed),
+        "interactive_goodput_tok_s": round(good_tokens / wall, 1),
+        "interactive_ttft_p95_ms":
+            (round(1e3 * pct(ttfts, 95), 1) if ttfts else None),
+        "flood_admitted": flood_stats["admitted"],
+        "flood_bounced": flood_stats["bounced"],
+        "wall_s": round(wall, 3),
+    }
+
+
+def lane_overload(model, cfg):
+    """Uncontended baseline, then the 2x flood twice: once drowning the
+    interactive class (everything "interactive"), once shed as
+    ``priority="batch"``. Fresh engine per pass — queue state must not
+    leak across arms."""
+    passes = {}
+    for name, flood_priority, contended in (
+            ("uncontended", None, False),
+            ("uncontrolled", None, True),
+            ("controlled", "batch", True)):
+        eng = serving.ServingEngine(model, max_slots=MAX_SLOTS,
+                                    max_len=MAX_LEN,
+                                    max_queue_depth=MAX_QUEUE_DEPTH)
+        eng.warmup()
+        eng.start()
+        passes[name] = _interactive_pass(eng, cfg, flood_priority,
+                                         contended)
+        eng.stop(abort=True, drain_timeout_s=10.0)
+    base = passes["uncontended"]["interactive_goodput_tok_s"]
+    held = passes["controlled"]["interactive_goodput_tok_s"]
+    ratio = held / base if base else 0.0
+    p95_base = passes["uncontended"]["interactive_ttft_p95_ms"] or 0.0
+    unctl = passes["uncontrolled"]
+    # without priority classes the same closed-loop flood visibly hurts
+    # the interactive stream: arrivals bounce off the full same-class
+    # queue, or the survivors' TTFT tail stretches
+    degraded = unctl["interactive_rejected"] > 0 \
+        or (unctl["interactive_ttft_p95_ms"] or 0.0) > 1.5 * p95_base
+    return {
+        "max_queue_depth": MAX_QUEUE_DEPTH,
+        "flood_tokens": FLOOD_TOKENS,
+        "passes": passes,
+        "controlled_vs_uncontended_goodput": round(ratio, 4),
+        "verdict_goodput_held": ratio >= GOODPUT_FLOOR_FRAC,
+        "verdict_uncontrolled_degraded": degraded,
+    }
+
+
+# ---------------------------------------------------------------------------
+# lane 3: the poison crash-loop bill
+# ---------------------------------------------------------------------------
+
+def lane_poison(model, cfg):
+    quarantine_crashes = 2
+    sups = [serving.EngineSupervisor(model, max_slots=MAX_SLOTS,
+                                     max_len=MAX_LEN,
+                                     quarantine_crashes=quarantine_crashes,
+                                     max_restarts=3)
+            for _ in range(2)]
+    rng = np.random.RandomState(11)
+    poison_prompt = rng.randint(1, cfg.vocab_size, 6).astype(np.int32)
+    poison_params = serving.SamplingParams(max_new_tokens=16)
+    fp = serving.request_fingerprint(poison_prompt, poison_params)
+    chaos = [serving.SupervisedChaos(
+        s, arm=lambda m: m.poison_fingerprint(fp)) for s in sups]
+
+    innocents = []
+    for i in range(12):
+        params = dict(max_new_tokens=12)
+        if i % 3 == 1:
+            params = dict(max_new_tokens=10, do_sample=True, top_k=8,
+                          seed=50 + i)
+        innocents.append(
+            (rng.randint(1, cfg.vocab_size, 4 + (i % 5)).astype(np.int32),
+             params))
+    refs = [generation.generate(model, p[None], **params)
+            .numpy()[0, len(p):] for p, params in innocents]
+
+    router = serving.Router(sups, serving.RouterConfig(
+        probe_interval_s=0.05, max_retries_per_request=2,
+        unroutable_timeout_s=30.0))
+    router.start()
+    retr0 = serving_retraces()
+    t0 = time.perf_counter()
+    rr_poison = router.submit(poison_prompt, params=poison_params)
+    rrs = [router.submit(p, params=serving.SamplingParams(**params))
+           for p, params in innocents]
+    for rr in [rr_poison] + rrs:
+        try:
+            rr.result(timeout=120.0)
+        except TimeoutError:
+            pass
+    wall = time.perf_counter() - t0
+    restarts = sum(s.restarts for s in sups)
+    fired = sum(c.injected["poison"] for c in chaos)
+    completed = [rr for rr in rrs
+                 if rr.status == serving.RequestStatus.COMPLETED]
+    parity = all(np.array_equal(np.asarray(rr.output_tokens), ref)
+                 for rr, ref in zip(rrs, refs)
+                 if rr.status == serving.RequestStatus.COMPLETED)
+    quarantined = sorted(set(sups[0].quarantined + sups[1].quarantined))
+    new_retraces = serving_retraces() - retr0
+    router.stop(drain=True, timeout_s=30)
+    return {
+        "innocents": len(rrs),
+        "innocent_completed": len(completed),
+        "innocent_completed_frac": round(len(completed) / len(rrs), 4),
+        "innocent_parity": parity,
+        "poison_status": rr_poison.status,
+        "poison_marker_in_error": bool(rr_poison.error
+                                       and POISON_MARKER in rr_poison.error),
+        "poison_fired": fired,
+        "quarantine_crashes_budget": quarantine_crashes,
+        "fleet_restarts": restarts,
+        "quarantined_fingerprints": quarantined,
+        "new_retraces": new_retraces,
+        "wall_s": round(wall, 3),
+        "verdict_restarts_bounded": restarts <= quarantine_crashes,
+        "verdict_all_innocents": len(completed) == len(rrs),
+    }
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig(**MODEL_KW)
+    model = LlamaForCausalLM(cfg)
+    print(f"[bench_overload] model {MODEL_KW['hidden_size']}h x "
+          f"{MODEL_KW['num_hidden_layers']}L", flush=True)
+
+    workload = _prompts(cfg, 42, OVERHEAD_WORKLOAD)
+    overhead = lane_overhead(model, workload)
+    print(f"[bench_overload] supervisor tax: direct "
+          f"{overhead['direct_tok_s']} tok/s vs supervised "
+          f"{overhead['supervised_tok_s']} tok/s -> "
+          f"{overhead['overhead_pct']}% (<2% verdict: "
+          f"{overhead['verdict_lt_2pct']})", flush=True)
+
+    overload = lane_overload(model, cfg)
+    p = overload["passes"]
+    print(f"[bench_overload] overload: interactive goodput uncontended "
+          f"{p['uncontended']['interactive_goodput_tok_s']} tok/s, "
+          f"uncontrolled {p['uncontrolled']['interactive_goodput_tok_s']} "
+          f"tok/s, controlled "
+          f"{p['controlled']['interactive_goodput_tok_s']} tok/s "
+          f"({overload['controlled_vs_uncontended_goodput']:.2f}x of "
+          f"baseline; held: {overload['verdict_goodput_held']})",
+          flush=True)
+    print(f"[bench_overload] interactive TTFT p95: uncontended "
+          f"{p['uncontended']['interactive_ttft_p95_ms']} ms, "
+          f"uncontrolled {p['uncontrolled']['interactive_ttft_p95_ms']} "
+          f"ms, controlled {p['controlled']['interactive_ttft_p95_ms']} "
+          f"ms", flush=True)
+
+    poison = lane_poison(model, cfg)
+    print(f"[bench_overload] poison: {poison['fleet_restarts']} fleet "
+          f"restarts (budget {poison['quarantine_crashes_budget']}), "
+          f"{poison['innocent_completed']}/{poison['innocents']} "
+          f"innocents completed, parity {poison['innocent_parity']}, "
+          f"new retraces {poison['new_retraces']}", flush=True)
+
+    verdicts = {
+        "supervisor_overhead_lt_2pct": overhead["verdict_lt_2pct"],
+        "interactive_goodput_held": overload["verdict_goodput_held"],
+        "uncontrolled_degraded": overload["verdict_uncontrolled_degraded"],
+        "poison_restarts_bounded": poison["verdict_restarts_bounded"],
+        "poison_quarantined": poison["poison_status"] == "failed"
+        and poison["poison_marker_in_error"],
+        "poison_fault_fired": poison["poison_fired"] >= 1,
+        "all_innocents_completed": poison["verdict_all_innocents"],
+        "innocent_parity": poison["innocent_parity"],
+        "zero_retraces": poison["new_retraces"] == 0,
+    }
+    out = {
+        "model": MODEL_KW,
+        "max_slots": MAX_SLOTS,
+        "overhead": overhead,
+        "overload": overload,
+        "poison": poison,
+        "verdicts": verdicts,
+    }
+    path = os.path.join(HERE, "bench_overload.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"[bench_overload] -> {path}", flush=True)
+    failed = [k for k, v in verdicts.items() if not v]
+    if failed:
+        print(f"[bench_overload] VERDICTS FAILED: {failed}", flush=True)
+        return 1
+    print("[bench_overload] all verdicts passed", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,16 +48,29 @@ Four mechanisms, each consumed by ``serving/router.py``:
   router applies to per-replica TPOT p50s — a replica whose decode
   cadence sits far above the fleet median is flagged ``straggler``
   without any absolute latency threshold to mis-tune.
+
+- **SLO-driven brownout**: ``BrownoutController`` closes the loop the
+  ``SLOTracker`` leaves open — when BOTH burn windows run hot it steps
+  the serving plane through a declarative degradation ladder (shed
+  batch-class work → disable hedging → cap batch decode length →
+  shrink speculation), one level per burning report with a minimum
+  dwell, and walks back down only after a streak of consecutive
+  healthy reports (hysteresis: a single good minute must not re-admit
+  the load that caused the burn). Every transition is a counter, a
+  gauge move, and a traced instant — brownout is an OPERATED state,
+  never a silent one.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import metrics as _m
+from . import tracing as _tracing
 from .exporters import parse_prometheus_text, render_families
 
 __all__ = [
@@ -66,6 +79,7 @@ __all__ = [
     "traceparent_of", "merge_catapult",
     "FleetMetricsAggregator", "FLEET_REPLICA_LABEL",
     "SLOConfig", "SLOTracker",
+    "BrownoutController", "BROWNOUT_LEVELS",
     "mad_zscores",
 ]
 
@@ -504,6 +518,170 @@ class SLOTracker:
                        "slow_window_s": cfg.slow_window_s},
             "objectives": objectives,
         }
+
+
+# ---------------------------------------------------------------------------
+# SLO-driven brownout
+# ---------------------------------------------------------------------------
+
+_brownout_level = _m.gauge(
+    "paddle_tpu_brownout_level",
+    "current degradation level (0 = normal; higher = more load shed "
+    "to protect the interactive SLO)")
+_brownout_transitions = _m.counter(
+    "paddle_tpu_brownout_transitions_total",
+    "brownout ladder transitions", ("direction",))
+
+# the degradation ladder, mildest first. Each level IMPLIES every level
+# below it: at "cap_batch_tokens" the fleet is also shedding batch and
+# not hedging. The ordering is goodput-per-cost: shed the work whose
+# deadline tolerates a retry first, spend compile-cache-warm capacity
+# (spec) last.
+BROWNOUT_LEVELS = (
+    "normal",            # 0: no degradation
+    "shed_batch",        # 1: reject batch-class submits at the router
+    "no_hedge",          # 2: stop duplicating slow attempts
+    "cap_batch_tokens",  # 3: clamp batch-class max_new_tokens
+    "shrink_spec",       # 4: cap speculation width (verify FLOPs back)
+)
+
+
+class BrownoutController:
+    """Hysteresis ladder from SLO burn to degradation actions.
+
+    Feed it ``SLOTracker.report()`` dicts on a fixed cadence (the
+    router's probe loop). When a report is unhealthy (``ok`` False —
+    both burn windows hot on some objective) the controller escalates
+    ONE level, at most once per ``min_dwell_s``; when
+    ``recover_reports`` consecutive healthy reports arrive it
+    de-escalates one level (again dwell-limited). Asymmetry is the
+    point: escalation needs one bad report because budget is burning
+    NOW; recovery needs a streak because re-admitting load on a single
+    good sample re-triggers the burn (the classic overload-control
+    flap). Action predicates (``shed_batch`` etc.) are what the
+    router/engine consult inline — reading them is lock-free-cheap and
+    allocation-free."""
+
+    GUARDED_BY = {"_level": "_lock", "_streak": "_lock",
+                  "_last_move": "_lock", "_transitions": "_lock"}
+
+    def __init__(self, recover_reports: int = 3,
+                 min_dwell_s: float = 2.0, max_level: int = None,
+                 clock=time.perf_counter):
+        if recover_reports < 1:
+            raise ValueError("recover_reports must be >= 1")
+        top = len(BROWNOUT_LEVELS) - 1
+        self.recover_reports = int(recover_reports)
+        self.min_dwell_s = float(min_dwell_s)
+        self.max_level = top if max_level is None else min(int(max_level),
+                                                           top)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._level = 0
+        self._streak = 0           # consecutive healthy reports
+        self._last_move = -1e18    # so the first escalation is immediate
+        self._transitions = deque(maxlen=64)  # (ts, from, to, direction)
+        _brownout_level.set(0)
+
+    # -- the control loop ----------------------------------------------------
+    def update(self, slo_report: Optional[dict],
+               now: Optional[float] = None) -> int:
+        """One control tick. Returns the (possibly new) level."""
+        if now is None:
+            now = self._clock()
+        healthy = bool(slo_report.get("ok", True)) if slo_report else True
+        # an SLO report with nothing observed is vacuously healthy —
+        # browning out an idle fleet would be pure self-harm
+        if slo_report and not slo_report.get("observed"):
+            healthy = True
+        with self._lock:
+            if not healthy:
+                self._streak = 0
+                if self._level < self.max_level \
+                        and now - self._last_move >= self.min_dwell_s:
+                    self._move(self._level + 1, "escalate", now,
+                               slo_report)
+            else:
+                self._streak += 1
+                if self._level > 0 \
+                        and self._streak >= self.recover_reports \
+                        and now - self._last_move >= self.min_dwell_s:
+                    self._streak = 0
+                    self._move(self._level - 1, "recover", now, slo_report)
+            return self._level
+
+    # holds-lock: _lock
+    def _move(self, new_level: int, direction: str, now: float,
+              slo_report: Optional[dict]):
+        """Caller holds the lock."""
+        old = self._level
+        self._level = new_level
+        self._last_move = now
+        self._transitions.append(
+            {"ts": round(now, 3), "from": BROWNOUT_LEVELS[old],
+             "to": BROWNOUT_LEVELS[new_level], "direction": direction})
+        _brownout_level.set(new_level)
+        _brownout_transitions.labels(direction).inc()
+        burning = []
+        if slo_report:
+            burning = [n for n, o in
+                       slo_report.get("objectives", {}).items()
+                       if not o.get("ok", True)]
+        _tracing.instant(
+            "brownout_" + direction, cat="brownout", trace="brownout",
+            args={"from": BROWNOUT_LEVELS[old],
+                  "to": BROWNOUT_LEVELS[new_level],
+                  "burning": burning})
+
+    # -- action predicates (what the serving plane consults inline) ---------
+    @property
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    @property
+    def level_name(self) -> str:
+        return BROWNOUT_LEVELS[self.level]
+
+    @property
+    def shed_batch(self) -> bool:
+        """Level >= 1: reject batch-class work at the router door."""
+        return self.level >= 1
+
+    @property
+    def hedge_disabled(self) -> bool:
+        """Level >= 2: a hedge is a deliberate duplicate — the first
+        capacity to reclaim after shedding deferrable work."""
+        return self.level >= 2
+
+    @property
+    def cap_batch_tokens(self) -> bool:
+        """Level >= 3: batch work that DID get in decodes short."""
+        return self.level >= 3
+
+    @property
+    def shrink_spec(self) -> bool:
+        """Level >= 4: cap spec_k — verify-bundle FLOPs back to decode."""
+        return self.level >= 4
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "level": self._level,
+                "level_name": BROWNOUT_LEVELS[self._level],
+                "levels": list(BROWNOUT_LEVELS),
+                "max_level": self.max_level,
+                "healthy_streak": self._streak,
+                "recover_reports": self.recover_reports,
+                "min_dwell_s": self.min_dwell_s,
+                "actions": {
+                    "shed_batch": self._level >= 1,
+                    "hedge_disabled": self._level >= 2,
+                    "cap_batch_tokens": self._level >= 3,
+                    "shrink_spec": self._level >= 4,
+                },
+                "transitions": list(self._transitions),
+            }
 
 
 # ---------------------------------------------------------------------------

@@ -710,6 +710,10 @@ BENCH_METRIC_SOURCES = {
                                "lanes.tp2.weight_bytes_per_device_frac"),
     "train.tok_s_per_chip": ("bench_train.json", "tokens_per_sec_per_chip"),
     "train.mfu": ("bench_train.json", "mfu"),
+    "overload.supervisor_overhead_pct": ("bench_overload.json",
+                                         "overhead.overhead_pct"),
+    "overload.innocent_completed_frac": (
+        "bench_overload.json", "poison.innocent_completed_frac"),
 }
 
 

@@ -61,8 +61,19 @@ static-shape decode substrate:
                   hedging, and graceful drain.
 - ``router_http``: the router's HTTP front end (``RouterHTTPServer``)
                   + SIGTERM -> fleet drain.
+- ``supervisor``: self-healing layer over one engine
+                  (``EngineSupervisor``): warm in-process restart after
+                  a decode-loop crash (fresh pools, zero-compile
+                  warmup, innocent queued+running requests requeued on
+                  the seed-deterministic replay — same handles, same
+                  bytes), a crash-loop breaker, and poison-request
+                  quarantine (``PoisonedRequestError``) whose
+                  fingerprint blacklist the router propagates
+                  fleet-wide via /stats and the retry path.
 - ``chaos``:      deterministic fault injection (``ChaosEngine``,
-                  ``ChaosReplica``) powering the router chaos suite.
+                  ``ChaosReplica``, restart-surviving
+                  ``SupervisedChaos`` with fingerprint-targeted poison
+                  faults) powering the router chaos suite.
 
 Quick start::
 
@@ -79,22 +90,26 @@ from __future__ import annotations
 from . import metrics  # registers the serving gauges at import
 from .block_pool import (BlockPool, BlockPoolError, PoolExhaustedError,
                          PrefixCache)
-from .chaos import ChaosEngine, ChaosError, ChaosReplica
+from .chaos import ChaosEngine, ChaosError, ChaosReplica, SupervisedChaos
 from .engine import (EngineDrainingError, EngineStoppedError, ServingConfig,
                      ServingEngine)
 from .http import (ServingHTTPServer, start_serving_http_server,
                    stop_serving_http_server)
 from .kv_tier import DiskPrefixStore, KVTier, TierCostModel
-from .request import Request, RequestStatus, SamplingParams
+from .request import (PRIORITY_CLASSES, Request, RequestStatus,
+                      SamplingParams, request_fingerprint)
 from .router import (HTTPReplica, LocalReplica, NoReplicaError, ReplicaState,
                      Router, RouterConfig, RouterRequest)
 from .router_http import (RouterHTTPServer, install_sigterm_drain,
                           uninstall_sigterm_drain)
-from .scheduler import QueueFullError, Scheduler
+from .scheduler import DeadlineInfeasibleError, QueueFullError, Scheduler
+from .supervisor import EngineSupervisor, PoisonedRequestError
 
 __all__ = [
     "ServingConfig", "ServingEngine", "SamplingParams", "Request",
     "RequestStatus", "Scheduler", "QueueFullError",
+    "DeadlineInfeasibleError", "PRIORITY_CLASSES", "request_fingerprint",
+    "EngineSupervisor", "PoisonedRequestError",
     "EngineStoppedError", "EngineDrainingError",
     "BlockPool", "PrefixCache", "PoolExhaustedError", "BlockPoolError",
     "KVTier", "TierCostModel", "DiskPrefixStore",
@@ -103,5 +118,5 @@ __all__ = [
     "Router", "RouterConfig", "RouterRequest", "ReplicaState",
     "LocalReplica", "HTTPReplica", "NoReplicaError",
     "RouterHTTPServer", "install_sigterm_drain", "uninstall_sigterm_drain",
-    "ChaosEngine", "ChaosReplica", "ChaosError",
+    "ChaosEngine", "ChaosReplica", "ChaosError", "SupervisedChaos",
 ]

@@ -23,9 +23,21 @@ Two injection points, matching the two surfaces the router touches:
   timeouts, malformed or erroring ``/healthz`` probes, and
   ``PoolExhausted``/``QueueFull`` submit storms.
 
-Both keep counters of everything they injected, so tests assert the
-fault actually fired (a chaos test that silently injected nothing
-proves nothing).
+Poison requests — the supervisor's chaos counterpart — are injected by
+REQUEST IDENTITY, not call count: ``poison_fingerprint`` crashes any
+step in which a request with the armed fingerprint is running, however
+many times that request is admitted, on whichever engine generation.
+That is exactly the deterministic-crash shape quarantine exists for,
+and it is what makes the fault survive a warm restart (a call-count
+fault would fire once and be gone; the poison re-fires every time the
+supervisor's probe re-admits the suspect). ``SupervisedChaos`` keeps
+the fault armed ACROSS restarts by re-wrapping each rebuilt engine via
+the supervisor's rebuild hook, with one shared ``injected`` ledger so
+a test can assert the total crash count fleet-wide.
+
+All injectors keep counters of everything they injected, so tests
+assert the fault actually fired (a chaos test that silently injected
+nothing proves nothing).
 """
 
 from __future__ import annotations
@@ -38,7 +50,8 @@ from typing import Optional
 from .block_pool import PoolExhaustedError
 from .scheduler import QueueFullError
 
-__all__ = ["ChaosError", "ChaosEngine", "ChaosReplica"]
+__all__ = ["ChaosError", "ChaosEngine", "ChaosReplica",
+           "SupervisedChaos"]
 
 
 class ChaosError(RuntimeError):
@@ -71,7 +84,10 @@ class ChaosEngine:
         self._slow_s = 0.0
         self._hang_at: Optional[int] = None
         self._hang_event = threading.Event()
-        self.injected = {"crash": 0, "slow": 0, "hang": 0}
+        self._poison_fp: Optional[str] = None
+        self._poison_left: Optional[int] = None
+        self._poison_msg = "chaos: poisoned request crashed the step"
+        self.injected = {"crash": 0, "slow": 0, "hang": 0, "poison": 0}
         engine.step = self._step
 
     # -- arming --------------------------------------------------------------
@@ -99,6 +115,23 @@ class ChaosEngine:
             self._slow_at = self._steps_seen + int(after)
             self._slow_for = int(for_steps)
             self._slow_s = float(delay_s)
+        return self
+
+    def poison_fingerprint(self, fingerprint: str,
+                           crashes: Optional[int] = None,
+                           msg: Optional[str] = None):
+        """Crash every step in which a request with this fingerprint is
+        RUNNING — the deterministic poison request. Unlike the count
+        faults this one is not one-shot: it re-fires each time the
+        request is (re-)admitted, which is the shape quarantine must
+        defeat. ``crashes`` bounds the total firings (None =
+        unbounded); the quarantine contract says the supervisor stops
+        re-admitting the fingerprint before any sane bound is hit."""
+        with self._lock:
+            self._poison_fp = str(fingerprint)
+            self._poison_left = None if crashes is None else int(crashes)
+            if msg:
+                self._poison_msg = msg
         return self
 
     def hang_after_steps(self, n: int):
@@ -131,6 +164,21 @@ class ChaosEngine:
             slow = (self._slow_at is not None and self._slow_at <= n
                     < self._slow_at + self._slow_for)
             hang = self._hang_at is not None and n >= self._hang_at
+            poison = False
+            if self._poison_fp is not None and \
+                    (self._poison_left is None or self._poison_left > 0):
+                # identity fault: fires iff the poisoned request is in
+                # a slot RIGHT NOW (same thread as the step — the slot
+                # table is stable here)
+                for r in self.engine._slot_req:
+                    if r is not None and r.fingerprint == self._poison_fp:
+                        poison = True
+                        if self._poison_left is not None:
+                            self._poison_left -= 1
+                        break
+        if poison:
+            self.injected["poison"] += 1
+            raise ChaosError(self._poison_msg)
         if hang:
             self.injected["hang"] += 1
             with self._lock:
@@ -272,3 +320,49 @@ class ChaosReplica:
     def start(self):
         if hasattr(self.inner, "start"):
             self.inner.start()
+
+
+class SupervisedChaos:
+    """Chaos that SURVIVES warm restarts.
+
+    A plain ``ChaosEngine`` dies with its engine: the supervisor's
+    rebuild swaps in a fresh ``ServingEngine`` whose ``step`` is
+    unwrapped, so any fault armed on the old engine silently stops
+    firing — and a poison-quarantine test that silently stops injecting
+    proves nothing. This wrapper registers a rebuild hook on the
+    supervisor and re-wraps every engine generation with a fresh
+    ``ChaosEngine``, re-armed by the caller's ``arm`` closure and
+    writing into ONE shared ``injected`` ledger, so the test's "the
+    poison fired exactly N times fleet-wide" assertion spans restarts.
+
+    >>> chaos = SupervisedChaos(sup, arm=lambda m:
+    ...     m.poison_fingerprint(fp))
+    >>> ...  # crash, restart, crash again: chaos.injected["poison"] == 2
+    """
+
+    def __init__(self, supervisor, arm=None, seed: int = 0):
+        self.supervisor = supervisor
+        self.seed = seed
+        self._arm = arm
+        self.injected = {"crash": 0, "slow": 0, "hang": 0, "poison": 0}
+        self.monkeys: list = []
+        supervisor.add_rebuild_hook(self._attach)
+        self._attach(supervisor.engine)
+
+    def _attach(self, engine):
+        m = ChaosEngine(engine, seed=self.seed)
+        m.injected = self.injected  # one ledger across generations
+        if self._arm is not None:
+            self._arm(m)
+        self.monkeys.append(m)
+        return m
+
+    @property
+    def current(self) -> ChaosEngine:
+        """The monkey on the supervisor's CURRENT engine generation."""
+        return self.monkeys[-1]
+
+    def restore(self):
+        for m in self.monkeys:
+            m.restore()
+        return self

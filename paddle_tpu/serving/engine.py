@@ -506,6 +506,11 @@ class ServingEngine:
         self._draining = False   # no new admissions; in-flight finishing
         self._stopped = False    # terminal: drained (or aborted) + loop down
         self._warmed_up = False  # warmup() ran: executables AOT-compiled
+        # supervisor crash-capture hook: called by _on_loop_crash (step
+        # lock held, flight dump already taken, requests NOT yet failed)
+        # so a supervisor can detach queued+running requests for requeue
+        # on a rebuilt engine before _fail_inflight reaches them
+        self._crash_hook = None
         _sm.engine_unhealthy.set(0)  # a fresh engine is the healthy one
 
         # /debug/requests keeps the tail of finished requests next to the
@@ -1586,6 +1591,39 @@ class ServingEngine:
                 best, best_seq = slot, self._slot_seq[slot]
         return best
 
+    def _build_resume(self, slot: int):
+        """Seed-deterministic resume state for the slot's occupant (the
+        recipe both preemption and supervised restart replay): mid-
+        prefill restarts the same chunk job; mid-decode folds the
+        generated tokens into the next prefill with the PRNG chain
+        split back to the right link, and the one token the resumed
+        prefill's final select re-derives is skipped, never
+        re-delivered. The resumed decode is bit-identical — on THIS
+        engine after a preemption or on a fresh one after a crash.
+        Returns ``(tokens, recompute_len)`` for the caller's block
+        bookkeeping (``(None, 0)`` when nothing ran yet: a fresh
+        prefill replays everything)."""
+        req = self._slot_req[slot]
+        job = self._jobs[slot] if self.paged else None
+        if job is not None:
+            # mid-prefill: nothing delivered yet; restart the same job
+            req._resume = (job.tokens, job.key, job.skip)
+            return job.tokens, job.done
+        g = len(req.output_tokens)
+        if g == 0:
+            # claimed but never prefilled (crash between admission
+            # bookkeeping and the first chunk): full replay
+            req._resume = None
+            return None, 0
+        key = jax.random.PRNGKey(req.params.seed)
+        for _ in range(g - 1):
+            key, _ = jax.random.split(key)
+        tokens = np.concatenate(
+            [req.prompt,
+             np.asarray(req.output_tokens[:g - 1], np.int32)])
+        req._resume = (tokens, key, 1)
+        return tokens, (self._slot_len[slot] if self.paged else len(tokens))
+
     def _preempt(self, slot: int):
         """Preemption by recompute: release the slot's blocks and push
         the request back to the QUEUE FRONT with its generated tokens
@@ -1593,23 +1631,12 @@ class ServingEngine:
         resumed decode is bit-identical, and the one token the resumed
         prefill's select re-derives is skipped, never re-delivered."""
         req = self._slot_req[slot]
-        job = self._jobs[slot]
-        if job is not None:
-            # mid-prefill: nothing delivered yet; restart the same job
-            req._resume = (job.tokens, job.key, job.skip)
-            self._demote_slot_blocks(slot, job.tokens, job.done)
-        else:
-            g = len(req.output_tokens)  # >= 1: prefill delivered one
-            key = jax.random.PRNGKey(req.params.seed)
-            for _ in range(g - 1):
-                key, _ = jax.random.split(key)
-            tokens = np.concatenate(
-                [req.prompt,
-                 np.asarray(req.output_tokens[:g - 1], np.int32)])
-            req._resume = (tokens, key, 1)
-            # the resume prefill recomputes exactly tokens[:_slot_len];
-            # demoting the private blocks now lets it re-admit them
-            self._demote_slot_blocks(slot, tokens, self._slot_len[slot])
+        tokens, recompute_len = self._build_resume(slot)
+        if tokens is not None:
+            # the resume prefill recomputes exactly tokens[:recompute_
+            # len]; demoting the private blocks now lets it re-admit
+            # them through the tier instead of re-running the chunks
+            self._demote_slot_blocks(slot, tokens, recompute_len)
         req.slot = None
         req.preempt_count += 1
         # whichever lifecycle span is open (prefill or decode) ends at
@@ -1919,10 +1946,25 @@ class ServingEngine:
         next decode step. Paged admission only claims blocks and queues
         the chunk job; contiguous admission runs the whole bucketed
         prefill inline (the pre-paging behavior)."""
+        # quarantine-probe isolation: a crash SUSPECT the supervisor
+        # requeued runs ALONE — admitted only into an idle pool, with
+        # nothing admitted beside it. A repeat crash then implicates
+        # exactly one request instead of smearing suspicion over
+        # innocent co-runners (which is what would let a single poison
+        # request quarantine its whole cohort).
+        if any(r is not None and r.quarantine_probe for r in self._slot_req):
+            return
         for slot in range(self.config.max_slots):
             while self._slot_req[slot] is None:
                 req = self.scheduler.pop_ready()
                 if req is None:
+                    return
+                if req.quarantine_probe and self.busy_slots():
+                    # the probe waits at the queue front for an idle
+                    # pool (admission-backoff requeue: same wait
+                    # window), and blocks everything behind it — brief,
+                    # bounded by the in-flight requests' decode
+                    self.scheduler.requeue(req)
                     return
                 try:
                     if self.paged:
@@ -1940,6 +1982,9 @@ class ServingEngine:
                     req.finish(RequestStatus.FAILED, error=repr(e))
                     _sm.requests_total.labels("failed").inc()
                     self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
+                else:
+                    if req.quarantine_probe:
+                        return  # solo: nothing is admitted beside it
 
     # -- the iteration -------------------------------------------------------
     def step(self) -> bool:
@@ -2254,6 +2299,17 @@ class ServingEngine:
                 _perf.dump_oom(exc)
             else:
                 _trace.flight_dump("engine_crash", extra={"error": err})
+            # supervised engines: the supervisor's capture hook runs
+            # AFTER the post-mortem (the dump shows the true in-flight
+            # state) and BEFORE _fail_inflight (finish() is idempotent
+            # and irreversible — anything the hook does not detach is
+            # failed below, exactly the unsupervised semantics)
+            hook = self._crash_hook
+            if hook is not None:
+                try:
+                    hook(self, exc)
+                except Exception:  # noqa: BLE001 — the crash path must
+                    pass           # survive a broken supervisor
             self._fail_inflight(f"engine loop crashed: {err}")
         with self._wake:
             self._wake.notify_all()
@@ -2274,6 +2330,42 @@ class ServingEngine:
             req.finish(RequestStatus.FAILED, error=error)
             _sm.requests_total.labels("failed").inc()
             self._outcomes["failed"] = self._outcomes.get("failed", 0) + 1
+
+    def _export_inflight(self) -> tuple:
+        """Detach every running and queued request WITHOUT finishing
+        them — the supervised-restart capture (caller holds the step
+        lock, normally from inside ``_crash_hook``). Returns
+        ``(running, queued)`` in FCFS admission order. This engine is
+        presumed dead: no pool bookkeeping happens (the pools die with
+        the engine); only host-side request state is rebuilt, via the
+        same ``_build_resume`` recipe preemption uses, so a FRESH
+        engine resumes each running request bit-identically. Queued
+        requests were never touched by the crashing step and carry no
+        resume state at all. On a contiguous engine (no resume support
+        in its prefill path) only fresh running requests are detached —
+        ones with delivered tokens stay and fail as before rather than
+        re-deliver duplicates."""
+        running = []
+        order = sorted(
+            (slot for slot in range(self.config.max_slots)
+             if self._slot_req[slot] is not None),
+            key=lambda s: self._slot_seq[s])
+        for slot in order:
+            req = self._slot_req[slot]
+            if not self.paged and req.output_tokens:
+                continue  # contiguous decode cannot replay; fail it
+            self._build_resume(slot)
+            req.slot = None
+            req._tr_end("prefill")
+            req._tr_end("decode")
+            req._tr_event("captured", slot=slot,
+                          generated=len(req.output_tokens))
+            self._slot_req[slot] = None
+            self._decoding[slot] = False
+            if self.paged:
+                self._jobs[slot] = None
+            running.append(req)
+        return running, self.scheduler.detach_all()
 
     @property
     def crashed(self) -> Optional[str]:

@@ -8,7 +8,8 @@ completions are lists of token ids.
 - ``POST /generate`` body
   ``{"prompt": [ids], "max_new_tokens": 16, "do_sample": false,
      "temperature": 1.0, "top_k": 0, "top_p": 1.0, "eos_token_id": null,
-     "seed": 0, "spec_k": null, "deadline_s": null, "stream": false}``
+     "seed": 0, "spec_k": null, "priority": "interactive",
+     "deadline_s": null, "stream": false}``
   (``spec_k`` is the per-request speculative override on draft-model
   engines: 0 opts out, null takes the engine default — outputs are
   identical either way, only throughput moves)
@@ -71,6 +72,7 @@ from ..observability import fleet as _fleet
 from ..observability import tracing as _tracing
 from .engine import EngineStoppedError
 from .scheduler import QueueFullError
+from .supervisor import PoisonedRequestError
 
 __all__ = ["ServingHTTPServer", "start_serving_http_server",
            "stop_serving_http_server"]
@@ -225,10 +227,14 @@ class ServingHTTPServer:
                                             **body)
                 except QueueFullError as e:
                     # backpressure carries the same digest-derived
-                    # Retry-After hint the saturated /healthz payload does
+                    # Retry-After hint the saturated /healthz payload
+                    # does; a deadline-infeasible rejection carries the
+                    # queue-wait estimate the deadline lost to instead
                     from . import metrics as _sm
 
-                    ra = _sm.queue_wait_retry_after()
+                    ra = getattr(e, "retry_after_s", None)
+                    if ra is None:
+                        ra = _sm.queue_wait_retry_after()
                     self._json(429, {"error": str(e), "retry_after_s": ra},
                                headers=retry_after_header(
                                    {"retry_after_s": ra}))
@@ -236,6 +242,18 @@ class ServingHTTPServer:
                 except EngineStoppedError as e:
                     self._json(503, {"error": str(e),
                                      "status": engine.health()[1]["status"]})
+                    return
+                except PoisonedRequestError as e:
+                    # quarantined fingerprint (supervised engines): an
+                    # ACTIONABLE 400 — the body says why, names the
+                    # fingerprint, and tells the caller not to retry.
+                    # Must precede the generic ValueError arm (it IS a
+                    # ValueError — deliberately, so unsupervised
+                    # surfaces still treat it as a plain bad request).
+                    self._json(400, {"error": str(e),
+                                     "quarantined": True,
+                                     "fingerprint": e.fingerprint,
+                                     "retriable": False})
                     return
                 except (TypeError, ValueError) as e:
                     self._json(400, {"error": f"bad request: {e}"})

@@ -33,6 +33,10 @@ __all__ = [
     "prefill_chunk_seconds", "goodput_tokens_per_second",
     "latency_digests", "spec_drafted_tokens", "spec_accepted_tokens",
     "spec_rejected_tokens", "spec_accept_len", "queue_wait_retry_after",
+    "queue_wait_p50",
+    "requests_shed_total", "deadline_rejected_total",
+    "supervisor_restarts_total", "supervisor_requeued_total",
+    "requests_quarantined_total",
     "router_requests_total", "router_attempts_total",
     "router_retries_total", "router_hedges_total",
     "router_probe_failures_total", "router_ejections_total",
@@ -40,6 +44,7 @@ __all__ = [
     "router_replica_healthy", "router_replica_inflight",
     "router_unroutable_total",
     "router_stragglers_total", "router_replica_straggler",
+    "router_poison_blocked_total",
 ]
 
 requests_total = _m.counter(
@@ -65,6 +70,34 @@ engine_crashes_total = _m.counter(
     "paddle_tpu_serving_engine_crashes_total",
     "decode-loop crashes outside the per-request guards (every queued "
     "and running request is failed, /healthz flips unhealthy)")
+# -- self-healing supervision (serving/supervisor.py) ----------------------
+supervisor_restarts_total = _m.counter(
+    "paddle_tpu_serving_supervisor_restarts_total",
+    "warm engine restarts the supervisor performed after a decode-loop "
+    "crash (fresh pools + warmup() zero-compile boot; innocent "
+    "requests requeued, crash suspects re-admitted as solo probes)")
+supervisor_requeued_total = _m.counter(
+    "paddle_tpu_serving_supervisor_requeued_total",
+    "requests carried across a supervised engine restart instead of "
+    "failed, by where the crash caught them ('queued' = waiting for a "
+    "slot, untouched by the crashing step; 'running' = active in the "
+    "crashing step, requeued under the seed-deterministic PRNG replay "
+    "so the resumed output stays bit-identical)", ("kind",))
+requests_quarantined_total = _m.counter(
+    "paddle_tpu_serving_quarantined_total",
+    "requests failed terminally as poison: their fingerprint was "
+    "implicated in the quarantine budget's worth of distinct engine "
+    "crashes, and no replica will re-admit it")
+# -- priority-aware overload control (DAGOR-style shedding) ----------------
+requests_shed_total = _m.counter(
+    "paddle_tpu_serving_requests_shed_total",
+    "queued requests shed (REJECTED) to admit a higher-priority class "
+    "under queue pressure, by the shed request's class", ("cls",))
+deadline_rejected_total = _m.counter(
+    "paddle_tpu_serving_deadline_rejected_total",
+    "requests rejected at admission because their deadline could not "
+    "beat the live queue-wait p50 (429 + Retry-After: failing fast "
+    "beats queueing work that is already dead), by class", ("cls",))
 engine_unhealthy = _m.gauge(
     "paddle_tpu_serving_engine_unhealthy",
     "1 while the most recent serving engine is crash-dead; constructing "
@@ -270,6 +303,12 @@ router_replica_straggler = _m.gauge(
     "1 while the replica's decode cadence is a robust-MAD outlier vs "
     "the fleet median (optionally fed into the admission score via "
     "RouterConfig.straggler_penalty)", ("replica",))
+router_poison_blocked_total = _m.counter(
+    "paddle_tpu_router_poison_blocked_total",
+    "router-side poison verdicts: submissions refused for a quarantined "
+    "fingerprint plus attempts failed terminally on a replica's "
+    "PoisonedRequestError (either way, the poison never reaches "
+    "another engine)", ("site",))
 
 _DIGESTS = {
     "ttft_s": ttft_summary,
@@ -290,6 +329,18 @@ def queue_wait_retry_after(default: float = 1.0) -> float:
     if p50 is None:
         return default
     return max(round(float(p50), 3), 0.05)
+
+
+def queue_wait_p50(min_count: int = 8) -> "float | None":
+    """The queue-wait digest's live p50, or ``None`` before the digest
+    has ``min_count`` samples — the deadline-feasibility estimate the
+    scheduler rejects against. The warm-up guard matters: rejecting on
+    one early outlier would turn a cold start into a 429 storm."""
+    quantiles, _total, count = queue_wait_seconds._d().snapshot()
+    if count < min_count:
+        return None
+    p50 = quantiles.get(0.5)
+    return None if p50 is None else float(p50)
 
 
 def latency_digests() -> dict:

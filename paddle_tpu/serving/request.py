@@ -13,6 +13,7 @@ which position".
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import queue
 import threading
@@ -20,9 +21,38 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+import numpy as np
+
 from ..observability import tracing as _tracing
 
-__all__ = ["SamplingParams", "Request", "RequestStatus"]
+__all__ = ["SamplingParams", "Request", "RequestStatus",
+           "PRIORITY_CLASSES", "request_fingerprint"]
+
+# priority classes, LOWEST first — the shed order under queue pressure
+# (DAGOR-style: batch work is shed before interactive work ever waits).
+# The default is "interactive" so single-class workloads see exactly
+# the pre-priority FCFS behavior: shedding only ever triggers when a
+# STRICTLY lower class is present to shed.
+PRIORITY_CLASSES = ("batch", "interactive")
+
+
+def request_fingerprint(prompt, params: "SamplingParams") -> str:
+    """Deterministic identity of a request's WORK: a short hex digest
+    over the prompt tokens and every decode knob that reaches the
+    compiled step. Two submissions of the same prompt+params — across
+    retries, replicas, or engine restarts — share a fingerprint, which
+    is what lets the poison-request quarantine recognize a
+    deterministically-crashing request no matter which replica admits
+    it. Priority and deadline are deliberately EXCLUDED: they change
+    scheduling, not the work, and a poison request resubmitted at a
+    different priority is still poison."""
+    h = hashlib.sha256()
+    h.update(np.asarray(prompt, np.int32).tobytes())
+    h.update(repr((params.max_new_tokens, params.do_sample,
+                   params.temperature, params.top_k, params.top_p,
+                   params.eos_token_id, params.seed,
+                   params.spec_k)).encode())
+    return h.hexdigest()[:16]
 
 
 class RequestStatus:
@@ -63,6 +93,23 @@ class SamplingParams:
     eos_token_id: Optional[int] = None
     seed: int = 0
     spec_k: Optional[int] = None
+    # priority CLASS, not a numeric weight: "interactive" (default) or
+    # "batch". Under queue pressure the scheduler sheds the lowest
+    # class first, and the router's brownout ladder degrades batch
+    # work (shed -> token cap -> spec cap) before interactive work
+    # feels anything. Priority never changes outputs — only admission.
+    priority: str = "interactive"
+
+    def __post_init__(self):
+        if self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority class {self.priority!r}: expected one "
+                f"of {PRIORITY_CLASSES} (lowest-shed-first order)")
+
+    @property
+    def priority_rank(self) -> int:
+        """Position in the shed order (0 = shed first)."""
+        return PRIORITY_CLASSES.index(self.priority)
 
 
 _ids = itertools.count()
@@ -135,8 +182,27 @@ class Request:
         # the generated tokens fold into the next prefill and the final
         # select's re-derived token is skipped, never re-delivered
         self._resume = None
+        # supervisor quarantine state: the lazily-computed work
+        # fingerprint (identity across retries/replicas/restarts) and
+        # the solo-probe flag — a crash SUSPECT the supervisor requeues
+        # is re-admitted in isolation so a repeat crash implicates it
+        # definitively instead of smearing suspicion over co-runners
+        self._fingerprint: Optional[str] = None
+        self.quarantine_probe = False
         self._done = threading.Event()
         self._stream_q: "queue.Queue" = queue.Queue()
+
+    @property
+    def fingerprint(self) -> str:
+        fp = self._fingerprint
+        if fp is None:
+            fp = self._fingerprint = request_fingerprint(self.prompt,
+                                                         self.params)
+        return fp
+
+    @property
+    def priority(self) -> str:
+        return self.params.priority
 
     # -- tracing -------------------------------------------------------------
     def _tr_begin(self, name: str, ts_ns: Optional[int] = None, **args):
@@ -252,6 +318,7 @@ class Request:
             "request_id": self.id,
             "trace": self.trace,
             "status": self.status,
+            "priority": self.params.priority,
             "slot": self.slot,
             "prompt_len": int(self.prompt.shape[0]),
             "generated": len(self.output_tokens),

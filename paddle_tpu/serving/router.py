@@ -62,6 +62,19 @@ actually lives:
   per-replica TPOT deviation (robust MAD) flags stragglers in
   ``/replicas`` — optionally penalized in the admission score.
 
+- **Quarantine propagation + brownout** (the self-healing plane): a
+  replica supervisor (``serving/supervisor.py``) that quarantines a
+  poison request publishes the fingerprint in its ``/stats`` block;
+  the router merges every replica's blacklist on its normal stats
+  cadence AND learns from the retry path (an attempt failing with the
+  ``PoisonedRequestError`` marker is terminal, never retried — the
+  poison must not crash-loop its way across the fleet). And when the
+  fleet SLO burns on BOTH windows, a ``BrownoutController`` steps the
+  router through the degradation ladder: shed batch-class submits,
+  disable hedging, clamp batch decode length, cap speculation — with
+  hysteresis on recovery so one good minute doesn't re-admit the
+  overload.
+
 The router talks to replicas through a small client protocol —
 ``healthz() / stats() / submit() / cancel() / drain()`` (plus the
 optional fleet extensions ``metrics_text() / trace_events()``) — with two
@@ -85,7 +98,7 @@ import time
 import urllib.error
 import urllib.request
 import weakref
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -95,8 +108,10 @@ from ..observability import fleet as _fleet
 from ..observability import tracing as _trace
 from . import metrics as _sm
 from .engine import EngineStoppedError, ServingEngine
-from .request import RequestStatus, SamplingParams
+from .request import RequestStatus, SamplingParams, request_fingerprint
 from .scheduler import QueueFullError
+from .supervisor import (EngineSupervisor, POISON_MARKER,
+                         PoisonedRequestError)
 
 __all__ = ["Router", "RouterConfig", "RouterRequest", "ReplicaState",
            "LocalReplica", "HTTPReplica", "NoReplicaError"]
@@ -262,6 +277,16 @@ class _HTTPAttempt:
                     return
             self._finish(RequestStatus.FAILED, "stream ended without a "
                                                "done record")
+        except urllib.error.HTTPError as e:
+            # a non-200 carries a JSON error body (429 backpressure,
+            # 400 bad-request/quarantine): surface the SERVER's message
+            # — repr(e) would swallow it, and the router's poison
+            # marker check reads this string
+            try:
+                err = json.loads(e.read()).get("error") or repr(e)
+            except Exception:  # noqa: BLE001 — body unreadable
+                err = repr(e)
+            self._finish(RequestStatus.FAILED, err)
         except Exception as e:  # noqa: BLE001 — connection-level failure
             if self._cancelled:
                 self._finish(RequestStatus.CANCELLED)
@@ -332,7 +357,8 @@ class HTTPReplica:
                 "do_sample": p.do_sample, "temperature": p.temperature,
                 "top_k": p.top_k, "top_p": p.top_p,
                 "eos_token_id": p.eos_token_id, "seed": p.seed,
-                "spec_k": p.spec_k, "deadline_s": deadline_s}
+                "spec_k": p.spec_k, "priority": p.priority,
+                "deadline_s": deadline_s}
         headers = {}
         if trace_id is not None:
             tp = _fleet.traceparent_of(trace_id)
@@ -404,6 +430,15 @@ class RouterConfig:
     # (0.0 = detect-and-report only, never shed load)
     straggler_penalty: float = 0.0
     recent_requests: int = 256         # merged-trace lookup registry cap
+    # SLO-driven brownout (rides the fleet plane: needs the SLOTracker's
+    # burn rates for input, so fleet_observability off disables it too).
+    # Escalation is driven from the probe loop; the ladder's actions
+    # fire at submit/attempt/hedge time.
+    brownout: bool = True
+    brownout_recover_reports: int = 3  # healthy streak to de-escalate
+    brownout_min_dwell_s: float = 2.0  # min residence per level
+    brownout_batch_max_new_tokens: int = 16  # cap_batch_tokens clamp
+    brownout_spec_k_cap: int = 0       # shrink_spec clamp (0 = plain)
 
     def __post_init__(self):
         if self.probe_failures_to_eject < 1:
@@ -420,6 +455,12 @@ class RouterConfig:
                              "penalty would ATTRACT load to stragglers)")
         if self.recent_requests < 1:
             raise ValueError("recent_requests must be >= 1")
+        if self.brownout_batch_max_new_tokens < 1:
+            raise ValueError("brownout_batch_max_new_tokens must be >= 1 "
+                             "(a zero-token cap silently discards work; "
+                             "use shedding for that)")
+        if self.brownout_spec_k_cap < 0:
+            raise ValueError("brownout_spec_k_cap must be >= 0")
 
 
 @dataclass
@@ -501,6 +542,10 @@ class RouterRequest:
         self.id = next(_router_req_ids)
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         self.params = params
+        # same identity the replica supervisors quarantine by: when an
+        # attempt dies with the poison marker, THIS is the fingerprint
+        # the router blacklists — no parsing of error strings needed
+        self.fingerprint = request_fingerprint(self.prompt, params)
         self.arrival_ts = time.perf_counter()
         self.deadline_ts = (self.arrival_ts + deadline_s
                             if deadline_s is not None else None)
@@ -709,6 +754,14 @@ class Router:
         self._aggregator = _fleet.FleetMetricsAggregator()
         self._slo = _fleet.SLOTracker(config.slo or _fleet.SLOConfig())
         self._stragglers_flagged = 0
+        # fingerprint -> where the quarantine was learned (replica name
+        # or "retry"); merged from replica /stats and the retry path
+        self._quarantined: Dict[str, str] = {}
+        self._brownout = (
+            _fleet.BrownoutController(
+                recover_reports=config.brownout_recover_reports,
+                min_dwell_s=config.brownout_min_dwell_s)
+            if (config.brownout and config.fleet_observability) else None)
         for i, rep in enumerate(replicas):
             self.add_replica(rep, name=getattr(rep, "name", None) or f"r{i}")
         ref = weakref.ref(self)
@@ -727,7 +780,10 @@ class Router:
         their background loop is started — a replica that enters
         rotation cold would pay its executable compiles out of the
         first routed request's deadline."""
-        if isinstance(client, ServingEngine):
+        if isinstance(client, (ServingEngine, EngineSupervisor)):
+            # a supervisor exposes the full engine surface, so the same
+            # LocalReplica shim serves both: the router sees warm
+            # restarts as a brief "restarting" 503, not a new replica
             client = LocalReplica(client)
         name = name or getattr(client, "name", None) \
             or f"r{len(self._replicas)}"
@@ -777,6 +833,12 @@ class Router:
                 continue
             self._probe(rep)
         self.update_stragglers()
+        if self._brownout is not None:
+            # brownout rides the probe cadence: deterministic for tests
+            # (probe_once() -> exactly one control tick), and the
+            # min-dwell hysteresis keeps the 0.2s cadence from racing
+            # the ladder up
+            self._brownout.update(self._slo.report())
 
     def _probe(self, rep: _Replica):
         cfg = self.config
@@ -799,6 +861,15 @@ class Router:
             rep.saturated_until = time.perf_counter() + float(
                 payload.get("retry_after_s") or 1.0)
             return self._probe_ok(rep, payload)
+        if status == "restarting":
+            # a supervised replica mid warm-restart: alive, coming back
+            # with a warmed engine in well under a probe-ejection window
+            # — back off briefly rather than burn an ejection strike
+            # (if the restart FAILS the breaker flips the payload to
+            # "crashed" + restarts_exhausted and ejection proceeds)
+            rep.saturated_until = time.perf_counter() + 0.1
+            rep.consecutive_probe_failures = 0
+            return None
         if status in ("draining", "stopped"):
             # the replica is going away on its own terms
             if rep.state != ReplicaState.STOPPED:
@@ -871,6 +942,14 @@ class Router:
             ld.tpot_p50 = (digests.get("tpot_s") or {}).get("p50")
             ld.kv_tier = st.get("kv_tier")
             ld.stale = False
+            # quarantine propagation: the supervisor's /stats block is
+            # the fleet-wide gossip channel — one replica's verdict
+            # blacklists the fingerprint at THIS router for every
+            # replica, on the normal stats cadence (no new endpoint)
+            sup = st.get("supervisor")
+            if isinstance(sup, dict):
+                for fp in sup.get("quarantined") or ():
+                    self._learn_quarantine(str(fp), rep.name)
         except (TypeError, ValueError):
             rep.stats_errors += 1
             rep.load.stale = True
@@ -946,6 +1025,24 @@ class Router:
             params = SamplingParams(**sampling)
         elif sampling:
             raise ValueError("pass params OR sampling kwargs, not both")
+        fp = request_fingerprint(
+            np.asarray(prompt, dtype=np.int32).reshape(-1), params)
+        with self._lock:
+            poisoned = fp in self._quarantined
+        if poisoned:
+            _sm.router_poison_blocked_total.labels("submit").inc()
+            raise PoisonedRequestError(
+                f"{POISON_MARKER}: request fingerprint {fp} is "
+                f"quarantined fleet-wide (it crashed serving engines "
+                f"until its restart budget ran out) — do not resubmit",
+                fingerprint=fp)
+        if self._brownout is not None and self._brownout.shed_batch \
+                and params.priority == "batch":
+            _sm.requests_shed_total.labels("batch").inc()
+            raise QueueFullError(
+                f"brownout level {self._brownout.level_name!r}: "
+                f"batch-class work is shed while the fleet SLO is "
+                f"burning — retry later or resubmit as interactive")
         with self._lock:
             have_any = any(r.state != ReplicaState.STOPPED
                            for r in self._replicas.values())
@@ -965,6 +1062,16 @@ class Router:
                              name=f"paddle-tpu-router-req-{rr.id}")
         t.start()
         return rr
+
+    def _learn_quarantine(self, fp: str, source: str):
+        """Blacklist a fingerprint router-wide (idempotent)."""
+        with self._lock:
+            if fp in self._quarantined:
+                return
+            self._quarantined[fp] = source
+        _sm.router_poison_blocked_total.labels("learned").inc()
+        _trace.instant("quarantine_learned", cat="router",
+                       args={"fingerprint": fp, "source": source})
 
     def _observe_slo(self, rr: RouterRequest):
         """SLO observation at a request's terminal transition (the
@@ -1042,6 +1149,19 @@ class Router:
         """(gen, handle, attempt_record); handle None = not submitted
         (rejected/refused, record says why — or ``rr`` finished for a
         caller error no replica can fix)."""
+        with self._lock:
+            poisoned = rr.fingerprint in self._quarantined
+        if poisoned:
+            # quarantined between submission and this (re)try: the
+            # retry path must not carry the poison to a fresh replica
+            _sm.router_poison_blocked_total.labels("retry").inc()
+            rr.finish(RequestStatus.FAILED,
+                      error=f"{POISON_MARKER}: request fingerprint "
+                            f"{rr.fingerprint} was quarantined while "
+                            f"in flight — not retried")
+            return 0, None, {"replica": rep.name, "outcome": "poisoned",
+                             "hedge": hedge, "error": None,
+                             "trace_id": None}
         gen = rr._next_gen()
         if hedge:
             with rr._lock:
@@ -1053,6 +1173,7 @@ class Router:
             rr._on_attempt_token(gen, name, tok)
 
         rem = rr.remaining_s()
+        params = self._attempt_params(rr)
         # fleet trace propagation: each attempt (retry/hedge included)
         # gets a DISTINCT deterministic trace id — the replica-side span
         # tree records under it and the merged catapult file shows one
@@ -1067,7 +1188,7 @@ class Router:
                 try:
                     handle = rep.client.submit(
                         rr.prompt, deadline_s=rem, on_token=_relay,
-                        params=rr.params, trace_id=tid)
+                        params=params, trace_id=tid)
                 except TypeError:
                     # pre-fleet client (no trace_id kwarg): submit
                     # without propagation rather than failing the
@@ -1075,11 +1196,21 @@ class Router:
                     record["trace_id"] = tid = None
                     handle = rep.client.submit(
                         rr.prompt, deadline_s=rem, on_token=_relay,
-                        params=rr.params)
+                        params=params)
             else:
                 handle = rep.client.submit(rr.prompt, deadline_s=rem,
                                            on_token=_relay,
-                                           params=rr.params)
+                                           params=params)
+        except PoisonedRequestError as e:
+            # the replica's supervisor already blacklisted this
+            # fingerprint (its /stats hadn't been merged yet): learn it
+            # and fail terminally — a poison verdict is never retried
+            self._learn_quarantine(e.fingerprint or rr.fingerprint,
+                                   rep.name)
+            _sm.router_poison_blocked_total.labels("retry").inc()
+            record.update(outcome="poisoned", error=repr(e))
+            rr.finish(RequestStatus.FAILED, error=str(e))
+            return gen, None, record
         except QueueFullError as e:
             rep.saturated_until = time.perf_counter() + \
                 _sm.queue_wait_retry_after()
@@ -1105,6 +1236,26 @@ class Router:
         _trace.instant("routed", cat="router", trace=f"router/{rr.id}",
                        args={"replica": rep.name, "hedge": hedge})
         return gen, handle, record
+
+    def _attempt_params(self, rr: RouterRequest) -> SamplingParams:
+        """The params one attempt actually submits: under brownout,
+        batch-class work gets its decode length clamped (level >=
+        ``cap_batch_tokens``) and everyone's speculation width capped
+        (level >= ``shrink_spec``) — explicit, per-attempt degradation
+        that never mutates the caller's ``rr.params``."""
+        bo = self._brownout
+        if bo is None:
+            return rr.params
+        p = rr.params
+        changes = {}
+        if bo.cap_batch_tokens and p.priority == "batch" \
+                and p.max_new_tokens > \
+                self.config.brownout_batch_max_new_tokens:
+            changes["max_new_tokens"] = \
+                self.config.brownout_batch_max_new_tokens
+        if bo.shrink_spec and p.spec_k > self.config.brownout_spec_k_cap:
+            changes["spec_k"] = self.config.brownout_spec_k_cap
+        return _dc_replace(p, **changes) if changes else p
 
     def _release_attempt(self, rep: _Replica):
         rep.inflight = max(0, rep.inflight - 1)
@@ -1187,6 +1338,21 @@ class Router:
                     rec["outcome"] = "cancelled"
                     rr.finish(RequestStatus.CANCELLED)
                     return "cancelled"
+                if h.error and POISON_MARKER in str(h.error):
+                    # the replica's supervisor quarantined this request
+                    # MID-FLIGHT (it was implicated in its last allowed
+                    # crash). The marker rides the terminal error string
+                    # — which survives the HTTP NDJSON done-record — so
+                    # the verdict propagates on the retry path too:
+                    # terminal here, blacklisted everywhere.
+                    rec["outcome"] = "poisoned"
+                    rec["error"] = h.error
+                    self._learn_quarantine(rr.fingerprint, r.name)
+                    _sm.router_poison_blocked_total.labels("retry").inc()
+                    for other in watch:
+                        self._abandon(rr, other, "poisoned")
+                    rr.finish(RequestStatus.FAILED, error=h.error)
+                    return "done"
                 # FAILED / REJECTED / engine-side cancel we didn't ask
                 # for: the attempt died with its replica -> retriable
                 rec["outcome"] = "failed"
@@ -1220,9 +1386,13 @@ class Router:
             if not watch:
                 return "retriable"
             # hedging: first token slower than the digest-derived
-            # threshold -> race a second replica
+            # threshold -> race a second replica (suppressed from
+            # brownout level "no_hedge" up: a hedge is a deliberate
+            # duplicate, the first capacity to reclaim under overload)
             if cfg.hedge and not hedged_here and not rr.output_tokens \
-                    and len(watch) == 1:
+                    and len(watch) == 1 \
+                    and not (self._brownout is not None
+                             and self._brownout.hedge_disabled):
                 p95 = watch[0][0].load.ttft_p95
                 threshold = max(cfg.hedge_min_wait_s,
                                 cfg.hedge_ttft_factor * p95 if p95 else 0.0)
@@ -1363,8 +1533,12 @@ class Router:
 
     def slo_report(self) -> dict:
         """The fleet SLO verdict (router ``GET /slo``): per-objective
-        multi-window burn rates and ok/breach flags."""
-        return self._slo.report()
+        multi-window burn rates and ok/breach flags, plus the brownout
+        ladder state the verdict drives."""
+        out = self._slo.report()
+        if self._brownout is not None:
+            out["brownout"] = self._brownout.report()
+        return out
 
     def merged_trace(self, request_id: int) -> Optional[dict]:
         """ONE catapult file for one routed request: the router's own
@@ -1414,6 +1588,9 @@ class Router:
             "stragglers": {r.name: r.straggler
                            for r in self._rep_list()},
             "stragglers_flagged": self._stragglers_flagged,
+            "brownout": (self._brownout.report()
+                         if self._brownout is not None else None),
+            "quarantined": sorted(self._quarantined),
         }
 
     # -- drain / lifecycle ---------------------------------------------------
@@ -1502,12 +1679,17 @@ class Router:
         with self._lock:
             requests = self._requests
             extra = self._extra_attempts
+            quarantined = dict(self._quarantined)
         return {
             "replicas": self.replicas(),
             "requests": requests,
             "extra_attempts": extra,
             "amplification": round(1.0 + extra / requests, 4)
             if requests else None,
+            "quarantine": {"fingerprints": sorted(quarantined),
+                           "sources": quarantined},
+            "brownout": (self._brownout.report()
+                         if self._brownout is not None else None),
             "fleet": {
                 "enabled": self.fleet_enabled,
                 "federation": self._aggregator.stats(),
@@ -1523,5 +1705,6 @@ class Router:
                     self.config.retry_amplification_cap,
                 "hedge": self.config.hedge,
                 "straggler_penalty": self.config.straggler_penalty,
+                "brownout": self.config.brownout,
             },
         }

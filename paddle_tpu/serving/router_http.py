@@ -8,7 +8,12 @@ fans out over many).
   ``"hedged"`` in the record), streaming via ``"stream": true``.
   Failover/retry/hedging happen underneath; the client sees each token
   once. ``503`` + ``Retry-After`` when no replica can admit
-  (saturation), ``400`` for bad requests.
+  (saturation), ``400`` for bad requests. A QUARANTINED fingerprint
+  (a poison request that crashed supervised engines until its budget
+  ran out) gets an actionable ``400`` — ``{"quarantined": true,
+  "fingerprint": ..., "retriable": false}`` — whether refused at
+  submit or convicted mid-flight; batch-class work shed under SLO
+  brownout gets ``429`` + ``Retry-After``.
 - ``GET /healthz`` — fleet health: 200 while at least one replica is in
   rotation; 503 payload distinguishes ``draining`` (shutdown in
   progress) from ``unavailable`` (everything ejected). Per-replica
@@ -49,6 +54,8 @@ import time
 from .http import retry_after_header
 from .request import RequestStatus
 from .router import NoReplicaError, ReplicaState, Router
+from .scheduler import QueueFullError
+from .supervisor import POISON_MARKER, PoisonedRequestError
 
 __all__ = ["RouterHTTPServer", "install_sigterm_drain",
            "uninstall_sigterm_drain"]
@@ -205,6 +212,24 @@ class RouterHTTPServer:
                                headers=retry_after_header(
                                    {"retry_after_s": e.retry_after_s or 1}))
                     return
+                except PoisonedRequestError as e:
+                    # fleet-wide quarantine verdict: an actionable 400 —
+                    # the body names the fingerprint and says never to
+                    # resubmit (a 429/503 would invite the retry that
+                    # crash-loops fleets)
+                    self._json(400, {"error": str(e),
+                                     "quarantined": True,
+                                     "fingerprint": e.fingerprint,
+                                     "retriable": False})
+                    return
+                except QueueFullError as e:
+                    # brownout shed (batch class under SLO burn): 429 +
+                    # Retry-After — deferrable work comes back later
+                    ra = getattr(e, "retry_after_s", None) or 1
+                    self._json(429, {"error": str(e), "retry_after_s": ra},
+                               headers=retry_after_header(
+                                   {"retry_after_s": ra}))
+                    return
                 except (TypeError, ValueError) as e:
                     self._json(400, {"error": f"bad request: {e}"})
                     return
@@ -222,6 +247,16 @@ class RouterHTTPServer:
                             and "no admitting replica" in rr.error:
                         self._json(503, rec, headers=retry_after_header(
                             {"retry_after_s": 1}))
+                        return
+                    if rr.status == RequestStatus.FAILED and rr.error \
+                            and POISON_MARKER in rr.error:
+                        # quarantined MID-FLIGHT (the request was
+                        # implicated in its last allowed crash): same
+                        # actionable 400 as the submit-time refusal
+                        rec["quarantined"] = True
+                        rec["fingerprint"] = rr.fingerprint
+                        rec["retriable"] = False
+                        self._json(400, rec)
                         return
                     self._json(200, rec)
                     return

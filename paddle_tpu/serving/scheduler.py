@@ -4,9 +4,18 @@ depth (backpressure), per-request deadlines, and cancellation.
 Iteration-level scheduling (Orca) splits serving into two loops: the
 ADMISSION decision (this module — which request gets the next free slot)
 and the ITERATION itself (engine.py — one decode step for every running
-slot). FCFS is deliberately the whole policy here: the TPU-side design
-makes admission cheap enough (bucketed prefill + cache splice, no
-recompile) that fancier policies are a drop-in swap of ``pop_ready``.
+slot). FCFS within a priority class is deliberately the whole policy
+here: the TPU-side design makes admission cheap enough (bucketed
+prefill + cache splice, no recompile) that fancier policies are a
+drop-in swap of ``pop_ready``.
+
+Overload control (the DAGOR shape — Zhou et al., SoCC'18): when the
+queue is FULL and a higher-priority request arrives, the newest
+lowest-class queued request is SHED (rejected with an explicit error)
+to make room — batch work absorbs the pressure before interactive work
+ever bounces. And a request whose deadline cannot beat the live
+queue-wait p50 is rejected AT ADMISSION (429 + Retry-After) instead of
+queued: work that will expire in the queue is load with zero goodput.
 """
 
 from __future__ import annotations
@@ -19,12 +28,25 @@ from typing import Optional
 from . import metrics as _sm
 from .request import Request, RequestStatus
 
-__all__ = ["Scheduler", "QueueFullError"]
+__all__ = ["Scheduler", "QueueFullError", "DeadlineInfeasibleError"]
 
 
 class QueueFullError(RuntimeError):
     """Backpressure: the admission queue is at max depth. Callers should
     shed load or retry later — the engine NEVER buffers unboundedly."""
+
+
+class DeadlineInfeasibleError(QueueFullError):
+    """Admission-time rejection: the request's deadline cannot beat the
+    live queue-wait estimate, so queueing it would only produce an
+    EXPIRED request later. Subclasses ``QueueFullError`` so every
+    existing backpressure surface (HTTP 429 + Retry-After, the
+    router's saturated-backoff path) handles it for free;
+    ``retry_after_s`` carries the wait estimate the deadline lost to."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class Scheduler:
@@ -45,18 +67,63 @@ class Scheduler:
             return len(self._q)
 
     def submit(self, req: Request):
-        """FCFS enqueue. Raises ``QueueFullError`` (and marks the request
-        REJECTED) when the queue is at max depth — the clear-rejection
-        contract: the caller knows immediately, nothing is dropped
-        silently."""
+        """FCFS enqueue with priority-aware overload control.
+
+        Raises ``QueueFullError`` (and marks the request REJECTED) when
+        the queue is at max depth and holds nothing of a strictly lower
+        priority class — the clear-rejection contract: the caller knows
+        immediately, nothing is dropped silently. When a LOWER class is
+        queued, the newest such request is shed instead (it is the one
+        that has invested the least wait) and the arrival is admitted.
+        Raises ``DeadlineInfeasibleError`` when the queue is non-empty
+        and the request's remaining deadline cannot beat the live
+        queue-wait p50 — failing fast at admission beats queueing work
+        that will expire before a slot frees."""
         with self._lock:
+            if req.deadline_ts is not None and self._q:
+                est = _sm.queue_wait_p50()
+                remaining = req.deadline_ts - time.perf_counter()
+                if est is not None and remaining <= est:
+                    req.finish(
+                        RequestStatus.REJECTED,
+                        error=f"deadline infeasible: {remaining:.3f}s "
+                              f"remain but the queue-wait p50 is "
+                              f"{est:.3f}s")
+                    _sm.requests_total.labels("rejected").inc()
+                    _sm.deadline_rejected_total.labels(req.priority).inc()
+                    raise DeadlineInfeasibleError(
+                        f"deadline cannot beat the queue: {remaining:.3f}s "
+                        f"remain, queue-wait p50 is {est:.3f}s — retry "
+                        f"with a later deadline or back off",
+                        retry_after_s=round(est, 3))
             if len(self._q) >= self.max_queue_depth:
-                req.finish(RequestStatus.REJECTED,
-                           error=f"queue full (depth {self.max_queue_depth})")
+                victim = None
+                rank = req.params.priority_rank
+                for cand in reversed(self._q):  # newest lowest class
+                    if cand.params.priority_rank < rank and \
+                            (victim is None or cand.params.priority_rank
+                             < victim.params.priority_rank):
+                        victim = cand
+                        if victim.params.priority_rank == 0:
+                            break
+                if victim is None:
+                    req.finish(RequestStatus.REJECTED,
+                               error=f"queue full "
+                                     f"(depth {self.max_queue_depth})")
+                    _sm.requests_total.labels("rejected").inc()
+                    raise QueueFullError(
+                        f"serving queue is full ({self.max_queue_depth} "
+                        f"requests waiting); retry later or raise "
+                        f"max_queue_depth")
+                self._q.remove(victim)
+                victim.finish(
+                    RequestStatus.REJECTED,
+                    error=f"shed under queue pressure: class "
+                          f"{victim.priority} yielded its place to an "
+                          f"arriving {req.priority} request — retry "
+                          f"later")
                 _sm.requests_total.labels("rejected").inc()
-                raise QueueFullError(
-                    f"serving queue is full ({self.max_queue_depth} requests "
-                    f"waiting); retry later or raise max_queue_depth")
+                _sm.requests_shed_total.labels(victim.priority).inc()
             req.status = RequestStatus.QUEUED
             self._q.append(req)
             _sm.queue_depth.set(len(self._q))
@@ -87,6 +154,20 @@ class Scheduler:
         table's waiting section)."""
         with self._lock:
             return list(self._q)
+
+    def detach_all(self) -> list:
+        """Remove and return every queued request WITHOUT finishing
+        them (FCFS order) — the supervisor's crash-capture hook. A
+        queued request was never touched by the crashing step; handing
+        it to a rebuilt engine instead of failing it is the whole
+        point of supervised restart (``Request.finish`` is idempotent
+        and irreversible, so capture must happen BEFORE the crash
+        path's ``_fail_inflight`` can reach the queue)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            _sm.queue_depth.set(0)
+            return out
 
     def depth_spec_opted_out(self) -> int:
         """Queued requests that opted OUT of speculation

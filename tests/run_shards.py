@@ -102,6 +102,14 @@ TPU_LANE = [
     # real DMAs; pair with benchmarks/bench_kv_tier.py for the >=80%
     # recompute-elimination acceptance
     ("test_kv_tier.py", 600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # self-healing supervisor: warm restart / quarantine / brownout are
+    # host-side by design, but the zero-retrace-after-rebuild-warmup and
+    # bit-identical-replay-of-innocents invariants deserve one compiled
+    # run (a fresh engine's warmup compiles against the REAL backend and
+    # crash/restart timing differs from CPU); pair with
+    # benchmarks/bench_overload.py for the <2% supervisor-overhead and
+    # >=80% controlled-goodput acceptances
+    ("test_supervisor.py", 600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     # perf observability: on chip the peak table resolves from the real
     # device_kind, so MFU/roofline go from "unknown" to classified —
     # this entry is the first run where the ledger publishes real MFU
@@ -467,6 +475,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
     router_bench = _read_bench("bench_router.json")
     tp_bench = _read_bench("bench_tp.json")
     kv_tier_bench = _read_bench("bench_kv_tier.json")
+    overload_bench = _read_bench("bench_overload.json")
     bench_dir = os.path.join(os.path.dirname(HERE), "benchmarks")
     perf_ledger, gate_rc = build_perf_ledger_block(
         bench_dir, totals.pop("perf_entries"))
@@ -488,6 +497,7 @@ def merge_telemetry_snapshots(dump_prefix: str, platform: str) -> tuple:
             "router_bench": router_bench,
             "tp_bench": tp_bench,
             "kv_tier_bench": kv_tier_bench,
+            "overload_bench": overload_bench,
         }, fh, indent=1)
     print(f"[run_shards] telemetry lane -> {out_path} "
           f"(compiles {totals['compiles_total']}, fused-conv hit rate "

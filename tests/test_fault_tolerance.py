@@ -522,6 +522,7 @@ class TestServingEngineCrash:
         eng._running = True
         eng._thread = None
         eng._crashed = None
+        eng._crash_hook = None  # unsupervised: crash fails everything
         eng._steps = 0
         eng._occupancy_integral = 0
         # round-8 observability state: the /debug/requests recent ring +

@@ -196,6 +196,10 @@ class TestSchedulerPolicies:
         eng = serving.ServingEngine(model, max_slots=1, max_len=64)
         rng = np.random.RandomState(29)
         blocker = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=8)
+        eng.step()  # blocker takes the lone slot; the queue drains
+        # queue empty at submit -> the deadline-infeasibility admission
+        # gate stays out of the way; this test pins the QUEUED-request
+        # expiry path (admission-time rejection is test_supervisor's)
         doomed = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=8,
                             deadline_s=0.0)
         time.sleep(0.01)

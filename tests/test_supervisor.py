@@ -1,0 +1,490 @@
+"""Self-healing serving suite (paddle_tpu/serving/supervisor.py).
+
+Invariants asserted under injected faults:
+
+- WARM RESTART, NO INNOCENT FAILURES: a supervised decode-loop crash
+  requeues every queued and running request onto the rebuilt engine —
+  the PR-4 fail-everything semantics are the unsupervised fallback, not
+  the supervised behavior. Every innocent request COMPLETES with output
+  bit-identical to a single-engine ``generation.generate`` run (greedy
+  AND sampled: the seed-deterministic PRNG replay is exact), and the
+  restart itself causes zero retraces (the fresh engine's ``warmup()``
+  is the zero-compile boot).
+- CRASH-LOOP BREAKER: more than ``max_restarts`` crashes inside
+  ``restart_window_s`` stop the restarting — the supervisor stays
+  crashed, pending work fails with an explicit crash-loop error, and
+  ``/healthz`` reports ``restarts_exhausted`` so a router ejects it.
+- POISON QUARANTINE: a request that deterministically crashes the step
+  is implicated once per crash (solo-probe isolation: a suspect is
+  re-admitted ALONE, so a repeat crash convicts exactly one
+  fingerprint), fails terminally with ``PoisonedRequestError`` after
+  ``quarantine_crashes`` strikes, and is refused at submit thereafter.
+  Fleet-wide: the router learns the blacklist via ``/stats`` and the
+  retry path — ONE poison request among many costs the whole fleet at
+  most ``quarantine_crashes`` restarts, over LocalReplica and real
+  HTTP alike.
+- OVERLOAD CONTROL: the scheduler sheds lowest-priority-class work
+  under queue pressure (DAGOR shape), rejects deadline-infeasible
+  arrivals at admission, and the router's SLO-driven brownout ladder
+  sheds batch work / disables hedging while the error budget burns,
+  with hysteresis on the way back down.
+
+All faults are deterministic (fingerprint- or step-count-triggered) —
+see ``paddle_tpu/serving/chaos.py``.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import generation, serving
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import fleet, recompile
+from paddle_tpu.serving.supervisor import POISON_MARKER
+
+SEED = 4321
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _prompt(rng, cfg, n):
+    return rng.randint(1, cfg.vocab_size, n).astype("int32")
+
+
+def _supervisor(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    return serving.EngineSupervisor(model, **kw)
+
+
+def _serving_retraces():
+    return sum(v["retraces"] for k, v in recompile.entry_stats().items()
+               if k.startswith("serving."))
+
+
+def _fingerprint(prompt, spec):
+    return serving.request_fingerprint(
+        np.asarray(prompt, np.int32), serving.SamplingParams(**spec))
+
+
+def _drive(router, rrs, timeout=120.0, probe=True):
+    t0 = time.monotonic()
+    while not all(r.done for r in rrs):
+        if probe:
+            router.probe_once()
+        time.sleep(0.01)
+        assert time.monotonic() - t0 < timeout, (
+            f"requests stuck: {[r.status for r in rrs]}")
+
+
+def _ref(model, p, s):
+    return generation.generate(model, p[None], **s).numpy()[0, len(p):]
+
+
+# ---------------------------------------------------------------------------
+# warm restart: innocents carried across the crash
+# ---------------------------------------------------------------------------
+
+class TestWarmRestart:
+    def test_crash_requeues_innocents_bit_identical(self, tiny_model):
+        """The PR-4 regression pin: a supervised crash fails ZERO
+        innocent requests. Queued and running requests ride to the
+        rebuilt engine and complete bit-identical (greedy AND sampled),
+        and the warm restart retraces nothing."""
+        model, cfg = tiny_model
+        sup = _supervisor(model)
+        sup.warmup()
+        retr0 = _serving_retraces()
+        monkey = serving.ChaosEngine(sup.engine).crash_after_steps(2)
+        rng = np.random.RandomState(SEED)
+        specs = [dict(max_new_tokens=8),
+                 dict(max_new_tokens=8, do_sample=True, top_k=8, seed=7),
+                 dict(max_new_tokens=6, do_sample=True, top_p=0.9, seed=3),
+                 dict(max_new_tokens=7)]
+        prompts = [_prompt(rng, cfg, 4 + i) for i in range(len(specs))]
+        reqs = [sup.submit(p, **s) for p, s in zip(prompts, specs)]
+        sup.run_until_idle()
+        assert monkey.injected["crash"] == 1  # the fault fired
+        assert sup.restarts == 1
+        assert not sup.broken
+        for req, p, s in zip(reqs, prompts, specs):
+            assert req.status == serving.RequestStatus.COMPLETED, req.error
+            np.testing.assert_array_equal(
+                np.asarray(req.result(1.0)), _ref(model, p, s))
+        # zero-retrace boot: the rebuilt engine's compiles are warmup
+        # entries (inside warmup_scope), never retraces of live traffic
+        assert _serving_retraces() == retr0
+        st = sup.supervisor_stats()
+        assert st["crashes"] == 1 and st["restarts"] == 1
+        assert st["quarantined"] == []  # one crash implicates no one
+
+    def test_crash_loop_breaker_stays_crashed(self, tiny_model):
+        """More than ``max_restarts`` crashes in the window trip the
+        breaker: pending work fails with an explicit crash-loop error,
+        health reports ``restarts_exhausted``, submit refuses."""
+        model, cfg = tiny_model
+        sup = _supervisor(model, max_restarts=1, restart_window_s=60.0)
+        sup.warmup()
+        chaos = serving.SupervisedChaos(
+            sup, arm=lambda m: m.crash_after_steps(0))
+        rng = np.random.RandomState(SEED + 1)
+        req = sup.submit(_prompt(rng, cfg, 5), max_new_tokens=4)
+        sup.run_until_idle()
+        assert chaos.injected["crash"] == 2  # crash, restart, crash
+        assert sup.broken
+        assert sup.restarts == 1  # the budget was spent, then tripped
+        assert req.status == serving.RequestStatus.FAILED
+        assert "crash-loop" in req.error
+        code, payload = sup.health()
+        assert code == 503
+        assert payload["status"] == "crashed"
+        assert payload["restarts_exhausted"] is True
+        assert payload["supervisor"]["broken"] is True
+        with pytest.raises(RuntimeError, match="crashed"):
+            sup.submit(_prompt(rng, cfg, 5), max_new_tokens=4)
+
+
+# ---------------------------------------------------------------------------
+# poison quarantine, single supervisor
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_poison_quarantined_innocents_survive(self, tiny_model):
+        """One poison request (crashes every step it runs in) among
+        innocents: exactly ``quarantine_crashes`` restarts, the poison
+        fails terminally with the marker, every innocent completes
+        bit-identical, and resubmitting the fingerprint is refused."""
+        model, cfg = tiny_model
+        sup = _supervisor(model, quarantine_crashes=2, max_restarts=3)
+        sup.warmup()
+        rng = np.random.RandomState(SEED + 2)
+        poison_prompt = _prompt(rng, cfg, 6)
+        poison_spec = dict(max_new_tokens=8)
+        fp = _fingerprint(poison_prompt, poison_spec)
+        chaos = serving.SupervisedChaos(
+            sup, arm=lambda m: m.poison_fingerprint(fp))
+        specs = [dict(max_new_tokens=8),
+                 dict(max_new_tokens=6, do_sample=True, top_k=8, seed=11),
+                 dict(max_new_tokens=7)]
+        prompts = [_prompt(rng, cfg, 4 + i) for i in range(len(specs))]
+        poison = sup.submit(poison_prompt, **poison_spec)
+        reqs = [sup.submit(p, **s) for p, s in zip(prompts, specs)]
+        sup.run_until_idle()
+        # the identity fault fired once per admission of the suspect:
+        # co-running crash, then the solo-probe crash that convicted it
+        assert chaos.injected["poison"] == 2
+        assert sup.restarts == 2
+        assert not sup.broken
+        assert poison.status == serving.RequestStatus.FAILED
+        assert POISON_MARKER in poison.error
+        assert fp in poison.error  # actionable: names the fingerprint
+        assert sup.is_quarantined(fp)
+        assert sup.quarantined == [fp]
+        for req, p, s in zip(reqs, prompts, specs):
+            assert req.status == serving.RequestStatus.COMPLETED, req.error
+            np.testing.assert_array_equal(
+                np.asarray(req.result(1.0)), _ref(model, p, s))
+        st = sup.supervisor_stats()
+        assert st["quarantine"][0]["fingerprint"] == fp
+        assert st["quarantine"][0]["crashes"] == 2
+        with pytest.raises(serving.PoisonedRequestError) as ei:
+            sup.submit(poison_prompt, **poison_spec)
+        assert ei.value.fingerprint == fp
+
+    def test_router_poison_chaos_one_poison_among_twenty(self, tiny_model):
+        """The fleet acceptance lane: 1 poison + 19 normal requests
+        (greedy AND sampled) over a 2-supervised-replica router. The
+        poison costs the FLEET at most ``quarantine_crashes`` restarts,
+        fails with the marker, lands on the router's blacklist (learned
+        from /stats or the conviction path), and every innocent
+        completes bit-identical."""
+        model, cfg = tiny_model
+        s0 = _supervisor(model, quarantine_crashes=2, max_restarts=3)
+        s1 = _supervisor(model, quarantine_crashes=2, max_restarts=3)
+        rng = np.random.RandomState(SEED + 3)
+        poison_prompt = _prompt(rng, cfg, 6)
+        poison_spec = dict(max_new_tokens=8)
+        fp = _fingerprint(poison_prompt, poison_spec)
+        chaos0 = serving.SupervisedChaos(
+            s0, arm=lambda m: m.poison_fingerprint(fp))
+        chaos1 = serving.SupervisedChaos(
+            s1, arm=lambda m: m.poison_fingerprint(fp))
+        cfgr = serving.RouterConfig(probe_failures_to_eject=3,
+                                    max_retries_per_request=2,
+                                    unroutable_timeout_s=15.0)
+        router = serving.Router([s0, s1], cfgr)
+        specs, prompts = [], []
+        for i in range(19):
+            if i % 3 == 1:
+                specs.append(dict(max_new_tokens=6, do_sample=True,
+                                  top_k=8, seed=20 + i))
+            elif i % 3 == 2:
+                specs.append(dict(max_new_tokens=6, do_sample=True,
+                                  top_p=0.9, seed=40 + i))
+            else:
+                specs.append(dict(max_new_tokens=7))
+            prompts.append(_prompt(rng, cfg, 3 + (i % 6)))
+        # parity oracles traced up front: generate() tracing must not
+        # run concurrently with a rebuild thread's warmup tracing
+        refs = [_ref(model, p, s) for p, s in zip(prompts, specs)]
+        try:
+            rr_poison = router.submit(poison_prompt, **poison_spec)
+            rrs = [router.submit(p, **s) for p, s in zip(prompts, specs)]
+            _drive(router, [rr_poison] + rrs)
+            # fleet-wide restart bill for one poison request
+            fired = chaos0.injected["poison"] + chaos1.injected["poison"]
+            assert fired == 2
+            assert s0.restarts + s1.restarts <= 2
+            assert not (s0.broken or s1.broken)
+            assert rr_poison.status == serving.RequestStatus.FAILED
+            assert POISON_MARKER in rr_poison.error
+            assert sorted(s0.quarantined + s1.quarantined) == [fp]
+            # zero innocent casualties, bit-identical outputs
+            for rr, ref in zip(rrs, refs):
+                assert rr.status == serving.RequestStatus.COMPLETED, rr.error
+                np.testing.assert_array_equal(
+                    np.asarray(rr.result(1.0)), ref)
+            # the router convicted the fingerprint (stats gossip or the
+            # in-flight conviction path) and now refuses it at submit
+            qs = router.stats()["quarantine"]
+            assert fp in qs["fingerprints"]
+            with pytest.raises(serving.PoisonedRequestError):
+                router.submit(poison_prompt, **poison_spec)
+        finally:
+            router.stop(drain=True, timeout_s=10)
+
+    def test_quarantine_propagates_over_http(self, tiny_model):
+        """Satellite (c): the same verdict over the REAL process
+        boundary — supervised engines behind ``ServingHTTPServer``,
+        ``HTTPReplica`` clients, the router's own HTTP front end. The
+        poison POST gets an actionable 400 (``quarantined: true``),
+        innocents stream to completion, and a resubmit is refused at
+        the router's gate without touching any replica."""
+        model, cfg = tiny_model
+        s0 = _supervisor(model, quarantine_crashes=2, max_restarts=3)
+        s1 = _supervisor(model, quarantine_crashes=2, max_restarts=3)
+        s0.warmup()
+        s1.warmup()
+        rng = np.random.RandomState(SEED + 4)
+        poison_prompt = _prompt(rng, cfg, 5)
+        poison_spec = dict(max_new_tokens=6)
+        fp = _fingerprint(poison_prompt, poison_spec)
+        serving.SupervisedChaos(s0, arm=lambda m: m.poison_fingerprint(fp))
+        serving.SupervisedChaos(s1, arm=lambda m: m.poison_fingerprint(fp))
+        h0 = serving.ServingHTTPServer(s0, port=0)
+        h1 = serving.ServingHTTPServer(s1, port=0)
+        router = serving.Router(
+            [serving.HTTPReplica(f"http://127.0.0.1:{h0.port}"),
+             serving.HTTPReplica(f"http://127.0.0.1:{h1.port}")],
+            serving.RouterConfig(max_retries_per_request=2,
+                                 unroutable_timeout_s=15.0))
+        front = serving.RouterHTTPServer(router, port=0)
+        base = f"http://127.0.0.1:{front.port}"
+
+        def _post(body, timeout=90.0):
+            req = urllib.request.Request(
+                base + "/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        specs = [dict(max_new_tokens=6),
+                 dict(max_new_tokens=5, do_sample=True, top_k=8, seed=13),
+                 dict(max_new_tokens=6)]
+        prompts = [_prompt(rng, cfg, 4 + i) for i in range(len(specs))]
+        # oracles traced BEFORE any traffic: generate() tracing must not
+        # race a supervisor rebuild thread's warmup tracing
+        refs = [_ref(model, p, s).astype(np.int64)
+                for p, s in zip(prompts, specs)]
+        try:
+            code, rec = _post({"prompt": [int(t) for t in poison_prompt],
+                               **poison_spec})
+            assert code == 400
+            assert rec["quarantined"] is True
+            assert rec["retriable"] is False
+            assert rec["fingerprint"] == fp  # mid-flight verdict names it
+            assert POISON_MARKER in rec["error"]
+            # innocents stream over the same fleet, full records
+            for p, s, ref in zip(prompts, specs, refs):
+                req = urllib.request.Request(
+                    base + "/generate",
+                    data=json.dumps({"prompt": [int(t) for t in p],
+                                     "stream": True, **s}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=90.0) as resp:
+                    lines = [json.loads(ln) for ln in resp]
+                done = lines[-1]
+                assert done["status"] == serving.RequestStatus.COMPLETED, \
+                    done.get("error")
+                toks = [ln["token"] for ln in lines[:-1]]
+                np.testing.assert_array_equal(np.asarray(toks, np.int64),
+                                              ref)
+            assert s0.restarts + s1.restarts <= 2
+            # submit-time refusal at the router gate: immediate 400
+            code, rec = _post({"prompt": [int(t) for t in poison_prompt],
+                               **poison_spec}, timeout=10.0)
+            assert code == 400 and rec["quarantined"] is True
+            assert rec["fingerprint"] == fp
+        finally:
+            front.stop()
+            router.stop(drain=True, timeout_s=10)
+            h0.stop()
+            h1.stop()
+
+
+# ---------------------------------------------------------------------------
+# overload control: priority shed, deadline admission, brownout
+# ---------------------------------------------------------------------------
+
+class TestOverloadControl:
+    def test_priority_shed_lowest_class_first(self, tiny_model):
+        """DAGOR-shape shedding: a full queue sheds its newest
+        batch-class request to admit an interactive arrival; an
+        all-interactive full queue still bounces the arrival."""
+        model, cfg = tiny_model
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64,
+                                    max_queue_depth=2)
+        rng = np.random.RandomState(SEED + 5)
+        b1 = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4,
+                        priority="batch")
+        b2 = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4,
+                        priority="batch")
+        inter = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)
+        assert inter.status == serving.RequestStatus.QUEUED
+        assert b1.status == serving.RequestStatus.QUEUED  # oldest survives
+        assert b2.status == serving.RequestStatus.REJECTED  # newest shed
+        assert "shed under queue pressure" in b2.error
+        assert "batch" in b2.error and "interactive" in b2.error
+        # the next interactive arrival sheds the remaining batch entry
+        inter2 = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)
+        assert inter2.status == serving.RequestStatus.QUEUED
+        assert b1.status == serving.RequestStatus.REJECTED
+        # nothing lower-class queued: the arrival itself is rejected
+        with pytest.raises(serving.QueueFullError):
+            eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)
+        # and batch never sheds interactive
+        with pytest.raises(serving.QueueFullError):
+            eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4,
+                       priority="batch")
+
+    def test_deadline_infeasible_rejected_at_admission(self, tiny_model,
+                                                       monkeypatch):
+        """A deadline that cannot beat the live queue-wait p50 is
+        rejected AT ADMISSION (429-shaped, Retry-After = the estimate)
+        instead of queued to expire."""
+        from paddle_tpu.serving import scheduler as sched_mod
+        model, cfg = tiny_model
+        monkeypatch.setattr(sched_mod._sm, "queue_wait_p50",
+                            lambda min_count=8: 0.5)
+        eng = serving.ServingEngine(model, max_slots=2, max_len=64)
+        rng = np.random.RandomState(SEED + 6)
+        eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4)  # non-empty queue
+        with pytest.raises(serving.DeadlineInfeasibleError) as ei:
+            eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4,
+                       deadline_s=0.1)
+        assert ei.value.retry_after_s == 0.5
+        assert isinstance(ei.value, serving.QueueFullError)  # 429 surface
+        # a feasible deadline still queues
+        ok = eng.submit(_prompt(rng, cfg, 4), max_new_tokens=4,
+                        deadline_s=5.0)
+        assert ok.status == serving.RequestStatus.QUEUED
+
+    def test_brownout_controller_ladder(self):
+        """Unit: escalation one level per unhealthy report, hysteresis
+        on recovery (streak + dwell), idle fleets never brown out."""
+        t = [0.0]
+        ctl = fleet.BrownoutController(recover_reports=2, min_dwell_s=1.0,
+                                       clock=lambda: t[0])
+        bad = {"ok": False, "observed": 10}
+        good = {"ok": True, "observed": 10}
+        idle = {"ok": False, "observed": 0}
+        assert ctl.level_name == "normal"
+        ctl.update(bad)
+        assert ctl.level == 1 and ctl.shed_batch
+        ctl.update(bad)  # dwell not elapsed: stays put
+        assert ctl.level == 1
+        t[0] = 1.5
+        ctl.update(bad)
+        assert ctl.level == 2 and ctl.hedge_disabled
+        t[0] = 3.0
+        ctl.update(bad)
+        t[0] = 4.5
+        ctl.update(bad)
+        assert ctl.level == 4 and ctl.cap_batch_tokens and ctl.shrink_spec
+        t[0] = 6.0
+        ctl.update(bad)  # top of the ladder: stays
+        assert ctl.level_name == "shrink_spec"
+        # recovery needs a streak of healthy reports AND the dwell
+        ctl.update(good)
+        assert ctl.level == 4
+        t[0] = 7.5
+        ctl.update(good)  # streak == 2: de-escalate
+        assert ctl.level == 3
+        ctl.update(idle)  # an idle fleet reads as healthy...
+        t[0] = 9.0
+        ctl.update(idle)  # ...and keeps de-escalating
+        assert ctl.level == 2
+        rep = ctl.report()
+        assert rep["level"] == 2
+        assert rep["level_name"] == "no_hedge"
+        assert rep["actions"]["hedge_disabled"] is True
+        assert rep["actions"]["shed_batch"] is True
+        assert rep["actions"]["cap_batch_tokens"] is False
+        dirs = [tr["direction"] for tr in rep["transitions"]]
+        assert dirs == ["escalate"] * 4 + ["recover"] * 2
+        # a new unhealthy report resets the streak immediately
+        t[0] = 10.5
+        ctl.update(bad)
+        assert ctl.level == 3
+
+    def test_brownout_sheds_batch_while_slo_burns(self, tiny_model):
+        """Router integration: burning the availability budget in both
+        windows escalates the ladder on the probe cadence; batch-class
+        submits are then shed with a 429-shaped error while the burn
+        lasts, and recovery re-admits them."""
+        model, cfg = tiny_model
+        slo = fleet.SLOConfig(fast_window_s=0.6, slow_window_s=0.6)
+        router = serving.Router([], serving.RouterConfig(
+            slo=slo, brownout_min_dwell_s=0.0,
+            brownout_recover_reports=1))
+        rng = np.random.RandomState(SEED + 7)
+        p = _prompt(rng, cfg, 4)
+        for _ in range(20):
+            router._slo.observe("failed", None, False)
+        assert router.slo_report()["ok"] is False
+        router.probe_once()  # one control tick: level 1, shed_batch
+        rep = router.slo_report()["brownout"]
+        assert rep["level"] >= 1 and rep["actions"]["shed_batch"]
+        with pytest.raises(serving.QueueFullError, match="brownout"):
+            router.submit(p, max_new_tokens=4, priority="batch")
+        router.probe_once()  # still burning: hedge goes next
+        assert router.slo_report()["brownout"]["actions"]["hedge_disabled"]
+        # interactive work is never brownout-shed (it fails on routing
+        # instead: this router has no replicas at all)
+        with pytest.raises(serving.NoReplicaError):
+            router.submit(p, max_new_tokens=4,
+                          deadline_s=0.2)
+        # recovery: the failures age out of both windows
+        time.sleep(0.7)
+        for _ in range(3):
+            router._slo.observe("completed", 0.01, True)
+        assert router.slo_report()["ok"] is True
+        for _ in range(4):
+            router.probe_once()
+        assert router.slo_report()["brownout"]["level"] == 0
+        with pytest.raises(serving.NoReplicaError):
+            # batch is admitted past the brownout gate again
+            router.submit(p, max_new_tokens=4, priority="batch")
