@@ -17,12 +17,35 @@ identical request sets decoded through (a) the plain paged engine and
   overhead floor: every round pays k draft forwards + one k+1-wide
   verify and advances one token.
 
+Tree lanes ride the same harness at EQUAL drafted-token budget vs the
+chain: ``spec_tree=[1,1,1,1]`` (the chain as a degenerate tree — the
+mechanical-overhead ceiling pin vs ``spec_k=4``) and ``spec_tree=[2,2]``
+vs ``spec_k=6`` (same 6-token budget; the branching payoff is measured
+at the adversarial floor where the 2-level draft halves round cost).
+
 The bench asserts while it measures:
-- every speculative request bit-matches its plain-engine twin (the
-  coupling contract: speculation NEVER changes output);
+- every speculative request — chain AND tree — bit-matches its
+  plain-engine twin (the coupling contract: speculation NEVER changes
+  output);
 - zero spec_draft/spec_verify compiles in the measured passes (warmup
   compiled them; accept-length patterns are data);
-- best coupled config reaches >= 1.3x plain paged decode tok/s.
+- best coupled config reaches >= 1.3x plain paged decode tok/s;
+- the tree beats the chain at equal drafted budget on the adversarial
+  floor (``[2,2]`` vs ``spec_k=6``: two level forwards replace six
+  serial draft steps per round, so the round is cheaper where
+  acceptance is draft-quality-bound — the lane branching exists for).
+
+The coupled accept-1.0 ceiling is where a branching tree CANNOT beat a
+chain at equal budget (chain k=6 commits 7 tokens/round; tree [2,2]
+commits 3), so the coupled lanes are reported, not gated. Even the
+degenerate ``[1,1,1,1]`` twin pays a structural CPU-box tax vs
+``spec_k=4``: the tree draft runs D level forwards PLUS one write-only
+full-width forward (leaf KV), D+1 dispatches vs the chain's D, and
+re-feeds the whole tree-so-far each level (the kernel's in-bundle
+ancestor mask is square). On chip those extra dispatches are
+bandwidth-amortized; on this dispatch-bound box the ratio measures
+~D/(D+1). That ratio is pinned in ``perf_baseline.json`` as a
+mechanical-overhead REGRESSION guard, not a >=1 claim.
 
 Artifact: ``benchmarks/bench_spec_decode.json`` — per (k, occupancy,
 draft) tok/s + accept rates + verdicts; ``tests/run_shards.py`` folds it
@@ -192,6 +215,93 @@ def main():
         adv[OCCUPANCIES[0]]["tok_s"] / plain[OCCUPANCIES[0]]["tok_s"], 2)
     result["spec_k4_adversarial"] = adv
 
+    # --- tree lanes: tree vs chain at EQUAL drafted-token budget -----------
+    # (a) [1,1,1,1] is the chain expressed as a degenerate tree — same
+    #     4-token budget, same serial draft depth, same accepts as
+    #     spec_k=4 — so its coupled accept-1.0 ratio isolates the tree
+    #     lane's mechanical overhead (ancestor-mask operand, path-move
+    #     commit, per-branch folded keys) and must not lose to the
+    #     chain. This is the tree>=chain equal-budget ceiling pin.
+    # (b) [2,2] drafts the SAME 6-token budget as spec_k=6 in 2 level
+    #     forwards instead of 6 serial ones. A branching tree spends
+    #     its budget on siblings, not depth, so on a deterministic
+    #     accept-1.0 workload its ceiling sits BELOW the chain's by
+    #     construction (3 commits/round vs 7) — reported honestly, not
+    #     gated. The branching payoff shows at the adversarial floor:
+    #     rounds are ~half the forwards, so tok/s at accept~0 is
+    #     strictly better, and real workloads interpolate toward it as
+    #     sibling hedges rescue rejected chains.
+    tree_parity_ok = True
+    tree_id, tree_id_out, id_compiles, id_retraces = bench_engine(
+        lambda: serving.ServingEngine(
+            target, draft_model=draft, spec_tree=[1, 1, 1, 1], **eng_kw()),
+        prompt_sets, spec_entries)
+    if any(id_compiles.values()) or any(id_retraces.values()):
+        zero_compiles = False
+    chain4 = result["spec_k4_coupled"]["by_occupancy"]
+    for occ in OCCUPANCIES:
+        if tree_id_out[occ] != plain_out[occ]:
+            tree_parity_ok = False
+        tree_id[occ]["tok_s_ratio_vs_chain"] = round(
+            tree_id[occ]["tok_s"] / chain4[occ]["tok_s"], 3)
+    result["spec_tree_1111_coupled"] = {
+        "by_occupancy": tree_id, "chain_twin": "spec_k4_coupled",
+        "measured_pass_compiles": id_compiles,
+        "measured_pass_retraces": id_retraces,
+    }
+
+    occ1 = OCCUPANCIES[0]
+    chain6, chain6_out, _, _ = bench_engine(
+        lambda: serving.ServingEngine(
+            target, draft_model=draft, spec_k=6, **eng_kw()),
+        prompt_sets[:1], spec_entries)
+    tree22, tree22_out, t22_compiles, t22_retraces = bench_engine(
+        lambda: serving.ServingEngine(
+            target, draft_model=draft, spec_tree=[2, 2], **eng_kw()),
+        prompt_sets[:1], spec_entries)
+    chain6_adv, chain6_adv_out, _, _ = bench_engine(
+        lambda: serving.ServingEngine(
+            target, draft_model=adversarial, spec_k=6, **eng_kw()),
+        prompt_sets[:1], spec_entries)
+    tree22_adv, tree22_adv_out, _, _ = bench_engine(
+        lambda: serving.ServingEngine(
+            target, draft_model=adversarial, spec_tree=[2, 2], **eng_kw()),
+        prompt_sets[:1], spec_entries)
+    for out in (chain6_out, tree22_out, chain6_adv_out, tree22_adv_out):
+        if out[occ1] != plain_out[occ1]:
+            tree_parity_ok = False
+    if any(t22_compiles.values()) or any(t22_retraces.values()):
+        zero_compiles = False
+    result["equal_budget_6"] = {
+        "chain_k6_coupled": chain6[occ1],
+        "tree_22_coupled": dict(
+            tree22[occ1], tok_s_ratio_vs_chain=round(
+                tree22[occ1]["tok_s"] / chain6[occ1]["tok_s"], 3)),
+        "chain_k6_adversarial": chain6_adv[occ1],
+        "tree_22_adversarial": dict(
+            tree22_adv[occ1], tok_s_ratio_vs_chain=round(
+                tree22_adv[occ1]["tok_s"] / chain6_adv[occ1]["tok_s"], 3)),
+    }
+
+    tree_ratio = max(tree_id[occ]["tok_s_ratio_vs_chain"]
+                     for occ in OCCUPANCIES)
+    floor_ratio = result["equal_budget_6"]["tree_22_adversarial"][
+        "tok_s_ratio_vs_chain"]
+    result["spec_tree"] = {
+        # degenerate-tree twin vs spec_k=4, coupled: the mechanical-
+        # overhead pin (D+1 draft dispatches vs D + whole-tree re-feed
+        # ~= D/(D+1) on this dispatch-bound box; bandwidth-amortized on
+        # chip). A regression guard via perf_baseline.json, NOT a >=1
+        # claim — see module docstring.
+        "tok_s_ratio_vs_chain": tree_ratio,
+        "adversarial_floor_ratio_vs_chain": floor_ratio,
+        "parity": 1.0 if tree_parity_ok else 0.0,
+    }
+    # equal-budget verdict on the lane branching exists for: where
+    # acceptance is draft-quality-bound, two [2,2] level forwards must
+    # beat six serial chain draft steps per round
+    result["tree_ge_chain_equal_budget"] = bool(floor_ratio >= 1.0)
+
     best = max(
         result[f"spec_k{k}_coupled"]["by_occupancy"][occ]
         ["speedup_vs_plain"]
@@ -201,7 +311,7 @@ def main():
         for k in KS for occ in OCCUPANCIES)
     result["best_speedup"] = best
     result["best_config_accept_rate"] = best_rate
-    result["per_request_parity"] = bool(parity_ok)
+    result["per_request_parity"] = bool(parity_ok and tree_parity_ok)
     result["zero_spec_compiles_measured"] = bool(zero_compiles)
     result["acceptance_1p3x"] = bool(best >= 1.3)
 
@@ -211,7 +321,8 @@ def main():
     print(json.dumps(result, indent=1))
     print(f"[bench_spec_decode] artifact -> {path}")
 
-    ok = parity_ok and zero_compiles and best >= 1.3
+    ok = (parity_ok and tree_parity_ok and zero_compiles and best >= 1.3
+          and result["tree_ge_chain_equal_budget"])
     if not ok:
         print("[bench_spec_decode] ACCEPTANCE FAILED", file=sys.stderr)
     return 0 if ok else 1

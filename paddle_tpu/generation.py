@@ -29,7 +29,8 @@ from .utils.functional import functional_call
 __all__ = ["GenerationConfig", "generate", "generate_uncached",
            "update_static_kv_cache", "make_kv_caches", "make_cached_runner",
            "select_tokens", "split_keys", "split_key_levels",
-           "spec_accept_length", "truncated_draft", "make_paged_kv_pools",
+           "spec_accept_length", "spec_tree_plan", "truncated_draft",
+           "make_paged_kv_pools",
            "paged_kv_cache_write", "gather_paged_kv",
            "kv_cache_write_quant", "paged_kv_cache_write_quant",
            "gather_paged_kv_dequant", "dequantize_kv_buffer",
@@ -82,6 +83,45 @@ def _causal_cache_mask(position_offset, s: int, max_len: int) -> Tensor:
     qpos = position_offset + jnp.arange(s)
     m = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < position_offset + s)
     return Tensor(jnp.where(m[None, None], 0.0, -1e30).astype(jnp.float32))
+
+
+def _tree_cache_mask(position_offset, s: int, max_len: int, tree_mask):
+    """Tree-speculative variant of ``_causal_cache_mask``: the ``s``
+    query rows are the flattened draft-tree bundle at cache slots
+    ``position_offset + i``, and ``tree_mask`` [b, s, s] (bool, True =
+    visible) says which bundle slots are each node's ancestors. A node
+    sees every PAST position (< offset, untouched semantics) plus its
+    ancestor-or-self set inside the bundle — never a sibling branch."""
+    anc = tree_mask._data if isinstance(tree_mask, Tensor) \
+        else jnp.asarray(tree_mask)
+    if anc.ndim != 3 or anc.shape[1] != s or anc.shape[2] != s:
+        raise ValueError(
+            f"tree_mask must be [batch, {s}, {s}] (one bool row per "
+            f"bundle node), got shape {tuple(anc.shape)}")
+    B = anc.shape[0]
+    kpos = jnp.arange(max_len)
+    po = position_offset._data if isinstance(position_offset, Tensor) \
+        else jnp.asarray(position_offset)
+    if not _is_per_row(po):
+        po = jnp.broadcast_to(po, (B,))
+    past = kpos[None, None, :] < po[:, None, None]          # [b, 1, max]
+    rel = kpos[None, None, :] - po[:, None, None]
+    in_bundle = (rel >= 0) & (rel < s)
+    relc = jnp.clip(rel, 0, s - 1)
+    anc_g = jnp.take_along_axis(
+        anc, jnp.broadcast_to(relc, (B, s, max_len)), axis=2)
+    m = past | (in_bundle & anc_g)                          # [b, s, max]
+    return Tensor(jnp.where(m[:, None], 0.0, -1e30).astype(jnp.float32))
+
+
+def _cache_mask(kv_cache, position_offset, s: int, max_len: int):
+    """The additive cache mask for this step: the tree-ancestor mask
+    when the cache dict carries one (spec-tree bundles), else the
+    shared causal mask."""
+    tm = kv_cache.get("tree_mask") if isinstance(kv_cache, dict) else None
+    if tm is not None:
+        return _tree_cache_mask(position_offset, s, max_len, tm)
+    return _causal_cache_mask(position_offset, s, max_len)
 
 
 def kv_format_of(arr) -> str:
@@ -367,7 +407,7 @@ def _update_paged_kv_cache(kv_cache: dict, k, v, position_offset,
     bt_arr = bt._data if isinstance(bt, Tensor) else bt
     bs = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
     max_len = int(bt_arr.shape[1]) * bs
-    mask = _causal_cache_mask(position_offset, k.shape[1], max_len) \
+    mask = _cache_mask(kv_cache, position_offset, k.shape[1], max_len) \
         if build_mask else None
     if gather:
         if quant:
@@ -408,12 +448,14 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
                                        position_offset, fmt)
         cv, cvs = kv_cache_write_quant(kv_cache["v"], kv_cache["vs"], v,
                                        position_offset, fmt)
-        new_cache = {"k": ck, "v": cv, "ks": cks, "vs": cvs}
+        new_cache = dict(kv_cache)
+        new_cache.update({"k": ck, "v": cv, "ks": cks, "vs": cvs})
         mask = None
         if build_mask:
             max_len = int(ck._data.shape[1] if isinstance(ck, Tensor)
                           else ck.shape[1])
-            mask = _causal_cache_mask(position_offset, k.shape[1], max_len)
+            mask = _cache_mask(kv_cache, position_offset, k.shape[1],
+                               max_len)
         if gather:
             cd = (k._data if isinstance(k, Tensor) else k).dtype
             return (dequantize_kv_buffer(ck, cks, cd),
@@ -425,8 +467,10 @@ def update_static_kv_cache(kv_cache: dict, k, v, position_offset,
     if build_mask:
         s = k.shape[1]
         max_len = int(ck._data.shape[1] if isinstance(ck, Tensor) else ck.shape[1])
-        mask = _causal_cache_mask(position_offset, s, max_len)
-    return ck, cv, {"k": ck, "v": cv}, mask
+        mask = _cache_mask(kv_cache, position_offset, s, max_len)
+    new_cache = dict(kv_cache)
+    new_cache.update({"k": ck, "v": cv})
+    return ck, cv, new_cache, mask
 
 
 def _mask_after_eos(gen, eos_id):
@@ -519,6 +563,63 @@ def spec_accept_length(drafts, candidates, spec_len):
     match = (drafts == candidates[:, :k]).astype(jnp.int32)
     n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
     return jnp.minimum(n_acc + 1, jnp.asarray(spec_len, jnp.int32))
+
+
+def spec_tree_plan(spec_tree):
+    """Static host-side descriptor of a draft token tree with per-level
+    branching factors ``spec_tree`` (e.g. ``[4, 2, 2]``): level 0 is the
+    single root (the slot's current last token), level t+1 holds
+    ``factors[t]`` children per level-t node, and nodes are flattened in
+    BFS order — so every ancestor has a LOWER index than its
+    descendants, which is what lets a per-row BFS-prefix width act as a
+    truncated (shallower) tree.
+
+    Returns a dict of numpy arrays (all static, shared by the offline
+    oracle, the serving engine, and the tests):
+
+    - ``factors`` tuple, ``depth`` D, ``nodes`` w, ``offsets`` [D+2]
+      (``offsets[t]`` = first BFS index of level t, ``offsets[D+1]`` = w)
+    - ``parent`` [w] int32 (``parent[0] == 0``)
+    - ``depth_vec`` [w] int32 (level of each node)
+    - ``anc_idx`` [w, D+1] int32: ``anc_idx[i, t]`` = node i's ancestor
+      at depth t (padded with i itself past node i's depth — padded
+      entries are never committed, the emit gate stops at the depth)
+    - ``anc`` [w, w] bool: ancestor-or-self adjacency, the tree
+      attention mask"""
+    factors = tuple(int(f) for f in spec_tree)
+    if not factors or any(f < 1 for f in factors):
+        raise ValueError(
+            f"spec_tree must be a non-empty sequence of branching "
+            f"factors >= 1 per draft level, got {spec_tree!r}")
+    depth = len(factors)
+    offsets = [0, 1]
+    wl = 1
+    for f in factors:
+        wl *= f
+        offsets.append(offsets[-1] + wl)
+    w = offsets[-1]
+    parent = np.zeros(w, np.int32)
+    depth_vec = np.zeros(w, np.int32)
+    for t in range(depth):
+        f = factors[t]
+        for r in range(offsets[t + 2] - offsets[t + 1]):
+            i = offsets[t + 1] + r
+            parent[i] = offsets[t] + r // f
+            depth_vec[i] = t + 1
+    anc = np.eye(w, dtype=bool)
+    for i in range(1, w):
+        anc[i] |= anc[parent[i]]
+    anc_idx = np.zeros((w, depth + 1), np.int32)
+    for i in range(w):
+        chain = [i]
+        while chain[-1] != 0:
+            chain.append(int(parent[chain[-1]]))
+        chain.reverse()
+        for t in range(depth + 1):
+            anc_idx[i, t] = chain[t] if t < len(chain) else i
+    return {"factors": factors, "depth": depth, "nodes": w,
+            "offsets": np.asarray(offsets, np.int32), "parent": parent,
+            "depth_vec": depth_vec, "anc_idx": anc_idx, "anc": anc}
 
 
 # Bounded-nucleus fast path for select_tokens: a full-vocab XLA sort is
@@ -923,12 +1024,258 @@ def _generate_speculative(model, draft_model, ids, cfg: GenerationConfig,
     return Tensor(jnp.concatenate([ids, gen], axis=1))
 
 
+def _generate_speculative_tree(model, draft_model, ids,
+                               cfg: GenerationConfig, spec_tree):
+    """Offline TREE-speculative decode (the serving tree lane's oracle):
+    the draft proposes a branching token tree (``spec_tree`` branching
+    factors per level), the target scores the whole flattened tree of w
+    nodes in ONE cached forward under the tree-ancestor mask, and
+    acceptance walks the deepest root-to-leaf path whose every node
+    matches the target's own selection for its parent.
+
+    PRNG coupling per branch: all nodes at depth t share the chain
+    subkey ``subs[:, t]`` at VERIFY (any node whose ancestor chain fully
+    matched carries the true chain prefix, so its selection IS the
+    non-speculative sampler's draw); at DRAFT time branch 0 of each node
+    proposes with that same subkey (the exact chain guess) and branches
+    r>0 diversify via ``fold_in`` on the child's global tree index.
+    Emitted sequences stay bit-identical to non-speculative ``generate``
+    — greedy and sampled — the tree only changes how many tokens each
+    round advances.
+
+    Accepted-path KV is committed BY POSITION in both models' caches
+    (gather the path nodes' slots, scatter them onto the contiguous
+    positions; non-committed entries route back onto their own slot, a
+    same-value no-op), and the next round's writes land on top of every
+    rejected slot before any query can attend it."""
+    plan = spec_tree_plan(spec_tree)
+    D, w = plan["depth"], plan["nodes"]
+    off = [int(o) for o in plan["offsets"]]
+    factors = plan["factors"]
+    parent = jnp.asarray(plan["parent"])
+    depth_vec = jnp.asarray(plan["depth_vec"])
+    anc_idx = jnp.asarray(plan["anc_idx"])
+    anc = jnp.asarray(plan["anc"])
+    B, S = ids.shape
+    N = cfg.max_new_tokens
+    mcfg = model.config
+    dcfg = draft_model.config
+    if dcfg.vocab_size != mcfg.vocab_size:
+        raise ValueError(
+            f"draft/target vocab mismatch: draft vocab_size "
+            f"({dcfg.vocab_size}) != target vocab_size "
+            f"({mcfg.vocab_size}) — speculative decoding verifies draft "
+            f"token ids against target logits, so both models must share "
+            f"one tokenizer/vocab (e.g. build the draft with "
+            f"generation.truncated_draft)")
+    if S + N + D > min(dcfg.max_position_embeddings,
+                       mcfg.max_position_embeddings):
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({N}) + tree depth ({D}) "
+            f"exceeds max_position_embeddings "
+            f"({min(dcfg.max_position_embeddings, mcfg.max_position_embeddings)}) "
+            f"— tree nodes take RoPE/positional indices up to pos + depth")
+    dtype = next(iter(model.parameters()))._data.dtype
+    ddtype = next(iter(draft_model.parameters()))._data.dtype
+    # verify bundles write [pos, pos+w-1]; the +w tail keeps every
+    # per-row write window in bounds (the draft always drafts the FULL
+    # tree — the accept gate, not the draft, enforces per-row budgets)
+    cache_len = S + N + w
+    run = make_cached_runner(model)
+    drun = make_cached_runner(draft_model)
+    pb = {**{kk: v._data for kk, v in model.named_parameters_dict().items()},
+          **{kk: v._data for kk, v in model.named_buffers_dict().items()}}
+    dpb = {**{kk: v._data
+              for kk, v in draft_model.named_parameters_dict().items()},
+           **{kk: v._data
+              for kk, v in draft_model.named_buffers_dict().items()}}
+    ds = jnp.full((B,), cfg.do_sample)
+    temp = jnp.full((B,), cfg.temperature, jnp.float32)
+    tkv = jnp.full((B,), cfg.top_k, jnp.int32)
+    tpv = jnp.full((B,), cfg.top_p, jnp.float32)
+
+    from .pallas_kernels.decode_attention import flash_decode_enabled
+    from .pallas_kernels.quant_matmul import quant_matmul_enabled
+
+    def _rep(x, m):
+        return jnp.broadcast_to(x[:, None], (B, m)).reshape(B * m)
+
+    def _with_tree(caches, n):
+        tm = jnp.broadcast_to(anc[:n, :n][None], (B, n, n))
+        return [dict(c, tree_mask=tm, tree_depth=depth_vec[:n])
+                for c in caches]
+
+    def _strip(caches):
+        return [{kk: c[kk] for kk in ("k", "v")} for c in caches]
+
+    def _kv_path_move(caches, src, dst):
+        # gather the [B, D+1] source slots, scatter onto the dest slots
+        # (functional: every gather reads the pre-move buffer; routed
+        # no-op writes collide only with identical values)
+        def mv(buf):
+            return jax.vmap(lambda bu, s_, d_: bu.at[d_].set(bu[s_]))(
+                buf, src, dst)
+        return [{kk: mv(vv) for kk, vv in c.items()} for c in caches]
+
+    darch = (type(draft_model).__name__, dcfg.num_hidden_layers,
+             dcfg.hidden_size, dcfg.num_attention_heads,
+             dcfg.num_key_value_heads, dcfg.intermediate_size)
+    gen_key = ("spec_tree", B, S, N, factors, cfg.do_sample,
+               cfg.temperature, cfg.top_k, cfg.top_p, darch,
+               flash_decode_enabled(), quant_matmul_enabled())
+    cache_store = model.__dict__.setdefault("_generate_jit_cache", {})
+    if gen_key not in cache_store:
+
+        @jax.jit
+        def tprefill(pb, dpb, ids, keys):
+            caches = make_kv_caches(mcfg, B, cache_len, dtype)
+            dcaches = make_kv_caches(dcfg, B, cache_len, ddtype)
+            logits, caches = run(pb, ids, caches, 0)
+            _, dcaches = drun(dpb, ids, dcaches, 0)
+            levels, subs = split_key_levels(keys, 1)
+            token = select_tokens(logits[:, -1], subs[:, 0], ds, temp,
+                                  tkv, tpv)
+            return token, levels[:, 1], caches, dcaches
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def tdraft(dpb, dcaches, tokens, pos, keys):
+            # level-t forward re-feeds the WHOLE tree-so-far (square
+            # ancestor mask — past-KV masking stays untouched, so a
+            # rectangular "new nodes only" query is not expressible);
+            # earlier nodes' KV is rewritten bit-identically
+            _, subs = split_key_levels(keys, D + 1)
+            tok_tree = jnp.zeros((B, w), jnp.int32).at[:, 0].set(tokens)
+            for t in range(D):
+                n = off[t + 1]
+                logits, dc = drun(dpb, tok_tree[:, :n],
+                                  _with_tree(dcaches, n), pos)
+                dcaches = _strip(dc)
+                lvl = logits[:, off[t]:n]             # [B, w_t, V]
+                f = factors[t]
+                w_next = off[t + 2] - off[t + 1]
+                # greedy: branch 0 = argmax EXPLICITLY (bit-parity with
+                # the verify selection under any top_k tie-break),
+                # branches r>0 = the r-th ranked token
+                tk = jax.lax.top_k(lvl, f)[1].astype(jnp.int32)
+                tk = tk.at[:, :, 0].set(
+                    jnp.argmax(lvl, axis=-1).astype(jnp.int32))
+                children = tk.reshape(B, w_next)
+                if cfg.do_sample:
+                    V = lvl.shape[-1]
+                    base = subs[:, t]                 # the chain subkey
+                    gidx = off[t + 1] + jnp.arange(w_next,
+                                                   dtype=jnp.uint32)
+                    folded = jax.vmap(lambda kk: jax.vmap(
+                        lambda g: jax.random.fold_in(kk, g))(gidx))(base)
+                    use_base = (jnp.arange(w_next) % f) == 0
+                    keys_lvl = jnp.where(
+                        use_base[None, :, None],
+                        jnp.broadcast_to(base[:, None], (B, w_next, 2)),
+                        folded)
+                    sampled = select_tokens(
+                        jnp.repeat(lvl, f, axis=1).reshape(B * w_next, V),
+                        keys_lvl.reshape(B * w_next, 2),
+                        _rep(ds, w_next), _rep(temp, w_next),
+                        _rep(tkv, w_next),
+                        _rep(tpv, w_next)).reshape(B, w_next)
+                    children = jnp.where(ds[:, None], sampled, children)
+                tok_tree = tok_tree.at[:, off[t + 1]:off[t + 2]].set(
+                    children)
+            # write-only forward at full width: leaf KV, so a deep
+            # accept never leaves the draft attending a hole next round
+            _, dc = drun(dpb, tok_tree, _with_tree(dcaches, w), pos)
+            return tok_tree[:, 1:], _strip(dc)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def tverify(pb, caches, dcaches, tokens, drafts, pos, keys,
+                    spec_len):
+            bundle = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            logits, cl = run(pb, bundle, _with_tree(caches, w), pos)
+            caches = _strip(cl)
+            levels, subs = split_key_levels(keys, D + 1)
+            node_keys = jnp.take(subs, depth_vec, axis=1)  # [B, w, 2]
+            V = logits.shape[-1]
+            cand = select_tokens(
+                logits.reshape(B * w, V), node_keys.reshape(B * w, 2),
+                _rep(ds, w), _rep(temp, w), _rep(tkv, w),
+                _rep(tpv, w)).reshape(B, w)
+            # deepest fully-matching root-to-leaf path: a node survives
+            # iff its own token matches the target's selection for its
+            # parent AND every ancestor survives (D parent-AND sweeps)
+            match = jnp.concatenate(
+                [jnp.ones((B, 1), bool),
+                 bundle[:, 1:] == jnp.take(cand, parent[1:], axis=1)],
+                axis=1)
+            acc = match & (jnp.arange(w)[None, :]
+                           < jnp.asarray(spec_len, jnp.int32)[:, None])
+            for _ in range(D):
+                acc = acc & jnp.take(acc, parent, axis=1)
+            score = jnp.where(acc, depth_vec[None, :] + 1, 0)
+            best = jnp.argmax(score, axis=1)
+            n_emit = jnp.take_along_axis(score, best[:, None],
+                                         axis=1)[:, 0]
+            path = jnp.take(anc_idx, best, axis=0)         # [B, D+1]
+            emitted = jnp.take_along_axis(cand, path, axis=1)
+            new_keys = jnp.take_along_axis(
+                levels, n_emit[:, None, None], axis=1)[:, 0]
+            last = jnp.take_along_axis(cand, best[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(n_emit > 0, last, tokens)
+            # commit the accepted path by position in BOTH caches:
+            # slot pos+t <- slot pos+path[t] for 1 <= t < n_emit, every
+            # other entry routes back onto its own source slot (no-op)
+            tt = jnp.arange(D + 1)[None, :]
+            src = pos[:, None] + path
+            dst = pos[:, None] + tt
+            commit = (tt < n_emit[:, None]) & (tt >= 1)
+            dst = jnp.where(commit, dst, src)
+            caches = _kv_path_move(caches, src, dst)
+            dcaches = _kv_path_move(dcaches, src, dst)
+            return (emitted, n_emit, new_keys, new_tok, caches, dcaches)
+
+        cache_store[gen_key] = (tprefill, tdraft, tverify)
+    tprefill, tdraft, tverify = cache_store[gen_key]
+
+    with _entrypoint("generation.generate"), \
+            _tracing.span("generation.spec_tree_decode", cat="generation",
+                          args={"B": B, "S": S, "N": N,
+                                "factors": list(factors), "nodes": w}):
+        keys = _spec_row_keys(cfg.seed, B)
+        token, keys, caches, dcaches = tprefill(pb, dpb, jnp.asarray(ids),
+                                                keys)
+        tok_np = np.asarray(token)
+        out = [[int(tok_np[b])] for b in range(B)]
+        emitted_n = np.ones(B, np.int64)
+        pos = np.full(B, S, np.int64)
+        while int(emitted_n.min()) < N:
+            # per-row BFS-prefix width: clamp the tree DEPTH to the
+            # remaining budget (0 remaining -> width 0 -> row idles)
+            rem = N - emitted_n
+            spec_len = np.asarray(
+                [off[min(D, int(r) - 1) + 1] if r > 0 else 0
+                 for r in rem], np.int32)
+            drafts, dcaches = tdraft(dpb, dcaches, token,
+                                     jnp.asarray(pos, jnp.int32), keys)
+            em, n_emit, keys, token, caches, dcaches = tverify(
+                pb, caches, dcaches, token, drafts,
+                jnp.asarray(pos, jnp.int32), keys, jnp.asarray(spec_len))
+            n_np = np.asarray(n_emit)
+            em_np = np.asarray(em)
+            for b in range(B):
+                out[b].extend(int(t) for t in em_np[b, :n_np[b]])
+            pos += n_np
+            emitted_n += n_np
+    gen = jnp.asarray(np.stack([np.asarray(r[:N], np.int32) for r in out]))
+    if cfg.eos_token_id is not None:
+        gen = _mask_after_eos(gen, cfg.eos_token_id)
+    return Tensor(jnp.concatenate([ids, gen], axis=1))
+
+
 def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
              temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              loop_mode: str = "scan", pad_token_id: Optional[int] = None,
              stream: bool = False, draft_model=None, spec_k: int = 4,
-             kv_format: str = "bf16", tp: int = 1):
+             spec_tree=None, kv_format: str = "bf16", tp: int = 1):
     """Generate continuations for ``input_ids`` [B, S]; returns [B, S+N].
 
     Greedy by default; sampling with temperature/top-k/top-p when
@@ -965,6 +1312,14 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
     use independent per-row key chains; see ``_spec_row_keys``) — the
     draft only changes how fast rows advance. Unsupported together with
     ``stream`` and with ragged/left-padded prompts (``pad_token_id``).
+
+    ``spec_tree=[4, 2, 2]`` (requires ``draft_model``, replaces the
+    single ``spec_k`` chain) drafts a branching token TREE instead: the
+    draft samples ``factors[t]`` children per level-t node, the target
+    scores the whole flattened tree in one forward under the
+    tree-ancestor mask, and the deepest fully-matching root-to-leaf
+    path is emitted. Same bit-parity contract as the chain lane; see
+    ``spec_tree_plan`` for the flattening.
 
     ``kv_format="int8"``/``"fp8"`` stores the KV cache quantized
     (per-token-per-head absmax scales; fp8 = e4m3 where the jnp dtype
@@ -1054,7 +1409,12 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
         if stream:
             return iter(())
         return Tensor(ids)
-    if draft_model is not None and spec_k >= 1:
+    if spec_tree is not None and draft_model is None:
+        raise ValueError(
+            "spec_tree requires draft_model: the tree nodes are drafted "
+            "by the small model — pass draft_model= (e.g. "
+            "generation.truncated_draft) or drop spec_tree")
+    if draft_model is not None and (spec_tree is not None or spec_k >= 1):
         if stream:
             raise ValueError(
                 "stream=True is not supported with draft_model: the "
@@ -1067,6 +1427,9 @@ def generate(model, input_ids, max_new_tokens: int = 32, do_sample: bool = False
                 "prompts (pad_token_id): the speculative verify derives "
                 "its masking from positions only — pass equal-length "
                 "prompts or drop draft_model")
+        if spec_tree is not None:
+            return _generate_speculative_tree(model, draft_model, ids,
+                                              cfg, spec_tree)
         return _generate_speculative(model, draft_model, ids, cfg, spec_k)
 
     # jitted executables are cached on the model so repeat generate() calls

@@ -87,8 +87,14 @@ class GPTBlock(nn.Layer):
                 decode_dispatch, paged_decode_dispatch)
 
             dispatch = paged_decode_dispatch if paged_cache else decode_dispatch
+            # spec-tree bundles: the PAGED kernel takes the ancestor
+            # mask natively; the contiguous kernel has no mask input so
+            # a tree bundle there declines like an external mask
+            tree_mask = kv_cache.get("tree_mask")
+            ext_mask = attn_mask is not None or (
+                tree_mask is not None and not paged_cache)
             use_flash_decode = dispatch(
-                "gpt", q_len=s, has_mask=attn_mask is not None,
+                "gpt", q_len=s, has_mask=ext_mask,
                 dtype=q.dtype, quantized="ks" in kv_cache)
             k, v, new_cache, mask = update_static_kv_cache(
                 kv_cache, k, v, position_offset,
@@ -108,7 +114,8 @@ class GPTBlock(nn.Layer):
                 a = paged_flash_decode_attention(
                     q, new_cache["k"], new_cache["v"], new_cache["bt"],
                     position_offset, k_scale=new_cache.get("ks"),
-                    v_scale=new_cache.get("vs"))
+                    v_scale=new_cache.get("vs"),
+                    ancestor_mask=new_cache.get("tree_mask"))
             else:
                 a = flash_decode_attention(
                     q, k, v, position_offset,
@@ -139,7 +146,20 @@ class GPTModel(nn.Layer):
         # position_offset may be traced (jitted decode step): index wpe
         # with a dynamic starting position; a per-row [b] vector (serving
         # decode: each slot at its own position) gathers [b, s] rows
-        if getattr(position_offset, "ndim", 0) == 1:
+        td = None
+        if kv_caches is not None and isinstance(kv_caches[0], dict):
+            # spec-tree bundle: node i's LEARNED position is
+            # pos + depth(i), decoupled from its cache slot pos + i
+            td = kv_caches[0].get("tree_depth")
+        if td is not None:
+            tdv = td._data if isinstance(td, Tensor) else jnp.asarray(td)
+            po = position_offset._data \
+                if isinstance(position_offset, Tensor) \
+                else jnp.asarray(position_offset, jnp.int32)
+            if po.ndim == 0:
+                po = jnp.broadcast_to(po, (b,))
+            pos = po[:, None] + tdv[None, :].astype(jnp.int32)
+        elif getattr(position_offset, "ndim", 0) == 1:
             pos = position_offset[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
         else:
             pos = position_offset + jnp.arange(s, dtype=jnp.int32)

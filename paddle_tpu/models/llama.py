@@ -103,6 +103,12 @@ def apply_rotary_pos_emb(q: Tensor, k: Tensor, cos_tab, sin_tab, position_offset
         if isinstance(position_offset, int):
             c = cos[position_offset:position_offset + s]
             si = sin[position_offset:position_offset + s]
+        elif getattr(position_offset, "ndim", 0) == 2:
+            # explicit [b, s] position grid (spec-tree bundles: node i
+            # occupies cache slot pos+i but its ROTARY position is
+            # pos+depth(i) — siblings share a position)
+            c = cos[position_offset]   # [b, s, d/2]
+            si = sin[position_offset]
         elif getattr(position_offset, "ndim", 0) == 1:
             # per-row offsets [b]: gather [b, s] position rows
             idx = position_offset[:, None] + jnp.arange(s)
@@ -183,7 +189,24 @@ class LlamaAttention(nn.Layer):
 
         q, k, v = (maybe_constrain_heads(q), maybe_constrain_heads(k),
                    maybe_constrain_heads(v))
-        q, k = apply_rotary_pos_emb(q, k, cos_tab, sin_tab, position_offset)
+        # spec-tree bundle companions riding the cache dict: the
+        # [b, s, s] ancestor mask and the [s] node-depth vector that
+        # decouples each node's rotary position from its cache slot
+        tree_mask = tree_depth = None
+        if isinstance(kv_cache, dict):
+            tree_mask = kv_cache.get("tree_mask")
+            tree_depth = kv_cache.get("tree_depth")
+        rope_pos = position_offset
+        if tree_depth is not None:
+            td = tree_depth._data if isinstance(tree_depth, Tensor) \
+                else jnp.asarray(tree_depth)
+            po = position_offset._data \
+                if isinstance(position_offset, Tensor) \
+                else jnp.asarray(position_offset, jnp.int32)
+            if po.ndim == 0:
+                po = jnp.broadcast_to(po, (b,))
+            rope_pos = po[:, None] + td[None, :].astype(jnp.int32)
+        q, k = apply_rotary_pos_emb(q, k, cos_tab, sin_tab, rope_pos)
 
         static_cache = isinstance(kv_cache, dict)
         # paged static cache: the dict carries a "bt" block table and
@@ -215,8 +238,13 @@ class LlamaAttention(nn.Layer):
                 decode_dispatch, paged_decode_dispatch)
 
             dispatch = paged_decode_dispatch if paged_cache else decode_dispatch
+            # the PAGED kernel scores tree bundles natively (ancestor
+            # mask input); the contiguous kernel has no mask input, so a
+            # tree bundle there counts as an external mask and declines
+            ext_mask = attn_mask is not None or (
+                tree_mask is not None and not paged_cache)
             use_flash_decode = dispatch(
-                "llama", q_len=s, has_mask=attn_mask is not None,
+                "llama", q_len=s, has_mask=ext_mask,
                 dtype=q.dtype, quantized=quant_cache)
         if static_cache:
             # pre-allocated buffers updated in place at position_offset
@@ -253,7 +281,8 @@ class LlamaAttention(nn.Layer):
                 out = paged_flash_decode_attention(
                     q, new_cache["k"], new_cache["v"], new_cache["bt"],
                     position_offset, k_scale=new_cache.get("ks"),
-                    v_scale=new_cache.get("vs"))
+                    v_scale=new_cache.get("vs"),
+                    ancestor_mask=tree_mask)
             else:
                 out = flash_decode_attention(
                     q, k, v, position_offset,

@@ -693,6 +693,9 @@ BENCH_METRIC_SOURCES = {
     "spec.best_speedup": ("bench_spec_decode.json", "best_speedup"),
     "spec.k8_occ1_tok_s": ("bench_spec_decode.json",
                            "spec_k8_coupled.by_occupancy.1.tok_s"),
+    "spec_tree.tok_s_ratio_vs_chain": ("bench_spec_decode.json",
+                                       "spec_tree.tok_s_ratio_vs_chain"),
+    "spec_tree.parity": ("bench_spec_decode.json", "spec_tree.parity"),
     "router.tok_s": ("bench_router.json", "goodput.tok_s"),
     "router.overhead_pct": ("bench_router.json", "overhead.overhead_pct"),
     "router.fleet_overhead_pct": ("bench_router.json",

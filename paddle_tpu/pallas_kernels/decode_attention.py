@@ -65,7 +65,8 @@ from .flash_attention import NEG_INF, _dot_prec, _interpret
 __all__ = ["flash_decode_attention", "flash_decode_enabled",
            "decode_dispatch", "MAX_DECODE_Q_LEN",
            "paged_flash_decode_attention", "paged_decode_dispatch",
-           "MAX_PAGED_Q_LEN", "MAX_SPEC_K", "spec_verify_eligibility"]
+           "MAX_PAGED_Q_LEN", "MAX_SPEC_K", "spec_verify_eligibility",
+           "spec_tree_width"]
 
 _FLASH_DECODE_ENV = "PADDLE_TPU_FLASH_DECODE"
 
@@ -204,28 +205,45 @@ def paged_decode_dispatch(model: str, *, q_len: int, has_mask: bool,
     return False
 
 
-def spec_verify_eligibility(spec_k: int, dtype):
-    """Will a speculative verify bundle (q_len = spec_k + 1) take the
-    paged flash-decode kernel, and if not, why? Called ONCE per engine
-    at construction — the per-layer dispatch still decides each trace
-    via ``paged_decode_dispatch``; this is the engine-level preflight
-    that records the expected path (and its fallback reason, under the
-    ``spec_`` prefix) so a config that silently pushes every verify
-    onto the XLA gather fallback is visible in the metrics before any
-    traffic arrives."""
+def spec_tree_width(spec_tree) -> int:
+    """Node count of a draft token tree with per-depth branching factors
+    ``spec_tree`` (root + every level): ``[4, 2, 2]`` -> 1 + 4 + 8 + 16
+    = 29. This is the verify bundle's q_len — the quantity the kernel's
+    query window bounds."""
+    w = wl = 1
+    for f in spec_tree:
+        wl *= int(f)
+        w += wl
+    return w
+
+
+def spec_verify_eligibility(spec_k: int, dtype, spec_tree=None):
+    """Will a speculative verify bundle (q_len = spec_k + 1 for a chain,
+    the flattened node count for a ``spec_tree``) take the paged
+    flash-decode kernel, and if not, why? Called ONCE per engine at
+    construction — the per-layer dispatch still decides each trace via
+    ``paged_decode_dispatch``; this is the engine-level preflight that
+    records the expected path (and its fallback reason, under the
+    ``spec_`` / ``spec_tree_`` prefix) so a config that silently pushes
+    every verify onto the XLA gather fallback is visible in the metrics
+    before any traffic arrives."""
+    if spec_tree is not None:
+        prefix, width = "spec_tree_", spec_tree_width(spec_tree)
+    else:
+        prefix, width = "spec_", spec_k + 1
     reason = None
     if not flash_decode_enabled():
         reason = "disabled"
     elif not _HAS_TPU_PALLAS:  # pragma: no cover
         reason = "no_tpu_pallas"
-    elif spec_k + 1 > MAX_PAGED_Q_LEN:
+    elif width > MAX_PAGED_Q_LEN:
         reason = "q_len"
     elif str(dtype) not in ("float32", "bfloat16"):
         reason = "dtype"
     if reason is None:
         return True, None
     if _obs_on[0]:
-        _fd_fallbacks.labels("spec_" + reason).inc()
+        _fd_fallbacks.labels(prefix + reason).inc()
     return False, reason
 
 
@@ -254,21 +272,47 @@ def _compiler_kwargs():
 
 
 def _cell_partial(q, k, v, length, start, o_ref, m_ref, l_ref, *,
-                  block_k: int, sm_scale: float, q_len: int, group: int):
+                  block_k: int, sm_scale: float, q_len: int, group: int,
+                  mask=None):
     """The block's online-softmax partial for the whole query bundle —
     shared by the plain and dequantizing kernel variants so the math can
     never drift between them (quantized vs bf16 parity oracles depend on
-    identical masking/summation order)."""
+    identical masking/summation order).
+
+    ``mask`` (None or [q_len, q_len] f32, 1.0 = visible): the row's
+    in-bundle ancestor mask for tree-speculative verify. None keeps the
+    causal bundle (kpos <= qpos) bitwise — a causal ancestor mask input
+    reproduces it exactly, so the chain lane never pays the extra
+    operand. Past-KV masking (everything before the bundle) is untouched
+    either way: all of it is ancestry by construction."""
     gq, d = q.shape
     sc = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                  precision=_dot_prec(q.dtype)) * sm_scale
     kpos = start + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 1)
-    # query row r sits at absolute position pos + r // group; masking
-    # kpos <= qpos covers BOTH the right-pad beyond the row's length
-    # and causality inside the q_len window
-    qpos = (length - q_len) \
-        + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 0) // group
-    sc = jnp.where(kpos <= qpos, sc, NEG_INF)
+    if mask is None:
+        # query row r sits at absolute position pos + r // group; masking
+        # kpos <= qpos covers BOTH the right-pad beyond the row's length
+        # and causality inside the q_len window
+        qpos = (length - q_len) \
+            + jax.lax.broadcasted_iota(jnp.int32, (gq, block_k), 0) // group
+        vis = kpos <= qpos
+    else:
+        # bundle node j lives at cache position (length - q_len) + j; a
+        # dynamic per-column gather of mask[:, j] is not expressible in
+        # the cell, so build the column one-hot [q_len, block_k] and
+        # read the tile through one small MXU matmul. Columns outside
+        # the bundle window match no one-hot row and fall to the past-KV
+        # term (kpos < length - q_len), which also bounds the right-pad:
+        # kpos >= length matches nothing and stays masked.
+        mask_g = jnp.broadcast_to(
+            mask[:, None, :], (q_len, group, q_len)).reshape(gq, q_len)
+        j_col = (start - (length - q_len)) \
+            + jax.lax.broadcasted_iota(jnp.int32, (q_len, block_k), 1)
+        onehot = (jax.lax.broadcasted_iota(
+            jnp.int32, (q_len, block_k), 0) == j_col).astype(jnp.float32)
+        anc = jnp.dot(mask_g, onehot, preferred_element_type=jnp.float32)
+        vis = (kpos < length - q_len) | (anc > 0.5)
+    sc = jnp.where(vis, sc, NEG_INF)
     m = sc.max(axis=-1)                # [gq] f32
     p = jnp.exp(sc - m[:, None])
     l = p.sum(axis=-1)
@@ -290,13 +334,15 @@ def _cell_skip(o_ref, m_ref, l_ref, gq: int, d: int):
 
 
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
-                   block_k: int, sm_scale: float, q_len: int, group: int):
+                   block_k: int, sm_scale: float, q_len: int, group: int,
+                   mask_ref=None):
     """One (batch row, kv head, kv block) cell: the block's online-
     softmax partial for the whole query bundle.
 
     Refs (blocked):
       q [1, q_len, 1, group, d]   — the kv head's query bundle
       k/v [1, block_k, 1, d]      — one cache block of this kv head
+      mask [1, q_len, q_len] f32  — optional in-bundle ancestor mask
       o [1, 1, 1, gq, d] f32      — unnormalized accumulator partial
       m/l [1, 1, 1, gq, 1] f32    — running max / sum partials
     """
@@ -312,9 +358,10 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
         q = q_ref[0, :, 0].reshape(gq, d)  # rows r = i*group + g
         k = k_ref[0, :, 0, :]              # [block_k, d]
         v = v_ref[0, :, 0, :]
+        mask = None if mask_ref is None else mask_ref[0]
         _cell_partial(q, k, v, length, start, o_ref, m_ref, l_ref,
                       block_k=block_k, sm_scale=sm_scale, q_len=q_len,
-                      group=group)
+                      group=group, mask=mask)
 
     @pl.when(start >= length)
     def _skip():
@@ -324,7 +371,7 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
 def _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                          o_ref, m_ref, l_ref, *, block_k: int,
                          sm_scale: float, q_len: int, group: int,
-                         bound: float):
+                         bound: float, mask_ref=None):
     """The quantized-cache cell: identical to ``_decode_kernel`` plus a
     DEQUANT PROLOGUE — the int8/fp8 K/V block and its per-token absmax
     scale column ([1, block_k, 1] f32) are widened to the query dtype in
@@ -351,9 +398,10 @@ def _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
              * ks[:, None] / bound).astype(q.dtype)
         v = (v_ref[0, :, 0, :].astype(jnp.float32)
              * vs[:, None] / bound).astype(q.dtype)
+        mask = None if mask_ref is None else mask_ref[0]
         _cell_partial(q, k, v, length, start, o_ref, m_ref, l_ref,
                       block_k=block_k, sm_scale=sm_scale, q_len=q_len,
-                      group=group)
+                      group=group, mask=mask)
 
     @pl.when(start >= length)
     def _skip():
@@ -524,7 +572,7 @@ def flash_decode_attention(q, k_cache, v_cache, positions, sm_scale=None,
 
 
 def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None, ancestor_mask=None):
     """q5 [B, q_len, KV, group, d], pools [num_blocks, bs, KV, d],
     bt [B, nb] int32, lens [B] int32 -> [B, KV, gq, d] f32 (combined and
     normalized). Identical math to ``_flash_decode`` — the only change
@@ -536,7 +584,12 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
 
     ``k_scale``/``v_scale`` ([num_blocks, bs, KV] f32): quantized pools
     — the scale column rides the same table-indirected index map and the
-    cell dequantizes its block in the prologue."""
+    cell dequantizes its block in the prologue.
+
+    ``ancestor_mask`` ([B, q_len, q_len] f32, 1.0 = visible): per-row
+    in-bundle visibility for tree-speculative verify; every cell of row
+    b reads the same [q_len, q_len] block (index map pins (b, 0, 0)).
+    None compiles the causal bundle exactly as before."""
     from ..quantization.intx import format_bound
 
     B, q_len, KV, group, d = q5.shape
@@ -544,6 +597,7 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
     nb = bt.shape[1]
     gq = q_len * group
     quant = k_scale is not None
+    tree = ancestor_mask is not None
 
     def _idx_q(b, h, s, lens, bt):
         return (b, 0, h, 0, 0)
@@ -556,6 +610,9 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
         last = jnp.maximum(pl.cdiv(lens[b], bs) - 1, 0)
         return (bt[b, jnp.minimum(s, last)], 0, h)
 
+    def _idx_mask(b, h, s, lens, bt):
+        return (b, 0, 0)
+
     def _idx_out(b, h, s, lens, bt):
         return (b, h, s, 0, 0)
 
@@ -567,6 +624,8 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
     if quant:
         in_specs += [pl.BlockSpec((1, bs, 1), _idx_scale),
                      pl.BlockSpec((1, bs, 1), _idx_scale)]
+    if tree:
+        in_specs += [pl.BlockSpec((1, q_len, q_len), _idx_mask)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, nb),
@@ -578,33 +637,50 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
         ],
     )
 
+    operands = (lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp, vp)
     if quant:
         bound = format_bound("int8" if kp.dtype == jnp.int8 else "fp8")
-
-        def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
-                  o_ref, m_ref, l_ref):
-            del bt_ref
-            _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref,
-                                 vs_ref, o_ref, m_ref, l_ref, block_k=bs,
-                                 sm_scale=sm_scale, q_len=q_len,
-                                 group=group, bound=bound)
-
-        operands = (lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp,
-                    vp, k_scale.astype(jnp.float32),
-                    v_scale.astype(jnp.float32))
+        operands += (k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32))
+        if tree:
+            def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, ks_ref,
+                      vs_ref, am_ref, o_ref, m_ref, l_ref):
+                del bt_ref
+                _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref,
+                                     vs_ref, o_ref, m_ref, l_ref,
+                                     block_k=bs, sm_scale=sm_scale,
+                                     q_len=q_len, group=group, bound=bound,
+                                     mask_ref=am_ref)
+        else:
+            def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, ks_ref,
+                      vs_ref, o_ref, m_ref, l_ref):
+                del bt_ref
+                _decode_kernel_quant(lens_ref, q_ref, k_ref, v_ref, ks_ref,
+                                     vs_ref, o_ref, m_ref, l_ref,
+                                     block_k=bs, sm_scale=sm_scale,
+                                     q_len=q_len, group=group, bound=bound)
     else:
-        def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                  l_ref):
-            # bt_ref is consumed by the index maps; the cell body itself
-            # is the contiguous kernel verbatim (same lens-bounded
-            # masking)
-            del bt_ref
-            _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                           l_ref, block_k=bs, sm_scale=sm_scale,
-                           q_len=q_len, group=group)
-
-        operands = (lens.astype(jnp.int32), bt.astype(jnp.int32), q5, kp,
-                    vp)
+        if tree:
+            def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, am_ref,
+                      o_ref, m_ref, l_ref):
+                del bt_ref
+                _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
+                               m_ref, l_ref, block_k=bs,
+                               sm_scale=sm_scale, q_len=q_len,
+                               group=group, mask_ref=am_ref)
+        else:
+            def _kern(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                      l_ref):
+                # bt_ref is consumed by the index maps; the cell body
+                # itself is the contiguous kernel verbatim (same
+                # lens-bounded masking)
+                del bt_ref
+                _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
+                               m_ref, l_ref, block_k=bs,
+                               sm_scale=sm_scale, q_len=q_len,
+                               group=group)
+    if tree:
+        operands += (ancestor_mask.astype(jnp.float32),)
 
     o_p, m_p, l_p = pl.pallas_call(
         _kern,
@@ -624,7 +700,8 @@ def _paged_flash_decode(q5, kp, vp, bt, lens, *, sm_scale: float,
 
 
 def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
-                                 sm_scale=None, k_scale=None, v_scale=None):
+                                 sm_scale=None, k_scale=None, v_scale=None,
+                                 ancestor_mask=None):
     """Flash-decode attention over PAGED KV pools.
 
     q: [B, q_len, heads, d] (q_len <= MAX_PAGED_Q_LEN — the serving
@@ -642,6 +719,13 @@ def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
     (``make_paged_kv_pools(kv_format=...)``'s ``ks``/``vs``) — dequant
     happens in the kernel prologue, per block, behind the same
     table-indirected index map.
+
+    TREE-SPECULATIVE bundles: ``ancestor_mask`` [B, q_len, q_len] bool
+    (True = bundle node i may attend bundle node j) replaces ONLY the
+    in-bundle causal mask — every query still attends all of its row's
+    past KV (every committed position is an ancestor of every tree
+    node). A causal lower-triangular mask reproduces the default path
+    bitwise.
     """
     from ..core.tensor import Tensor
     from ..ops.dispatch import apply_op
@@ -651,6 +735,7 @@ def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
     bt_arr = block_table._data if isinstance(block_table, Tensor) \
         else block_table
     ks_arr, vs_arr = _unwrap(k_scale), _unwrap(v_scale)
+    am_arr = _unwrap(ancestor_mask)
     if (ks_arr is None) != (vs_arr is None):
         raise ValueError("pass both k_scale and v_scale or neither")
 
@@ -670,9 +755,14 @@ def paged_flash_decode_attention(q, k_pool, v_pool, block_table, positions,
             pos = jnp.broadcast_to(pos, (B,))
         max_len = bt.shape[1] * ka.shape[1]
         lens = jnp.minimum(pos + q_len, max_len)
+        if am_arr is not None and tuple(am_arr.shape) != (B, q_len, q_len):
+            raise ValueError(
+                f"ancestor_mask must be [B={B}, q_len={q_len}, "
+                f"q_len={q_len}], got {tuple(am_arr.shape)}")
         q5 = qa.reshape(B, q_len, KV, group, d)
         o = _paged_flash_decode(q5, ka, va, bt, lens, sm_scale=scale,
-                                k_scale=ks_arr, v_scale=vs_arr)
+                                k_scale=ks_arr, v_scale=vs_arr,
+                                ancestor_mask=am_arr)
         o = o.reshape(B, KV, q_len, group, d)
         o = jnp.transpose(o, (0, 2, 1, 3, 4)).reshape(B, q_len, H, d)
         return o.astype(qa.dtype)

@@ -166,6 +166,18 @@ class ServingConfig:
       query positions through the paged kernel). Requests opt out (or
       shrink their k) per-request via ``SamplingParams.spec_k``; ignored
       without a draft model.
+    - ``spec_tree``: per-level branching factors (e.g. ``[4, 2, 2]``)
+      upgrading the speculative lane from a single draft chain to a
+      token TREE: the draft proposes every branch, ONE verify scores
+      the whole flattened tree (root + all nodes) through the paged
+      kernel's ancestor-masked bundle path, and the deepest fully-
+      matching root-to-leaf path is committed. Mutually exclusive with
+      a non-default ``spec_k`` — one engine runs one lane. The node
+      count (``spec_tree_width``) must fit the kernel's query window
+      (``MAX_PAGED_Q_LEN``). ``SamplingParams.spec_k`` still applies
+      per-request, clamping the tree DEPTH (0 = plain decode rows
+      riding the bundle at width 1). Outputs stay bit-identical to
+      non-speculative decode, greedy and sampled.
     - ``kv_format``: KV block storage (paged only) — ``"bf16"`` keeps
       the model compute dtype (default); ``"int8"``/``"fp8"`` store the
       pool narrow with per-token-per-head absmax scale pools riding the
@@ -209,6 +221,7 @@ class ServingConfig:
     prefill_chunk: int = 32
     prefix_caching: bool = True
     spec_k: int = 4
+    spec_tree: Optional[Sequence[int]] = None
     kv_format: str = "bf16"
     # tensor parallelism: shard ONE model over `tp` chips (Megatron
     # layout via distributed/partition.py rule tables; KV pools shard on
@@ -253,7 +266,8 @@ class ServingConfig:
                     f"paged kernel prologue) — switch kv_mode to 'paged' "
                     f"or drop kv_format (the contiguous engine is the "
                     f"bf16 A/B baseline)")
-        from ..pallas_kernels.decode_attention import MAX_SPEC_K
+        from ..pallas_kernels.decode_attention import (
+            MAX_PAGED_Q_LEN, MAX_SPEC_K, spec_tree_width)
 
         if not 0 <= int(self.spec_k) <= MAX_SPEC_K:
             raise ValueError(
@@ -263,6 +277,32 @@ class ServingConfig:
                 f"MAX_PAGED_Q_LEN = {MAX_SPEC_K + 1} — shrink spec_k (draft "
                 f"win saturates long before that) or raise MAX_PAGED_Q_LEN "
                 f"with the kernel's block budget in mind")
+        if self.spec_tree is not None:
+            factors = tuple(int(f) for f in self.spec_tree)
+            if not factors or any(f < 1 for f in factors):
+                raise ValueError(
+                    f"spec_tree must be a non-empty sequence of branching "
+                    f"factors >= 1 per draft level (e.g. [4, 2, 2]), got "
+                    f"{self.spec_tree!r}")
+            if int(self.spec_k) != 4:
+                raise ValueError(
+                    f"spec_tree ({list(factors)}) and a non-default spec_k "
+                    f"({self.spec_k}) are mutually exclusive: one engine "
+                    f"runs ONE speculative lane — the chain (spec_k drafts "
+                    f"per round) or the tree (branching factors per level). "
+                    f"Drop spec_k (per-request depth clamps still ride "
+                    f"SamplingParams.spec_k) or drop spec_tree")
+            wnodes = spec_tree_width(factors)
+            if wnodes > MAX_PAGED_Q_LEN:
+                raise ValueError(
+                    f"spec_tree {list(factors)} flattens to {wnodes} nodes, "
+                    f"but the verify bundle scores every node in one paged "
+                    f"flash-decode call whose query window is "
+                    f"MAX_PAGED_Q_LEN = {MAX_PAGED_Q_LEN} — shrink the "
+                    f"branching factors or the depth (accept depth "
+                    f"saturates long before that) or raise MAX_PAGED_Q_LEN "
+                    f"with the kernel's block budget in mind")
+            self.spec_tree = factors
         if int(self.tp) < 1:
             raise ValueError(f"tp ({self.tp}) must be >= 1")
         if int(self.tp) > 1 and self.kv_mode != "paged":
@@ -411,19 +451,35 @@ class ServingEngine:
         self.spec = draft_model is not None
         if self.spec:
             config.validate_draft(mcfg, draft_model.config)
-            self._spec_k = int(config.spec_k)
+            self._spec_tree = (tuple(config.spec_tree)
+                               if config.spec_tree is not None else None)
+            if self._spec_tree is not None:
+                from ..generation import spec_tree_plan
+                self._tree = spec_tree_plan(self._spec_tree)
+                # per-request SamplingParams.spec_k clamps the tree
+                # DEPTH on the tree lane, so _spec_k doubles as the
+                # depth bound and sizes the accept histogram (a round
+                # accepts 0..depth draft nodes, one per path level)
+                self._spec_k = int(self._tree["depth"])
+            else:
+                self._tree = None
+                self._spec_k = int(config.spec_k)
             from ..pallas_kernels.decode_attention import \
                 spec_verify_eligibility
             ok, reason = spec_verify_eligibility(
                 self._spec_k,
-                next(iter(model.parameters()))._data.dtype)
+                next(iter(model.parameters()))._data.dtype,
+                spec_tree=self._spec_tree)
             # expected verify-bundle path, recorded once per engine: the
-            # kernel serves q_len = spec_k + 1 bundles, or the XLA
-            # gather fallback does (reason-counted either way)
+            # kernel serves q_len = spec_k + 1 (chain) or w-node (tree)
+            # bundles, or the XLA gather fallback does (reason-counted
+            # either way, under the spec_ / spec_tree_ prefix)
             self._spec_verify_kernel = ok
             _trace.instant("spec_verify_path", cat="engine",
                            args={"kernel": ok, "reason": reason,
-                                 "k": self._spec_k})
+                                 "k": self._spec_k,
+                                 "tree": (list(self._spec_tree)
+                                          if self._spec_tree else None)})
         B = int(config.max_slots)
         self.scheduler = Scheduler(config.max_queue_depth)
 
@@ -444,9 +500,11 @@ class ServingEngine:
             self._spec_accepted = 0
             self._spec_rounds = 0
             # engine-local accept-length histogram (0..k accepted per
-            # round): /stats percentiles come from THIS engine's rounds;
-            # the registry Summary stays the fleet-wide scrape surface
-            self._accept_hist = [0] * (int(config.spec_k) + 1)
+            # round — on the tree lane k is the DEPTH, one accepted node
+            # per path level): /stats percentiles come from THIS
+            # engine's rounds; the registry Summary stays the fleet-wide
+            # scrape surface
+            self._accept_hist = [0] * (self._spec_k + 1)
 
         # per-slot decode state (last token, position, PRNG chain,
         # sampling params) lives on DEVICE across steps — the decode loop
@@ -1123,6 +1181,15 @@ class ServingEngine:
                         (pb_sh, pool_sh, state_sh, rep, rep, rep, rep, rep),
                         (rep, rep, pool_sh, state_sh))
 
+        if self._spec_tree is not None:
+            # tree lane (ServingConfig.spec_tree): the chain pair above
+            # is replaced before anything traces it — same entry names,
+            # so warmup, recompile accounting, and the dispatch sites
+            # stay lane-agnostic. The tree verify additionally owns the
+            # draft pools (the accepted path's KV commits by position in
+            # BOTH models' caches).
+            _draft, _verify = self._build_tree_spec(B, run)
+
         def _chunk_spec(pb, dpb, pools, dpools, state, bt_row, ids, pos0,
                         valid, slot, is_last, last_idx, key, ds, temp, tk,
                         tp):
@@ -1188,12 +1255,235 @@ class ServingEngine:
         self._verify_fn = _verify
         self._chunk_spec_fn = _chunk_spec
         self._cow_spec_fn = _cow_spec
-        self._zero_drafts = jnp.zeros((B, k), jnp.int32)
+        wd = int(self._tree["nodes"]) - 1 if self._spec_tree is not None \
+            else k
+        self._zero_drafts = jnp.zeros((B, wd), jnp.int32)
         _recompile.register_entry_location("serving.spec_draft", _draft)
         _recompile.register_entry_location("serving.spec_verify", _verify)
         _recompile.register_entry_location("serving.prefill_chunk",
                                            _chunk_spec)
         _recompile.register_entry_location("serving.cow", _cow_spec)
+
+    def _build_tree_spec(self, B: int, run):
+        """TREE-speculative draft + verify executables
+        (``ServingConfig.spec_tree``; Medusa/SpecInfer-class token-tree
+        verification on this repo's paged substrate).
+
+        ``spec_draft`` grows the token tree level by level, each forward
+        re-feeding the WHOLE tree-so-far under the square ancestor mask
+        (past-KV masking is untouched, so a rectangular new-nodes-only
+        query is not expressible; earlier nodes' KV rewrites
+        bit-identically). Branch 0 of every node proposes with the exact
+        chain subkey for its depth — the non-speculative sampler's own
+        draw — and branches r > 0 diversify via ``fold_in`` on the
+        child's BFS index. ``spec_verify`` scores all w flattened nodes
+        in ONE paged flash-decode call (the [B, w, w] ancestor mask
+        rides the cache dicts the way per-slot sampling params ride the
+        state), walks the deepest root-to-leaf path whose every node
+        matches the target's selection for its parent, and commits that
+        path's KV BY POSITION in both models' pools — a gather/scatter
+        through the block tables where non-committed slots route back
+        onto themselves (same-value no-op writes). Node i's cache slot
+        is pos + i; its RoPE/positional index is pos + depth(i), carried
+        by the ``tree_depth`` vector.
+
+        PRNG contract: identical to the chain lane — all depth-t nodes
+        verify with chain subkey ``subs[:, t]``, the chain commits at
+        level ``n_emit`` (one split per EMITTED token), so outputs are
+        bit-identical to non-speculative decode (greedy AND sampled) and
+        preemption replay / failover requeue machinery never notices the
+        tree. Every per-row quantity (positions, block tables, live
+        BFS-prefix width ``spec_valid``, accept depth) is traced data:
+        both programs compile exactly once; width-1 rows ride the bundle
+        as plain decode steps."""
+        config = self.config
+        drun = self._drun
+        pool_keys = self._pool_keys
+        _wrap = self._tp_wrap
+        rep = self._tp_rep
+        pb_sh, dpb_sh = self._tp_pb_sh, self._tp_dpb_sh
+        pool_sh = getattr(self, "_tp_pool_sh", None)
+        dpool_sh = getattr(self, "_tp_dpool_sh", None)
+        state_sh = self._tp_state_sh
+        plan = self._tree
+        D, w = int(plan["depth"]), int(plan["nodes"])
+        off = [int(o) for o in plan["offsets"]]
+        factors = plan["factors"]
+        parent = jnp.asarray(plan["parent"])
+        depth_vec = jnp.asarray(plan["depth_vec"])
+        anc_idx = jnp.asarray(plan["anc_idx"])
+        anc = jnp.asarray(plan["anc"])
+        bs = config.block_size
+
+        def _rep_bw(x, m):
+            return jnp.broadcast_to(x[:, None], (B, m)).reshape(B * m)
+
+        def _tree_caches(pools, bt, valid, n):
+            tm = jnp.broadcast_to(anc[:n, :n][None], (B, n, n))
+            return [dict(c, bt=bt, valid=valid, tree_mask=tm,
+                         tree_depth=depth_vec[:n]) for c in pools]
+
+        def _draft(dpb, dpools, state, bt, spec_valid, any_sampling):
+            """D level forwards + one write-only full-width forward.
+            ``spec_valid`` [B] is each row's live node width (a BFS
+            prefix): writes beyond it route to the dump block, so rows
+            opted down to plain decode still get their root token's
+            draft KV at width 1 (draft cache stays consistent for
+            free)."""
+            _, subs = split_key_levels(state["keys"], D + 1)
+            tok_tree = jnp.zeros((B, w), jnp.int32).at[:, 0].set(
+                state["tokens"])
+            pos = state["pos"]
+            cur = dpools
+            for t in range(D):
+                n = off[t + 1]
+                caches = _tree_caches(
+                    cur, bt, jnp.minimum(spec_valid, jnp.int32(n)), n)
+                logits, newdc = drun(dpb, tok_tree[:, :n], caches, pos)
+                cur = [{kk: c[kk] for kk in pool_keys} for c in newdc]
+                lvl = logits[:, off[t]:n]            # [B, w_t, V]
+                f = factors[t]
+                w_next = off[t + 2] - off[t + 1]
+                # greedy: branch 0 = argmax EXPLICITLY (bit-parity with
+                # the verify selection under any top_k tie-break),
+                # branches r>0 = the r-th ranked token
+                tk = jax.lax.top_k(lvl, f)[1].astype(jnp.int32)
+                tk = tk.at[:, :, 0].set(
+                    jnp.argmax(lvl, axis=-1).astype(jnp.int32))
+                greedy = tk.reshape(B, w_next)
+
+                def _samp(lvl=lvl, t=t, f=f, w_next=w_next, greedy=greedy):
+                    V = lvl.shape[-1]
+                    base = subs[:, t]                # the chain subkey
+                    gidx = off[t + 1] + jnp.arange(w_next,
+                                                   dtype=jnp.uint32)
+                    folded = jax.vmap(lambda kk: jax.vmap(
+                        lambda g: jax.random.fold_in(kk, g))(gidx))(base)
+                    use_base = (jnp.arange(w_next) % f) == 0
+                    keys_lvl = jnp.where(
+                        use_base[None, :, None],
+                        jnp.broadcast_to(base[:, None], (B, w_next, 2)),
+                        folded)
+                    sampled = select_tokens(
+                        jnp.repeat(lvl, f, axis=1).reshape(B * w_next, V),
+                        keys_lvl.reshape(B * w_next, 2),
+                        _rep_bw(state["ds"], w_next),
+                        _rep_bw(state["temp"], w_next),
+                        _rep_bw(state["tk"], w_next),
+                        _rep_bw(state["tp"], w_next)).reshape(B, w_next)
+                    return jnp.where(state["ds"][:, None], sampled, greedy)
+
+                children = jax.lax.cond(any_sampling, _samp,
+                                        lambda g=greedy: g)
+                tok_tree = tok_tree.at[:, off[t + 1]:off[t + 2]].set(
+                    children)
+            # write-only forward at full width: leaf KV, so a deep
+            # accept never leaves the next round's draft attending a
+            # hole (outputs are unaffected either way — the verify is
+            # target-authoritative)
+            caches = _tree_caches(cur, bt, spec_valid, w)
+            _, newdc = drun(dpb, tok_tree, caches, pos)
+            cur = [{kk: c[kk] for kk in pool_keys} for c in newdc]
+            return tok_tree[:, 1:], cur
+
+        _draft = _wrap(_draft, (1,),
+                       (dpb_sh, dpool_sh, state_sh, rep, rep, rep),
+                       (rep, dpool_sh))
+
+        def _kv_path_move(pools, bt, src_tok, dst_tok):
+            """Commit-walk scatter: flat pool index = physical block
+            (via the row's table) * block_size + offset; every path
+            slot's payload is gathered BEFORE any write lands, and
+            duplicate destinations only ever carry identical values
+            (non-committed entries route onto their own source)."""
+            nb_cols = bt.shape[1]
+            sblk = jnp.clip(src_tok // bs, 0, nb_cols - 1)
+            dblk = jnp.clip(dst_tok // bs, 0, nb_cols - 1)
+            fsrc = (jnp.take_along_axis(bt, sblk, axis=1) * bs
+                    + src_tok % bs).reshape(-1)
+            fdst = (jnp.take_along_axis(bt, dblk, axis=1) * bs
+                    + dst_tok % bs).reshape(-1)
+            out = []
+            for c in pools:
+                nc = {}
+                for kk in c:
+                    p = c[kk]
+                    fl = p.reshape((p.shape[0] * p.shape[1],)
+                                   + p.shape[2:])
+                    fl = fl.at[fdst].set(fl[fsrc])
+                    nc[kk] = fl.reshape(p.shape)
+                out.append(nc)
+            return out
+
+        def _verify(pb, pools, dpools, state, bt, drafts, spec_valid,
+                    any_sampling, active):
+            """ONE target forward over the [B, w] flattened tree, per-
+            node candidate selection with the node's DEPTH subkey, the
+            deepest-path accept walk, and the by-position KV commit in
+            both pools."""
+            bundle = jnp.concatenate([state["tokens"][:, None], drafts],
+                                     axis=1)
+            caches = _tree_caches(pools, bt, spec_valid, w)
+            logits, newc = run(pb, bundle, caches, state["pos"])
+            levels, subs = split_key_levels(state["keys"], D + 1)
+            node_keys = jnp.take(subs, depth_vec, axis=1)   # [B, w, 2]
+            V = logits.shape[-1]
+            flat = logits.reshape(B * w, V)
+            cand = jax.lax.cond(
+                any_sampling,
+                lambda: select_tokens(
+                    flat, node_keys.reshape(B * w, 2),
+                    _rep_bw(state["ds"], w), _rep_bw(state["temp"], w),
+                    _rep_bw(state["tk"], w), _rep_bw(state["tp"], w)),
+                lambda: jnp.argmax(flat, axis=-1).astype(jnp.int32)
+            ).reshape(B, w)
+            # a node survives iff its token matches the target's
+            # selection for its PARENT and every ancestor survives
+            # (D parent-AND sweeps); the BFS-prefix width gates rows
+            match = jnp.concatenate(
+                [jnp.ones((B, 1), bool),
+                 bundle[:, 1:] == jnp.take(cand, parent[1:], axis=1)],
+                axis=1)
+            acc = match & (jnp.arange(w)[None, :] < spec_valid[:, None])
+            for _ in range(D):
+                acc = acc & jnp.take(acc, parent, axis=1)
+            score = jnp.where(acc, depth_vec[None, :] + 1, 0)
+            best = jnp.argmax(score, axis=1)
+            n_emit = jnp.take_along_axis(score, best[:, None],
+                                         axis=1)[:, 0]
+            path = jnp.take(anc_idx, best, axis=0)          # [B, D+1]
+            emitted = jnp.take_along_axis(cand, path, axis=1)
+            new_keys = jnp.take_along_axis(
+                levels, n_emit[:, None, None], axis=1)[:, 0]
+            last = jnp.take_along_axis(cand, best[:, None], axis=1)[:, 0]
+            pos = state["pos"]
+            # commit slot pos+t <- slot pos+path[t] for 1 <= t < n_emit
+            # in BOTH pools; everything else routes onto itself
+            tt = jnp.arange(D + 1)[None, :]
+            src_tok = pos[:, None] + path
+            dst_tok = pos[:, None] + tt
+            commit = (tt < n_emit[:, None]) & (tt >= 1)
+            dst_tok = jnp.where(commit, dst_tok, src_tok)
+            pools_out = _kv_path_move(
+                [{kk: c[kk] for kk in pool_keys} for c in newc],
+                bt, src_tok, dst_tok)
+            dpools_out = _kv_path_move(dpools, bt, src_tok, dst_tok)
+            state = dict(state)
+            state["tokens"] = jnp.where(n_emit > 0, last,
+                                        state["tokens"])
+            state["pos"] = jnp.where(
+                active,
+                jnp.minimum(pos + n_emit,
+                            jnp.int32(config.max_len - 1)),
+                jnp.int32(0))
+            state["keys"] = new_keys
+            return emitted, n_emit, pools_out, dpools_out, state
+
+        _verify = _wrap(_verify, (1, 2, 3),
+                        (pb_sh, pool_sh, dpool_sh, state_sh,
+                         rep, rep, rep, rep, rep),
+                        (rep, rep, pool_sh, dpool_sh, state_sh))
+        return _draft, _verify
 
     # -- executables: contiguous (the pre-paging engine, A/B baseline) -------
     def _init_contiguous(self, B: int, run):
@@ -1350,9 +1640,16 @@ class ServingEngine:
                     self._dpb, self._dpools, self._state, btB, sv0,
                     jnp.asarray(False))
             with _entrypoint("serving.spec_verify"):
-                _, _, self._pools, self._state = self._verify_fn(
-                    self._pb, self._pools, self._state, btB,
-                    self._zero_drafts, sv0, jnp.asarray(False), off)
+                if self._spec_tree is not None:
+                    _, _, self._pools, self._dpools, self._state = \
+                        self._verify_fn(
+                            self._pb, self._pools, self._dpools,
+                            self._state, btB, self._zero_drafts, sv0,
+                            jnp.asarray(False), off)
+                else:
+                    _, _, self._pools, self._state = self._verify_fn(
+                        self._pb, self._pools, self._state, btB,
+                        self._zero_drafts, sv0, jnp.asarray(False), off)
         else:
             entries.append("serving.step")
             with _entrypoint("serving.step"):
@@ -2140,6 +2437,15 @@ class ServingEngine:
             else max(0, min(int(p.spec_k), self._spec_k))
         remaining = p.max_new_tokens - len(req.output_tokens)
         room = self.config.max_len - self._slot_len[slot]
+        if self._spec_tree is not None:
+            # tree lane: k_req clamps the DEPTH; the bundle width is
+            # the BFS node count of the clamped tree (an accepted path
+            # emits at most depth+1 tokens, so depth caps at
+            # remaining-1), then clips to the slot's KV room — any
+            # BFS prefix is a valid (ragged) tree
+            depth_cap = max(0, min(k_req, remaining - 1))
+            width = int(self._tree["offsets"][depth_cap + 1])
+            return max(1, min(width, room))
         return max(1, min(k_req + 1, remaining, room))
 
     def _spec_step(self, active, active_mask, any_sampling, t0: float) -> bool:
@@ -2160,6 +2466,7 @@ class ServingEngine:
         bt_j = jnp.asarray(bt_step)
         sv_j = jnp.asarray(spec_valid)
         as_j = jnp.asarray(any_sampling)
+        tree = self._spec_tree is not None
         need_draft = bool((spec_valid > 1).any())
         if need_draft:
             td0 = time.perf_counter()
@@ -2169,14 +2476,24 @@ class ServingEngine:
             td1 = time.perf_counter()
             _trace.complete("serving.spec_draft", "engine", "engine",
                             int(td0 * 1e9), int((td1 - td0) * 1e9),
-                            {"active": len(active), "k": k})
+                            {"active": len(active), "k": k,
+                             **({"tree": list(self._spec_tree),
+                                 "nodes": int(self._tree["nodes"])}
+                                if tree else {})})
         else:
             drafts = self._zero_drafts
         tv0 = time.perf_counter()
         with _entrypoint("serving.spec_verify"):
-            cand, n_emit, self._pools, self._state = self._verify_fn(
-                self._pb, self._pools, self._state, bt_j, drafts, sv_j,
-                as_j, jnp.asarray(active_mask))
+            if tree:
+                cand, n_emit, self._pools, self._dpools, self._state = \
+                    self._verify_fn(
+                        self._pb, self._pools, self._dpools, self._state,
+                        bt_j, drafts, sv_j, as_j,
+                        jnp.asarray(active_mask))
+            else:
+                cand, n_emit, self._pools, self._state = self._verify_fn(
+                    self._pb, self._pools, self._state, bt_j, drafts,
+                    sv_j, as_j, jnp.asarray(active_mask))
         cand_np = np.asarray(cand)   # the round's device->host sync
         n_np = np.asarray(n_emit)
         now = time.perf_counter()
@@ -2184,7 +2501,9 @@ class ServingEngine:
         _sm.step_seconds.observe(now - t0)
         _trace.complete("serving.spec_verify", "engine", "engine",
                         int(tv0 * 1e9), int((now - tv0) * 1e9),
-                        {"active": len(active), "step": self._steps})
+                        {"active": len(active), "step": self._steps,
+                         **({"tree": list(self._spec_tree)}
+                            if tree else {})})
         self._steps += 1
         self._occupancy_integral += len(active)
         self._spec_rounds += 1
@@ -2209,6 +2528,13 @@ class ServingEngine:
                 _sm.spec_accepted_tokens.inc(accepted)
                 _sm.spec_rejected_tokens.inc(drafted - accepted)
                 _sm.spec_accept_len.observe(accepted)
+                if tree:
+                    # node accounting + the per-depth accept histogram
+                    # (on the tree lane `accepted` IS the accepted path
+                    # depth: one draft node per committed level)
+                    _sm.spec_tree_nodes_drafted.inc(drafted)
+                    _sm.spec_tree_nodes_accepted.inc(accepted)
+                    _sm.spec_accept_depth.observe(accepted)
                 self._accept_hist[accepted] += 1
                 # accepted-k instant on the request's PR-7 trace lane
                 req._tr_event("spec_accept", drafted=drafted,
@@ -2487,8 +2813,9 @@ class ServingEngine:
                     return float(i)
             return float(len(self._accept_hist) - 1)
 
-        return {
+        out = {
             "enabled": True,
+            "mode": "tree" if self._spec_tree is not None else "chain",
             "k": self._spec_k,
             "verify_kernel": self._spec_verify_kernel,
             "rounds": self._spec_rounds,
@@ -2505,6 +2832,22 @@ class ServingEngine:
                 "mean": (total / count) if count else None,
                 "count": count},
         }
+        if self._spec_tree is not None:
+            # tree lane: the drafted/accepted totals above count NODES
+            # (the whole flattened tree verifies; most siblings lose by
+            # construction), so accept_rate is structurally low — the
+            # per-round accepted PATH depth is the useful signal
+            out["tree"] = {
+                "factors": list(self._spec_tree),
+                "depth": int(self._tree["depth"]),
+                "nodes": int(self._tree["nodes"]),
+                "drafted_nodes": self._spec_drafted,
+                "accepted_nodes": self._spec_accepted,
+                # +1: the root token always commits alongside the path
+                "mean_accepted_path_len":
+                    (total / count) + 1.0 if count else None,
+            }
+        return out
 
     def kv_block_stats(self) -> Optional[dict]:
         """Pool utilization + internal fragmentation (allocated token

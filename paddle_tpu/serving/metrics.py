@@ -32,7 +32,9 @@ __all__ = [
     "ttft_summary", "tpot_summary", "queue_wait_seconds",
     "prefill_chunk_seconds", "goodput_tokens_per_second",
     "latency_digests", "spec_drafted_tokens", "spec_accepted_tokens",
-    "spec_rejected_tokens", "spec_accept_len", "queue_wait_retry_after",
+    "spec_rejected_tokens", "spec_accept_len", "spec_accept_depth",
+    "spec_tree_nodes_drafted", "spec_tree_nodes_accepted",
+    "queue_wait_retry_after",
     "queue_wait_p50",
     "requests_shed_total", "deadline_rejected_total",
     "supervisor_restarts_total", "supervisor_requeued_total",
@@ -199,6 +201,26 @@ spec_rejected_tokens = _m.counter(
     "paddle_tpu_serving_spec_rejected_tokens_total",
     "draft tokens rejected at verify (the round still emits the "
     "target's own token, so rejection costs draft work, never output)")
+# tree lane (ServingConfig.spec_tree): node accounting is distinct from
+# the token counters above — a tree drafts width-1 NODES per round but
+# can accept at most depth of them (one root-to-leaf path), so node
+# accept RATE is structurally low even when every path matches; the
+# depth histogram is the tuning surface (shift width toward the depths
+# that actually accept)
+spec_tree_nodes_drafted = _m.counter(
+    "paddle_tpu_serving_spec_tree_nodes_drafted_total",
+    "draft tree nodes proposed to tree-speculative verify rounds "
+    "(tree width - 1 per live row per round)")
+spec_tree_nodes_accepted = _m.counter(
+    "paddle_tpu_serving_spec_tree_nodes_accepted_total",
+    "draft tree nodes on accepted root-to-leaf paths (each one a decode "
+    "step the pool did not have to run)")
+spec_accept_depth = _m.histogram(
+    "paddle_tpu_serving_spec_accept_depth",
+    "accepted path depth per tree-speculative verify round (0 = only "
+    "the root's own target token emitted, d = a depth-d draft path "
+    "fully matched)",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
 
 step_seconds = _m.histogram(
     "paddle_tpu_serving_step_seconds",

@@ -402,6 +402,11 @@ class RouterConfig:
     w_queue: float = 1.0
     w_util: float = 1.0
     w_ttft: float = 0.5
+    # supervisor-aware shedding: scales the replica's restart pressure
+    # (supervisor restarts_in_window / max_restarts, from /stats) so a
+    # chronically-restarting replica sheds load BEFORE its crash-loop
+    # breaker trips and the prober has to eject it (0.0 = off)
+    w_restart: float = 0.5
     # deadline-aware retry
     max_retries_per_request: int = 2
     retry_backoff_base_s: float = 0.02
@@ -453,6 +458,9 @@ class RouterConfig:
         if self.straggler_penalty < 0:
             raise ValueError("straggler_penalty must be >= 0 (a negative "
                              "penalty would ATTRACT load to stragglers)")
+        if self.w_restart < 0:
+            raise ValueError("w_restart must be >= 0 (a negative weight "
+                             "would ATTRACT load to crash-looping replicas)")
         if self.recent_requests < 1:
             raise ValueError("recent_requests must be >= 1")
         if self.brownout_batch_max_new_tokens < 1:
@@ -477,6 +485,10 @@ class _Load:
     tpot_p50: Optional[float] = None   # straggler-detection input
     kv_tier: Optional[dict] = None     # hierarchical-KV tier state, for
     stale: bool = False                # cache-aware routing to read
+    # supervisor restart pressure: restarts_in_window / max_restarts
+    # (1.0 = one crash from the breaker) + quarantined-prompt count
+    restart_pressure: float = 0.0
+    quarantined_count: int = 0
 
 
 class _Replica:
@@ -519,6 +531,8 @@ class _Replica:
                 "tpot_p50": self.load.tpot_p50,
                 "kv_tier": self.load.kv_tier,
                 "stale": self.load.stale,
+                "restart_pressure": round(self.load.restart_pressure, 4),
+                "quarantined_count": self.load.quarantined_count,
             },
         }
 
@@ -950,6 +964,18 @@ class Router:
             if isinstance(sup, dict):
                 for fp in sup.get("quarantined") or ():
                     self._learn_quarantine(str(fp), rep.name)
+                # restart pressure: how close this replica sits to its
+                # crash-loop breaker — fraction of the windowed restart
+                # budget already burned. Scored via w_restart so the
+                # fleet sheds load off a flapping replica proactively
+                # instead of waiting for restarts_exhausted ejection.
+                budget = max(1, int(sup.get("max_restarts", 1) or 1))
+                ld.restart_pressure = min(
+                    1.0, int(sup.get("restarts_in_window", 0)) / budget)
+                ld.quarantined_count = len(sup.get("quarantined") or ())
+            else:
+                ld.restart_pressure = 0.0
+                ld.quarantined_count = 0
         except (TypeError, ValueError):
             rep.stats_errors += 1
             rep.load.stale = True
@@ -983,6 +1009,7 @@ class Router:
                 + cfg.w_queue * ld.queue_depth / ld.max_queue_depth
                 + cfg.w_util * ld.util
                 + cfg.w_ttft * ttft_norm
+                + cfg.w_restart * ld.restart_pressure
                 + (cfg.straggler_penalty if rep.straggler else 0.0))
 
     def _pick(self, exclude=()) -> tuple:

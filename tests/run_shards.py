@@ -76,6 +76,12 @@ TPU_LANE = [
     # container — pair with benchmarks/bench_spec_decode.py for the
     # >=1.3x coupled-draft acceptance on chip
     ("test_spec_decode.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # tree speculative decoding: the ancestor-masked bundle cell +
+    # whole-tree verify in one kernel call; CPU-verified (interpret
+    # mode) in the build container — this entry is the masked cell's
+    # first compiled run (pair with bench_spec_decode.py's tree lanes
+    # for the tree>=chain equal-budget acceptance on chip)
+    ("test_spec_tree.py", 420, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     # multi-replica router + chaos suite: host-side by design, but the
     # warmup-zero-compile, zero-retrace-on-survivors, and bit-identical
     # failover invariants deserve one compiled run (remote-PJRT crash/

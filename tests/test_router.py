@@ -844,3 +844,78 @@ class TestRouterHTTP:
                      "paddle_tpu_router_ejections_total",
                      "paddle_tpu_router_probe_failures_total"):
             assert name in text
+
+
+# ---------------------------------------------------------------------------
+# supervisor-aware placement
+# ---------------------------------------------------------------------------
+
+class TestSupervisorAwareScoring:
+    def test_restart_pressure_sheds_load(self, tiny_model):
+        """A replica whose supervisor block shows a nearly-spent restart
+        budget scores worse than an equally-loaded clean replica, so the
+        fleet sheds load off it BEFORE the crash-loop breaker trips —
+        and ``/replicas`` surfaces the pressure for operators."""
+        model, cfg = tiny_model
+        e1, e2 = _engine(model), _engine(model)
+        router = serving.Router([e1, e2], w_ttft=0.0)
+        try:
+            flappy = router._replicas["r0"]
+            clean = router._replicas["r1"]
+            real_stats = flappy.client.stats
+
+            def flapping_stats():
+                st = real_stats()
+                st["supervisor"] = {"max_restarts": 3,
+                                    "restarts_in_window": 2,
+                                    "quarantined": ["deadbeef01"]}
+                return st
+
+            flappy.client.stats = flapping_stats
+            now = time.perf_counter()
+            flappy.load.ts = clean.load.ts = 0.0
+            router._refresh_load(flappy, now)
+            router._refresh_load(clean, now)
+            assert flappy.load.restart_pressure == pytest.approx(2 / 3)
+            assert flappy.load.quarantined_count == 1
+            assert clean.load.restart_pressure == 0.0
+            # strictly worse at equal load; weight off -> term gone
+            assert router._score(flappy, 0.0) > router._score(clean, 0.0)
+            assert (router._score(flappy, 0.0) - router._score(clean, 0.0)
+                    == pytest.approx(router.config.w_restart * 2 / 3))
+            # the same block still gossips quarantines fleet-wide
+            assert "deadbeef01" in router._quarantined
+            rows = {r["name"]: r for r in router.replicas()}
+            assert rows["r0"]["load"]["restart_pressure"] == pytest.approx(
+                2 / 3, abs=1e-4)
+            assert rows["r0"]["load"]["quarantined_count"] == 1
+            assert rows["r1"]["load"]["restart_pressure"] == 0.0
+            # end-to-end: sequential picks on an idle pool all avoid the
+            # flapping replica
+            rng = np.random.RandomState(SEED + 70)
+            for _ in range(3):
+                rr = router.submit(_prompt(rng, cfg, 4), max_new_tokens=3)
+                _drive(router, [rr], probe=False)
+                assert rr.status == serving.RequestStatus.COMPLETED
+                assert rr.replica == "r1"
+        finally:
+            router.stop(drain=True, timeout_s=10)
+
+    def test_w_restart_validation_and_off_switch(self, tiny_model):
+        model, _ = tiny_model
+        with pytest.raises(ValueError, match="w_restart"):
+            serving.RouterConfig(w_restart=-0.1)
+        eng = _engine(model)
+        router = serving.Router([eng], w_restart=0.0, auto_warmup=False)
+        try:
+            rep = router._replicas["r0"]
+            rep.load.restart_pressure = 1.0  # even a breaker-edge replica
+            base = serving.Router([_engine(model)], w_restart=0.0,
+                                  auto_warmup=False)
+            try:
+                other = base._replicas["r0"]
+                assert router._score(rep, 0.0) == base._score(other, 0.0)
+            finally:
+                base.stop(drain=False)
+        finally:
+            router.stop(drain=False)
